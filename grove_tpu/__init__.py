@@ -1,0 +1,19 @@
+"""grove_tpu — a TPU-native gang-scheduling orchestration framework.
+
+A from-scratch rebuild of the capabilities of NVIDIA Grove (ai-dynamo/grove):
+declarative workload API (PodCliqueSet / PodClique / PodCliqueScalingGroup /
+ClusterTopology), hierarchical gang scheduling via a PodGang scheduler IR,
+topology-aware placement, multi-level autoscaling, startup ordering, gang
+termination and rolling updates — with the placement engine rebuilt as a JAX
+batched bin-packing solver that runs on TPU.
+
+Layout (mirrors SURVEY.md §7):
+  api/          workload model + scheduler IR + naming/defaulting/validation
+  orchestrator/ reconcile cascade: expansion, gating, termination, updates
+  state/        dense cluster snapshot tensors (nodes × resources × domains)
+  solver/       the TPU part: masks, scoring, all-or-nothing gang commit
+  backend/      scheduler-backend boundary (gRPC sidecar, GREP-375 contract)
+  sim/          synthetic cluster generator + event-driven simulator
+"""
+
+__version__ = "0.1.0"

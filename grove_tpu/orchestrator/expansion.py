@@ -1,0 +1,404 @@
+"""PodCliqueSet expansion: PCS → PodCliques, ScalingGroups, PodGangs, Pods.
+
+This is the declarative core of the reconcile cascade (SURVEY.md §1/§3.3),
+rebuilt as a pure function: given a defaulted PodCliqueSet and a ClusterTopology,
+produce the full desired object set. Parity targets:
+  - base/scaled gang split: PCSG replicas [0, minAvailable) join the base gang of
+    their PCS replica; replicas [minAvailable, replicas) each get one scaled gang
+    (operator/internal/controller/podcliqueset/components/podgang/syncflow.go:166-327)
+  - PodGroups carry {PodReferences, MinReplicas=clique minAvailable}
+    (syncflow.go:560-581)
+  - topology translation: workload PackDomain → IR Required node-label key
+    (syncflow.go:341-365); missing domain in the ClusterTopology nullifies the
+    constraint rather than erroring
+  - PCSG-level constraints become per-PCSG-replica TopologyConstraintGroupConfigs
+    over that replica's member PodGroups (scheduler/api podgang.go:120-128)
+  - pod build: scheduling gate `grove.io/podgang-pending-creation`, GROVE_* env,
+    hostname `<pclqFQN>-<idx>`, subdomain = headless service
+    (podclique/components/pod/pod.go:68,135-172,232-269)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from grove_tpu.api import constants, naming
+from grove_tpu.api.pod import Pod
+from grove_tpu.api.podgang import (
+    IRTopologyConstraint,
+    NamespacedName,
+    PodGang,
+    PodGangSpec,
+    PodGroup,
+    TopologyConstraintGroupConfig,
+    TopologyPackConstraint,
+)
+from grove_tpu.api.types import (
+    ClusterTopology,
+    ObjectMeta,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueScalingGroupSpec,
+    PodCliqueSet,
+    PodCliqueTemplateSpec,
+    TopologyConstraint,
+)
+
+
+@dataclass
+class DesiredState:
+    """Everything one PodCliqueSet materializes into."""
+
+    headless_services: list[str] = field(default_factory=list)
+    podcliques: list[PodClique] = field(default_factory=list)
+    scaling_groups: list[PodCliqueScalingGroup] = field(default_factory=list)
+    podgangs: list[PodGang] = field(default_factory=list)
+    pods: list[Pod] = field(default_factory=list)
+
+    def podgang(self, name: str) -> Optional[PodGang]:
+        for g in self.podgangs:
+            if g.name == name:
+                return g
+        return None
+
+    def clique(self, fqn: str) -> Optional[PodClique]:
+        for c in self.podcliques:
+            if c.metadata.name == fqn:
+                return c
+        return None
+
+    def pods_of_clique(self, fqn: str) -> list[Pod]:
+        return [p for p in self.pods if p.pclq_fqn == fqn]
+
+    def pods_of_gang(self, gang_name: str) -> list[Pod]:
+        return [p for p in self.pods if p.podgang_name == gang_name]
+
+
+def compute_pod_template_hash(template: PodCliqueTemplateSpec, priority_class_name: str = "") -> str:
+    """Stable short hash over what constitutes a pod *template* change.
+
+    Parity with the reference hash inputs (podcliqueset/reconcilespec.go:109-122 /
+    internal/utils/kubernetes/pod.go:125): clique labels + annotations + PodSpec +
+    the template-level PriorityClassName. Deliberately EXCLUDES replicas,
+    minAvailable, scaleConfig and startsAfter — scaling is not an update.
+    """
+    h = hashlib.sha256()
+    h.update(repr(sorted(template.labels.items())).encode())
+    h.update(repr(sorted(template.annotations.items())).encode())
+    h.update(repr(template.spec.pod_spec).encode())
+    h.update(priority_class_name.encode())
+    return h.hexdigest()[:10]
+
+
+def compute_generation_hash(pcs: PodCliqueSet) -> str:
+    """Hash over all clique pod templates (podcliqueset/reconcilespec.go:109-122)."""
+    h = hashlib.sha256()
+    pcn = pcs.spec.template.priority_class_name
+    for clique in pcs.spec.template.cliques:
+        h.update(compute_pod_template_hash(clique, pcn).encode())
+    return h.hexdigest()[:10]
+
+
+def translate_pack_constraint(
+    tc: TopologyConstraint | None, topology: ClusterTopology | None, tas_enabled: bool = True
+) -> Optional[IRTopologyConstraint]:
+    """Workload domain name → IR node-label key (podgang/syncflow.go:341-365).
+
+    A domain missing from the ClusterTopology nullifies the constraint (logged
+    and skipped in the reference) rather than failing the sync.
+    """
+    if not tas_enabled or tc is None or topology is None:
+        return None
+    key = topology.label_key_for(tc.pack_domain)
+    if key is None:
+        return None
+    return IRTopologyConstraint(pack_constraint=TopologyPackConstraint(required=key))
+
+
+def expand_podcliqueset(
+    pcs: PodCliqueSet,
+    topology: ClusterTopology | None = None,
+    *,
+    tas_enabled: bool = True,
+    pcsg_replica_overrides: dict[str, int] | None = None,
+    pclq_replica_overrides: dict[str, int] | None = None,
+    rng: random.Random | None = None,
+) -> DesiredState:
+    """Expand a defaulted PodCliqueSet into its full desired object set.
+
+    `pcsg_replica_overrides` / `pclq_replica_overrides` carry HPA-mutated scale
+    values keyed by FQN (analog of determinePodCliqueReplicas,
+    podgang/syncflow.go:368-395).
+    """
+    rng = rng or random.Random(0)
+    pcsg_replica_overrides = pcsg_replica_overrides or {}
+    pclq_replica_overrides = pclq_replica_overrides or {}
+    out = DesiredState()
+    ns = pcs.metadata.namespace
+    pcs_name = pcs.metadata.name
+    tmpl = pcs.spec.template
+    gen_hash = compute_generation_hash(pcs)
+    # The host level is always present (the reference appends it when building
+    # the ClusterTopology CR, internal/clustertopology/clustertopology.go:102-107).
+    if topology is not None:
+        topology = topology.with_host_level()
+    # Per-template hashes, computed once (templates repeat across PCS/PCSG replicas).
+    tmpl_hashes = {
+        c.name: compute_pod_template_hash(c, tmpl.priority_class_name) for c in tmpl.cliques
+    }
+
+    def _new_podgang(name: str, pcs_replica: int, base_name: str | None = None) -> PodGang:
+        return PodGang(
+            name=name,
+            namespace=ns,
+            pcs_name=pcs_name,
+            pcs_replica_index=pcs_replica,
+            base_podgang_name=base_name,
+            spec=PodGangSpec(
+                priority_class_name=tmpl.priority_class_name,
+                topology_constraint=translate_pack_constraint(
+                    tmpl.topology_constraint, topology, tas_enabled
+                ),
+            ),
+        )
+
+    for i in range(pcs.spec.replicas):
+        svc = naming.headless_service_name(pcs_name, i)
+        out.headless_services.append(svc)
+        base_gang = _new_podgang(naming.base_podgang_name(pcs_name, i), i)
+
+        # Standalone cliques — always members of the base gang.
+        for clique_tmpl in pcs.standalone_clique_templates():
+            fqn = naming.podclique_name(pcs_name, i, clique_tmpl.name)
+            replicas = pclq_replica_overrides.get(fqn, clique_tmpl.spec.replicas)
+            pclq = _build_podclique(
+                pcs, clique_tmpl, fqn, i, base_gang.name, replicas=replicas
+            )
+            out.podcliques.append(pclq)
+            group = _build_pod_group(pclq, clique_tmpl, topology, tas_enabled)
+            base_gang.spec.pod_groups.append(group)
+            pods = _build_pods(
+                pcs, pclq, clique_tmpl, svc, i, gen_hash, rng,
+                tmpl_hash=tmpl_hashes[clique_tmpl.name],
+            )
+            group.pod_references = [NamespacedName(ns, p.name) for p in pods]
+            out.pods.extend(pods)
+
+        # Scaling groups.
+        for cfg in tmpl.pod_clique_scaling_group_configs:
+            pcsg_fqn = naming.scaling_group_name(pcs_name, i, cfg.name)
+            pcsg_replicas = pcsg_replica_overrides.get(pcsg_fqn, cfg.replicas)
+            pcsg = PodCliqueScalingGroup(
+                metadata=ObjectMeta(
+                    name=pcsg_fqn,
+                    namespace=ns,
+                    labels={
+                        constants.LABEL_MANAGED_BY: constants.LABEL_MANAGED_BY_VALUE,
+                        constants.LABEL_PART_OF: pcs_name,
+                        constants.LABEL_PCS_REPLICA_INDEX: str(i),
+                    },
+                    owner=pcs_name,
+                ),
+                spec=PodCliqueScalingGroupSpec(
+                    clique_names=list(cfg.clique_names),
+                    replicas=pcsg_replicas,
+                    min_available=cfg.min_available,
+                ),
+                template_name=cfg.name,
+                pcs_name=pcs_name,
+                pcs_replica_index=i,
+                topology_constraint=cfg.topology_constraint,
+            )
+            out.scaling_groups.append(pcsg)
+
+            for j in range(pcsg_replicas):
+                in_base = j < cfg.min_available
+                if in_base:
+                    gang = base_gang
+                else:
+                    gang = _new_podgang(
+                        naming.scaled_podgang_name(pcsg_fqn, j - cfg.min_available),
+                        i,
+                        base_name=base_gang.name,
+                    )
+                    out.podgangs.append(gang)
+
+                replica_group_names: list[str] = []
+                for clique_name in cfg.clique_names:
+                    clique_tmpl = pcs.clique_template(clique_name)
+                    if clique_tmpl is None:
+                        continue
+                    fqn = naming.podclique_name(pcsg_fqn, j, clique_tmpl.name)
+                    pclq = _build_podclique(
+                        pcs,
+                        clique_tmpl,
+                        fqn,
+                        i,
+                        gang.name,
+                        replicas=clique_tmpl.spec.replicas,
+                        pcsg_name=pcsg_fqn,
+                        pcsg_replica_index=j,
+                        base_podgang_name=None if in_base else base_gang.name,
+                    )
+                    out.podcliques.append(pclq)
+                    group = _build_pod_group(pclq, clique_tmpl, topology, tas_enabled)
+                    gang.spec.pod_groups.append(group)
+                    replica_group_names.append(group.name)
+                    pods = _build_pods(
+                        pcs, pclq, clique_tmpl, svc, i, gen_hash, rng,
+                        tmpl_hash=tmpl_hashes[clique_tmpl.name],
+                        pcsg_fqn=pcsg_fqn, pcsg_replica=j,
+                        base_podgang_name=None if in_base else base_gang.name,
+                    )
+                    group.pod_references = [NamespacedName(ns, p.name) for p in pods]
+                    out.pods.extend(pods)
+
+                # PCSG-level packing: all pods of this PCSG replica pack together
+                # (one TopologyConstraintGroupConfig per replica).
+                sg_tc = translate_pack_constraint(cfg.topology_constraint, topology, tas_enabled)
+                if sg_tc is not None and replica_group_names:
+                    gang.spec.topology_constraint_group_configs.append(
+                        TopologyConstraintGroupConfig(
+                            name=f"{pcsg_fqn}-{j}",
+                            pod_group_names=replica_group_names,
+                            topology_constraint=sg_tc,
+                        )
+                    )
+
+        out.podgangs.append(base_gang)
+
+    # Stable ordering: base gangs in replica order, then scaled.
+    out.podgangs.sort(key=lambda g: (g.is_scaled, g.pcs_replica_index, g.name))
+    return out
+
+
+def _build_podclique(
+    pcs: PodCliqueSet,
+    clique_tmpl: PodCliqueTemplateSpec,
+    fqn: str,
+    pcs_replica: int,
+    podgang_name: str,
+    *,
+    replicas: int,
+    pcsg_name: str | None = None,
+    pcsg_replica_index: int | None = None,
+    base_podgang_name: str | None = None,
+) -> PodClique:
+    import copy
+
+    spec = copy.deepcopy(clique_tmpl.spec)
+    spec.replicas = replicas
+    labels = {
+        constants.LABEL_MANAGED_BY: constants.LABEL_MANAGED_BY_VALUE,
+        constants.LABEL_PART_OF: pcs.metadata.name,
+        constants.LABEL_PCS_REPLICA_INDEX: str(pcs_replica),
+        constants.LABEL_PODGANG: podgang_name,
+        **clique_tmpl.labels,
+    }
+    if pcsg_name is not None:
+        labels[constants.LABEL_SCALING_GROUP] = pcsg_name
+        labels[constants.LABEL_PCSG_REPLICA_INDEX] = str(pcsg_replica_index)
+    if base_podgang_name is not None:
+        labels[constants.LABEL_BASE_PODGANG] = base_podgang_name
+    return PodClique(
+        metadata=ObjectMeta(
+            name=fqn,
+            namespace=pcs.metadata.namespace,
+            labels=labels,
+            annotations=dict(clique_tmpl.annotations),
+            owner=pcsg_name or pcs.metadata.name,
+        ),
+        spec=spec,
+        template_name=clique_tmpl.name,
+        pcs_name=pcs.metadata.name,
+        pcs_replica_index=pcs_replica,
+        pcsg_name=pcsg_name,
+        pcsg_replica_index=pcsg_replica_index,
+        pod_gang_name=podgang_name,
+        topology_constraint=clique_tmpl.topology_constraint,
+    )
+
+
+def _build_pod_group(
+    pclq: PodClique,
+    clique_tmpl: PodCliqueTemplateSpec,
+    topology: ClusterTopology | None,
+    tas_enabled: bool,
+) -> PodGroup:
+    return PodGroup(
+        name=pclq.metadata.name,
+        min_replicas=pclq.min_available,
+        topology_constraint=translate_pack_constraint(
+            clique_tmpl.topology_constraint, topology, tas_enabled
+        ),
+    )
+
+
+def _build_pods(
+    pcs: PodCliqueSet,
+    pclq: PodClique,
+    clique_tmpl: PodCliqueTemplateSpec,
+    headless_service: str,
+    pcs_replica: int,
+    gen_hash: str,
+    rng: random.Random,
+    *,
+    tmpl_hash: str | None = None,
+    pcsg_fqn: str | None = None,
+    pcsg_replica: int | None = None,
+    base_podgang_name: str | None = None,
+) -> list[Pod]:
+    """Build the pods of one clique (podclique/components/pod/pod.go:135-269)."""
+    import copy
+
+    pods = []
+    if tmpl_hash is None:
+        tmpl_hash = compute_pod_template_hash(clique_tmpl)
+    fqn = pclq.metadata.name
+    for idx in range(pclq.spec.replicas):
+        env = {
+            constants.ENV_PCS_NAME: pcs.metadata.name,
+            constants.ENV_PCS_INDEX: str(pcs_replica),
+            constants.ENV_PCLQ_NAME: fqn,
+            constants.ENV_PCLQ_POD_INDEX: str(idx),
+            constants.ENV_HEADLESS_SERVICE: naming.headless_service_address(
+                pcs.metadata.name, pcs_replica, pcs.metadata.namespace
+            ),
+        }
+        if pcsg_fqn is not None:
+            env[constants.ENV_PCSG_NAME] = pcsg_fqn
+            env[constants.ENV_PCSG_INDEX] = str(pcsg_replica)
+        labels = {
+            constants.LABEL_MANAGED_BY: constants.LABEL_MANAGED_BY_VALUE,
+            constants.LABEL_PART_OF: pcs.metadata.name,
+            constants.LABEL_PODCLIQUE: fqn,
+            constants.LABEL_PODGANG: pclq.pod_gang_name,
+            constants.LABEL_PCS_REPLICA_INDEX: str(pcs_replica),
+            constants.LABEL_POD_TEMPLATE_HASH: tmpl_hash,
+            constants.LABEL_PCS_GENERATION_HASH: gen_hash,
+            constants.LABEL_POD_INDEX: str(idx),
+        }
+        if base_podgang_name is not None:
+            labels[constants.LABEL_BASE_PODGANG] = base_podgang_name
+        spec = copy.deepcopy(clique_tmpl.spec.pod_spec)
+        spec.hostname = naming.pod_hostname(fqn, idx)
+        spec.subdomain = headless_service
+        pods.append(
+            Pod(
+                name=naming.pod_name(fqn, rng),
+                namespace=pcs.metadata.namespace,
+                labels=labels,
+                spec=spec,
+                pclq_fqn=fqn,
+                podgang_name=pclq.pod_gang_name,
+                base_podgang_name=base_podgang_name,
+                pod_index=idx,
+                pod_template_hash=tmpl_hash,
+                env=env,
+                scheduling_gates=[constants.POD_GANG_SCHEDULING_GATE],
+            )
+        )
+    return pods

@@ -1,0 +1,32 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's test philosophy (SURVEY.md §4): multi-node behavior is
+tested without real hardware — fake client for logic, containerized nodes for
+integration, KWOK for scale. Here: CPU-JAX with 8 virtual devices stands in for
+a TPU slice; the same jitted code runs unmodified on real chips.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+import sys
+
+import pytest
+import yaml
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from grove_tpu.api import PodCliqueSet, default_podcliqueset  # noqa: E402
+
+
+@pytest.fixture
+def simple1() -> PodCliqueSet:
+    with open(REPO_ROOT / "examples" / "simple1.yaml") as f:
+        doc = yaml.safe_load(f)
+    return default_podcliqueset(PodCliqueSet.from_dict(doc))

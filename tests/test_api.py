@@ -1,0 +1,342 @@
+"""M0 tests: quantities, naming, defaulting, validation.
+
+Behavior tables derived from the reference's unit tests and webhook rules
+(operator/internal/webhook/admission/pcs/{defaulting,validation}/,
+operator/api/common/namegen.go).
+"""
+
+import pytest
+
+from grove_tpu.api import (
+    ClusterTopology,
+    CliqueStartupType,
+    PodCliqueSet,
+    TopologyDomain,
+    TopologyLevel,
+    default_podcliqueset,
+    naming,
+    validate_podcliqueset,
+    validate_update,
+)
+from grove_tpu.api.quantity import parse_quantity
+from grove_tpu.api.types import is_domain_narrower
+
+
+# --- quantities ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("10m", 0.01),
+        ("1", 1.0),
+        ("1Gi", 2**30),
+        ("500Mi", 500 * 2**20),
+        ("2k", 2000.0),
+        (8, 8.0),
+        ("1.5", 1.5),
+    ],
+)
+def test_parse_quantity(raw, expected):
+    assert parse_quantity(raw) == pytest.approx(expected)
+
+
+def test_parse_quantity_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+
+
+# --- naming (namegen.go parity) --------------------------------------------------
+
+
+def test_naming_scheme():
+    assert naming.headless_service_name("simple1", 0) == "simple1-0"
+    assert (
+        naming.headless_service_address("simple1", 0, "default")
+        == "simple1-0.default.svc.cluster.local"
+    )
+    assert naming.podclique_name("simple1", 0, "frontend") == "simple1-0-frontend"
+    assert naming.scaling_group_name("simple1", 0, "workers") == "simple1-0-workers"
+    assert naming.base_podgang_name("simple1", 0) == "simple1-0"
+    assert naming.scaled_podgang_name("simple1-0-workers", 0) == "simple1-0-workers-0"
+    # member clique of PCSG replica 1
+    assert naming.podclique_name("simple1-0-workers", 1, "prefill") == "simple1-0-workers-1-prefill"
+    assert naming.pod_hostname("simple1-0-frontend", 2) == "simple1-0-frontend-2"
+    assert naming.extract_sg_name_from_fqn("simple1-0-workers", "simple1", 0) == "workers"
+    assert naming.initc_sa_token_secret_name("x") == "x-initc-sa-token-secret"
+    assert naming.pod_role_name("x") == "grove.io:pcs:x"
+
+
+# --- topology domains ------------------------------------------------------------
+
+
+def test_domain_ordering():
+    assert is_domain_narrower(TopologyDomain.RACK, TopologyDomain.ZONE)
+    assert is_domain_narrower(TopologyDomain.NUMA, TopologyDomain.HOST)
+    assert not is_domain_narrower(TopologyDomain.REGION, TopologyDomain.ZONE)
+    assert not is_domain_narrower(TopologyDomain.RACK, TopologyDomain.RACK)
+
+
+def test_cluster_topology_auto_host_level():
+    topo = ClusterTopology(name="t", levels=[TopologyLevel(TopologyDomain.RACK, "topology/rack")])
+    with_host = topo.with_host_level()
+    assert with_host.label_key_for(TopologyDomain.HOST) == "kubernetes.io/hostname"
+    # idempotent
+    assert len(with_host.with_host_level().levels) == 2
+
+
+# --- defaulting (defaulting/podcliqueset.go:35-108) ------------------------------
+
+
+def test_defaulting(simple1: PodCliqueSet):
+    frontend = simple1.clique_template("frontend")
+    assert frontend.spec.replicas == 3
+    assert frontend.spec.min_available == 3  # defaults to replicas
+    assert frontend.spec.scale_config.min_replicas == 3  # defaults to replicas
+    assert simple1.spec.template.termination_delay_seconds == 4 * 3600
+    assert simple1.spec.template.headless_service_config.publish_not_ready_addresses
+    workers = simple1.spec.template.pod_clique_scaling_group_configs[0]
+    assert workers.replicas == 2
+    assert workers.min_available == 1
+    assert workers.scale_config.min_replicas == 2  # defaults to PCSG replicas
+
+
+def test_defaulting_zero_replicas():
+    pcs = PodCliqueSet.from_dict(
+        {
+            "metadata": {"name": "x"},
+            "spec": {"template": {"cliques": [{"name": "a", "spec": {"roleName": "a", "podSpec": {}}}]}},
+        }
+    )
+    default_podcliqueset(pcs)
+    c = pcs.clique_template("a")
+    assert c.spec.replicas == 1
+    assert c.spec.min_available == 1
+
+
+# --- validation (validation/podcliqueset.go) -------------------------------------
+
+
+def _mk(doc_spec):
+    pcs = PodCliqueSet.from_dict({"metadata": {"name": "t"}, "spec": doc_spec})
+    return default_podcliqueset(pcs)
+
+
+def _clique(name, replicas=1, **spec):
+    return {"name": name, "spec": {"roleName": name, "replicas": replicas, "podSpec": {}, **spec}}
+
+
+def test_validate_ok(simple1):
+    assert validate_podcliqueset(simple1) == []
+
+
+def test_validate_name_budget():
+    pcs = _mk({"template": {"cliques": [_clique("a")]}})
+    pcs.metadata.name = "x" * 46
+    errs = validate_podcliqueset(pcs)
+    assert any("45" in e.message for e in errs)
+
+
+def test_validate_requires_cliques():
+    pcs = _mk({"template": {"cliques": []}})
+    assert any("at least one PodClique" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_duplicate_clique_names():
+    pcs = _mk({"template": {"cliques": [_clique("a"), _clique("a")]}})
+    assert any("unique" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_min_available_exceeds_replicas():
+    pcs = _mk({"template": {"cliques": [_clique("a", replicas=2, minAvailable=3)]}})
+    assert any("minAvailable" in e.field for e in validate_podcliqueset(pcs))
+
+
+def test_validate_starts_after_requires_explicit():
+    pcs = _mk({"template": {"cliques": [_clique("a"), _clique("b", startsAfter=["a"])]}})
+    errs = validate_podcliqueset(pcs)
+    assert any("CliqueStartupTypeExplicit" in e.message for e in errs)
+
+
+def test_validate_starts_after_cycle():
+    pcs = _mk(
+        {
+            "template": {
+                "startupType": CliqueStartupType.EXPLICIT.value,
+                "cliques": [
+                    _clique("a", startsAfter=["c"]),
+                    _clique("b", startsAfter=["a"]),
+                    _clique("c", startsAfter=["b"]),
+                ],
+            }
+        }
+    )
+    assert any("circular" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_starts_after_dag_ok():
+    pcs = _mk(
+        {
+            "template": {
+                "startupType": CliqueStartupType.EXPLICIT.value,
+                "cliques": [
+                    _clique("a"),
+                    _clique("b", startsAfter=["a"]),
+                    _clique("c", startsAfter=["a", "b"]),
+                ],
+            }
+        }
+    )
+    assert validate_podcliqueset(pcs) == []
+
+
+def test_validate_starts_after_self_reference():
+    pcs = _mk(
+        {
+            "template": {
+                "startupType": CliqueStartupType.EXPLICIT.value,
+                "cliques": [_clique("a", startsAfter=["a"])],
+            }
+        }
+    )
+    assert any("itself" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_unknown_starts_after():
+    pcs = _mk(
+        {
+            "template": {
+                "startupType": CliqueStartupType.EXPLICIT.value,
+                "cliques": [_clique("a", startsAfter=["ghost"])],
+            }
+        }
+    )
+    assert any("unknown clique" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_scaling_group_overlap():
+    pcs = _mk(
+        {
+            "template": {
+                "cliques": [_clique("a"), _clique("b")],
+                "podCliqueScalingGroups": [
+                    {"name": "g1", "cliqueNames": ["a", "b"]},
+                    {"name": "g2", "cliqueNames": ["b"]},
+                ],
+            }
+        }
+    )
+    assert any("overlap" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_scaling_group_min_available_exceeds_replicas():
+    pcs = _mk(
+        {
+            "template": {
+                "cliques": [_clique("a")],
+                "podCliqueScalingGroups": [
+                    {"name": "g", "cliqueNames": ["a"], "replicas": 2, "minAvailable": 3}
+                ],
+            }
+        }
+    )
+    assert any("minAvailable must not be greater" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_member_clique_cannot_autoscale():
+    pcs = _mk(
+        {
+            "template": {
+                "cliques": [_clique("a", autoScalingConfig={"maxReplicas": 3})],
+                "podCliqueScalingGroups": [{"name": "g", "cliqueNames": ["a"]}],
+            }
+        }
+    )
+    assert any("individual autoscaling" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_scale_config_min_replicas_below_min_available():
+    pcs = _mk(
+        {
+            "template": {
+                "cliques": [
+                    _clique("a", replicas=4, minAvailable=3, autoScalingConfig={"maxReplicas": 8, "minReplicas": 2})
+                ]
+            }
+        }
+    )
+    assert any("greater than or equal to minAvailable" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_topology_constraint_hierarchy():
+    topo = ClusterTopology(
+        name="t",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, "z"),
+            TopologyLevel(TopologyDomain.RACK, "r"),
+            TopologyLevel(TopologyDomain.HOST, "h"),
+        ],
+    )
+    # PCS constrains rack; clique asks for the *broader* zone -> invalid.
+    pcs = _mk(
+        {
+            "template": {
+                "topologyConstraint": {"packDomain": "rack"},
+                "cliques": [
+                    {
+                        "name": "a",
+                        "topologyConstraint": {"packDomain": "zone"},
+                        "spec": {"roleName": "a", "replicas": 1, "podSpec": {}},
+                    }
+                ],
+            }
+        }
+    )
+    errs = validate_podcliqueset(pcs, topo)
+    assert any("narrower" in e.message for e in errs)
+    # Narrower child is fine.
+    pcs2 = _mk(
+        {
+            "template": {
+                "topologyConstraint": {"packDomain": "zone"},
+                "cliques": [
+                    {
+                        "name": "a",
+                        "topologyConstraint": {"packDomain": "rack"},
+                        "spec": {"roleName": "a", "replicas": 1, "podSpec": {}},
+                    }
+                ],
+            }
+        }
+    )
+    assert validate_podcliqueset(pcs2, topo) == []
+
+
+def test_validate_topology_domain_must_exist():
+    topo = ClusterTopology(name="t", levels=[TopologyLevel(TopologyDomain.HOST, "h")])
+    pcs = _mk(
+        {
+            "template": {
+                "topologyConstraint": {"packDomain": "rack"},
+                "cliques": [_clique("a")],
+            }
+        }
+    )
+    assert any("not defined in the cluster topology" in e.message for e in validate_podcliqueset(pcs, topo))
+
+
+def test_validate_update_immutability(simple1):
+    import copy
+
+    new = copy.deepcopy(simple1)
+    new.clique_template("frontend").spec.min_available = 1
+    assert any("minAvailable" in e.field for e in validate_update(simple1, new))
+
+    new2 = copy.deepcopy(simple1)
+    new2.spec.template.cliques = new2.spec.template.cliques[:-1]
+    assert any("added or removed" in e.message for e in validate_update(simple1, new2))
+
+    # image change is allowed
+    new3 = copy.deepcopy(simple1)
+    new3.clique_template("frontend").spec.pod_spec.containers[0].image = "v2"
+    assert validate_update(simple1, new3) == []

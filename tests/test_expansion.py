@@ -1,0 +1,252 @@
+"""M0 tests: PCS → gangs expansion parity.
+
+Expected shapes derived from the reference's podgang syncflow behavior
+(operator/internal/controller/podcliqueset/components/podgang/syncflow.go:139-327)
+on the simple1 sample: with PCSG workers{replicas:2, minAvailable:1}, the base
+gang holds frontend+router+workers-replica-0's cliques and ONE scaled gang
+holds workers-replica-1's cliques.
+"""
+
+import pytest
+
+from grove_tpu.api import ClusterTopology, PodCliqueSet, TopologyDomain, TopologyLevel
+from grove_tpu.api.constants import (
+    LABEL_BASE_PODGANG,
+    POD_GANG_SCHEDULING_GATE,
+)
+from grove_tpu.orchestrator import compute_generation_hash, expand_podcliqueset
+
+
+@pytest.fixture
+def topo():
+    return ClusterTopology(
+        name="t",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, "topology.kubernetes.io/zone"),
+            TopologyLevel(TopologyDomain.RACK, "topology.kubernetes.io/rack"),
+            TopologyLevel(TopologyDomain.HOST, "kubernetes.io/hostname"),
+        ],
+    )
+
+
+def test_expansion_object_counts(simple1: PodCliqueSet):
+    ds = expand_podcliqueset(simple1)
+    # cliques: frontend, router (standalone) + 2 PCSG replicas × {prefill, decode}
+    assert len(ds.podcliques) == 2 + 2 * 2
+    assert len(ds.scaling_groups) == 1
+    # gangs: 1 base + (replicas - minAvailable) = 1 scaled
+    assert len(ds.podgangs) == 2
+    assert len(ds.headless_services) == 1
+    # pods: frontend 3 + router 2 + 2×(prefill 2 + decode 2)
+    assert len(ds.pods) == 3 + 2 + 2 * 4
+
+
+def test_base_and_scaled_gang_membership(simple1: PodCliqueSet):
+    ds = expand_podcliqueset(simple1)
+    base = ds.podgang("simple1-0")
+    scaled = ds.podgang("simple1-0-workers-0")
+    assert base is not None and not base.is_scaled
+    assert scaled is not None and scaled.is_scaled
+    assert scaled.base_podgang_name == "simple1-0"
+
+    base_groups = {g.name for g in base.spec.pod_groups}
+    assert base_groups == {
+        "simple1-0-frontend",
+        "simple1-0-router",
+        "simple1-0-workers-0-prefill",
+        "simple1-0-workers-0-decode",
+    }
+    scaled_groups = {g.name for g in scaled.spec.pod_groups}
+    assert scaled_groups == {"simple1-0-workers-1-prefill", "simple1-0-workers-1-decode"}
+
+
+def test_min_replicas_equal_clique_min_available(simple1: PodCliqueSet):
+    ds = expand_podcliqueset(simple1)
+    base = ds.podgang("simple1-0")
+    by_name = {g.name: g for g in base.spec.pod_groups}
+    assert by_name["simple1-0-frontend"].min_replicas == 3
+    assert by_name["simple1-0-workers-0-prefill"].min_replicas == 2
+
+
+def test_pod_references_match_replicas(simple1: PodCliqueSet):
+    ds = expand_podcliqueset(simple1)
+    base = ds.podgang("simple1-0")
+    for g in base.spec.pod_groups:
+        clique = ds.clique(g.name)
+        assert len(g.pod_references) == clique.spec.replicas
+
+
+def test_scaled_gang_pods_carry_base_gang_label(simple1: PodCliqueSet):
+    ds = expand_podcliqueset(simple1)
+    for p in ds.pods_of_gang("simple1-0-workers-0"):
+        assert p.labels[LABEL_BASE_PODGANG] == "simple1-0"
+        assert p.base_podgang_name == "simple1-0"
+    for p in ds.pods_of_gang("simple1-0"):
+        assert LABEL_BASE_PODGANG not in p.labels
+
+
+def test_all_pods_created_gated(simple1: PodCliqueSet):
+    ds = expand_podcliqueset(simple1)
+    for p in ds.pods:
+        assert p.scheduling_gates == [POD_GANG_SCHEDULING_GATE]
+        assert not p.is_scheduled
+
+
+def test_pod_env_and_hostname(simple1: PodCliqueSet):
+    ds = expand_podcliqueset(simple1)
+    pods = ds.pods_of_clique("simple1-0-frontend")
+    hostnames = {p.spec.hostname for p in pods}
+    assert hostnames == {"simple1-0-frontend-0", "simple1-0-frontend-1", "simple1-0-frontend-2"}
+    p = pods[0]
+    assert p.env["GROVE_PCS_NAME"] == "simple1"
+    assert p.env["GROVE_PCS_INDEX"] == "0"
+    assert p.env["GROVE_PCLQ_NAME"] == "simple1-0-frontend"
+    assert p.env["GROVE_HEADLESS_SERVICE"] == "simple1-0.default.svc.cluster.local"
+    assert p.spec.subdomain == "simple1-0"
+    pcsg_pod = ds.pods_of_clique("simple1-0-workers-1-prefill")[0]
+    assert pcsg_pod.env["GROVE_PCSG_NAME"] == "simple1-0-workers"
+    assert pcsg_pod.env["GROVE_PCSG_INDEX"] == "1"
+
+
+def test_multi_replica_pcs(simple1: PodCliqueSet):
+    simple1.spec.replicas = 3
+    ds = expand_podcliqueset(simple1)
+    base_gangs = [g for g in ds.podgangs if not g.is_scaled]
+    assert [g.name for g in base_gangs] == ["simple1-0", "simple1-1", "simple1-2"]
+    assert len(ds.podgangs) == 6
+    assert len(ds.headless_services) == 3
+
+
+def test_pcsg_scale_up_adds_scaled_gangs(simple1: PodCliqueSet):
+    # HPA scales workers 2 -> 4: scaled gangs indexed from minAvailable upward.
+    ds = expand_podcliqueset(simple1, pcsg_replica_overrides={"simple1-0-workers": 4})
+    scaled = sorted(g.name for g in ds.podgangs if g.is_scaled)
+    assert scaled == ["simple1-0-workers-0", "simple1-0-workers-1", "simple1-0-workers-2"]
+
+
+def test_pclq_hpa_override(simple1: PodCliqueSet):
+    ds = expand_podcliqueset(simple1, pclq_replica_overrides={"simple1-0-frontend": 5})
+    assert len(ds.pods_of_clique("simple1-0-frontend")) == 5
+    base = ds.podgang("simple1-0")
+    grp = next(g for g in base.spec.pod_groups if g.name == "simple1-0-frontend")
+    # minReplicas stays at the clique's minAvailable; extra pods are best-effort.
+    assert grp.min_replicas == 3
+    assert len(grp.pod_references) == 5
+
+
+def test_topology_translation(simple1: PodCliqueSet, topo: ClusterTopology):
+    simple1.spec.template.topology_constraint = None
+    cfg = simple1.spec.template.pod_clique_scaling_group_configs[0]
+    from grove_tpu.api import TopologyConstraint
+
+    cfg.topology_constraint = TopologyConstraint(pack_domain=TopologyDomain.RACK)
+    ds = expand_podcliqueset(simple1, topo)
+    base = ds.podgang("simple1-0")
+    # PCSG replica 0 is in the base gang -> one group config over its cliques.
+    assert len(base.spec.topology_constraint_group_configs) == 1
+    gc = base.spec.topology_constraint_group_configs[0]
+    assert set(gc.pod_group_names) == {"simple1-0-workers-0-prefill", "simple1-0-workers-0-decode"}
+    assert gc.topology_constraint.pack_constraint.required == "topology.kubernetes.io/rack"
+    scaled = ds.podgang("simple1-0-workers-0")
+    assert len(scaled.spec.topology_constraint_group_configs) == 1
+
+
+def test_topology_missing_domain_nullifies(simple1: PodCliqueSet):
+    from grove_tpu.api import TopologyConstraint
+
+    topo = ClusterTopology(name="t", levels=[TopologyLevel(TopologyDomain.HOST, "h")])
+    simple1.spec.template.topology_constraint = TopologyConstraint(pack_domain=TopologyDomain.RACK)
+    ds = expand_podcliqueset(simple1, topo)
+    assert ds.podgang("simple1-0").spec.topology_constraint is None
+
+
+def test_tas_disabled_drops_constraints(simple1: PodCliqueSet, topo: ClusterTopology):
+    from grove_tpu.api import TopologyConstraint
+
+    simple1.spec.template.topology_constraint = TopologyConstraint(pack_domain=TopologyDomain.RACK)
+    ds = expand_podcliqueset(simple1, topo, tas_enabled=False)
+    assert ds.podgang("simple1-0").spec.topology_constraint is None
+
+
+def test_generation_hash_changes_on_template_change(simple1: PodCliqueSet):
+    import copy
+
+    h1 = compute_generation_hash(simple1)
+    changed = copy.deepcopy(simple1)
+    changed.clique_template("frontend").spec.pod_spec.containers[0].image = "v2"
+    assert compute_generation_hash(changed) != h1
+    # replica change alone does NOT change the hash (scale is not an update)
+    scaled = copy.deepcopy(simple1)
+    scaled.spec.replicas = 5
+    assert compute_generation_hash(scaled) == h1
+
+
+def test_expansion_deterministic(simple1: PodCliqueSet):
+    a = expand_podcliqueset(simple1)
+    b = expand_podcliqueset(simple1)
+    assert [p.name for p in a.pods] == [p.name for p in b.pods]
+    assert [g.name for g in a.podgangs] == [g.name for g in b.podgangs]
+
+
+def test_template_hash_scale_vs_update(simple1: PodCliqueSet):
+    """Scale changes must NOT change the template hash; priorityClassName must."""
+    import copy
+
+    from grove_tpu.orchestrator import compute_pod_template_hash
+
+    base = compute_pod_template_hash(simple1.clique_template("frontend"))
+    scaled = copy.deepcopy(simple1)
+    scaled.clique_template("frontend").spec.replicas = 9
+    scaled.clique_template("frontend").spec.scale_config.max_replicas = 99
+    assert compute_pod_template_hash(scaled.clique_template("frontend")) == base
+    assert compute_pod_template_hash(simple1.clique_template("frontend"), "high-prio") != base
+
+
+def test_clique_startup_type_crd_key():
+    """CRD JSON tag is cliqueStartupType (reference podcliqueset.go:133)."""
+    from grove_tpu.api import CliqueStartupType, PodCliqueSet, default_podcliqueset, validate_podcliqueset
+
+    pcs = PodCliqueSet.from_dict(
+        {
+            "metadata": {"name": "x"},
+            "spec": {
+                "template": {
+                    "cliqueStartupType": "CliqueStartupTypeExplicit",
+                    "cliques": [
+                        {"name": "a", "spec": {"roleName": "a", "podSpec": {}}},
+                        {"name": "b", "spec": {"roleName": "b", "startsAfter": ["a"], "podSpec": {}}},
+                    ],
+                }
+            },
+        }
+    )
+    assert pcs.spec.template.startup_type == CliqueStartupType.EXPLICIT
+    assert validate_podcliqueset(default_podcliqueset(pcs)) == []
+
+
+def test_host_domain_constraint_without_host_level(simple1: PodCliqueSet):
+    """Host level is auto-appended (clustertopology.go:102-107)."""
+    from grove_tpu.api import TopologyConstraint, validate_podcliqueset
+
+    topo = ClusterTopology(name="t", levels=[TopologyLevel(TopologyDomain.RACK, "topology/rack")])
+    simple1.spec.template.topology_constraint = TopologyConstraint(pack_domain=TopologyDomain.HOST)
+    assert validate_podcliqueset(simple1, topo) == []
+    ds = expand_podcliqueset(simple1, topo)
+    tc = ds.podgang("simple1-0").spec.topology_constraint
+    assert tc.pack_constraint.required == "kubernetes.io/hostname"
+
+
+def test_env_value_from_preserved():
+    from grove_tpu.api.types import Container
+
+    c = Container.from_dict(
+        {
+            "name": "c",
+            "env": [
+                {"name": "A", "value": "1"},
+                {"name": "POD_IP", "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}},
+            ],
+        }
+    )
+    assert c.env == {"A": "1"}
+    assert c.env_value_from == {"POD_IP": {"fieldRef": {"fieldPath": "status.podIP"}}}
