@@ -118,6 +118,8 @@ class PodGang:
     # For scaled gangs: the base gang that must schedule first
     # (grove.io/base-podgang label; podclique/components/pod/syncflow.go:347-387).
     base_podgang_name: Optional[str] = None
+    # 0-based scaled-gang index (pcsg_replica - minAvailable); -1 for base gangs.
+    scaled_index: int = -1
 
     @property
     def is_scaled(self) -> bool:

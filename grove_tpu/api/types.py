@@ -639,8 +639,8 @@ def set_condition(conditions: list[Condition], cond: Condition, now: float = 0.0
 
 def _parse_duration(s: str) -> float:
     """Parse Go-style duration strings: '4h', '30m', '1h30m', '90s', '100ms'."""
-    m = re.findall(r"([0-9.]+)(h|ms|m|s|us|ns)", s)
-    if not m:
+    if re.fullmatch(r"(?:[0-9.]+(?:ms|us|ns|h|m|s))+", s) is None:
         raise ValueError(f"invalid duration: {s!r}")
+    m = re.findall(r"([0-9.]+)(ms|us|ns|h|m|s)", s)
     mult = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}
     return sum(float(v) * mult[u] for v, u in m)

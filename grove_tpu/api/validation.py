@@ -57,14 +57,26 @@ def validate_podcliqueset(
     name = pcs.metadata.name
     if not name:
         errs.append(ValidationError("metadata.name", "name is required"))
-    if len(name) > MAX_PCS_NAME_LENGTH:
-        errs.append(
-            ValidationError(
-                "metadata.name",
-                f"must be at most {MAX_PCS_NAME_LENGTH} characters so generated pod "
-                f"names fit the 63-character limit",
+    # The 45-char budget caps the COMBINED <pcs>[-<pcsg>]-<pclq> name material
+    # so generated pod names `<pcs>-<i>[-<pcsg>-<j>]-<pclq>-<suffix>` fit the
+    # 63-char DNS label (validation/podcliqueset.go:564-578).
+    sg_of_clique = {
+        cn: cfg.name
+        for cfg in pcs.spec.template.pod_clique_scaling_group_configs
+        for cn in cfg.clique_names
+    }
+    for clique in pcs.spec.template.cliques:
+        parts = [name, sg_of_clique.get(clique.name, ""), clique.name]
+        combined = sum(len(p) for p in parts if p)
+        if combined > MAX_PCS_NAME_LENGTH:
+            errs.append(
+                ValidationError(
+                    "metadata.name",
+                    f"combined name length {combined} for clique {clique.name!r} exceeds "
+                    f"{MAX_PCS_NAME_LENGTH} characters; generated pod names would not fit "
+                    f"the 63-character limit",
+                )
             )
-        )
     if pcs.spec.replicas < 1:
         errs.append(ValidationError("spec.replicas", "must be greater than 0"))
 
@@ -131,6 +143,13 @@ def validate_podcliqueset(
                     ValidationError(
                         f"{fld}.spec.autoScalingConfig.maxReplicas",
                         "must be greater than or equal to minReplicas",
+                    )
+                )
+            if sc.max_replicas < spec.replicas:
+                errs.append(
+                    ValidationError(
+                        f"{fld}.spec.autoScalingConfig.maxReplicas",
+                        "must be greater than or equal to replicas",
                     )
                 )
 

@@ -150,13 +150,16 @@ def expand_podcliqueset(
         c.name: compute_pod_template_hash(c, tmpl.priority_class_name) for c in tmpl.cliques
     }
 
-    def _new_podgang(name: str, pcs_replica: int, base_name: str | None = None) -> PodGang:
+    def _new_podgang(
+        name: str, pcs_replica: int, base_name: str | None = None, scaled_index: int = -1
+    ) -> PodGang:
         return PodGang(
             name=name,
             namespace=ns,
             pcs_name=pcs_name,
             pcs_replica_index=pcs_replica,
             base_podgang_name=base_name,
+            scaled_index=scaled_index,
             spec=PodGangSpec(
                 priority_class_name=tmpl.priority_class_name,
                 topology_constraint=translate_pack_constraint(
@@ -223,6 +226,7 @@ def expand_podcliqueset(
                         naming.scaled_podgang_name(pcsg_fqn, j - cfg.min_available),
                         i,
                         base_name=base_gang.name,
+                        scaled_index=j - cfg.min_available,
                     )
                     out.podgangs.append(gang)
 
@@ -270,8 +274,11 @@ def expand_podcliqueset(
 
         out.podgangs.append(base_gang)
 
-    # Stable ordering: base gangs in replica order, then scaled.
-    out.podgangs.sort(key=lambda g: (g.is_scaled, g.pcs_replica_index, g.name))
+    # Stable ordering: base gangs in replica order, then scaled gangs by
+    # numeric scaled index (NOT name — "-10" must sort after "-2").
+    out.podgangs.sort(
+        key=lambda g: (g.is_scaled, g.pcs_replica_index, g.scaled_index, g.name)
+    )
     return out
 
 

@@ -1,0 +1,10 @@
+"""The TPU placement engine: masks, scoring, all-or-nothing gang commit."""
+
+from grove_tpu.solver.core import (  # noqa: F401
+    SolveResult,
+    SolverParams,
+    decode_assignments,
+    solve,
+    solve_batch,
+)
+from grove_tpu.solver.encode import GangBatch, GangDecodeInfo, encode_gangs  # noqa: F401

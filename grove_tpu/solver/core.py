@@ -1,0 +1,349 @@
+"""The TPU placement engine: batched all-or-nothing gang bin-packing.
+
+Replaces the reference's per-pod Filter/Score/Permit scheduler cycle (KAI,
+behind scheduler/api PodGang) with one jitted program:
+
+  lax.scan over gangs (sequential commit — later gangs see earlier placements)
+    stage 1: pack-set domain commitment, broad→narrow (lax.scan over sets):
+             per-domain feasibility via segment_sum (capacity + slot counts),
+             best-fit domain choice; a required set with no feasible domain
+             rejects the whole gang
+    stage 2: group count-allocation (lax.scan over groups): per-node slot
+             counts, score = preferred-domain bonus + gang locality + bin-pack
+             tightness, sorted-cumsum greedy take
+    stage 3: counts → per-pod node ids (vmapped searchsorted)
+    stage 4: all-or-nothing: capacity update applied only if every group met
+             its floor (PodGroup.MinReplicas, scheduler podgang.go:80-84) and
+             no required pack-set failed; otherwise the gang is rejected whole
+             (GS "all pods scheduled or none" semantics,
+             operator/e2e/tests/gang_scheduling_test.go GS1)
+
+Filter predicates are boolean masks; Score is a vectorized cost; Permit is the
+masked take — the design stated in BASELINE.json's north star.
+
+Everything is static-shaped: gangs/groups/sets/pods are padded per bucket
+(solver/encode.py), nodes padded by the snapshot. Runs identically on CPU
+(tests) and TPU (bench): no data-dependent Python control flow, f32
+throughout (resource quantities need exactness to ~1e-3 of a core, far inside
+f32; the MXU-heavy parts are the [MG,N,R] slot/score tensors).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.solver.encode import GangBatch
+
+SLOT_CAP = 1 << 20  # slots for a zero-request group (effectively unbounded)
+_EPS = 1e-6
+
+
+class SolverParams(NamedTuple):
+    """Score weights (Score plugin analog)."""
+
+    w_tight: jnp.float32 = 1.0  # bin-pack: prefer nodes with less free capacity
+    w_pref: jnp.float32 = 4.0  # preferred-domain bonus per matching pack-set
+    w_reuse: jnp.float32 = 2.0  # gang locality: prefer nodes this gang already uses
+    w_reserve: jnp.float32 = 8.0  # keep non-members out of committed pack domains
+
+
+class SolveResult(NamedTuple):
+    assigned: jax.Array  # i32 [G, MP] node index or -1
+    ok: jax.Array  # bool [G] gang admitted whole
+    placement_score: jax.Array  # f32 [G] quality in (0,1], 1.0 = optimal
+    free_after: jax.Array  # f32 [N, R]
+
+
+def _group_slots(free: jax.Array, group_req: jax.Array) -> jax.Array:
+    """Per-node pod capacity for each group's request vector.
+
+    free [N,R], group_req [MG,R] -> i32 [MG,N].
+    """
+    pos = group_req > 0  # [MG, R]
+    ratio = jnp.floor((free[None, :, :] + _EPS) / jnp.maximum(group_req[:, None, :], 1e-9))
+    ratio = jnp.where(pos[:, None, :], ratio, jnp.inf)
+    slots = ratio.min(axis=-1)  # [MG, N]
+    slots = jnp.where(jnp.isinf(slots), SLOT_CAP, slots)
+    return jnp.clip(slots, 0, SLOT_CAP).astype(jnp.int32)
+
+
+def _domain_sum(values: jax.Array, seg: jax.Array, n: int) -> jax.Array:
+    """Sum `values` [N, ...] per domain ordinal; unlabeled nodes spill to a
+    dropped padding segment."""
+    return jax.ops.segment_sum(values, seg, num_segments=n + 1)[:n]
+
+
+def _place_gang(free, used_carry, gang, *, schedulable, node_domain_id, cap_scale, params):
+    """Place one gang against `free`; pure function of its inputs."""
+    n, r = free.shape
+    levels = node_domain_id.shape[0]
+    group_req = gang["group_req"]  # [MG, R]
+    group_total = gang["group_total"]  # [MG]
+    group_required = gang["group_required"]  # [MG]
+    group_valid = gang["group_valid"]  # [MG]
+    set_member = gang["set_member"]  # [MS, MG]
+    set_req_level = gang["set_req_level"]  # [MS]
+    set_pref_level = gang["set_pref_level"]  # [MS]
+    set_valid = gang["set_valid"]  # [MS]
+    mg = group_req.shape[0]
+    ms = set_member.shape[0]
+
+    def seg_of(level):
+        dom = node_domain_id[jnp.clip(level, 0, levels - 1)]  # [N]
+        return jnp.where(dom >= 0, dom, n), dom
+
+    # ---- Stage 1: commit a domain per pack-set, broadest first --------------
+    def commit_set(carry, s):
+        committed_req, committed_pref, fail = carry
+        member = set_member[s]  # [MG]
+        req_level = set_req_level[s]
+        pref_level = set_pref_level[s]
+        active = set_valid[s]
+
+        # Node eligibility from previously committed sets sharing a group.
+        overlap = (set_member & member[None, :]).any(axis=-1)  # [MS]
+
+        def mask_from(c_req, lvl, ov):
+            dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+            return jnp.where((c_req >= 0) & ov, dom == c_req, True)
+
+        masks = jax.vmap(mask_from)(committed_req, set_req_level, overlap)  # [MS, N]
+        node_ok = schedulable & masks.all(axis=0)  # [N]
+
+        memberf = member & group_valid  # [MG]
+        demand = (group_req * (group_required * memberf).astype(jnp.float32)[:, None]).sum(0)  # [R]
+        slots = _group_slots(free, group_req)  # [MG, N]
+        slots = jnp.where(node_ok[None, :], slots, 0)
+
+        def pick_domain(level, extra_node_mask):
+            """Best-fit feasible domain at `level` among nodes passing masks."""
+            seg, _ = seg_of(level)
+            ok_nodes = node_ok & extra_node_mask
+            dom_free = _domain_sum(jnp.where(ok_nodes[:, None], free, 0.0), seg, n)  # [N_dom, R]
+            dom_slots = _domain_sum(jnp.where(ok_nodes[None, :], slots, 0).T, seg, n)  # [N_dom, MG]
+            feas_cap = (dom_free >= demand[None, :] - _EPS).all(axis=-1)
+            feas_slots = ((dom_slots >= group_required[None, :]) | ~memberf[None, :]).all(axis=-1)
+            nonempty = _domain_sum(ok_nodes.astype(jnp.int32), seg, n) > 0
+            feasible = feas_cap & feas_slots & nonempty
+            score = jnp.where(feasible, -dom_free.sum(axis=-1), -jnp.inf)
+            return jnp.argmax(score), feasible.any()
+
+        has_req = active & (req_level >= 0)
+        req_choice, req_any = pick_domain(req_level, jnp.ones((n,), dtype=bool))
+        new_req = jnp.where(has_req & req_any, req_choice, -1)
+        fail = fail | (has_req & ~req_any)
+
+        # Preferred: choose within the (possibly just-committed) required domain.
+        req_dom = node_domain_id[jnp.clip(req_level, 0, levels - 1)]
+        inside_req = jnp.where(new_req >= 0, req_dom == new_req, True)
+        has_pref = active & (pref_level >= 0)
+        pref_choice, pref_any = pick_domain(pref_level, inside_req)
+        new_pref = jnp.where(has_pref & pref_any, pref_choice, -1)
+
+        committed_req = committed_req.at[s].set(new_req)
+        committed_pref = committed_pref.at[s].set(new_pref)
+        return (committed_req, committed_pref, fail), None
+
+    init = (
+        jnp.full((ms,), -1, dtype=jnp.int32),
+        jnp.full((ms,), -1, dtype=jnp.int32),
+        jnp.asarray(False),
+    )
+    (committed_req, committed_pref, set_fail), _ = jax.lax.scan(
+        commit_set, init, jnp.arange(ms)
+    )
+
+    # ---- Stage 2: allocate counts per group, honoring commitments -----------
+    # Two phases so best-effort extras can never starve a later group's floor:
+    # phase 0 places exactly the required counts (the gang guarantee), phase 1
+    # tops up the remaining best-effort pods from leftover capacity.
+    def alloc_group(carry, xs):
+        free_g, used, ok = carry
+        g, phase = xs
+        valid = group_valid[g]
+        req = group_req[g]  # [R]
+        total = jnp.where(phase == 0, group_required[g], group_total[g] - group_required[g])
+        required = jnp.where(phase == 0, group_required[g], 0)
+
+        def set_mask(c_req, lvl, memb):
+            dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+            return jnp.where(memb & (c_req >= 0), dom == c_req, True)
+
+        masks = jax.vmap(set_mask)(committed_req, set_req_level, set_member[:, g])  # [MS, N]
+        node_ok = schedulable & masks.all(axis=0)
+
+        slots = _group_slots(free_g, req[None, :])[0]  # [N]
+        slots = jnp.where(node_ok, jnp.minimum(slots, total), 0)
+
+        def pref_hit(c_pref, lvl, memb):
+            dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+            return (memb & (c_pref >= 0) & (dom == c_pref)).astype(jnp.float32)
+
+        pref_bonus = jax.vmap(pref_hit)(committed_pref, set_pref_level, set_member[:, g]).sum(0)  # [N]
+
+        def reserved_hit(c_req, lvl, memb):
+            """Node sits in a domain committed to a set this group is NOT in."""
+            dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+            return (~memb & (c_req >= 0) & (dom == c_req)).astype(jnp.float32)
+
+        reserved = jax.vmap(reserved_hit)(committed_req, set_req_level, set_member[:, g]).sum(0)
+        norm_free = (free_g / cap_scale[None, :]).mean(axis=-1)  # [N] in ~[0,1]
+        score = (
+            params.w_pref * pref_bonus
+            + params.w_reuse * used.astype(jnp.float32)
+            - params.w_tight * norm_free
+            - params.w_reserve * reserved
+        )
+        order = jnp.argsort(-jnp.where(slots > 0, score, -jnp.inf))
+        slots_sorted = slots[order]
+        csum = jnp.cumsum(slots_sorted)
+        take_sorted = jnp.clip(total - (csum - slots_sorted), 0, slots_sorted)
+        counts = jnp.zeros((n,), dtype=jnp.int32).at[order].set(take_sorted)
+        counts = jnp.where(valid, counts, 0)
+        placed = counts.sum()
+        ok = ok & ((placed >= required) | ~valid)
+        free_g = free_g - counts.astype(jnp.float32)[:, None] * req[None, :]
+        used = used | (counts > 0)
+        return (free_g, used, ok), counts
+
+    order = gang["group_order"]  # [MG] permutation: constrained groups first
+    group_ids = jnp.concatenate([order, order])
+    phases = jnp.concatenate([jnp.zeros((mg,), jnp.int32), jnp.ones((mg,), jnp.int32)])
+    (free2, used2, groups_ok), counts2 = jax.lax.scan(
+        alloc_group, (free, used_carry, jnp.asarray(True)), (group_ids, phases)
+    )  # counts2 [2*MG, N] in scan order
+    counts = (
+        jnp.zeros((mg, free.shape[0]), dtype=jnp.int32)
+        .at[order].set(counts2[:mg])
+        .at[order].add(counts2[mg:])
+    )  # [MG, N] floor + best-effort, back in group-index order
+
+    gang_ok = gang["gang_valid"] & groups_ok & ~set_fail
+
+    # ---- Stage 3: counts -> per-pod node assignment --------------------------
+    ccum = jnp.cumsum(counts, axis=1)  # [MG, N]
+    placed_per_group = counts.sum(axis=1)  # [MG]
+
+    def pod_node(pg, pr):
+        gidx = jnp.clip(pg, 0, mg - 1)
+        idx = jnp.searchsorted(ccum[gidx], pr, side="right")
+        live = (pg >= 0) & (pr < placed_per_group[gidx]) & gang_ok
+        return jnp.where(live, idx, -1)
+
+    assigned = jax.vmap(pod_node)(gang["pod_group"], gang["pod_rank"])  # [MP]
+
+    # ---- Stage 4: placement quality (podgang.go:176-178, 1.0 = optimal) ------
+    def pref_frac(c_pref, lvl, memb):
+        dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+        in_dom = (dom == c_pref).astype(jnp.float32)  # [N]
+        cnt = (counts * memb[:, None]).astype(jnp.float32)  # [MG, N]
+        tot = cnt.sum()
+        hits = (cnt * in_dom[None, :]).sum()
+        frac = jnp.where(tot > 0, hits / jnp.maximum(tot, 1.0), 1.0)
+        active = (lvl >= 0)
+        return jnp.where(active & (c_pref >= 0), frac, jnp.where(active, 0.0, 1.0))
+
+    fracs = jax.vmap(pref_frac)(committed_pref, set_pref_level, set_member.astype(jnp.float32))
+    has_pref = set_valid & (set_pref_level >= 0)
+    mean_frac = jnp.where(
+        has_pref.any(),
+        (jnp.where(has_pref, fracs, 0.0).sum()) / jnp.maximum(has_pref.sum(), 1),
+        1.0,
+    )
+    placement_score = jnp.where(gang_ok, 0.5 + 0.5 * mean_frac, 0.0)
+
+    free_out = jnp.where(gang_ok, free2, free)
+    used_out = jnp.where(gang_ok, used2, used_carry)
+    return free_out, used_out, assigned, gang_ok, placement_score
+
+
+@functools.partial(jax.jit, static_argnames=("track_gang_locality",))
+def solve_batch(
+    free0: jax.Array,  # f32 [N, R]
+    capacity: jax.Array,  # f32 [N, R]
+    schedulable: jax.Array,  # bool [N]
+    node_domain_id: jax.Array,  # i32 [L, N]
+    batch: GangBatch,
+    params: SolverParams = SolverParams(),
+    track_gang_locality: bool = True,
+) -> SolveResult:
+    """Sequentially commit every gang in the batch (priority order = batch order)."""
+    n = free0.shape[0]
+    g = batch.gang_valid.shape[0]
+    cap_scale = jnp.maximum(capacity.max(axis=0), 1e-9)  # [R]
+
+    def step(carry, xs):
+        free, ok_vec = carry
+        gang_slices, gi = xs
+        # Scaled gangs wait for their base gang (syncflow.go:347-387): the base
+        # gang sits earlier in the batch, so its verdict is already in ok_vec.
+        dep = gang_slices["depends_on"]
+        dep_ok = jnp.where(dep >= 0, ok_vec[jnp.clip(dep, 0, g - 1)], True)
+        gang_slices = dict(gang_slices)
+        gang_slices["gang_valid"] = gang_slices["gang_valid"] & dep_ok
+        used0 = jnp.zeros((n,), dtype=bool)  # per-gang locality resets each gang
+        free_out, _, assigned, ok, score = _place_gang(
+            free,
+            used0,
+            gang_slices,
+            schedulable=schedulable,
+            node_domain_id=node_domain_id,
+            cap_scale=cap_scale,
+            params=params,
+        )
+        ok_vec = ok_vec.at[gi].set(ok)
+        return (free_out, ok_vec), (assigned, ok, score)
+
+    gang_dict = {
+        "group_req": batch.group_req,
+        "group_total": batch.group_total,
+        "group_required": batch.group_required,
+        "group_valid": batch.group_valid,
+        "set_member": batch.set_member,
+        "set_req_level": batch.set_req_level,
+        "set_pref_level": batch.set_pref_level,
+        "set_valid": batch.set_valid,
+        "pod_group": batch.pod_group,
+        "pod_rank": batch.pod_rank,
+        "gang_valid": batch.gang_valid,
+        "group_order": batch.group_order,
+        "depends_on": batch.depends_on,
+    }
+    (free_final, _), (assigned, ok, score) = jax.lax.scan(
+        step, (free0, jnp.zeros((g,), dtype=bool)), (gang_dict, jnp.arange(g))
+    )
+    return SolveResult(assigned=assigned, ok=ok, placement_score=score, free_after=free_final)
+
+
+def solve(snapshot, batch: GangBatch, params: SolverParams = SolverParams()) -> SolveResult:
+    """Convenience wrapper: snapshot (numpy) -> device -> solve_batch."""
+    free0 = jnp.asarray(snapshot.free)
+    capacity = jnp.asarray(snapshot.capacity)
+    schedulable = jnp.asarray(snapshot.schedulable)
+    node_domain_id = jnp.asarray(snapshot.node_domain_id)
+    jbatch = GangBatch(*(jnp.asarray(x) for x in batch))
+    return solve_batch(free0, capacity, schedulable, node_domain_id, jbatch, params)
+
+
+def decode_assignments(result: SolveResult, decode_info, snapshot) -> dict[str, dict[str, str]]:
+    """SolveResult -> {gang name: {pod name: node name}} for admitted gangs."""
+    assigned = np.asarray(result.assigned)
+    ok = np.asarray(result.ok)
+    out: dict[str, dict[str, str]] = {}
+    for gi, gang_name in enumerate(decode_info.gang_names):
+        if not ok[gi]:
+            continue
+        bindings: dict[str, str] = {}
+        for slot, pod_name in enumerate(decode_info.pod_names[gi]):
+            if not pod_name:
+                continue
+            node_idx = int(assigned[gi, slot])
+            if node_idx >= 0:
+                bindings[pod_name] = snapshot.node_names[node_idx]
+        out[gang_name] = bindings
+    return out

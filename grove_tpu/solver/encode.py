@@ -1,0 +1,224 @@
+"""Encode PodGangs into the dense, padded batch the solver consumes.
+
+Shapes are static per (MG, MS, MP) bucket so XLA compiles once per bucket
+(SURVEY.md §7 "ragged shapes" discipline):
+  G  gangs in the batch          MG max PodGroups per gang
+  MS max pack-sets per gang      MP max pods per gang
+  N  nodes                       R  resource kinds
+  L  topology levels
+
+A *pack-set* is one packing constraint instance: (subset of groups, level) —
+"all pods of these groups must land in ONE domain at this level". Gang-level
+TopologyConstraint covers all groups (scheduler podgang.go:55-57), each
+TopologyConstraintGroupConfig covers its subset (podgang.go:120-128), each
+PodGroup constraint covers itself (podgang.go:84-88). Sets are ordered
+broadest→narrowest so domain commitment can proceed top-down.
+
+Every pod of a PodGroup shares one template (podgang.go:75 "share the same
+PodTemplateSpec"), so a group is encoded as (request-vector, total, required)
+and placement is count allocation, not per-pod assignment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import numpy as np
+
+from grove_tpu.api.pod import Pod
+from grove_tpu.api.podgang import PodGang
+from grove_tpu.api.types import TopologyDomain
+from grove_tpu.state.cluster import ClusterSnapshot, pod_request_vector
+
+
+class GangBatch(NamedTuple):
+    """Dense solver input; all arrays are numpy (device put happens in solve)."""
+
+    group_req: np.ndarray  # f32 [G, MG, R] per-pod request of each group
+    group_total: np.ndarray  # i32 [G, MG] pods referenced
+    group_required: np.ndarray  # i32 [G, MG] gang floor (min_replicas, clamped)
+    group_valid: np.ndarray  # bool [G, MG]
+    set_member: np.ndarray  # bool [G, MS, MG]
+    set_req_level: np.ndarray  # i32 [G, MS] topology level index, -1 = none
+    set_pref_level: np.ndarray  # i32 [G, MS] topology level index, -1 = none
+    set_valid: np.ndarray  # bool [G, MS]
+    pod_group: np.ndarray  # i32 [G, MP] group index of each pod slot, -1 pad
+    pod_rank: np.ndarray  # i32 [G, MP] rank of pod within its group
+    gang_valid: np.ndarray  # bool [G]
+    # Allocation order over groups: required-pack-constrained groups first so
+    # unconstrained groups can't consume a committed domain's capacity, then
+    # biggest demand first (classic first-fit-decreasing).
+    group_order: np.ndarray  # i32 [G, MG] permutation of group indices
+    # Scaled gangs only schedule once their base gang is scheduled
+    # (grove.io/base-podgang; podclique/components/pod/syncflow.go:347-387).
+    # Index of the base gang within this batch (must be earlier), -1 = no dep.
+    depends_on: np.ndarray  # i32 [G]
+
+    @property
+    def n_gangs(self) -> int:
+        return self.group_req.shape[0]
+
+
+@dataclass
+class GangDecodeInfo:
+    """Host-side mapping from batch slots back to object names."""
+
+    gang_names: list[str]
+    # per gang, per pod slot: pod name ("" for padding)
+    pod_names: list[list[str]]
+    group_names: list[list[str]]
+
+
+def _level_index(snapshot: ClusterSnapshot, label_key: str | None) -> int:
+    """Node-label key (IR constraint) → topology level index in the snapshot."""
+    if label_key is None:
+        return -1
+    for li, domain in enumerate(snapshot.level_domains):
+        level = snapshot.topology.label_key_for(domain)
+        if level == label_key:
+            return li
+    # Hostname key is always resolvable through the implied host level.
+    if label_key == "kubernetes.io/hostname":
+        try:
+            return snapshot.level_domains.index(TopologyDomain.HOST)
+        except ValueError:
+            return -1
+    return -1
+
+
+def encode_gangs(
+    gangs: list[PodGang],
+    pods_by_name: dict[str, Pod],
+    snapshot: ClusterSnapshot,
+    *,
+    max_groups: int | None = None,
+    max_sets: int | None = None,
+    max_pods: int | None = None,
+    pad_gangs_to: int | None = None,
+    scheduled_gangs: set[str] | None = None,
+) -> tuple[GangBatch, GangDecodeInfo]:
+    """Flatten gang CRs into the padded batch + decode info.
+
+    `scheduled_gangs`: names of gangs already scheduled in earlier solves. A
+    scaled gang whose base gang is neither in this batch (at an earlier index)
+    nor in `scheduled_gangs` is marked invalid — it must wait, mirroring the
+    base-gang gate (podclique/components/pod/syncflow.go:347-387).
+    """
+    g_count = pad_gangs_to if pad_gangs_to is not None else len(gangs)
+    if g_count < len(gangs):
+        raise ValueError("pad_gangs_to smaller than gang count")
+    r = len(snapshot.resource_names)
+
+    def _sets_of(gang: PodGang) -> list[tuple[list[int], int, int]]:
+        """Return (member group indices, req_level, pref_level), broad→narrow."""
+        group_idx = {grp.name: k for k, grp in enumerate(gang.spec.pod_groups)}
+        raw: list[tuple[list[int], int, int]] = []
+        if gang.spec.topology_constraint and gang.spec.topology_constraint.pack_constraint:
+            pc = gang.spec.topology_constraint.pack_constraint
+            raw.append(
+                (
+                    list(range(len(gang.spec.pod_groups))),
+                    _level_index(snapshot, pc.required),
+                    _level_index(snapshot, pc.preferred),
+                )
+            )
+        for gc in gang.spec.topology_constraint_group_configs:
+            if gc.topology_constraint and gc.topology_constraint.pack_constraint:
+                pc = gc.topology_constraint.pack_constraint
+                members = [group_idx[n] for n in gc.pod_group_names if n in group_idx]
+                if members:
+                    raw.append(
+                        (members, _level_index(snapshot, pc.required), _level_index(snapshot, pc.preferred))
+                    )
+        for k, grp in enumerate(gang.spec.pod_groups):
+            if grp.topology_constraint and grp.topology_constraint.pack_constraint:
+                pc = grp.topology_constraint.pack_constraint
+                raw.append(([k], _level_index(snapshot, pc.required), _level_index(snapshot, pc.preferred)))
+        # Drop sets with neither level resolvable (constraint nullified).
+        raw = [s for s in raw if s[1] >= 0 or s[2] >= 0]
+        # Broadest required level first (-1 required sorts last).
+        raw.sort(key=lambda s: (s[1] if s[1] >= 0 else 10**6))
+        return raw
+
+    mg = max_groups or max((len(g.spec.pod_groups) for g in gangs), default=1) or 1
+    all_sets = [_sets_of(g) for g in gangs]
+    ms = max_sets or max((len(s) for s in all_sets), default=1) or 1
+    mp = max_pods or max((g.total_pods() for g in gangs), default=1) or 1
+
+    batch = GangBatch(
+        group_req=np.zeros((g_count, mg, r), dtype=np.float32),
+        group_total=np.zeros((g_count, mg), dtype=np.int32),
+        group_required=np.zeros((g_count, mg), dtype=np.int32),
+        group_valid=np.zeros((g_count, mg), dtype=bool),
+        set_member=np.zeros((g_count, ms, mg), dtype=bool),
+        set_req_level=np.full((g_count, ms), -1, dtype=np.int32),
+        set_pref_level=np.full((g_count, ms), -1, dtype=np.int32),
+        set_valid=np.zeros((g_count, ms), dtype=bool),
+        pod_group=np.full((g_count, mp), -1, dtype=np.int32),
+        pod_rank=np.zeros((g_count, mp), dtype=np.int32),
+        gang_valid=np.zeros((g_count,), dtype=bool),
+        group_order=np.tile(np.arange(mg, dtype=np.int32), (g_count, 1)),
+        depends_on=np.full((g_count,), -1, dtype=np.int32),
+    )
+    decode = GangDecodeInfo(gang_names=[], pod_names=[], group_names=[])
+    gang_index = {g.name: i for i, g in enumerate(gangs)}
+    scheduled_gangs = scheduled_gangs or set()
+
+    for gi, gang in enumerate(gangs):
+        if len(gang.spec.pod_groups) > mg:
+            raise ValueError(f"gang {gang.name}: {len(gang.spec.pod_groups)} groups > bucket {mg}")
+        if gang.total_pods() > mp:
+            raise ValueError(f"gang {gang.name}: {gang.total_pods()} pods > bucket {mp}")
+        decode.gang_names.append(gang.name)
+        pod_names: list[str] = []
+        group_names: list[str] = []
+        batch.gang_valid[gi] = True
+        if gang.base_podgang_name is not None:
+            base_idx = gang_index.get(gang.base_podgang_name, -1)
+            if 0 <= base_idx < gi:
+                batch.depends_on[gi] = base_idx
+            elif gang.base_podgang_name not in scheduled_gangs:
+                # Base gang missing and not yet scheduled: gate this gang out.
+                batch.gang_valid[gi] = False
+        slot = 0
+        for k, grp in enumerate(gang.spec.pod_groups):
+            group_names.append(grp.name)
+            refs = [ref.name for ref in grp.pod_references]
+            batch.group_total[gi, k] = len(refs)
+            batch.group_required[gi, k] = min(grp.min_replicas, len(refs))
+            batch.group_valid[gi, k] = True
+            if refs:
+                first = pods_by_name.get(refs[0])
+                if first is not None:
+                    batch.group_req[gi, k] = pod_request_vector(first, snapshot.resource_names)
+            for rank, ref in enumerate(refs):
+                batch.pod_group[gi, slot] = k
+                batch.pod_rank[gi, slot] = rank
+                pod_names.append(ref)
+                slot += 1
+        if len(all_sets[gi]) > ms:
+            raise ValueError(
+                f"gang {gang.name}: {len(all_sets[gi])} pack-sets > bucket {ms}"
+            )
+        req_constrained: set[int] = set()
+        for si, (members, req_l, pref_l) in enumerate(all_sets[gi]):
+            batch.set_valid[gi, si] = True
+            batch.set_req_level[gi, si] = req_l
+            batch.set_pref_level[gi, si] = pref_l
+            for k in members:
+                batch.set_member[gi, si, k] = True
+                if req_l >= 0:
+                    req_constrained.add(k)
+        demand = [
+            float(batch.group_total[gi, k] * batch.group_req[gi, k].sum()) for k in range(mg)
+        ]
+        batch.group_order[gi] = np.array(
+            sorted(range(mg), key=lambda k: (k not in req_constrained, -demand[k])),
+            dtype=np.int32,
+        )
+        pod_names += [""] * (mp - len(pod_names))
+        decode.pod_names.append(pod_names)
+        decode.group_names.append(group_names)
+
+    return batch, decode

@@ -1,0 +1,11 @@
+"""Dense cluster snapshot tensors."""
+
+from grove_tpu.state.cluster import (  # noqa: F401
+    DEFAULT_RESOURCES,
+    ClusterSnapshot,
+    Node,
+    apply_binding,
+    build_snapshot,
+    pod_request_vector,
+    release_binding,
+)

@@ -1,0 +1,173 @@
+"""Dense cluster snapshot: nodes × resources × topology-domain tensors.
+
+The analog of the reference's informer caches (node/pod listers) flattened into
+the tensors the TPU solver consumes. The reference reads cluster state through
+kube-apiserver watch streams (SURVEY.md §5.8); here a snapshot is built from any
+source (simulator, KWOK replay, live lister) and handed to the solver whole.
+
+Encoding:
+  capacity / allocated : float32 [N, R]  (base units; R = len(resource_names))
+  node_domain_id       : int32  [L, N]   (domain ordinal per topology level;
+                                          -1 = node not labeled at that level)
+  schedulable          : bool   [N]      (False = cordoned/unready)
+
+Topology levels are the sorted (broad→narrow) levels of the ClusterTopology
+(clustertopology.go:92-136). Domain ordinals are dense per level so per-domain
+aggregates are jax.ops.segment_sum calls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from grove_tpu.api.pod import Pod
+from grove_tpu.api.types import ClusterTopology, TopologyDomain
+
+DEFAULT_RESOURCES = ("cpu", "memory", "google.com/tpu", "nvidia.com/gpu")
+
+
+@dataclass
+class Node:
+    """One schedulable node."""
+
+    name: str
+    capacity: dict[str, float] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    schedulable: bool = True
+
+
+@dataclass
+class ClusterSnapshot:
+    """Immutable dense view of the cluster at one instant."""
+
+    resource_names: tuple[str, ...]
+    node_names: list[str]
+    capacity: np.ndarray  # f32 [N, R]
+    allocated: np.ndarray  # f32 [N, R]
+    schedulable: np.ndarray  # bool [N]
+    # Topology:
+    topology: ClusterTopology
+    level_domains: list[TopologyDomain]  # broad→narrow, length L
+    node_domain_id: np.ndarray  # i32 [L, N]
+    domain_names: list[list[str]]  # per level: ordinal -> domain value
+    num_domains: np.ndarray  # i32 [L] (actual domain count per level)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.node_names)
+
+    @property
+    def free(self) -> np.ndarray:
+        return self.capacity - self.allocated
+
+    def node_index(self, name: str) -> int:
+        return self.node_names.index(name)
+
+    def level_index(self, domain: TopologyDomain) -> Optional[int]:
+        try:
+            return self.level_domains.index(domain)
+        except ValueError:
+            return None
+
+    def domain_of_node(self, node: int | str, level: TopologyDomain) -> Optional[str]:
+        if isinstance(node, str):
+            node = self.node_index(node)
+        li = self.level_index(level)
+        if li is None:
+            return None
+        did = int(self.node_domain_id[li, node])
+        if did < 0:
+            return None
+        return self.domain_names[li][did]
+
+
+def build_snapshot(
+    nodes: list[Node],
+    topology: ClusterTopology,
+    resource_names: tuple[str, ...] = DEFAULT_RESOURCES,
+    bound_pods: list[Pod] | None = None,
+    pad_nodes_to: int | None = None,
+) -> ClusterSnapshot:
+    """Flatten node objects + topology labels into the dense snapshot.
+
+    `pad_nodes_to` pads the node axis with unschedulable zero-capacity phantom
+    nodes so snapshots of similar size share one compiled solver (bucketing
+    discipline, SURVEY.md §7 "ragged shapes").
+    """
+    topology = topology.with_host_level()
+    levels = topology.sorted_levels()
+    n_real = len(nodes)
+    n = pad_nodes_to if pad_nodes_to is not None else n_real
+    if n < n_real:
+        raise ValueError(f"pad_nodes_to={n} < node count {n_real}")
+    r = len(resource_names)
+
+    capacity = np.zeros((n, r), dtype=np.float32)
+    schedulable = np.zeros((n,), dtype=bool)
+    for i, node in enumerate(nodes):
+        schedulable[i] = node.schedulable
+        for j, res in enumerate(resource_names):
+            capacity[i, j] = node.capacity.get(res, 0.0)
+
+    node_domain_id = np.full((len(levels), n), -1, dtype=np.int32)
+    domain_names: list[list[str]] = []
+    num_domains = np.zeros((len(levels),), dtype=np.int32)
+    # Domain identity is the PATH of label values down the hierarchy, not the
+    # raw value: rack "rack-1" in zone "z0" is a different physical rack than
+    # "rack-1" in zone "z1" (labels are commonly only unique within a parent).
+    node_paths: list[tuple[str, ...]] = [() for _ in range(n_real)]
+    for li, level in enumerate(levels):
+        ordinals: dict[tuple[str, ...], int] = {}
+        for i, node in enumerate(nodes):
+            value = node.labels.get(level.node_label_key)
+            if value is None and level.domain == TopologyDomain.HOST:
+                value = node.name  # hostname label implied by node identity
+            if value is None:
+                continue
+            path = node_paths[i] + (value,)
+            node_paths[i] = path
+            if path not in ordinals:
+                ordinals[path] = len(ordinals)
+            node_domain_id[li, i] = ordinals[path]
+        domain_names.append(
+            ["/".join(p) for p, _ in sorted(ordinals.items(), key=lambda kv: kv[1])]
+        )
+        num_domains[li] = len(ordinals)
+
+    allocated = np.zeros_like(capacity)
+    snap = ClusterSnapshot(
+        resource_names=tuple(resource_names),
+        node_names=[x.name for x in nodes],
+        capacity=capacity,
+        allocated=allocated,
+        schedulable=schedulable,
+        topology=topology,
+        level_domains=[lv.domain for lv in levels],
+        node_domain_id=node_domain_id,
+        domain_names=domain_names,
+        num_domains=num_domains,
+    )
+    for pod in bound_pods or []:
+        if pod.node_name is not None:
+            apply_binding(snap, pod)
+    return snap
+
+
+def pod_request_vector(pod: Pod, resource_names: tuple[str, ...]) -> np.ndarray:
+    total = pod.spec.total_requests()
+    return np.array([total.get(res, 0.0) for res in resource_names], dtype=np.float32)
+
+
+def apply_binding(snap: ClusterSnapshot, pod: Pod) -> None:
+    """Account a bound pod's requests against its node."""
+    idx = snap.node_index(pod.node_name)
+    snap.allocated[idx] += pod_request_vector(pod, snap.resource_names)
+
+
+def release_binding(snap: ClusterSnapshot, pod: Pod) -> None:
+    idx = snap.node_index(pod.node_name)
+    snap.allocated[idx] -= pod_request_vector(pod, snap.resource_names)
+    np.maximum(snap.allocated[idx], 0.0, out=snap.allocated[idx])

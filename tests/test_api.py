@@ -340,3 +340,61 @@ def test_validate_update_immutability(simple1):
     new3 = copy.deepcopy(simple1)
     new3.clique_template("frontend").spec.pod_spec.containers[0].image = "v2"
     assert validate_update(simple1, new3) == []
+
+
+def test_validate_combined_name_budget():
+    """45-char budget is over <pcs>+<pcsg>+<pclq> combined (podcliqueset.go:564-578)."""
+    pcs = _mk(
+        {
+            "template": {
+                "cliques": [_clique("prefill")],
+                "podCliqueScalingGroups": [
+                    {"name": "workers-group-for-decode-prefill", "cliqueNames": ["prefill"]}
+                ],
+            }
+        }
+    )
+    pcs.metadata.name = "inference-stack"  # 15 + 32 + 7 = 54 > 45
+    assert any("combined name length" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_validate_max_replicas_below_replicas():
+    pcs = _mk(
+        {
+            "template": {
+                "cliques": [
+                    _clique("a", replicas=4, minAvailable=2, autoScalingConfig={"maxReplicas": 3, "minReplicas": 2})
+                ]
+            }
+        }
+    )
+    assert any("greater than or equal to replicas" in e.message for e in validate_podcliqueset(pcs))
+
+
+def test_parse_duration_rejects_malformed():
+    from grove_tpu.api.types import _parse_duration
+
+    assert _parse_duration("1h30m") == 5400.0
+    for bad in ("1h30", "junk4hjunk", "h", ""):
+        with pytest.raises(ValueError):
+            _parse_duration(bad)
+
+
+def test_topology_domains_qualified_by_parent():
+    """rack-1 in z0 and rack-1 in z1 are different racks."""
+    from grove_tpu.state import Node, build_snapshot
+
+    topo = ClusterTopology(
+        name="t",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, "zone"),
+            TopologyLevel(TopologyDomain.RACK, "rack"),
+        ],
+    )
+    nodes = [
+        Node(name="a", capacity={"cpu": 1}, labels={"zone": "z0", "rack": "rack-1"}),
+        Node(name="b", capacity={"cpu": 1}, labels={"zone": "z1", "rack": "rack-1"}),
+    ]
+    snap = build_snapshot(nodes, topo)
+    li = snap.level_index(TopologyDomain.RACK)
+    assert snap.node_domain_id[li, 0] != snap.node_domain_id[li, 1]

@@ -250,3 +250,11 @@ def test_env_value_from_preserved():
     )
     assert c.env == {"A": "1"}
     assert c.env_value_from == {"POD_IP": {"fieldRef": {"fieldPath": "status.podIP"}}}
+
+
+def test_scaled_gang_numeric_ordering(simple1: PodCliqueSet):
+    """Scaled index 10 must sort after 2 (numeric, not lexicographic)."""
+    ds = expand_podcliqueset(simple1, pcsg_replica_overrides={"simple1-0-workers": 13})
+    scaled = [g.name for g in ds.podgangs if g.is_scaled]
+    assert scaled[:3] == ["simple1-0-workers-0", "simple1-0-workers-1", "simple1-0-workers-2"]
+    assert scaled[-1] == "simple1-0-workers-11"

@@ -1,0 +1,168 @@
+"""M1 tests: the JAX gang placement solver.
+
+Scenario sources: gang all-or-nothing semantics (GS1 analog,
+operator/e2e/tests/gang_scheduling_test.go:34), capacity manipulation by
+cordoning (e2e pattern), end-to-end simple1 placement.
+"""
+
+import numpy as np
+import pytest
+
+from grove_tpu.api import (
+    ClusterTopology,
+    PodCliqueSet,
+    TopologyDomain,
+    TopologyLevel,
+)
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.solver import decode_assignments, encode_gangs, solve
+from grove_tpu.state import Node, build_snapshot
+
+
+def mk_topology():
+    return ClusterTopology(
+        name="t",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, "topology.kubernetes.io/zone"),
+            TopologyLevel(TopologyDomain.RACK, "topology.kubernetes.io/rack"),
+        ],
+    )
+
+
+def mk_nodes(count, cpu=4.0, racks=2, zones=1, prefix="n"):
+    nodes = []
+    for i in range(count):
+        nodes.append(
+            Node(
+                name=f"{prefix}{i}",
+                capacity={"cpu": cpu, "memory": 8 * 2**30},
+                labels={
+                    "topology.kubernetes.io/zone": f"z{i % zones}",
+                    "topology.kubernetes.io/rack": f"r{i % racks}",
+                },
+            )
+        )
+    return nodes
+
+
+@pytest.fixture
+def simple_setup(simple1: PodCliqueSet):
+    topo = mk_topology()
+    ds = expand_podcliqueset(simple1, topo)
+    snap = build_snapshot(mk_nodes(8), topo)
+    pods_by_name = {p.name: p for p in ds.pods}
+    return ds, snap, pods_by_name
+
+
+def test_end_to_end_simple1(simple_setup):
+    """The M1 milestone: simple1 fully scheduled on an 8-node cluster."""
+    ds, snap, pods_by_name = simple_setup
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all()), "both gangs must schedule"
+    bindings = decode_assignments(result, decode, snap)
+    assert set(bindings) == {"simple1-0", "simple1-0-workers-0"}
+    # every pod of every admitted gang is bound
+    assert len(bindings["simple1-0"]) == 9  # frontend 3 + router 2 + workers-0 4
+    assert len(bindings["simple1-0-workers-0"]) == 4
+    # placement scores populated in (0, 1]
+    scores = np.asarray(result.placement_score)
+    assert (scores > 0).all() and (scores <= 1.0).all()
+
+
+def test_capacity_accounting(simple_setup):
+    """Free capacity after solve equals capacity minus placed requests."""
+    ds, snap, pods_by_name = simple_setup
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    free = np.asarray(result.free_after)
+    # 13 pods × 10m cpu placed
+    total_placed = snap.capacity[:, 0].sum() - free[:, 0].sum()
+    assert total_placed == pytest.approx(13 * 0.01, abs=1e-4)
+    assert (free >= -1e-5).all()
+
+
+def test_gang_all_or_nothing_capacity_shortfall(simple1: PodCliqueSet):
+    """GS1 analog: when capacity can't fit the gang floor, NOTHING is placed."""
+    topo = mk_topology()
+    ds = expand_podcliqueset(simple1, topo)
+    # 13 pods want 10m each; give the cluster room for only ~5 pods.
+    snap = build_snapshot(mk_nodes(1, cpu=0.05), topo)
+    pods_by_name = {p.name: p for p in ds.pods}
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    assert not bool(np.asarray(result.ok).any())
+    # capacity untouched
+    np.testing.assert_allclose(np.asarray(result.free_after), snap.free)
+    assert (np.asarray(result.assigned) == -1).all()
+    assert decode_assignments(result, decode, snap) == {}
+
+
+def test_partial_admission_scaled_gang_rejected(simple1: PodCliqueSet):
+    """Base gang fits, scaled gang doesn't -> only the base gang is admitted."""
+    topo = mk_topology()
+    ds = expand_podcliqueset(simple1, topo)
+    # base gang needs 9 pods x 10m = 0.09; scaled needs 4 x 10m = 0.04.
+    snap = build_snapshot(mk_nodes(1, cpu=0.10), topo)
+    pods_by_name = {p.name: p for p in ds.pods}
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    ok = dict(zip(decode.gang_names, np.asarray(result.ok)))
+    assert bool(ok["simple1-0"]) is True
+    assert bool(ok["simple1-0-workers-0"]) is False
+    bindings = decode_assignments(result, decode, snap)
+    assert "simple1-0-workers-0" not in bindings
+    assert len(bindings["simple1-0"]) == 9
+
+
+def test_unschedulable_nodes_excluded(simple_setup):
+    ds, _, pods_by_name = simple_setup
+    topo = mk_topology()
+    nodes = mk_nodes(8)
+    for node in nodes[:7]:
+        node.schedulable = False  # cordon all but one (cpu=4 fits 13 x 10m)
+    snap = build_snapshot(nodes, topo)
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, decode, snap)
+    used_nodes = {n for b in bindings.values() for n in b.values()}
+    assert used_nodes == {"n7"}
+
+
+def test_best_effort_pods_beyond_min_replicas(simple1: PodCliqueSet):
+    """Pods beyond MinReplicas are best-effort: gang admits even if they don't fit
+    (scheduler podgang.go:80-84)."""
+    topo = mk_topology()
+    # frontend: replicas 5 via HPA override, but minAvailable stays 3.
+    ds = expand_podcliqueset(simple1, topo, pclq_replica_overrides={"simple1-0-frontend": 5})
+    pods_by_name = {p.name: p for p in ds.pods}
+    # Solve ONLY the base gang: 11 pods (floor 9), room for 10.
+    base = [g for g in ds.podgangs if not g.is_scaled]
+    snap = build_snapshot(mk_nodes(1, cpu=0.101), topo)
+    batch, decode = encode_gangs(base, pods_by_name, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())  # floor met; extras best-effort
+    bindings = decode_assignments(result, decode, snap)
+    assert len(bindings["simple1-0"]) == 10  # 1 best-effort pod shed
+
+
+def test_padded_gang_slots_ignored(simple_setup):
+    ds, snap, pods_by_name = simple_setup
+    batch, decode = encode_gangs(
+        ds.podgangs, pods_by_name, snap, pad_gangs_to=8, max_groups=6, max_pods=16
+    )
+    result = solve(snap, batch)
+    ok = np.asarray(result.ok)
+    assert ok[:2].all() and not ok[2:].any()  # padding gangs never admit
+
+
+def test_pods_pack_per_group_identically(simple_setup):
+    """All pods of one group get real node assignments in rank order."""
+    ds, snap, pods_by_name = simple_setup
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    bindings = decode_assignments(result, decode, snap)
+    for gang_name, b in bindings.items():
+        for pod_name, node in b.items():
+            assert node in snap.node_names

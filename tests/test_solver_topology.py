@@ -1,0 +1,231 @@
+"""M2 tests: topology-aware placement (TAS e2e analogs, topology_test.go TAS1-16).
+
+Verifies required pack constraints confine pods to one domain, group configs
+pack PCSG replicas, infeasible constraints reject the gang, and preferred
+constraints shape scores without rejecting.
+"""
+
+import numpy as np
+import pytest
+
+from grove_tpu.api import (
+    ClusterTopology,
+    PodCliqueSet,
+    TopologyConstraint,
+    TopologyDomain,
+    TopologyLevel,
+)
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.solver import decode_assignments, encode_gangs, solve
+from grove_tpu.state import Node, build_snapshot
+
+
+def topo3():
+    return ClusterTopology(
+        name="t",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, "topology.kubernetes.io/zone"),
+            TopologyLevel(TopologyDomain.RACK, "topology.kubernetes.io/rack"),
+        ],
+    )
+
+
+def rack_nodes(n_racks, nodes_per_rack, cpu=1.0, zones=1):
+    nodes = []
+    for r in range(n_racks):
+        for i in range(nodes_per_rack):
+            nodes.append(
+                Node(
+                    name=f"r{r}n{i}",
+                    capacity={"cpu": cpu, "memory": 8 * 2**30},
+                    labels={
+                        "topology.kubernetes.io/zone": f"z{r % zones}",
+                        "topology.kubernetes.io/rack": f"rack-{r}",
+                    },
+                )
+            )
+    return nodes
+
+
+def nodes_of(bindings):
+    return {n for b in bindings.values() for n in b.values()}
+
+
+def racks_of(bindings, snap):
+    return {
+        snap.domain_of_node(n, TopologyDomain.RACK)
+        for b in bindings.values()
+        for n in b.values()
+    }
+
+
+@pytest.fixture
+def pcs_rack_required(simple1: PodCliqueSet):
+    simple1.spec.template.topology_constraint = TopologyConstraint(pack_domain=TopologyDomain.RACK)
+    return simple1
+
+
+def test_required_rack_packs_whole_gang(pcs_rack_required):
+    topo = topo3()
+    ds = expand_podcliqueset(pcs_rack_required, topo)
+    # 4 racks × 4 nodes × 1cpu: any rack fits a whole gang.
+    snap = build_snapshot(rack_nodes(4, 4), topo)
+    pods = {p.name: p for p in ds.pods}
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, decode, snap)
+    # each gang confined to exactly one rack
+    for gang_name, b in bindings.items():
+        gang_racks = {snap.domain_of_node(n, TopologyDomain.RACK) for n in b.values()}
+        assert len(gang_racks) == 1, f"{gang_name} spans {gang_racks}"
+
+
+def test_required_rack_infeasible_rejects(pcs_rack_required):
+    topo = topo3()
+    ds = expand_podcliqueset(pcs_rack_required, topo)
+    # Each rack has capacity for only 5 pods; base gang needs 9 in ONE rack.
+    snap = build_snapshot(rack_nodes(4, 1, cpu=0.05), topo)
+    pods = {p.name: p for p in ds.pods}
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    result = solve(snap, batch)
+    ok = dict(zip(decode.gang_names, np.asarray(result.ok)))
+    assert not ok["simple1-0"]
+    # and nothing placed (all-or-nothing even on topology failure)
+    np.testing.assert_allclose(np.asarray(result.free_after), snap.free)
+
+
+def test_unconstrained_gang_may_spread(simple1):
+    topo = topo3()
+    ds = expand_podcliqueset(simple1, topo)
+    # Without constraints the same tight cluster is fine: spread across racks.
+    snap = build_snapshot(rack_nodes(4, 1, cpu=0.05), topo)
+    pods = {p.name: p for p in ds.pods}
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, decode, snap)
+    assert len(racks_of(bindings, snap)) > 1
+
+
+def test_pcsg_group_config_packs_replica(simple1):
+    """PCSG rack constraint: each PCSG replica packs into one rack, but
+    different replicas may use different racks (podcliqueset.go:190-196)."""
+    topo = topo3()
+    cfg = simple1.spec.template.pod_clique_scaling_group_configs[0]
+    cfg.topology_constraint = TopologyConstraint(pack_domain=TopologyDomain.RACK)
+    ds = expand_podcliqueset(simple1, topo)
+    # rack capacity 5 pods: a 4-pod PCSG replica fits one rack, the 13-pod
+    # gang total does not — so packing must be per-replica.
+    snap = build_snapshot(rack_nodes(4, 1, cpu=0.05), topo)
+    pods = {p.name: p for p in ds.pods}
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, decode, snap)
+    # each PCSG replica's pods in one rack
+    for replica_cliques in (
+        ["simple1-0-workers-0-prefill", "simple1-0-workers-0-decode"],
+        ["simple1-0-workers-1-prefill", "simple1-0-workers-1-decode"],
+    ):
+        rep_nodes = [
+            node
+            for b in bindings.values()
+            for pod, node in b.items()
+            if any(pod.startswith(c) for c in replica_cliques)
+        ]
+        rep_racks = {snap.domain_of_node(n, TopologyDomain.RACK) for n in rep_nodes}
+        assert len(rep_racks) == 1
+
+
+def test_preferred_constraint_packs_when_possible(simple1):
+    """Preferred rack: pods pack into one rack when it fits, with score 1.0."""
+    topo = topo3()
+    ds = expand_podcliqueset(simple1, topo)
+    pods = {p.name: p for p in ds.pods}
+    snap = build_snapshot(rack_nodes(4, 4, cpu=1.0), topo)
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    # Inject a preferred-only constraint at gang level (operator may emit
+    # preferred via future defaulting; IR supports it, podgang.go:108-116).
+    from grove_tpu.api import IRTopologyConstraint, TopologyPackConstraint
+
+    for gang in ds.podgangs:
+        gang.spec.topology_constraint = IRTopologyConstraint(
+            pack_constraint=TopologyPackConstraint(preferred="topology.kubernetes.io/rack")
+        )
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    scores = dict(zip(decode.gang_names, np.asarray(result.placement_score)))
+    bindings = decode_assignments(result, decode, snap)
+    for gang_name, b in bindings.items():
+        gang_racks = {snap.domain_of_node(n, TopologyDomain.RACK) for n in b.values()}
+        assert len(gang_racks) == 1
+        assert scores[gang_name] == pytest.approx(1.0)
+
+
+def test_preferred_constraint_degrades_not_rejects(simple1):
+    """When no rack fits, a preferred constraint degrades the score but the
+    gang still schedules (podgang.go:108-116 'not binding')."""
+    topo = topo3()
+    ds = expand_podcliqueset(simple1, topo)
+    pods = {p.name: p for p in ds.pods}
+    snap = build_snapshot(rack_nodes(4, 1, cpu=0.05), topo)
+    from grove_tpu.api import IRTopologyConstraint, TopologyPackConstraint
+
+    base = [g for g in ds.podgangs if not g.is_scaled]
+    for gang in base:
+        gang.spec.topology_constraint = IRTopologyConstraint(
+            pack_constraint=TopologyPackConstraint(preferred="topology.kubernetes.io/rack")
+        )
+    batch, decode = encode_gangs(base, pods, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    score = float(np.asarray(result.placement_score)[0])
+    assert 0.0 < score < 1.0
+
+
+def test_required_and_preferred_combined(simple1):
+    """Required zone + preferred rack: hard zone confinement, best-effort rack."""
+    topo = topo3()
+    ds = expand_podcliqueset(simple1, topo)
+    pods = {p.name: p for p in ds.pods}
+    # 2 zones × 2 racks/zone × 4 nodes; zone fits, single rack fits too.
+    snap = build_snapshot(rack_nodes(4, 4, cpu=1.0, zones=2), topo)
+    from grove_tpu.api import IRTopologyConstraint, TopologyPackConstraint
+
+    for gang in ds.podgangs:
+        gang.spec.topology_constraint = IRTopologyConstraint(
+            pack_constraint=TopologyPackConstraint(
+                required="topology.kubernetes.io/zone",
+                preferred="topology.kubernetes.io/rack",
+            )
+        )
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, decode, snap)
+    for b in bindings.values():
+        zones = {snap.domain_of_node(n, TopologyDomain.ZONE) for n in b.values()}
+        assert len(zones) == 1
+    scores = np.asarray(result.placement_score)
+    np.testing.assert_allclose(scores, 1.0, atol=1e-6)
+
+
+def test_clique_level_constraint(simple1):
+    """PCLQ-level constraint packs just that clique's pods."""
+    topo = topo3()
+    simple1.clique_template("frontend").topology_constraint = TopologyConstraint(
+        pack_domain=TopologyDomain.RACK
+    )
+    ds = expand_podcliqueset(simple1, topo)
+    pods = {p.name: p for p in ds.pods}
+    snap = build_snapshot(rack_nodes(4, 1, cpu=0.05), topo)
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, decode, snap)
+    frontend_nodes = [
+        node for pod, node in bindings["simple1-0"].items() if pod.startswith("simple1-0-frontend")
+    ]
+    assert len({snap.domain_of_node(n, TopologyDomain.RACK) for n in frontend_nodes}) == 1
