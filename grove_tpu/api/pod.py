@@ -47,6 +47,10 @@ class Pod:
     deletion_timestamp: Optional[float] = None
     started_at: Optional[float] = None
     ready: bool = False
+    # Container terminated erroneously and keeps restarting: the pod stays
+    # bound and active (restartPolicy Always) but is neither ready nor
+    # "starting" (utils/kubernetes/pod.go:95-112 HasPodTerminatedErroneously).
+    crashlooping: bool = False
 
     @property
     def is_gated(self) -> bool:

@@ -1,0 +1,532 @@
+"""The reconcile cascade: PCS → cliques/PCSGs/gangs/pods → solver → bindings.
+
+Rebuilds the reference's three controllers (SURVEY.md §3.3) as one pass-based
+engine over the in-memory store:
+
+  reconcile(now)
+  ├─ sync_workloads      — expansion diff: create/delete cliques, PCSGs, gangs,
+  │                        pods (stable index fill, deletion sort), refresh
+  │                        PodGroup pod references
+  ├─ rolling_updates     — generation-hash change → one PCS replica at a time,
+  │                        priority: unscheduled → breached → ordinal
+  │                        (podcliquesetreplica/rollingupdate.go:39-223)
+  ├─ solve_pending       — encode gangs with gated pods → TPU solver → bind
+  │                        admitted gangs' pods (replaces gate-removal + KAI
+  │                        bind, podclique/components/pod/syncflow.go:242-301)
+  ├─ update_statuses     — clique/PCSG/gang/PCS condition rollup (status.py)
+  └─ gang_termination    — MinAvailableBreached > TerminationDelay ⇒ delete the
+                           PCS replica's cliques; recreated next pass
+                           (gangterminate.go:67-213)
+
+Incremental re-solve: a partially scheduled gang is encoded with only its
+gated pods and each group's floor reduced by already-bound pods, against a
+snapshot that accounts existing bindings — no global re-solve (SURVEY.md §7
+"incrementality").
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+
+from grove_tpu.api import constants, naming
+from grove_tpu.api.pod import Pod, PodPhase
+from grove_tpu.api.podgang import NamespacedName, PodGang
+from grove_tpu.api.types import (
+    ClusterTopology,
+    PodCliqueSet,
+    PodCliqueSetRollingUpdateProgress,
+)
+from grove_tpu.orchestrator import expansion as exp
+from grove_tpu.orchestrator.status import (
+    clique_breached_since,
+    compute_pcs_status,
+    compute_pcsg_status,
+    compute_podclique_status,
+    compute_podgang_status,
+    pcsg_breached_since,
+)
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.solver.core import SolverParams, decode_assignments, solve
+from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.state.cluster import build_snapshot
+
+
+@dataclass
+class GroveController:
+    cluster: Cluster
+    topology: ClusterTopology
+    solver_params: SolverParams = field(default_factory=SolverParams)
+    tas_enabled: bool = True
+    rng: random.Random = field(default_factory=lambda: random.Random(0))
+    # priority class name -> numeric priority (PriorityClassName ordering)
+    priority_classes: dict[str, int] = field(default_factory=dict)
+    # bucketing knobs (recompilation control; see solver/encode.py)
+    max_groups: int | None = None
+    max_sets: int | None = None
+    max_pods: int | None = None
+
+    # --- top-level pass ----------------------------------------------------------
+
+    def reconcile(self, now: float) -> None:
+        for pcs in list(self.cluster.podcliquesets.values()):
+            self.sync_workload(pcs, now)
+        self.rolling_updates(now)
+        self.solve_pending(now)
+        self.update_statuses(now)
+        self.gang_termination(now)
+
+    # --- workload sync (PCS controller analog) -----------------------------------
+
+    def sync_workload(self, pcs: PodCliqueSet, now: float) -> None:
+        c = self.cluster
+        pcsg_overrides = {
+            k: v
+            for k, v in c.scale_overrides.items()
+            if k in {naming.scaling_group_name(pcs.metadata.name, i, cfg.name)
+                     for i in range(pcs.spec.replicas)
+                     for cfg in pcs.spec.template.pod_clique_scaling_group_configs}
+        }
+        pclq_overrides = dict(c.scale_overrides)
+        desired = exp.expand_podcliqueset(
+            pcs,
+            self.topology,
+            tas_enabled=self.tas_enabled,
+            pcsg_replica_overrides=pcsg_overrides,
+            pclq_replica_overrides=pclq_overrides,
+            rng=self.rng,
+        )
+
+        c.headless_services.update(desired.headless_services)
+
+        desired_clique_names = {x.metadata.name for x in desired.podcliques}
+        desired_pcsg_names = {x.metadata.name for x in desired.scaling_groups}
+        desired_gang_names = {x.name for x in desired.podgangs}
+
+        # Upsert scaling groups & cliques (spec refresh preserves status).
+        for pcsg in desired.scaling_groups:
+            existing = c.scaling_groups.get(pcsg.metadata.name)
+            if existing is None:
+                c.scaling_groups[pcsg.metadata.name] = pcsg
+            else:
+                existing.spec = pcsg.spec
+        for clique in desired.podcliques:
+            existing = c.podcliques.get(clique.metadata.name)
+            if existing is None:
+                c.podcliques[clique.metadata.name] = clique
+                clique.status.current_pod_template_hash = exp.compute_pod_template_hash(
+                    pcs.clique_template(clique.template_name),
+                    pcs.spec.template.priority_class_name,
+                )
+            else:
+                existing.spec = clique.spec
+                existing.pod_gang_name = clique.pod_gang_name
+
+        # Delete objects from scale-down / replica removal (cascades pods).
+        for name in [n for n in c.podcliques if c.podcliques[n].pcs_name == pcs.metadata.name]:
+            if name not in desired_clique_names:
+                c.delete_clique_cascade(name)
+        for name in [n for n in c.scaling_groups if c.scaling_groups[n].pcs_name == pcs.metadata.name]:
+            if name not in desired_pcsg_names:
+                del c.scaling_groups[name]
+        for name in [g.name for g in c.gangs_of_pcs(pcs.metadata.name)]:
+            if name not in desired_gang_names:
+                del c.podgangs[name]
+
+        # Upsert gangs (pod references are refreshed below).
+        for gang in desired.podgangs:
+            existing = c.podgangs.get(gang.name)
+            if existing is None:
+                c.podgangs[gang.name] = gang
+            else:
+                existing.spec.topology_constraint = gang.spec.topology_constraint
+                existing.spec.topology_constraint_group_configs = (
+                    gang.spec.topology_constraint_group_configs
+                )
+                existing.spec.pod_groups = _merge_pod_groups(
+                    existing.spec.pod_groups, gang.spec.pod_groups
+                )
+
+        # Pod diff per clique: stable indices, gated creation, deletion sort.
+        gen_hash = exp.compute_generation_hash(pcs)
+        for clique in desired.podcliques:
+            live = c.podcliques[clique.metadata.name]
+            self._sync_clique_pods(pcs, live, gen_hash, now)
+
+        # Refresh PodGroup pod references from actual pods (sorted by index).
+        for gang in c.gangs_of_pcs(pcs.metadata.name):
+            for grp in gang.spec.pod_groups:
+                pods = sorted(
+                    (p for p in c.pods_of_clique(grp.name) if p.is_active),
+                    key=lambda p: p.pod_index,
+                )
+                grp.pod_references = [NamespacedName(pcs.metadata.namespace, p.name) for p in pods]
+
+    def _sync_clique_pods(self, pcs: PodCliqueSet, clique, gen_hash: str, now: float) -> None:
+        c = self.cluster
+        fqn = clique.metadata.name
+        # GC terminal pods so replacements are created (failed pods don't count
+        # toward replicas; the reference's pod component deletes them too).
+        for pod in c.pods_of_clique(fqn):
+            if not pod.is_active and pod.deletion_timestamp is None:
+                self._release_pod(pod, now, reason=f"terminal phase {pod.phase.value}")
+        active = [p for p in c.pods_of_clique(fqn) if p.is_active]
+        want = clique.spec.replicas
+        diff = want - len(active)
+        clique_tmpl = pcs.clique_template(clique.template_name)
+        if diff > 0:
+            # Fill the lowest free hostname indices (internal/index/tracker.go:32-43).
+            used = {p.pod_index for p in active}
+            svc = naming.headless_service_name(pcs.metadata.name, clique.pcs_replica_index)
+            new_indices = []
+            i = 0
+            while len(new_indices) < diff:
+                if i not in used:
+                    new_indices.append(i)
+                i += 1
+            pods = exp._build_pods(
+                pcs,
+                clique,
+                clique_tmpl,
+                svc,
+                clique.pcs_replica_index,
+                gen_hash,
+                self.rng,
+                tmpl_hash=exp.compute_pod_template_hash(
+                    clique_tmpl, pcs.spec.template.priority_class_name
+                ),
+                pcsg_fqn=clique.pcsg_name,
+                pcsg_replica=clique.pcsg_replica_index,
+                base_podgang_name=(
+                    c.podgangs[clique.pod_gang_name].base_podgang_name
+                    if clique.pod_gang_name in c.podgangs
+                    else None
+                ),
+            )
+            # _build_pods makes spec.replicas pods indexed 0..n-1; keep only the
+            # ones matching the free indices, re-pointing their index/hostname.
+            for pod, idx in zip(pods[:diff], new_indices):
+                pod.pod_index = idx
+                pod.spec.hostname = naming.pod_hostname(fqn, idx)
+                pod.name = naming.pod_name(fqn, self.rng)
+                pod.env[constants.ENV_PCLQ_POD_INDEX] = str(idx)
+                pod.labels[constants.LABEL_POD_INDEX] = str(idx)
+                c.pods[pod.name] = pod
+                c.record_event(now, fqn, f"created pod {pod.name} (index {idx})")
+        elif diff < 0:
+            # Deletion sort: unscheduled first, then not-ready, then highest
+            # index (podclique/components/pod/deletionsort.go).
+            victims = sorted(
+                active,
+                key=lambda p: (p.is_scheduled, p.ready, -p.pod_index),
+            )[: -diff]
+            for pod in victims:
+                self._release_pod(pod, now, reason="scale-down")
+
+    def _release_pod(self, pod: Pod, now: float, reason: str) -> None:
+        self.cluster.delete_pod(pod.name)
+        self.cluster.record_event(now, pod.pclq_fqn, f"deleted pod {pod.name} ({reason})")
+
+    # --- solver integration (scheduler-backend analog) ---------------------------
+
+    def solve_pending(self, now: float) -> int:
+        """Encode gangs with gated pods, run the solver, bind admitted pods.
+
+        Returns the number of newly admitted gangs."""
+        c = self.cluster
+        pending: list[PodGang] = []
+        for gang in c.podgangs.values():
+            pods = [p for p in c.pods_of_gang(gang.name) if p.is_active]
+            if pods and any(p.is_gated for p in pods):
+                pending.append(gang)
+        if not pending:
+            return 0
+
+        def prio(g: PodGang) -> int:
+            return self.priority_classes.get(g.spec.priority_class_name, 0)
+
+        scheduled_names = {
+            g.name for g in c.podgangs.values() if g.is_base_gang_scheduled() and g.spec.pod_groups
+        }
+        pending.sort(key=lambda g: (-prio(g), g.is_scaled, g.scaled_index, g.name))
+
+        # Partial gangs: encode only gated pods; floors shrink by bound pods.
+        sub_gangs: list[PodGang] = []
+        for gang in pending:
+            sub = PodGang(
+                name=gang.name,
+                namespace=gang.namespace,
+                pcs_name=gang.pcs_name,
+                pcs_replica_index=gang.pcs_replica_index,
+                base_podgang_name=gang.base_podgang_name,
+                scaled_index=gang.scaled_index,
+            )
+            sub.spec.topology_constraint = gang.spec.topology_constraint
+            sub.spec.priority_class_name = gang.spec.priority_class_name
+            group_names_with_gated = set()
+            for grp in gang.spec.pod_groups:
+                pods = [p for p in c.pods_of_clique(grp.name) if p.is_active]
+                gated = [p for p in pods if p.is_gated]
+                bound = sum(1 for p in pods if p.is_scheduled)
+                if not gated:
+                    continue
+                import copy as _copy
+
+                sub_grp = _copy.copy(grp)
+                sub_grp.pod_references = [
+                    NamespacedName(gang.namespace, p.name)
+                    for p in sorted(gated, key=lambda p: p.pod_index)
+                ]
+                sub_grp.min_replicas = max(0, grp.min_replicas - bound)
+                sub.spec.pod_groups.append(sub_grp)
+                group_names_with_gated.add(grp.name)
+            sub.spec.topology_constraint_group_configs = [
+                gc
+                for gc in gang.spec.topology_constraint_group_configs
+                if any(n in group_names_with_gated for n in gc.pod_group_names)
+            ]
+            sub_gangs.append(sub)
+
+        bound_pods = [p for p in c.pods.values() if p.is_scheduled and p.is_active]
+        snapshot = build_snapshot(
+            list(c.nodes.values()), self.topology, bound_pods=bound_pods
+        )
+        pods_by_name = dict(c.pods)
+        batch, decode = encode_gangs(
+            sub_gangs,
+            pods_by_name,
+            snapshot,
+            max_groups=self.max_groups,
+            max_sets=self.max_sets,
+            max_pods=self.max_pods,
+            scheduled_gangs=scheduled_names,
+        )
+        result = solve(snapshot, batch, self.solver_params)
+        bindings = decode_assignments(result, decode, snapshot)
+
+        admitted = 0
+        import numpy as np
+
+        scores = dict(zip(decode.gang_names, np.asarray(result.placement_score)))
+        for gang_name, pod_bindings in bindings.items():
+            gang = c.podgangs[gang_name]
+            for pod_name, node_name in pod_bindings.items():
+                pod = c.pods.get(pod_name)
+                if pod is None:
+                    continue
+                pod.node_name = node_name
+                pod.scheduling_gates = []
+                pod.phase = PodPhase.PENDING
+            gang.status.placement_score = float(scores.get(gang_name, 0.0))
+            c.record_event(now, gang_name, f"gang admitted ({len(pod_bindings)} pods bound)")
+            admitted += 1
+        return admitted
+
+    # --- statuses ----------------------------------------------------------------
+
+    def update_statuses(self, now: float) -> None:
+        c = self.cluster
+        updating_pcs = {
+            name
+            for name, pcs in c.podcliquesets.items()
+            if pcs.status.rolling_update_progress is not None
+            and pcs.status.rolling_update_progress.update_ended_at is None
+        }
+        for clique in c.podcliques.values():
+            compute_podclique_status(c, clique, now, updating=clique.pcs_name in updating_pcs)
+        for pcsg in c.scaling_groups.values():
+            compute_pcsg_status(c, pcsg, now, updating=pcsg.pcs_name in updating_pcs)
+        for gang in c.podgangs.values():
+            compute_podgang_status(c, gang, now)
+        for pcs in c.podcliquesets.values():
+            compute_pcs_status(c, pcs, now)
+
+    # --- gang termination (gangterminate.go) -------------------------------------
+
+    def gang_termination(self, now: float) -> list[tuple[str, int]]:
+        """Delete PCS replicas breached beyond TerminationDelay. Returns them."""
+        c = self.cluster
+        terminated: list[tuple[str, int]] = []
+        for pcs in c.podcliquesets.values():
+            delay = pcs.spec.template.termination_delay_seconds
+            for i in range(pcs.spec.replicas):
+                since_values = []
+                for clique in c.cliques_of_pcs_replica(pcs.metadata.name, i):
+                    if clique.pcsg_name is None:
+                        t = clique_breached_since(clique)
+                        if t is not None:
+                            since_values.append(t)
+                for pcsg in c.pcsgs_of_pcs(pcs.metadata.name):
+                    if pcsg.pcs_replica_index == i:
+                        t = pcsg_breached_since(pcsg)
+                        if t is not None:
+                            since_values.append(t)
+                if not since_values:
+                    continue
+                earliest = min(since_values)
+                if now - earliest > delay:
+                    for clique in list(c.cliques_of_pcs_replica(pcs.metadata.name, i)):
+                        c.delete_clique_cascade(clique.metadata.name)
+                    c.record_event(
+                        now,
+                        pcs.metadata.name,
+                        f"gang-terminated replica {i} (breached {now - earliest:.0f}s "
+                        f"> terminationDelay {delay:.0f}s)",
+                    )
+                    terminated.append((pcs.metadata.name, i))
+        return terminated
+
+    # --- rolling updates (rollingupdate.go) --------------------------------------
+
+    def rolling_updates(self, now: float) -> None:
+        c = self.cluster
+        for pcs in c.podcliquesets.values():
+            new_hash = exp.compute_generation_hash(pcs)
+            st = pcs.status
+            if st.current_generation_hash is None:
+                st.current_generation_hash = new_hash
+                continue
+            if new_hash != st.current_generation_hash and (
+                st.rolling_update_progress is None
+                or st.rolling_update_progress.update_ended_at is not None
+                or st.updated_generation_hash != new_hash
+            ):
+                st.rolling_update_progress = PodCliqueSetRollingUpdateProgress(
+                    update_started_at=now
+                )
+                st.updated_generation_hash = new_hash
+                c.record_event(now, pcs.metadata.name, f"rolling update started -> {new_hash}")
+            if st.rolling_update_progress is None or st.rolling_update_progress.update_ended_at:
+                continue
+            self._advance_rolling_update(pcs, now)
+
+    def _advance_rolling_update(self, pcs: PodCliqueSet, now: float) -> None:
+        c = self.cluster
+        st = pcs.status
+        prog = st.rolling_update_progress
+        new_hash = st.updated_generation_hash
+
+        # Staleness is per-clique pod-template hash: only cliques whose own
+        # template changed roll their pods (reconcilestatus.go:91-112 keys
+        # completion on CurrentPodTemplateHash, not the set-level hash).
+        def desired_hash(clique) -> str:
+            return exp.compute_pod_template_hash(
+                pcs.clique_template(clique.template_name),
+                pcs.spec.template.priority_class_name,
+            )
+
+        def stale_pods(i: int) -> list[Pod]:
+            out = []
+            for clique in c.cliques_of_pcs_replica(pcs.metadata.name, i):
+                want = desired_hash(clique)
+                out.extend(
+                    p
+                    for p in c.pods_of_clique(clique.metadata.name)
+                    if p.is_active and p.pod_template_hash != want
+                )
+            return out
+
+        def replica_updated(i: int) -> bool:
+            return not stale_pods(i)
+
+        # Replica order: no-scheduled-pods first, then breached, then ordinal
+        # (rollingupdate.go:196-223).
+        def order_key(i: int) -> tuple:
+            pods = [
+                p
+                for clique in c.cliques_of_pcs_replica(pcs.metadata.name, i)
+                for p in c.pods_of_clique(clique.metadata.name)
+                if p.is_active
+            ]
+            scheduled = sum(1 for p in pods if p.is_scheduled)
+            breached = any(
+                clique_breached_since(cl) is not None
+                for cl in c.cliques_of_pcs_replica(pcs.metadata.name, i)
+            )
+            return (scheduled > 0, not breached, i)
+
+        remaining = [
+            i
+            for i in range(pcs.spec.replicas)
+            if i not in prog.updated_replica_indices and not replica_updated(i)
+        ]
+        # Mark replicas that became up-to-date.
+        for i in range(pcs.spec.replicas):
+            if i not in prog.updated_replica_indices and replica_updated(i):
+                prog.updated_replica_indices.append(i)
+        remaining = [i for i in remaining if i not in prog.updated_replica_indices]
+        if not remaining:
+            prog.update_ended_at = now
+            prog.current_replica_index = None
+            st.current_generation_hash = new_hash
+            for clique in c.cliques_of_pcs(pcs.metadata.name):
+                clique.status.current_pcs_generation_hash = new_hash
+            c.record_event(now, pcs.metadata.name, f"rolling update complete -> {new_hash}")
+            return
+
+        current = min(remaining, key=order_key)
+        prog.current_replica_index = current
+        # Replace stale pods of the current replica: unscheduled/not-ready pods
+        # all at once, ready pods one at a time (scalinggroup.go:117-120).
+        stale = stale_pods(current)
+        ready_deleted = False
+        for pod in stale:
+            if pod.ready:
+                if ready_deleted:
+                    continue
+                ready_deleted = True
+            self._release_pod(pod, now, reason="rolling-update")
+
+    # --- autoscaling (hpa component analog) --------------------------------------
+
+    def autoscale(self, metrics: dict[str, float], now: float) -> None:
+        """Evaluate HPA targets. `metrics` maps target FQN (standalone clique or
+        PCSG) -> current average metric utilization, normalized so that 1.0 ==
+        the target value (classic HPA ratio scaling)."""
+        c = self.cluster
+        for pcs in c.podcliquesets.values():
+            for i in range(pcs.spec.replicas):
+                for clique_tmpl in pcs.standalone_clique_templates():
+                    sc = clique_tmpl.spec.scale_config
+                    if sc is None:
+                        continue
+                    fqn = naming.podclique_name(pcs.metadata.name, i, clique_tmpl.name)
+                    if fqn not in metrics:
+                        continue
+                    current = c.scale_overrides.get(fqn, clique_tmpl.spec.replicas)
+                    desired = math.ceil(current * metrics[fqn])
+                    lo = sc.min_replicas if sc.min_replicas is not None else clique_tmpl.spec.replicas
+                    desired = max(lo, min(sc.max_replicas, desired))
+                    if desired != current:
+                        c.scale_overrides[fqn] = desired
+                        c.record_event(now, fqn, f"HPA scaled {current} -> {desired}")
+                for cfg in pcs.spec.template.pod_clique_scaling_group_configs:
+                    if cfg.scale_config is None:
+                        continue
+                    fqn = naming.scaling_group_name(pcs.metadata.name, i, cfg.name)
+                    if fqn not in metrics:
+                        continue
+                    current = c.scale_overrides.get(fqn, cfg.replicas)
+                    desired = math.ceil(current * metrics[fqn])
+                    lo = cfg.scale_config.min_replicas if cfg.scale_config.min_replicas is not None else cfg.replicas
+                    desired = max(lo, min(cfg.scale_config.max_replicas, desired))
+                    if desired != current:
+                        c.scale_overrides[fqn] = desired
+                        c.record_event(now, fqn, f"HPA scaled {current} -> {desired}")
+
+
+def _merge_pod_groups(existing, desired):
+    """Keep existing group objects (with references) for groups that persist,
+    adopt new ones, drop removed ones — preserving desired order."""
+    by_name = {g.name: g for g in existing}
+    out = []
+    for g in desired:
+        if g.name in by_name:
+            kept = by_name[g.name]
+            kept.min_replicas = g.min_replicas
+            kept.topology_constraint = g.topology_constraint
+            out.append(kept)
+        else:
+            out.append(g)
+    return out

@@ -1,0 +1,232 @@
+"""Status & condition computation for cliques, scaling groups, and sets.
+
+Parity targets:
+  - MinAvailableBreached / PodCliqueScheduled semantics
+    (podclique/reconcilestatus.go:170-226): scheduled < minAvailable ⇒ NOT
+    breached (pre-schedule flap guard); ready-or-starting < minAvailable ⇒
+    breached; update in progress ⇒ Unknown.
+  - PCSG availability rollup (podcliquescalinggroup/reconcilestatus.go):
+    replica scheduled = every member clique scheduled; replica available =
+    every member clique not breached; MinAvailableBreached when
+    available < spec.minAvailable (same pre-schedule guard).
+  - PCS rollup incl. AvailableReplicas and per-gang phases
+    (podcliqueset/reconcilestatus.go).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from grove_tpu.api import constants
+from grove_tpu.api.pod import Pod, PodPhase
+from grove_tpu.api.podgang import PodGang, PodGangPhase
+from grove_tpu.api.types import (
+    Condition,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodGangStatusSummary,
+    set_condition,
+)
+from grove_tpu.orchestrator.store import Cluster
+
+
+def is_starting(pod: Pod) -> bool:
+    """Scheduled, alive, not yet ready, not crash-looping — counts toward the
+    availability grace (utils/kubernetes/pod.go pod categorization: a pod whose
+    container terminated erroneously is NOT starting)."""
+    return pod.is_scheduled and pod.is_active and not pod.ready and not pod.crashlooping
+
+
+def compute_podclique_status(
+    cluster: Cluster, clique: PodClique, now: float, updating: bool = False
+) -> None:
+    """Recompute clique status + conditions in place."""
+    pods = [p for p in cluster.pods_of_clique(clique.metadata.name) if p.is_active]
+    scheduled = sum(1 for p in pods if p.is_scheduled)
+    ready = sum(1 for p in pods if p.ready)
+    ready_or_starting = sum(1 for p in pods if p.ready or is_starting(p))
+    min_available = clique.min_available
+
+    st = clique.status
+    st.replicas = len(pods)
+    st.scheduled_replicas = scheduled
+    st.ready_replicas = ready
+    st.updated_replicas = sum(
+        1
+        for p in pods
+        if p.pod_template_hash and p.pod_template_hash == st.current_pod_template_hash
+    )
+
+    sched_cond = Condition(
+        type=constants.CONDITION_POD_CLIQUE_SCHEDULED,
+        status="True" if scheduled >= min_available else "False",
+        reason="SufficientScheduledPods" if scheduled >= min_available else "InsufficientScheduledPods",
+    )
+    st.conditions = set_condition(st.conditions, sched_cond, now)
+
+    if updating:
+        breached_status, reason = "Unknown", "UpdateInProgress"
+    elif scheduled < min_available:
+        # Not yet scheduled: never breached (avoids pre-schedule flapping,
+        # reconcilestatus.go:193-203).
+        breached_status, reason = "False", "WaitingForScheduling"
+    elif ready_or_starting < min_available:
+        breached_status, reason = "True", "InsufficientReadyOrStartingPods"
+    else:
+        breached_status, reason = "False", "SufficientAvailablePods"
+    st.conditions = set_condition(
+        st.conditions,
+        Condition(type=constants.CONDITION_MIN_AVAILABLE_BREACHED, status=breached_status, reason=reason),
+        now,
+    )
+
+
+def clique_breached(clique: PodClique) -> bool:
+    for c in clique.status.conditions:
+        if c.type == constants.CONDITION_MIN_AVAILABLE_BREACHED:
+            return c.status == "True"
+    return False
+
+
+def clique_breached_since(clique: PodClique) -> float | None:
+    for c in clique.status.conditions:
+        if c.type == constants.CONDITION_MIN_AVAILABLE_BREACHED and c.status == "True":
+            return c.last_transition_time
+    return None
+
+
+def compute_pcsg_status(
+    cluster: Cluster, pcsg: PodCliqueScalingGroup, now: float, updating: bool = False
+) -> None:
+    """Aggregate member-clique state per PCSG replica."""
+    members = cluster.cliques_of_pcsg(pcsg.metadata.name)
+    by_replica: dict[int, list[PodClique]] = defaultdict(list)
+    for c in members:
+        if c.pcsg_replica_index is not None:
+            by_replica[c.pcsg_replica_index].append(c)
+
+    expected_member_count = len(pcsg.spec.clique_names)
+    scheduled = available = 0
+    for _, cliques in sorted(by_replica.items()):
+        if len(cliques) < expected_member_count:
+            continue
+        if all(
+            any(
+                c2.type == constants.CONDITION_POD_CLIQUE_SCHEDULED and c2.status == "True"
+                for c2 in c.status.conditions
+            )
+            for c in cliques
+        ):
+            scheduled += 1
+            if all(not clique_breached(c) for c in cliques):
+                available += 1
+
+    st = pcsg.status
+    st.replicas = pcsg.spec.replicas
+    st.scheduled_replicas = scheduled
+    st.available_replicas = available
+
+    min_available = pcsg.spec.min_available
+    if updating:
+        status, reason = "Unknown", "UpdateInProgress"
+    elif scheduled < min_available:
+        status, reason = "False", "WaitingForScheduling"
+    elif available < min_available:
+        status, reason = "True", "InsufficientAvailableReplicas"
+    else:
+        status, reason = "False", "SufficientAvailableReplicas"
+    st.conditions = set_condition(
+        st.conditions,
+        Condition(type=constants.CONDITION_MIN_AVAILABLE_BREACHED, status=status, reason=reason),
+        now,
+    )
+
+
+def pcsg_breached(pcsg: PodCliqueScalingGroup) -> bool:
+    for c in pcsg.status.conditions:
+        if c.type == constants.CONDITION_MIN_AVAILABLE_BREACHED:
+            return c.status == "True"
+    return False
+
+
+def pcsg_breached_since(pcsg: PodCliqueScalingGroup) -> float | None:
+    for c in pcsg.status.conditions:
+        if c.type == constants.CONDITION_MIN_AVAILABLE_BREACHED and c.status == "True":
+            return c.last_transition_time
+    return None
+
+
+def compute_podgang_status(cluster: Cluster, gang: PodGang, now: float) -> None:
+    """Phase + per-group scheduled counts (scheduler podgang.go:143-168)."""
+    pods = [p for p in cluster.pods_of_gang(gang.name) if p.is_active]
+    by_group: dict[str, list[Pod]] = defaultdict(list)
+    for p in pods:
+        by_group[p.pclq_fqn].append(p)
+
+    gang.status.scheduled_replicas = {
+        grp.name: sum(1 for p in by_group.get(grp.name, []) if p.is_scheduled)
+        for grp in gang.spec.pod_groups
+    }
+    scheduled_ok = gang.is_base_gang_scheduled() and bool(gang.spec.pod_groups)
+    all_ready = scheduled_ok and all(
+        sum(1 for p in by_group.get(grp.name, []) if p.ready) >= grp.min_replicas
+        for grp in gang.spec.pod_groups
+    )
+    any_running = any(p.phase == PodPhase.RUNNING for p in pods)
+    if all_ready:
+        gang.status.phase = PodGangPhase.RUNNING
+    elif scheduled_ok and any_running:
+        gang.status.phase = PodGangPhase.STARTING
+    elif scheduled_ok:
+        gang.status.phase = PodGangPhase.STARTING
+    else:
+        gang.status.phase = PodGangPhase.PENDING
+    gang.status.conditions = set_condition(
+        gang.status.conditions,
+        Condition(
+            type=constants.PODGANG_CONDITION_SCHEDULED,
+            status="True" if scheduled_ok else "False",
+        ),
+        now,
+    )
+    gang.status.conditions = set_condition(
+        gang.status.conditions,
+        Condition(
+            type=constants.PODGANG_CONDITION_READY,
+            status="True" if all_ready else "False",
+        ),
+        now,
+    )
+
+
+def compute_pcs_status(cluster: Cluster, pcs: PodCliqueSet, now: float) -> None:
+    """Roll cliques/PCSGs/gangs up into the PCS status."""
+    name = pcs.metadata.name
+    st = pcs.status
+    st.replicas = pcs.spec.replicas
+    available = 0
+    for i in range(pcs.spec.replicas):
+        cliques = cluster.cliques_of_pcs_replica(name, i)
+        pcsgs = [g for g in cluster.pcsgs_of_pcs(name) if g.pcs_replica_index == i]
+        standalone = [c for c in cliques if c.pcsg_name is None]
+        if not cliques:
+            continue
+        replica_ok = all(not clique_breached(c) for c in standalone) and all(
+            not pcsg_breached(g) for g in pcsgs
+        )
+        scheduled = all(
+            any(
+                c2.type == constants.CONDITION_POD_CLIQUE_SCHEDULED and c2.status == "True"
+                for c2 in c.status.conditions
+            )
+            for c in standalone
+        )
+        if replica_ok and scheduled:
+            available += 1
+    st.available_replicas = available
+    st.pod_gang_statuses = [
+        PodGangStatusSummary(name=g.name, phase=g.status.phase.value, conditions=list(g.status.conditions))
+        for g in sorted(cluster.gangs_of_pcs(name), key=lambda g: g.name)
+    ]
+    st.observed_generation = pcs.metadata.generation

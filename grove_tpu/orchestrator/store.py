@@ -1,0 +1,102 @@
+"""In-memory object store — the apiserver/informer-cache analog.
+
+The reference's controllers read and write CRs through kube-apiserver watch
+streams (SURVEY.md §5.8). Here the store is a plain indexed object graph the
+controller reconciles against and the simulator mutates; a live-cluster driver
+can populate the same store from real informers.
+
+Unlike informer caches, reads here are strongly consistent — so the
+reference's ExpectationsStore machinery (internal/expect/expectations.go) is
+unnecessary by construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from grove_tpu.api.pod import Pod
+from grove_tpu.api.podgang import PodGang
+from grove_tpu.api.types import (
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+)
+from grove_tpu.state.cluster import Node
+
+
+@dataclass
+class Cluster:
+    """All objects, indexed by name. One namespace (multiplex outside if needed)."""
+
+    nodes: dict[str, Node] = field(default_factory=dict)
+    podcliquesets: dict[str, PodCliqueSet] = field(default_factory=dict)
+    podcliques: dict[str, PodClique] = field(default_factory=dict)
+    scaling_groups: dict[str, PodCliqueScalingGroup] = field(default_factory=dict)
+    podgangs: dict[str, PodGang] = field(default_factory=dict)
+    pods: dict[str, Pod] = field(default_factory=dict)
+    headless_services: set[str] = field(default_factory=set)
+    # HPA scale subresource values, keyed by target FQN (pclq or pcsg).
+    scale_overrides: dict[str, int] = field(default_factory=dict)
+    events: list[tuple[float, str, str]] = field(default_factory=list)  # (time, obj, msg)
+
+    # --- queries (componentutils analogs) ---------------------------------------
+
+    def pods_of_clique(self, pclq_fqn: str) -> list[Pod]:
+        return [p for p in self.pods.values() if p.pclq_fqn == pclq_fqn]
+
+    def pods_of_gang(self, gang_name: str) -> list[Pod]:
+        return [p for p in self.pods.values() if p.podgang_name == gang_name]
+
+    def cliques_of_pcs(self, pcs_name: str) -> list[PodClique]:
+        return [c for c in self.podcliques.values() if c.pcs_name == pcs_name]
+
+    def cliques_of_pcs_replica(self, pcs_name: str, replica: int) -> list[PodClique]:
+        return [
+            c
+            for c in self.podcliques.values()
+            if c.pcs_name == pcs_name and c.pcs_replica_index == replica
+        ]
+
+    def cliques_of_pcsg(self, pcsg_fqn: str) -> list[PodClique]:
+        return [c for c in self.podcliques.values() if c.pcsg_name == pcsg_fqn]
+
+    def pcsgs_of_pcs(self, pcs_name: str) -> list[PodCliqueScalingGroup]:
+        return [g for g in self.scaling_groups.values() if g.pcs_name == pcs_name]
+
+    def gangs_of_pcs(self, pcs_name: str) -> list[PodGang]:
+        return [g for g in self.podgangs.values() if g.pcs_name == pcs_name]
+
+    def record_event(self, now: float, obj: str, msg: str) -> None:
+        self.events.append((now, obj, msg))
+
+    # --- mutations ---------------------------------------------------------------
+
+    def delete_pod(self, name: str) -> Optional[Pod]:
+        return self.pods.pop(name, None)
+
+    def delete_clique_cascade(self, fqn: str) -> None:
+        """Delete a PodClique and its pods (owner-reference cascade)."""
+        self.podcliques.pop(fqn, None)
+        for pod in list(self.pods.values()):
+            if pod.pclq_fqn == fqn:
+                del self.pods[pod.name]
+
+    def delete_pcs_cascade(self, pcs_name: str) -> None:
+        """Finalizer-driven teardown of everything a PCS owns
+        (podcliqueset/reconciledelete.go analog)."""
+        self.podcliquesets.pop(pcs_name, None)
+        for c in [c.metadata.name for c in self.cliques_of_pcs(pcs_name)]:
+            self.delete_clique_cascade(c)
+        for g in [g.metadata.name for g in self.pcsgs_of_pcs(pcs_name)]:
+            self.scaling_groups.pop(g, None)
+        for g in [g.name for g in self.gangs_of_pcs(pcs_name)]:
+            self.podgangs.pop(g, None)
+        for svc in [s for s in self.headless_services if s.startswith(pcs_name + "-")]:
+            self.headless_services.discard(svc)
+        for key in [k for k in self.scale_overrides if k.startswith(pcs_name + "-")]:
+            del self.scale_overrides[key]
+
+
+def active_pods(pods: Iterable[Pod]) -> list[Pod]:
+    return [p for p in pods if p.is_active]

@@ -1,0 +1,3 @@
+"""Synthetic cluster generation + event-driven simulation."""
+
+from grove_tpu.sim.simulator import SimConfig, Simulator  # noqa: F401

@@ -1,0 +1,115 @@
+"""Event-driven cluster simulator — the KWOK/k3d analog (SURVEY.md §4).
+
+Plays the kubelet/runtime role against the in-memory store: bound pods start
+after a configurable delay, become Ready after another, honoring the startup
+ordering gate (the grove-initc analog, orchestrator/startup.py). Fault
+injection mirrors the e2e suite's techniques: fail pods, cordon nodes, kill
+nodes (e2e/setup/k8s_clusters.go:130-244 restarts node containers;
+gang_scheduling_test.go manipulates capacity by cordoning).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from grove_tpu.api.pod import PodPhase
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.startup import may_start
+from grove_tpu.orchestrator.store import Cluster
+
+
+@dataclass
+class SimConfig:
+    start_delay: float = 2.0  # bound -> containers running (image pull etc.)
+    ready_delay: float = 3.0  # running -> Ready probes pass
+
+
+@dataclass
+class Simulator:
+    cluster: Cluster
+    controller: GroveController
+    config: SimConfig = field(default_factory=SimConfig)
+    now: float = 0.0
+    _bound_at: dict[str, float] = field(default_factory=dict)
+    _running_at: dict[str, float] = field(default_factory=dict)
+
+    def step(self, dt: float = 1.0) -> None:
+        """Advance time, run pod lifecycle, then one reconcile pass."""
+        self.now += dt
+        self._lifecycle()
+        self.controller.reconcile(self.now)
+        self._lifecycle()  # let fresh bindings from this pass register
+
+    def run(self, seconds: float, dt: float = 1.0) -> None:
+        steps = int(seconds / dt)
+        for _ in range(steps):
+            self.step(dt)
+
+    def run_until(self, predicate, timeout: float = 300.0, dt: float = 1.0) -> bool:
+        deadline = self.now + timeout
+        while self.now < deadline:
+            self.step(dt)
+            if predicate():
+                return True
+        return False
+
+    # --- pod lifecycle -----------------------------------------------------------
+
+    def _lifecycle(self) -> None:
+        for pod in list(self.cluster.pods.values()):
+            if not pod.is_active:
+                continue
+            if pod.is_scheduled and pod.name not in self._bound_at:
+                self._bound_at[pod.name] = self.now
+            if (
+                pod.is_scheduled
+                and pod.phase == PodPhase.PENDING
+                and self.now - self._bound_at.get(pod.name, self.now) >= self.config.start_delay
+                and may_start(self.cluster, pod)  # initc gate (wait.go:240-275)
+            ):
+                pod.phase = PodPhase.RUNNING
+                pod.started_at = self.now
+                self._running_at[pod.name] = self.now
+            if (
+                pod.phase == PodPhase.RUNNING
+                and not pod.ready
+                and not pod.crashlooping
+                and self.now - self._running_at.get(pod.name, self.now) >= self.config.ready_delay
+            ):
+                pod.ready = True
+
+    # --- fault injection ----------------------------------------------------------
+
+    def fail_pod(self, pod_name: str) -> None:
+        """Hard failure (eviction/OOM-kill of the pod): phase Failed, inactive,
+        replaced by the clique controller."""
+        pod = self.cluster.pods.get(pod_name)
+        if pod is None:
+            return
+        pod.phase = PodPhase.FAILED
+        pod.ready = False
+        self.cluster.record_event(self.now, pod.pclq_fqn, f"pod {pod_name} failed")
+
+    def crash_pod(self, pod_name: str) -> None:
+        """Crash loop: container exits non-zero and restarts forever. The pod
+        stays bound and active but never Ready — the state that drives
+        MinAvailableBreached and eventually gang termination."""
+        pod = self.cluster.pods.get(pod_name)
+        if pod is None:
+            return
+        pod.crashlooping = True
+        pod.ready = False
+        self.cluster.record_event(self.now, pod.pclq_fqn, f"pod {pod_name} crash-looping")
+
+    def cordon(self, node_name: str) -> None:
+        self.cluster.nodes[node_name].schedulable = False
+
+    def uncordon(self, node_name: str) -> None:
+        self.cluster.nodes[node_name].schedulable = True
+
+    def kill_node(self, node_name: str) -> None:
+        """Node dies: cordon + every pod on it fails."""
+        self.cordon(node_name)
+        for pod in self.cluster.pods.values():
+            if pod.node_name == node_name and pod.is_active:
+                self.fail_pod(pod.name)

@@ -30,7 +30,6 @@ f32; the MXU-heavy parts are the [MG,N,R] slot/score tensors).
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -262,7 +261,7 @@ def _place_gang(free, used_carry, gang, *, schedulable, node_domain_id, cap_scal
     return free_out, used_out, assigned, gang_ok, placement_score
 
 
-@functools.partial(jax.jit, static_argnames=("track_gang_locality",))
+@jax.jit
 def solve_batch(
     free0: jax.Array,  # f32 [N, R]
     capacity: jax.Array,  # f32 [N, R]
@@ -270,7 +269,6 @@ def solve_batch(
     node_domain_id: jax.Array,  # i32 [L, N]
     batch: GangBatch,
     params: SolverParams = SolverParams(),
-    track_gang_locality: bool = True,
 ) -> SolveResult:
     """Sequentially commit every gang in the batch (priority order = batch order)."""
     n = free0.shape[0]

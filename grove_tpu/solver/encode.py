@@ -28,7 +28,6 @@ import numpy as np
 
 from grove_tpu.api.pod import Pod
 from grove_tpu.api.podgang import PodGang
-from grove_tpu.api.types import TopologyDomain
 from grove_tpu.state.cluster import ClusterSnapshot, pod_request_vector
 
 
@@ -78,12 +77,6 @@ def _level_index(snapshot: ClusterSnapshot, label_key: str | None) -> int:
         level = snapshot.topology.label_key_for(domain)
         if level == label_key:
             return li
-    # Hostname key is always resolvable through the implied host level.
-    if label_key == "kubernetes.io/hostname":
-        try:
-            return snapshot.level_domains.index(TopologyDomain.HOST)
-        except ValueError:
-            return -1
     return -1
 
 
@@ -110,39 +103,47 @@ def encode_gangs(
         raise ValueError("pad_gangs_to smaller than gang count")
     r = len(snapshot.resource_names)
 
-    def _sets_of(gang: PodGang) -> list[tuple[list[int], int, int]]:
-        """Return (member group indices, req_level, pref_level), broad→narrow."""
+    def _sets_of(gang: PodGang) -> tuple[list[tuple[list[int], int, int]], bool]:
+        """Return ((member group indices, req_level, pref_level) broad→narrow,
+        schedulable). A REQUIRED key that doesn't resolve to a snapshot
+        topology level makes the gang unschedulable — a hard co-location
+        guarantee must never be silently dropped (expansion already nullifies
+        constraints for domains missing from the ClusterTopology; skew between
+        expansion and snapshot is an error, not a waiver)."""
         group_idx = {grp.name: k for k, grp in enumerate(gang.spec.pod_groups)}
         raw: list[tuple[list[int], int, int]] = []
+        unresolved_required = False
+
+        def levels_of(pc) -> tuple[int, int]:
+            nonlocal unresolved_required
+            req = _level_index(snapshot, pc.required)
+            if pc.required is not None and req < 0:
+                unresolved_required = True
+            return req, _level_index(snapshot, pc.preferred)
+
         if gang.spec.topology_constraint and gang.spec.topology_constraint.pack_constraint:
-            pc = gang.spec.topology_constraint.pack_constraint
-            raw.append(
-                (
-                    list(range(len(gang.spec.pod_groups))),
-                    _level_index(snapshot, pc.required),
-                    _level_index(snapshot, pc.preferred),
-                )
-            )
+            req, pref = levels_of(gang.spec.topology_constraint.pack_constraint)
+            raw.append((list(range(len(gang.spec.pod_groups))), req, pref))
         for gc in gang.spec.topology_constraint_group_configs:
             if gc.topology_constraint and gc.topology_constraint.pack_constraint:
-                pc = gc.topology_constraint.pack_constraint
                 members = [group_idx[n] for n in gc.pod_group_names if n in group_idx]
                 if members:
-                    raw.append(
-                        (members, _level_index(snapshot, pc.required), _level_index(snapshot, pc.preferred))
-                    )
+                    req, pref = levels_of(gc.topology_constraint.pack_constraint)
+                    raw.append((members, req, pref))
         for k, grp in enumerate(gang.spec.pod_groups):
             if grp.topology_constraint and grp.topology_constraint.pack_constraint:
-                pc = grp.topology_constraint.pack_constraint
-                raw.append(([k], _level_index(snapshot, pc.required), _level_index(snapshot, pc.preferred)))
-        # Drop sets with neither level resolvable (constraint nullified).
+                req, pref = levels_of(grp.topology_constraint.pack_constraint)
+                raw.append(([k], req, pref))
+        # Drop sets with neither level resolvable.
         raw = [s for s in raw if s[1] >= 0 or s[2] >= 0]
         # Broadest required level first (-1 required sorts last).
         raw.sort(key=lambda s: (s[1] if s[1] >= 0 else 10**6))
-        return raw
+        return raw, not unresolved_required
 
     mg = max_groups or max((len(g.spec.pod_groups) for g in gangs), default=1) or 1
-    all_sets = [_sets_of(g) for g in gangs]
+    sets_and_ok = [_sets_of(g) for g in gangs]
+    all_sets = [s for s, _ in sets_and_ok]
+    sets_resolvable = [ok for _, ok in sets_and_ok]
     ms = max_sets or max((len(s) for s in all_sets), default=1) or 1
     mp = max_pods or max((g.total_pods() for g in gangs), default=1) or 1
 
@@ -173,7 +174,7 @@ def encode_gangs(
         decode.gang_names.append(gang.name)
         pod_names: list[str] = []
         group_names: list[str] = []
-        batch.gang_valid[gi] = True
+        batch.gang_valid[gi] = sets_resolvable[gi]
         if gang.base_podgang_name is not None:
             base_idx = gang_index.get(gang.base_podgang_name, -1)
             if 0 <= base_idx < gi:
@@ -190,8 +191,12 @@ def encode_gangs(
             batch.group_valid[gi, k] = True
             if refs:
                 first = pods_by_name.get(refs[0])
-                if first is not None:
-                    batch.group_req[gi, k] = pod_request_vector(first, snapshot.resource_names)
+                if first is None:
+                    raise ValueError(
+                        f"gang {gang.name}: pod {refs[0]!r} referenced by group "
+                        f"{grp.name!r} not found in pods_by_name"
+                    )
+                batch.group_req[gi, k] = pod_request_vector(first, snapshot.resource_names)
             for rank, ref in enumerate(refs):
                 batch.pod_group[gi, slot] = k
                 batch.pod_rank[gi, slot] = rank
@@ -210,8 +215,12 @@ def encode_gangs(
                 batch.set_member[gi, si, k] = True
                 if req_l >= 0:
                     req_constrained.add(k)
+        # Normalize per resource before summing — raw units are incomparable
+        # (cpu cores ~1 vs memory bytes ~1e10 vs TPU chips ~4).
+        cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
         demand = [
-            float(batch.group_total[gi, k] * batch.group_req[gi, k].sum()) for k in range(mg)
+            float(batch.group_total[gi, k] * (batch.group_req[gi, k] / cap_scale).sum())
+            for k in range(mg)
         ]
         batch.group_order[gi] = np.array(
             sorted(range(mg), key=lambda k: (k not in req_constrained, -demand[k])),

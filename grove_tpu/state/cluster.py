@@ -54,6 +54,7 @@ class ClusterSnapshot:
     node_domain_id: np.ndarray  # i32 [L, N]
     domain_names: list[list[str]]  # per level: ordinal -> domain value
     num_domains: np.ndarray  # i32 [L] (actual domain count per level)
+    node_index_map: dict[str, int] = field(default_factory=dict)
 
     @property
     def n_nodes(self) -> int:
@@ -64,7 +65,7 @@ class ClusterSnapshot:
         return self.capacity - self.allocated
 
     def node_index(self, name: str) -> int:
-        return self.node_names.index(name)
+        return self.node_index_map[name]
 
     def level_index(self, domain: TopologyDomain) -> Optional[int]:
         try:
@@ -149,9 +150,13 @@ def build_snapshot(
         node_domain_id=node_domain_id,
         domain_names=domain_names,
         num_domains=num_domains,
+        node_index_map={x.name: i for i, x in enumerate(nodes)},
     )
     for pod in bound_pods or []:
-        if pod.node_name is not None:
+        # Skip stale bindings to nodes that no longer exist (routine race
+        # between node deletion and pod cleanup) — the binding holds no
+        # capacity on any node in this snapshot.
+        if pod.node_name is not None and pod.node_name in snap.node_index_map:
             apply_binding(snap, pod)
     return snap
 

@@ -1,0 +1,259 @@
+"""M3 tests: the full dynamic control loop against the simulator.
+
+Scenario sources (SURVEY.md §4): gang scheduling GS1-12
+(e2e/tests/gang_scheduling_test.go), startup ordering SO1-4
+(startup_ordering_test.go), gang termination (§3.4), rolling updates RU7-21
+(rolling_updates_test.go), HPA scaling.
+"""
+
+import copy
+
+import pytest
+
+from grove_tpu.api import (
+    CliqueStartupType,
+    ClusterTopology,
+    PodCliqueSet,
+    PodGangPhase,
+    TopologyDomain,
+    TopologyLevel,
+)
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.sim import SimConfig, Simulator
+from grove_tpu.state import Node
+
+
+def mk_topology():
+    return ClusterTopology(
+        name="t",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, "topology.kubernetes.io/zone"),
+            TopologyLevel(TopologyDomain.RACK, "topology.kubernetes.io/rack"),
+        ],
+    )
+
+
+def mk_cluster(n_nodes=8, cpu=4.0):
+    cluster = Cluster()
+    for i in range(n_nodes):
+        cluster.nodes[f"n{i}"] = Node(
+            name=f"n{i}",
+            capacity={"cpu": cpu, "memory": 8 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/rack": f"r{i % 2}",
+            },
+        )
+    return cluster
+
+
+def mk_sim(pcs: PodCliqueSet, n_nodes=8, cpu=4.0):
+    cluster = mk_cluster(n_nodes, cpu)
+    cluster.podcliquesets[pcs.metadata.name] = pcs
+    controller = GroveController(cluster=cluster, topology=mk_topology())
+    return Simulator(cluster=cluster, controller=controller, config=SimConfig())
+
+
+def all_gangs_running(cluster):
+    return lambda: all(
+        g.status.phase == PodGangPhase.RUNNING for g in cluster.podgangs.values()
+    ) and bool(cluster.podgangs)
+
+
+def test_workload_reaches_running(simple1: PodCliqueSet):
+    sim = mk_sim(simple1)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    assert len(sim.cluster.pods) == 13
+    assert all(p.ready for p in sim.cluster.pods.values())
+    # PCS status rolled up
+    pcs = sim.cluster.podcliquesets["simple1"]
+    assert pcs.status.available_replicas == 1
+    assert {s.name for s in pcs.status.pod_gang_statuses} == {"simple1-0", "simple1-0-workers-0"}
+
+
+def test_gang_stays_pending_without_capacity(simple1: PodCliqueSet):
+    sim = mk_sim(simple1, n_nodes=1, cpu=0.05)  # room for 5 pods; base needs 9
+    sim.run(30)
+    assert all(not p.is_scheduled for p in sim.cluster.pods.values())
+    for gang in sim.cluster.podgangs.values():
+        assert gang.status.phase == PodGangPhase.PENDING
+    # capacity freed later -> gang admits (GS recovery)
+    sim.cluster.nodes["n0"].capacity["cpu"] = 4.0
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+
+
+def test_pod_failure_recovers(simple1: PodCliqueSet):
+    sim = mk_sim(simple1)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    victim = next(p for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-frontend")
+    sim.fail_pod(victim.name)
+    sim.step()
+    assert victim.name not in sim.cluster.pods  # GC'd
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    assert len([p for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-frontend"]) == 3
+
+
+def test_stable_index_reuse_on_replacement(simple1: PodCliqueSet):
+    sim = mk_sim(simple1)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    victim = next(
+        p for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-frontend" and p.pod_index == 1
+    )
+    sim.fail_pod(victim.name)
+    sim.step()
+    indices = sorted(
+        p.pod_index for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-frontend"
+    )
+    assert indices == [0, 1, 2]  # hole filled lowest-first (index/tracker.go:32-43)
+
+
+def test_node_death_triggers_recovery(simple1: PodCliqueSet):
+    sim = mk_sim(simple1, n_nodes=4)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    used = {p.node_name for p in sim.cluster.pods.values()}
+    victim_node = next(iter(used))
+    sim.kill_node(victim_node)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=120)
+    assert all(p.node_name != victim_node for p in sim.cluster.pods.values())
+
+
+def test_gang_termination_after_delay(simple1: PodCliqueSet):
+    """MinAvailableBreached > terminationDelay ⇒ replica torn down & rebuilt (§3.4)."""
+    simple1.spec.template.termination_delay_seconds = 20.0
+    sim = mk_sim(simple1, n_nodes=8)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    # Crash-loop 2 of 3 frontend pods: they stay bound but never Ready ->
+    # ready-or-starting < minAvailable(3) -> breached (reconcilestatus.go:170-226).
+    frontend_pods = [p for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-frontend"]
+    for p in frontend_pods[:2]:
+        sim.crash_pod(p.name)
+    sim.step()
+    clique = sim.cluster.podcliques["simple1-0-frontend"]
+    from grove_tpu.orchestrator.status import clique_breached
+
+    assert clique_breached(clique)
+    # before the delay elapses: no termination
+    sim.run(10)
+    assert any(g for g in sim.cluster.podgangs.values())
+    events_before = [e for e in sim.cluster.events if "gang-terminated" in e[2]]
+    assert not events_before
+    # after the delay: replica torn down, then rebuilt once capacity returns
+    sim.run(20)
+    events_after = [e for e in sim.cluster.events if "gang-terminated" in e[2]]
+    assert events_after
+    for n in sim.cluster.nodes:
+        sim.uncordon(n)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=120)
+
+
+def test_startup_ordering_explicit(simple1: PodCliqueSet):
+    """SO analog: router starts only after frontend is Ready >= minAvailable."""
+    simple1.spec.template.startup_type = CliqueStartupType.EXPLICIT
+    simple1.clique_template("router").spec.starts_after = ["frontend"]
+    sim = mk_sim(simple1)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=120)
+    frontend_started = [
+        p.started_at for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-frontend"
+    ]
+    router_started = [
+        p.started_at for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-router"
+    ]
+    # router containers begin strictly after every frontend pod became ready
+    # (frontend ready = started_at + ready_delay)
+    frontend_ready_time = max(frontend_started) + sim.config.ready_delay
+    assert min(router_started) >= frontend_ready_time
+
+
+def test_startup_ordering_in_order(simple1: PodCliqueSet):
+    simple1.spec.template.startup_type = CliqueStartupType.IN_ORDER
+    sim = mk_sim(simple1)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=200)
+    # template order: frontend, prefill, decode, router — each starts after prev
+    def started(fqn):
+        return [p.started_at for p in sim.cluster.pods.values() if p.pclq_fqn == fqn]
+
+    assert min(started("simple1-0-router")) > max(started("simple1-0-frontend"))
+
+
+def test_rolling_update(simple1: PodCliqueSet):
+    sim = mk_sim(simple1)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    pcs = sim.cluster.podcliquesets["simple1"]
+    old_hash = pcs.status.current_generation_hash
+    old_pod_names = set(sim.cluster.pods)
+    # template change: new image
+    pcs.clique_template("frontend").spec.pod_spec.containers[0].image = "registry.local/frontend:v2"
+    pcs.clique_template("prefill").spec.pod_spec.containers[0].image = "registry.local/worker:v2"
+    sim.step()
+    assert pcs.status.rolling_update_progress is not None
+    assert sim.run_until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None, timeout=300
+    )
+    assert pcs.status.current_generation_hash != old_hash
+    # every affected pod replaced; unaffected cliques (router/decode) kept pods
+    new_frontend = [p for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-frontend"]
+    assert all(p.name not in old_pod_names for p in new_frontend)
+    routers = [p for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-router"]
+    assert all(p.name in old_pod_names for p in routers)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=120)
+
+
+def test_rolling_update_one_replica_at_a_time(simple1: PodCliqueSet):
+    simple1.spec.replicas = 2
+    sim = mk_sim(simple1, n_nodes=16)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=120)
+    pcs = sim.cluster.podcliquesets["simple1"]
+    pcs.clique_template("router").spec.pod_spec.containers[0].image = "v2"
+    sim.step()
+    prog = pcs.status.rolling_update_progress
+    assert prog.current_replica_index is not None
+    first = prog.current_replica_index
+    # while replica `first` updates, the other replica's pods are untouched
+    other = 1 - first
+    other_pods = [
+        p
+        for p in sim.cluster.pods.values()
+        if p.labels["grove.io/podcliqueset-replica-index"] == str(other)
+    ]
+    assert all(p.ready for p in other_pods)
+    assert sim.run_until(lambda: prog.update_ended_at is not None, timeout=600)
+    assert sorted(prog.updated_replica_indices) == [0, 1]
+
+
+def test_hpa_scale_up_and_down(simple1: PodCliqueSet):
+    sim = mk_sim(simple1)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    # frontend at 150% of target -> scale 3 -> ceil(4.5) = 5 (max 5)
+    sim.controller.autoscale({"simple1-0-frontend": 1.5}, sim.now)
+    assert sim.run_until(
+        lambda: len([p for p in sim.cluster.pods.values() if p.pclq_fqn == "simple1-0-frontend"]) == 5,
+        timeout=60,
+    )
+    # PCSG scale-up: workers 2 -> 3 => one more scaled gang
+    sim.controller.autoscale({"simple1-0-workers": 1.4}, sim.now)
+    assert sim.run_until(lambda: "simple1-0-workers-1" in sim.cluster.podgangs, timeout=60)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=120)
+    # scale back down (HPA floor = minReplicas = 2)
+    sim.controller.autoscale({"simple1-0-workers": 0.3}, sim.now)
+    assert sim.run_until(lambda: "simple1-0-workers-1" not in sim.cluster.podgangs, timeout=60)
+
+
+def test_pcs_delete_cascade(simple1: PodCliqueSet):
+    sim = mk_sim(simple1)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    sim.cluster.delete_pcs_cascade("simple1")
+    sim.step()
+    assert not sim.cluster.pods
+    assert not sim.cluster.podcliques
+    assert not sim.cluster.podgangs
+    assert not sim.cluster.scaling_groups
+
+
+def test_scaled_gang_waits_for_base(simple1: PodCliqueSet):
+    """Scaled gang must not run ahead of an unschedulable base gang."""
+    sim = mk_sim(simple1, n_nodes=1, cpu=0.06)  # fits scaled (4 pods) not base (9)
+    sim.run(30)
+    scaled = sim.cluster.podgangs["simple1-0-workers-0"]
+    assert scaled.status.phase == PodGangPhase.PENDING
+    assert all(not p.is_scheduled for p in sim.cluster.pods.values())

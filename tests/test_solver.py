@@ -166,3 +166,39 @@ def test_pods_pack_per_group_identically(simple_setup):
     for gang_name, b in bindings.items():
         for pod_name, node in b.items():
             assert node in snap.node_names
+
+
+def test_encode_rejects_unknown_pod_reference(simple_setup):
+    ds, snap, pods_by_name = simple_setup
+    del pods_by_name[next(iter(pods_by_name))]
+    missing = {p.name: p for p in ds.pods}
+    first_pod = ds.podgangs[0].spec.pod_groups[0].pod_references[0].name
+    missing.pop(first_pod)
+    with pytest.raises(ValueError, match="not found in pods_by_name"):
+        encode_gangs(ds.podgangs, missing, snap)
+
+
+def test_unresolvable_required_constraint_gates_gang(simple_setup):
+    """A required pack key missing from the snapshot topology must gate the
+    gang, never silently waive the guarantee."""
+    from grove_tpu.api import IRTopologyConstraint, TopologyPackConstraint
+
+    ds, snap, pods_by_name = simple_setup
+    base = [g for g in ds.podgangs if not g.is_scaled]
+    base[0].spec.topology_constraint = IRTopologyConstraint(
+        pack_constraint=TopologyPackConstraint(required="topology.kubernetes.io/nonexistent")
+    )
+    batch, decode = encode_gangs(base, pods_by_name, snap)
+    result = solve(snap, batch)
+    assert not bool(np.asarray(result.ok)[0])
+
+
+def test_snapshot_skips_stale_node_binding(simple_setup):
+    ds, _, pods_by_name = simple_setup
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.api.types import PodSpec
+
+    stale = Pod(name="ghost", pclq_fqn="x", node_name="deleted-node")
+    topo = mk_topology()
+    snap = build_snapshot(mk_nodes(2), topo, bound_pods=[stale])
+    assert (snap.allocated == 0).all()
