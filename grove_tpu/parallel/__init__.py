@@ -1,0 +1,25 @@
+"""Multi-chip solve: device meshes and portfolio-parallel placement."""
+
+from grove_tpu.parallel.mesh import (
+    NODE_AXIS,
+    PORTFOLIO_AXIS,
+    factor_devices,
+    solver_mesh,
+)
+from grove_tpu.parallel.portfolio import (
+    params_population,
+    portfolio_solve_batch,
+    sharded_portfolio_solve,
+    tune_solve_step,
+)
+
+__all__ = [
+    "NODE_AXIS",
+    "PORTFOLIO_AXIS",
+    "factor_devices",
+    "solver_mesh",
+    "params_population",
+    "portfolio_solve_batch",
+    "sharded_portfolio_solve",
+    "tune_solve_step",
+]

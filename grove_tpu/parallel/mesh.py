@@ -1,0 +1,63 @@
+"""Device-mesh construction for the multi-chip solver.
+
+The solver's parallelism axes (SURVEY.md §5.8 "TPU-native equivalent"):
+
+  portfolio — data parallelism: independent solver variants (score-weight
+              vectors / commit orderings) solved concurrently, winner kept.
+              Rides ICI with zero communication until the final argmax.
+  node      — model parallelism analog: the node axis of the [MG, N] / [N, R]
+              score and capacity tensors is sharded across devices; XLA GSPMD
+              inserts the psum/all-gather collectives for the per-domain
+              segment reductions.
+
+This mirrors how the reference scales: it has no multi-device math (pure Go
+control plane, SURVEY.md §2.4), so the mesh here is new TPU-first design, not
+a port — the analog of its horizontal scaling (ConcurrentSyncs workers,
+operator/api/config/v1alpha1/types.go:180-208) done the XLA way.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PORTFOLIO_AXIS = "portfolio"
+NODE_AXIS = "node"
+
+
+def factor_devices(n: int) -> tuple[int, int]:
+    """Factor n into (portfolio, node) — the most-square split, portfolio-major.
+
+    Portfolio parallelism is communication-free so it gets the larger factor.
+    """
+    best = (n, 1)
+    k = 1
+    while k * k <= n:
+        if n % k == 0:
+            best = (n // k, k)
+        k += 1
+    return best
+
+
+def solver_mesh(devices: list | None = None) -> Mesh:
+    """Build the 2D (portfolio, node) mesh over all (or given) devices."""
+    devices = devices if devices is not None else jax.devices()
+    p, k = factor_devices(len(devices))
+    return Mesh(np.asarray(devices).reshape(p, k), (PORTFOLIO_AXIS, NODE_AXIS))
+
+
+def portfolio_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading axis split across the portfolio axis, rest replicated."""
+    return NamedSharding(mesh, PartitionSpec(PORTFOLIO_AXIS))
+
+
+def node_sharding(mesh: Mesh, node_axis_index: int, ndim: int) -> NamedSharding:
+    """Shard dimension `node_axis_index` of an ndim-array across NODE_AXIS."""
+    spec = [None] * ndim
+    spec[node_axis_index] = NODE_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

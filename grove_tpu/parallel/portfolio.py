@@ -1,0 +1,137 @@
+"""Portfolio-parallel gang placement: the multi-chip solve.
+
+The placement problem is combinatorial; the sequential-commit solver
+(solver/core.py) is a greedy heuristic whose quality depends on its score
+weights (SolverParams). Instead of one greedy pass, run P independent variants
+— a *portfolio* of weight vectors — in parallel across the device mesh and
+keep the best outcome (most gangs admitted, then highest placement quality).
+This is the TPU-native replacement for the reference's single-threaded KAI
+Filter/Score/Permit pipeline: quality comes from parallel search, throughput
+from batching, and both ride the MXU/ICI instead of goroutines.
+
+`tune_solve_step` goes one further: each call solves the portfolio, selects
+the winner, and emits the next generation of weights (elite + deterministic
+log-normal mutations) — a jittable evolutionary "training step" whose
+parameters are the solver's score weights. That is this framework's analog of
+a training loop, and the function `__graft_entry__.dryrun_multichip` shards
+over a (portfolio, node) mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.parallel.mesh import (
+    node_sharding,
+    portfolio_sharding,
+    replicated,
+    solver_mesh,
+)
+from grove_tpu.solver.core import SolveResult, SolverParams, solve_batch
+from grove_tpu.solver.encode import GangBatch
+
+_N_WEIGHTS = len(SolverParams._fields)
+
+
+def params_population(p: int, base: SolverParams = SolverParams(), spread: float = 0.6,
+                      seed: int = 0) -> SolverParams:
+    """Stack P weight vectors: the base plus log-normal perturbations.
+
+    Deterministic for a given seed so portfolio solves are reproducible.
+    """
+    rng = np.random.default_rng(seed)
+    factors = np.exp(rng.normal(0.0, spread, size=(p, _N_WEIGHTS))).astype(np.float32)
+    factors[0, :] = 1.0  # slot 0 is always the unperturbed base
+    base_vec = np.asarray([float(x) for x in base], dtype=np.float32)
+    stack = factors * base_vec[None, :]
+    return SolverParams(*(jnp.asarray(stack[:, i]) for i in range(_N_WEIGHTS)))
+
+
+def _mutation_factors(p: int, spread: float = 0.35, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    factors = np.exp(rng.normal(0.0, spread, size=(p, _N_WEIGHTS))).astype(np.float32)
+    factors[0, :] = 1.0  # elitism: slot 0 carries the winner unchanged
+    return factors
+
+
+def _objective(result: SolveResult) -> jax.Array:
+    """Lexicographic (gangs admitted, total placement quality) as one scalar."""
+    admitted = result.ok.sum(dtype=jnp.float32)
+    quality = jnp.where(result.ok, result.placement_score, 0.0).sum()
+    return admitted * 1e6 + quality
+
+
+@jax.jit
+def portfolio_solve_batch(
+    free0: jax.Array,
+    capacity: jax.Array,
+    schedulable: jax.Array,
+    node_domain_id: jax.Array,
+    batch: GangBatch,
+    params_stack: SolverParams,
+) -> tuple[SolveResult, jax.Array, jax.Array]:
+    """Solve the same batch under every weight vector; return the winner.
+
+    Returns (best SolveResult, winner index, per-member objective [P]).
+    """
+    vsolve = jax.vmap(solve_batch, in_axes=(None, None, None, None, None, 0))
+    results = vsolve(free0, capacity, schedulable, node_domain_id, batch, params_stack)
+    objectives = jax.vmap(_objective)(results)
+    winner = jnp.argmax(objectives)
+    best = jax.tree_util.tree_map(lambda x: x[winner], results)
+    return best, winner, objectives
+
+
+@partial(jax.jit, static_argnames=("spread_seed",))
+def tune_solve_step(
+    free0: jax.Array,
+    capacity: jax.Array,
+    schedulable: jax.Array,
+    node_domain_id: jax.Array,
+    batch: GangBatch,
+    params_stack: SolverParams,
+    spread_seed: int = 7,
+) -> tuple[SolveResult, SolverParams, jax.Array]:
+    """One evolutionary step: solve portfolio → pick winner → next generation.
+
+    The next generation is the winner's weights broadcast through fixed
+    log-normal mutation factors (slot 0 = elite copy). Fully jittable; calling
+    it in a loop anneals the solver's score weights to the workload.
+    """
+    p = params_stack[0].shape[0]
+    best, winner, objectives = portfolio_solve_batch(
+        free0, capacity, schedulable, node_domain_id, batch, params_stack
+    )
+    factors = jnp.asarray(_mutation_factors(p, seed=spread_seed))  # [P, W]
+    winner_vec = jnp.stack([w[winner] for w in params_stack])  # [W]
+    next_stack = SolverParams(*(factors[:, i] * winner_vec[i] for i in range(_N_WEIGHTS)))
+    return best, next_stack, objectives
+
+
+def sharded_portfolio_solve(snapshot, batch: GangBatch, params_stack: SolverParams,
+                            mesh=None) -> tuple[SolveResult, int, np.ndarray]:
+    """Device-mesh entry point: portfolio axis data-parallel, node axis sharded.
+
+    Places the P weight vectors across the mesh's portfolio axis and the node
+    dimension of the capacity/score tensors across its node axis; XLA GSPMD
+    inserts the collectives (per-domain reductions → psum over node shards,
+    winner argmax → all-reduce over the portfolio axis).
+    """
+    mesh = mesh if mesh is not None else solver_mesh()
+    rep = replicated(mesh)
+    free0 = jax.device_put(jnp.asarray(snapshot.free), node_sharding(mesh, 0, 2))
+    capacity = jax.device_put(jnp.asarray(snapshot.capacity), node_sharding(mesh, 0, 2))
+    schedulable = jax.device_put(jnp.asarray(snapshot.schedulable), node_sharding(mesh, 0, 1))
+    node_domain_id = jax.device_put(
+        jnp.asarray(snapshot.node_domain_id), node_sharding(mesh, 1, 2)
+    )
+    jbatch = GangBatch(*(jax.device_put(jnp.asarray(x), rep) for x in batch))
+    pstack = SolverParams(*(jax.device_put(jnp.asarray(x), portfolio_sharding(mesh)) for x in params_stack))
+    best, winner, objectives = portfolio_solve_batch(
+        free0, capacity, schedulable, node_domain_id, jbatch, pstack
+    )
+    return best, int(winner), np.asarray(objectives)

@@ -1,0 +1,124 @@
+"""Multi-chip solve: mesh factoring, portfolio parallelism, sharded execution.
+
+Runs on the 8-virtual-device CPU mesh (conftest.py), the same discipline the
+reference uses for multi-node behavior without hardware (SURVEY.md §4).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from grove_tpu.api import ClusterTopology, TopologyDomain, TopologyLevel
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.parallel import (
+    factor_devices,
+    params_population,
+    portfolio_solve_batch,
+    sharded_portfolio_solve,
+    solver_mesh,
+    tune_solve_step,
+)
+from grove_tpu.solver import encode_gangs, solve
+from grove_tpu.solver.core import SolverParams
+from grove_tpu.state import Node, build_snapshot
+
+
+def mk_topology():
+    return ClusterTopology(
+        name="t",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, "topology.kubernetes.io/zone"),
+            TopologyLevel(TopologyDomain.RACK, "topology.kubernetes.io/rack"),
+        ],
+    )
+
+
+@pytest.fixture
+def problem(simple1):
+    topo = mk_topology()
+    ds = expand_podcliqueset(simple1, topo)
+    nodes = [
+        Node(
+            name=f"n{i}",
+            capacity={"cpu": 4.0, "memory": 8 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": f"z{i % 2}",
+                "topology.kubernetes.io/rack": f"r{i % 4}",
+            },
+        )
+        for i in range(16)
+    ]
+    snap = build_snapshot(nodes, topo)
+    pods_by_name = {p.name: p for p in ds.pods}
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    return snap, batch, decode
+
+
+def test_factor_devices():
+    assert factor_devices(8) == (4, 2)
+    assert factor_devices(7) == (7, 1)
+    assert factor_devices(1) == (1, 1)
+    assert factor_devices(16) == (4, 4)
+
+
+def test_population_slot0_is_base():
+    base = SolverParams(w_tight=2.0, w_pref=3.0, w_reuse=1.0, w_reserve=5.0)
+    pop = params_population(6, base=base)
+    vec = np.asarray([float(w[0]) for w in pop])
+    np.testing.assert_allclose(vec, [2.0, 3.0, 1.0, 5.0], rtol=1e-6)
+    # other slots actually perturbed
+    assert not np.allclose(np.asarray(pop.w_tight), 2.0)
+
+
+def test_portfolio_matches_single_solve(problem):
+    """A portfolio of identical weight vectors must reproduce the single solve."""
+    snap, batch, _ = problem
+    single = solve(snap, batch)
+    base = SolverParams()
+    pop = SolverParams(*(np.full((4,), float(w), np.float32) for w in base))
+    best, winner, objectives = portfolio_solve_batch(
+        np.asarray(snap.free),
+        np.asarray(snap.capacity),
+        np.asarray(snap.schedulable),
+        np.asarray(snap.node_domain_id),
+        jax.tree_util.tree_map(np.asarray, batch),
+        pop,
+    )
+    np.testing.assert_array_equal(np.asarray(best.ok), np.asarray(single.ok))
+    np.testing.assert_array_equal(np.asarray(best.assigned), np.asarray(single.assigned))
+    assert np.asarray(objectives).std() < 1e-3
+
+
+def test_sharded_portfolio_solve(problem):
+    """Full mesh path: 8 virtual devices, (4, 2) mesh, winner admits all gangs."""
+    snap, batch, decode = problem
+    mesh = solver_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    best, winner, objectives = sharded_portfolio_solve(
+        snap, batch, params_population(8), mesh=mesh
+    )
+    assert np.asarray(best.ok).all()
+    assert 0 <= winner < 8
+    assert objectives.shape == (8,)
+    # objective encodes admitted count in its integer part
+    assert int(objectives[winner] // 1e6) == batch.n_gangs
+
+
+def test_tune_solve_step_elitism(problem):
+    snap, batch, _ = problem
+    pop = params_population(8)
+    args = (
+        np.asarray(snap.free),
+        np.asarray(snap.capacity),
+        np.asarray(snap.schedulable),
+        np.asarray(snap.node_domain_id),
+        jax.tree_util.tree_map(np.asarray, batch),
+    )
+    best, nxt, objectives = tune_solve_step(*args, pop)
+    winner = int(np.argmax(np.asarray(objectives)))
+    winner_vec = [float(np.asarray(w)[winner]) for w in pop]
+    elite_vec = [float(np.asarray(w)[0]) for w in nxt]
+    np.testing.assert_allclose(elite_vec, winner_vec, rtol=1e-6)
+    # a second step from the new generation still solves
+    best2, _, _ = tune_solve_step(*args, nxt)
+    assert np.asarray(best2.ok).sum() >= np.asarray(best.ok).sum()
