@@ -99,6 +99,16 @@ class GroveController:
         )
 
         c.headless_services.update(desired.headless_services)
+        # Drop services of removed PCS replicas (scale-down leaves no orphans).
+        prefix = pcs.metadata.name + "-"
+        stale_svcs = {
+            s
+            for s in c.headless_services
+            if s.startswith(prefix)
+            and s[len(prefix):].isdigit()
+            and s not in desired.headless_services
+        }
+        c.headless_services -= stale_svcs
 
         desired_clique_names = {x.metadata.name for x in desired.podcliques}
         desired_pcsg_names = {x.metadata.name for x in desired.scaling_groups}
@@ -252,7 +262,11 @@ class GroveController:
         pending.sort(key=lambda g: (-prio(g), g.is_scaled, g.scaled_index, g.name))
 
         # Partial gangs: encode only gated pods; floors shrink by bound pods.
+        # Bound pods' node NAMES are collected in the same pass (converted to
+        # snapshot indices below) so required pack-sets of a re-solved
+        # remainder pin to the domain the bound pods occupy.
         sub_gangs: list[PodGang] = []
+        bound_node_names: dict[str, dict[str, list[str]]] = {}
         for gang in pending:
             sub = PodGang(
                 name=gang.name,
@@ -265,10 +279,16 @@ class GroveController:
             sub.spec.topology_constraint = gang.spec.topology_constraint
             sub.spec.priority_class_name = gang.spec.priority_class_name
             group_names_with_gated = set()
+            per_group_nodes: dict[str, list[str]] = {}
             for grp in gang.spec.pod_groups:
                 pods = [p for p in c.pods_of_clique(grp.name) if p.is_active]
                 gated = [p for p in pods if p.is_gated]
-                bound = sum(1 for p in pods if p.is_scheduled)
+                scheduled_pods = [p for p in pods if p.is_scheduled]
+                if scheduled_pods:
+                    per_group_nodes[grp.name] = [
+                        p.node_name for p in scheduled_pods if p.node_name
+                    ]
+                bound = len(scheduled_pods)
                 if not gated:
                     continue
                 import copy as _copy
@@ -287,11 +307,27 @@ class GroveController:
                 if any(n in group_names_with_gated for n in gc.pod_group_names)
             ]
             sub_gangs.append(sub)
+            if per_group_nodes:
+                bound_node_names[gang.name] = per_group_nodes
 
         bound_pods = [p for p in c.pods.values() if p.is_scheduled and p.is_active]
         snapshot = build_snapshot(
             list(c.nodes.values()), self.topology, bound_pods=bound_pods
         )
+        # Convert the bound-pod node names collected above to snapshot indices.
+        bound_nodes: dict[str, dict[str, list[int]]] = {}
+        for gname, groups in bound_node_names.items():
+            per_group = {
+                grp: idxs
+                for grp, names in groups.items()
+                if (idxs := [
+                    snapshot.node_index(nm)
+                    for nm in names
+                    if nm in snapshot.node_index_map
+                ])
+            }
+            if per_group:
+                bound_nodes[gname] = per_group
         pods_by_name = dict(c.pods)
         batch, decode = encode_gangs(
             sub_gangs,
@@ -301,6 +337,7 @@ class GroveController:
             max_sets=self.max_sets,
             max_pods=self.max_pods,
             scheduled_gangs=scheduled_names,
+            bound_nodes_by_group=bound_nodes,
         )
         result = solve(snapshot, batch, self.solver_params)
         bindings = decode_assignments(result, decode, snapshot)
@@ -428,7 +465,22 @@ class GroveController:
             return out
 
         def replica_updated(i: int) -> bool:
-            return not stale_pods(i)
+            """Updated = no stale pods AND every clique back to ready >=
+            minAvailable (isPCLQUpdateComplete, rollingupdate.go:286-295 gates
+            on UpdatedReplicas and ReadyReplicas >= MinAvailable) — otherwise
+            the update would advance while the replica is still down, losing
+            the one-replica-at-a-time availability guarantee."""
+            if stale_pods(i):
+                return False
+            for clique in c.cliques_of_pcs_replica(pcs.metadata.name, i):
+                ready = sum(
+                    1
+                    for p in c.pods_of_clique(clique.metadata.name)
+                    if p.is_active and p.ready
+                )
+                if ready < clique.min_available:
+                    return False
+            return True
 
         # Replica order: no-scheduled-pods first, then breached, then ordinal
         # (rollingupdate.go:196-223).

@@ -14,6 +14,7 @@ Startup types (podcliqueset.go:249-257):
 
 from __future__ import annotations
 
+from grove_tpu.api import naming
 from grove_tpu.api.pod import Pod
 from grove_tpu.api.types import CliqueStartupType, PodClique, PodCliqueSet
 from grove_tpu.orchestrator.store import Cluster
@@ -53,11 +54,14 @@ def resolve_parent_fqns(
         if parent_template in cfg.clique_names:
             parent_sg = cfg
     if parent_sg is None:
-        return [f"{pcs.metadata.name}-{i}-{parent_template}"]
-    sg_fqn = f"{pcs.metadata.name}-{i}-{parent_sg.name}"
+        return [naming.podclique_name(pcs.metadata.name, i, parent_template)]
+    sg_fqn = naming.scaling_group_name(pcs.metadata.name, i, parent_sg.name)
     if child_sg is not None and child_sg.name == parent_sg.name:
-        return [f"{sg_fqn}-{child.pcsg_replica_index}-{parent_template}"]
-    return [f"{sg_fqn}-{j}-{parent_template}" for j in range(parent_sg.min_available)]
+        return [naming.podclique_name(sg_fqn, child.pcsg_replica_index, parent_template)]
+    return [
+        naming.podclique_name(sg_fqn, j, parent_template)
+        for j in range(parent_sg.min_available)
+    ]
 
 
 def may_start(cluster: Cluster, pod: Pod) -> bool:

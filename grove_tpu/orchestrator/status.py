@@ -18,7 +18,7 @@ from __future__ import annotations
 from collections import defaultdict
 
 from grove_tpu.api import constants
-from grove_tpu.api.pod import Pod, PodPhase
+from grove_tpu.api.pod import Pod
 from grove_tpu.api.podgang import PodGang, PodGangPhase
 from grove_tpu.api.types import (
     Condition,
@@ -173,11 +173,8 @@ def compute_podgang_status(cluster: Cluster, gang: PodGang, now: float) -> None:
         sum(1 for p in by_group.get(grp.name, []) if p.ready) >= grp.min_replicas
         for grp in gang.spec.pod_groups
     )
-    any_running = any(p.phase == PodPhase.RUNNING for p in pods)
     if all_ready:
         gang.status.phase = PodGangPhase.RUNNING
-    elif scheduled_ok and any_running:
-        gang.status.phase = PodGangPhase.STARTING
     elif scheduled_ok:
         gang.status.phase = PodGangPhase.STARTING
     else:
@@ -215,13 +212,16 @@ def compute_pcs_status(cluster: Cluster, pcs: PodCliqueSet, now: float) -> None:
         replica_ok = all(not clique_breached(c) for c in standalone) and all(
             not pcsg_breached(g) for g in pcsgs
         )
+        # Scheduled gate must cover PCSGs too: unscheduled PCSGs are "not
+        # breached" (WaitingForScheduling), so without this a PCSG-only
+        # template would report availability with zero pods placed.
         scheduled = all(
             any(
                 c2.type == constants.CONDITION_POD_CLIQUE_SCHEDULED and c2.status == "True"
                 for c2 in c.status.conditions
             )
             for c in standalone
-        )
+        ) and all(g.status.scheduled_replicas >= g.spec.min_available for g in pcsgs)
         if replica_ok and scheduled:
             available += 1
     st.available_replicas = available

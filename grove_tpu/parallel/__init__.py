@@ -9,6 +9,7 @@ from grove_tpu.parallel.mesh import (
 from grove_tpu.parallel.portfolio import (
     params_population,
     portfolio_solve_batch,
+    shard_inputs,
     sharded_portfolio_solve,
     tune_solve_step,
 )
@@ -20,6 +21,7 @@ __all__ = [
     "solver_mesh",
     "params_population",
     "portfolio_solve_batch",
+    "shard_inputs",
     "sharded_portfolio_solve",
     "tune_solve_step",
 ]

@@ -58,11 +58,11 @@ def _mutation_factors(p: int, spread: float = 0.35, seed: int = 7) -> np.ndarray
     return factors
 
 
-def _objective(result: SolveResult) -> jax.Array:
-    """Lexicographic (gangs admitted, total placement quality) as one scalar."""
-    admitted = result.ok.sum(dtype=jnp.float32)
+def _objective(result: SolveResult) -> tuple[jax.Array, jax.Array]:
+    """(gangs admitted, total placement quality) — compared lexicographically."""
+    admitted = result.ok.sum(dtype=jnp.int32)
     quality = jnp.where(result.ok, result.placement_score, 0.0).sum()
-    return admitted * 1e6 + quality
+    return admitted, quality
 
 
 @jax.jit
@@ -77,12 +77,17 @@ def portfolio_solve_batch(
     """Solve the same batch under every weight vector; return the winner.
 
     Returns (best SolveResult, winner index, per-member objective [P]).
+    The winner is chosen by exact lexicographic (admitted count, quality) —
+    a two-stage argmax, NOT a packed float (which would quantize the quality
+    tie-break away in f32 once admitted*1e6 dominates the mantissa).
     """
     vsolve = jax.vmap(solve_batch, in_axes=(None, None, None, None, None, 0))
     results = vsolve(free0, capacity, schedulable, node_domain_id, batch, params_stack)
-    objectives = jax.vmap(_objective)(results)
-    winner = jnp.argmax(objectives)
+    admitted, quality = jax.vmap(_objective)(results)
+    max_admitted = admitted.max()
+    winner = jnp.argmax(jnp.where(admitted == max_admitted, quality, -jnp.inf))
     best = jax.tree_util.tree_map(lambda x: x[winner], results)
+    objectives = admitted.astype(jnp.float32) * 1e6 + quality  # display only
     return best, winner, objectives
 
 
@@ -112,6 +117,26 @@ def tune_solve_step(
     return best, next_stack, objectives
 
 
+def shard_inputs(mesh, snapshot, batch: GangBatch, params_stack: SolverParams):
+    """Lay solver inputs out on the mesh: node tensors sharded along NODE_AXIS,
+    the weight stack along PORTFOLIO_AXIS, the gang batch replicated. The one
+    place the sharding layout is defined — production solve and the driver
+    dryrun both go through it.
+    """
+    rep = replicated(mesh)
+    free0 = jax.device_put(jnp.asarray(snapshot.free), node_sharding(mesh, 0, 2))
+    capacity = jax.device_put(jnp.asarray(snapshot.capacity), node_sharding(mesh, 0, 2))
+    schedulable = jax.device_put(jnp.asarray(snapshot.schedulable), node_sharding(mesh, 0, 1))
+    node_domain_id = jax.device_put(
+        jnp.asarray(snapshot.node_domain_id), node_sharding(mesh, 1, 2)
+    )
+    jbatch = GangBatch(*(jax.device_put(jnp.asarray(x), rep) for x in batch))
+    pstack = SolverParams(
+        *(jax.device_put(jnp.asarray(x), portfolio_sharding(mesh)) for x in params_stack)
+    )
+    return free0, capacity, schedulable, node_domain_id, jbatch, pstack
+
+
 def sharded_portfolio_solve(snapshot, batch: GangBatch, params_stack: SolverParams,
                             mesh=None) -> tuple[SolveResult, int, np.ndarray]:
     """Device-mesh entry point: portfolio axis data-parallel, node axis sharded.
@@ -122,16 +147,7 @@ def sharded_portfolio_solve(snapshot, batch: GangBatch, params_stack: SolverPara
     winner argmax → all-reduce over the portfolio axis).
     """
     mesh = mesh if mesh is not None else solver_mesh()
-    rep = replicated(mesh)
-    free0 = jax.device_put(jnp.asarray(snapshot.free), node_sharding(mesh, 0, 2))
-    capacity = jax.device_put(jnp.asarray(snapshot.capacity), node_sharding(mesh, 0, 2))
-    schedulable = jax.device_put(jnp.asarray(snapshot.schedulable), node_sharding(mesh, 0, 1))
-    node_domain_id = jax.device_put(
-        jnp.asarray(snapshot.node_domain_id), node_sharding(mesh, 1, 2)
-    )
-    jbatch = GangBatch(*(jax.device_put(jnp.asarray(x), rep) for x in batch))
-    pstack = SolverParams(*(jax.device_put(jnp.asarray(x), portfolio_sharding(mesh)) for x in params_stack))
     best, winner, objectives = portfolio_solve_batch(
-        free0, capacity, schedulable, node_domain_id, jbatch, pstack
+        *shard_inputs(mesh, snapshot, batch, params_stack)
     )
     return best, int(winner), np.asarray(objectives)

@@ -89,6 +89,7 @@ def _place_gang(free, used_carry, gang, *, schedulable, node_domain_id, cap_scal
     set_req_level = gang["set_req_level"]  # [MS]
     set_pref_level = gang["set_pref_level"]  # [MS]
     set_valid = gang["set_valid"]  # [MS]
+    set_pinned = gang["set_pinned"]  # [MS] forced domain ordinal, -1 = free
     mg = group_req.shape[0]
     ms = set_member.shape[0]
 
@@ -132,13 +133,18 @@ def _place_gang(free, used_carry, gang, *, schedulable, node_domain_id, cap_scal
             score = jnp.where(feasible, -dom_free.sum(axis=-1), -jnp.inf)
             return jnp.argmax(score), feasible.any()
 
+        # Incremental re-solve pin: bound pods of this set already sit in a
+        # domain; the remainder must land there too (or the gang fails) —
+        # a required co-location guarantee covers the whole gang.
+        req_dom = node_domain_id[jnp.clip(req_level, 0, levels - 1)]
+        pinned = set_pinned[s]
+        pin_mask = jnp.where(pinned >= 0, req_dom == pinned, jnp.ones((n,), dtype=bool))
         has_req = active & (req_level >= 0)
-        req_choice, req_any = pick_domain(req_level, jnp.ones((n,), dtype=bool))
+        req_choice, req_any = pick_domain(req_level, pin_mask)
         new_req = jnp.where(has_req & req_any, req_choice, -1)
         fail = fail | (has_req & ~req_any)
 
         # Preferred: choose within the (possibly just-committed) required domain.
-        req_dom = node_domain_id[jnp.clip(req_level, 0, levels - 1)]
         inside_req = jnp.where(new_req >= 0, req_dom == new_req, True)
         has_pref = active & (pref_level >= 0)
         pref_choice, pref_any = pick_domain(pref_level, inside_req)
@@ -306,6 +312,7 @@ def solve_batch(
         "set_req_level": batch.set_req_level,
         "set_pref_level": batch.set_pref_level,
         "set_valid": batch.set_valid,
+        "set_pinned": batch.set_pinned,
         "pod_group": batch.pod_group,
         "pod_rank": batch.pod_rank,
         "gang_valid": batch.gang_valid,

@@ -42,6 +42,11 @@ class GangBatch(NamedTuple):
     set_req_level: np.ndarray  # i32 [G, MS] topology level index, -1 = none
     set_pref_level: np.ndarray  # i32 [G, MS] topology level index, -1 = none
     set_valid: np.ndarray  # bool [G, MS]
+    # Domain pin for incremental re-solve: when part of a gang is already bound
+    # (pod replacement mid-gang), a required pack-set MUST stay in the domain
+    # the bound pods occupy — the constraint covers the whole gang, not just
+    # the re-solved remainder. -1 = unpinned.
+    set_pinned: np.ndarray  # i32 [G, MS] domain ordinal at set_req_level
     pod_group: np.ndarray  # i32 [G, MP] group index of each pod slot, -1 pad
     pod_rank: np.ndarray  # i32 [G, MP] rank of pod within its group
     gang_valid: np.ndarray  # bool [G]
@@ -90,6 +95,7 @@ def encode_gangs(
     max_pods: int | None = None,
     pad_gangs_to: int | None = None,
     scheduled_gangs: set[str] | None = None,
+    bound_nodes_by_group: dict[str, dict[str, list[int]]] | None = None,
 ) -> tuple[GangBatch, GangDecodeInfo]:
     """Flatten gang CRs into the padded batch + decode info.
 
@@ -97,21 +103,29 @@ def encode_gangs(
     scaled gang whose base gang is neither in this batch (at an earlier index)
     nor in `scheduled_gangs` is marked invalid — it must wait, mirroring the
     base-gang gate (podclique/components/pod/syncflow.go:347-387).
+
+    `bound_nodes_by_group`: gang name -> group name -> node indices of pods of
+    that group already bound in earlier solves. Used to pin required pack-sets
+    to the domain the bound pods occupy (incremental re-solve must not split a
+    co-location guarantee across domains).
     """
     g_count = pad_gangs_to if pad_gangs_to is not None else len(gangs)
     if g_count < len(gangs):
         raise ValueError("pad_gangs_to smaller than gang count")
     r = len(snapshot.resource_names)
 
-    def _sets_of(gang: PodGang) -> tuple[list[tuple[list[int], int, int]], bool]:
-        """Return ((member group indices, req_level, pref_level) broad→narrow,
-        schedulable). A REQUIRED key that doesn't resolve to a snapshot
-        topology level makes the gang unschedulable — a hard co-location
-        guarantee must never be silently dropped (expansion already nullifies
-        constraints for domains missing from the ClusterTopology; skew between
-        expansion and snapshot is an error, not a waiver)."""
+    def _sets_of(gang: PodGang):
+        """Return ((member group indices, req_level, pref_level, pin_names)
+        broad→narrow, schedulable). `pin_names` are the ORIGINAL member group
+        names the pin lookup consults — None means the whole gang, so bound
+        groups dropped from an incremental sub-gang still anchor the pin.
+        A REQUIRED key that doesn't resolve to a snapshot topology level makes
+        the gang unschedulable — a hard co-location guarantee must never be
+        silently dropped (expansion already nullifies constraints for domains
+        missing from the ClusterTopology; skew between expansion and snapshot
+        is an error, not a waiver)."""
         group_idx = {grp.name: k for k, grp in enumerate(gang.spec.pod_groups)}
-        raw: list[tuple[list[int], int, int]] = []
+        raw: list[tuple[list[int], int, int, list[str] | None]] = []
         unresolved_required = False
 
         def levels_of(pc) -> tuple[int, int]:
@@ -123,17 +137,17 @@ def encode_gangs(
 
         if gang.spec.topology_constraint and gang.spec.topology_constraint.pack_constraint:
             req, pref = levels_of(gang.spec.topology_constraint.pack_constraint)
-            raw.append((list(range(len(gang.spec.pod_groups))), req, pref))
+            raw.append((list(range(len(gang.spec.pod_groups))), req, pref, None))
         for gc in gang.spec.topology_constraint_group_configs:
             if gc.topology_constraint and gc.topology_constraint.pack_constraint:
                 members = [group_idx[n] for n in gc.pod_group_names if n in group_idx]
                 if members:
                     req, pref = levels_of(gc.topology_constraint.pack_constraint)
-                    raw.append((members, req, pref))
+                    raw.append((members, req, pref, list(gc.pod_group_names)))
         for k, grp in enumerate(gang.spec.pod_groups):
             if grp.topology_constraint and grp.topology_constraint.pack_constraint:
                 req, pref = levels_of(grp.topology_constraint.pack_constraint)
-                raw.append(([k], req, pref))
+                raw.append(([k], req, pref, [grp.name]))
         # Drop sets with neither level resolvable.
         raw = [s for s in raw if s[1] >= 0 or s[2] >= 0]
         # Broadest required level first (-1 required sorts last).
@@ -156,6 +170,7 @@ def encode_gangs(
         set_req_level=np.full((g_count, ms), -1, dtype=np.int32),
         set_pref_level=np.full((g_count, ms), -1, dtype=np.int32),
         set_valid=np.zeros((g_count, ms), dtype=bool),
+        set_pinned=np.full((g_count, ms), -1, dtype=np.int32),
         pod_group=np.full((g_count, mp), -1, dtype=np.int32),
         pod_rank=np.zeros((g_count, mp), dtype=np.int32),
         gang_valid=np.zeros((g_count,), dtype=bool),
@@ -165,6 +180,9 @@ def encode_gangs(
     decode = GangDecodeInfo(gang_names=[], pod_names=[], group_names=[])
     gang_index = {g.name: i for i, g in enumerate(gangs)}
     scheduled_gangs = scheduled_gangs or set()
+    # Normalize per resource before summing — raw units are incomparable
+    # (cpu cores ~1 vs memory bytes ~1e10 vs TPU chips ~4).
+    cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
 
     for gi, gang in enumerate(gangs):
         if len(gang.spec.pod_groups) > mg:
@@ -206,8 +224,9 @@ def encode_gangs(
             raise ValueError(
                 f"gang {gang.name}: {len(all_sets[gi])} pack-sets > bucket {ms}"
             )
+        gang_bound = (bound_nodes_by_group or {}).get(gang.name, {})
         req_constrained: set[int] = set()
-        for si, (members, req_l, pref_l) in enumerate(all_sets[gi]):
+        for si, (members, req_l, pref_l, pin_names) in enumerate(all_sets[gi]):
             batch.set_valid[gi, si] = True
             batch.set_req_level[gi, si] = req_l
             batch.set_pref_level[gi, si] = pref_l
@@ -215,9 +234,19 @@ def encode_gangs(
                 batch.set_member[gi, si, k] = True
                 if req_l >= 0:
                     req_constrained.add(k)
-        # Normalize per resource before summing — raw units are incomparable
-        # (cpu cores ~1 vs memory bytes ~1e10 vs TPU chips ~4).
-        cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
+            if req_l >= 0 and gang_bound:
+                # Pin to the domain the already-bound member pods live in.
+                # pin_names carries ORIGINAL member names: a fully-bound group
+                # dropped from an incremental sub-gang still anchors the pin.
+                lookup = gang_bound.keys() if pin_names is None else pin_names
+                for name in lookup:
+                    for node_idx in gang_bound.get(name, []):
+                        dom = int(snapshot.node_domain_id[req_l, node_idx])
+                        if dom >= 0:
+                            batch.set_pinned[gi, si] = dom
+                            break
+                    if batch.set_pinned[gi, si] >= 0:
+                        break
         demand = [
             float(batch.group_total[gi, k] * (batch.group_req[gi, k] / cap_scale).sum())
             for k in range(mg)

@@ -257,3 +257,62 @@ def test_scaled_gang_waits_for_base(simple1: PodCliqueSet):
     scaled = sim.cluster.podgangs["simple1-0-workers-0"]
     assert scaled.status.phase == PodGangPhase.PENDING
     assert all(not p.is_scheduled for p in sim.cluster.pods.values())
+
+
+def test_rolling_update_waits_for_ready_before_next_replica(simple1: PodCliqueSet):
+    """isPCLQUpdateComplete parity (rollingupdate.go:286-295): the update only
+    advances past a replica once its cliques are back to ready >= minAvailable;
+    at no instant are two replicas' pods simultaneously torn down."""
+    pcs = copy.deepcopy(simple1)
+    pcs.spec.replicas = 2
+    sim = mk_sim(pcs, n_nodes=16)
+    assert sim.run_until(all_gangs_running(sim.cluster), timeout=120)
+
+    # Trigger an update, then watch every step: while replica 0 is mid-update
+    # (has non-ready pods), replica 1 must keep all its ready pods.
+    pcs.spec.template.cliques[0].spec.pod_spec.containers[0].image = "app:v2"
+    min_ready = {}
+    for clique in sim.cluster.podcliques.values():
+        min_ready[clique.metadata.name] = clique.min_available
+
+    violations = []
+    for _ in range(200):
+        sim.step()
+        prog = pcs.status.rolling_update_progress
+        if prog is None or prog.update_ended_at is not None:
+            break
+        cur = prog.current_replica_index
+        if cur is None:
+            continue
+        for clique in sim.cluster.podcliques.values():
+            if clique.pcs_replica_index == cur:
+                continue
+            ready = sum(
+                1
+                for p in sim.cluster.pods_of_clique(clique.metadata.name)
+                if p.is_active and p.ready
+            )
+            if ready < min_ready[clique.metadata.name]:
+                violations.append((sim.now, clique.metadata.name, ready))
+    prog = pcs.status.rolling_update_progress
+    assert prog is not None and prog.update_ended_at is not None, "update must finish"
+    assert not violations, f"other replicas lost availability mid-update: {violations[:5]}"
+
+
+def test_pcsg_only_template_not_available_until_scheduled(simple1: PodCliqueSet):
+    """A PCS whose cliques are all in scaling groups must report 0 available
+    replicas while its gangs are pending (status rollup PCSG-scheduled gate)."""
+    pcs = copy.deepcopy(simple1)
+    sg_members = set()
+    for cfg in pcs.spec.template.pod_clique_scaling_group_configs:
+        sg_members.update(cfg.clique_names)
+    pcs.spec.template.cliques = [
+        c for c in pcs.spec.template.cliques if c.name in sg_members
+    ]
+    pcs.spec.template.startup_type = CliqueStartupType.ANY_ORDER
+    for c in pcs.spec.template.cliques:
+        c.spec.starts_after = []
+    # Zero capacity: nothing can schedule.
+    sim = mk_sim(pcs, n_nodes=1, cpu=0.0)
+    sim.run(10)
+    assert pcs.status.available_replicas == 0

@@ -229,3 +229,130 @@ def test_clique_level_constraint(simple1):
         node for pod, node in bindings["simple1-0"].items() if pod.startswith("simple1-0-frontend")
     ]
     assert len({snap.domain_of_node(n, TopologyDomain.RACK) for n in frontend_nodes}) == 1
+
+
+def test_incremental_resolve_pins_required_domain(pcs_rack_required):
+    """Pod replacement mid-gang: the re-solved remainder must stay in the rack
+    the bound pods occupy — a required co-location guarantee covers the whole
+    gang, not just the re-solved subset (solver set_pinned path)."""
+    topo = topo3()
+    ds = expand_podcliqueset(pcs_rack_required, topo)
+    snap = build_snapshot(rack_nodes(2, 12), topo)
+    pods = {p.name: p for p in ds.pods}
+    base = next(g for g in ds.podgangs if g.name == "simple1-0")
+
+    # First solve: the full base gang lands in one rack.
+    batch, decode = encode_gangs([base], pods, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, decode, snap)
+    home_rack = racks_of(bindings, snap)
+    assert len(home_rack) == 1
+
+    # Re-solve one "replacement" pod with the rest bound. Skew the scores so an
+    # unpinned solver would prefer the other rack: bound pods are accounted,
+    # making the home rack tighter... so instead cordon every home-rack node
+    # EXCEPT one with just enough room, and verify the pin still lands there —
+    # then fill the home rack completely and verify the gang FAILS rather than
+    # silently splitting across racks.
+    (home,) = home_rack
+    bound_nodes = {}
+    some_group = base.spec.pod_groups[0]
+    replacement = some_group.pod_references[0].name
+    for grp in base.spec.pod_groups:
+        idxs = [
+            snap.node_index(bindings["simple1-0"][ref.name])
+            for ref in grp.pod_references
+            if ref.name != replacement
+        ]
+        if idxs:
+            bound_nodes[grp.name] = idxs
+
+    import copy
+
+    sub = copy.deepcopy(base)
+    sub.spec.pod_groups = [copy.copy(some_group)]
+    sub.spec.pod_groups[0].pod_references = [
+        r for r in some_group.pod_references if r.name == replacement
+    ]
+    sub.spec.pod_groups[0].min_replicas = 1
+
+    # Account all bound pods against the snapshot.
+    from grove_tpu.state import build_snapshot as _bs
+
+    bound = [pods[n] for n in bindings["simple1-0"] if n != replacement]
+    for p, node in ((pods[n], bindings["simple1-0"][n]) for n in bindings["simple1-0"]):
+        if p.name != replacement:
+            p.node_name = node
+    snap2 = _bs(rack_nodes(2, 12), topo, bound_pods=bound)
+
+    batch2, decode2 = encode_gangs(
+        [sub], pods, snap2, bound_nodes_by_group={"simple1-0": bound_nodes}
+    )
+    assert (batch2.set_pinned >= 0).any(), "pin must be encoded"
+    result2 = solve(snap2, batch2)
+    assert bool(np.asarray(result2.ok).all())
+    b2 = decode_assignments(result2, decode2, snap2)
+    new_rack = {snap2.domain_of_node(n, TopologyDomain.RACK) for n in b2["simple1-0"].values()}
+    assert new_rack == {home}, f"replacement left the pinned rack: {new_rack}"
+
+    # Now make the home rack full: the pinned re-solve must FAIL, not split.
+    for node in snap2.node_names:
+        if snap2.domain_of_node(node, TopologyDomain.RACK) == home:
+            snap2.allocated[snap2.node_index(node)] = snap2.capacity[snap2.node_index(node)]
+    batch3, decode3 = encode_gangs(
+        [sub], pods, snap2, bound_nodes_by_group={"simple1-0": bound_nodes}
+    )
+    result3 = solve(snap2, batch3)
+    assert not bool(np.asarray(result3.ok).any()), "must fail rather than split the rack"
+
+
+def test_pin_survives_dropped_bound_group(pcs_rack_required):
+    """Incremental sub-gang where the bound group was dropped entirely (all its
+    pods bound, none gated): the gang-level required pack-set must STILL pin to
+    the bound group's rack — the pin lookup consults original member names,
+    not just the sub-gang's remaining groups."""
+    import copy
+
+    topo = topo3()
+    ds = expand_podcliqueset(pcs_rack_required, topo)
+    snap = build_snapshot(rack_nodes(2, 12), topo)
+    pods = {p.name: p for p in ds.pods}
+    base = next(g for g in ds.podgangs if g.name == "simple1-0")
+
+    batch, decode = encode_gangs([base], pods, snap)
+    result = solve(snap, batch)
+    bindings = decode_assignments(result, decode, snap)
+    (home,) = racks_of(bindings, snap)
+
+    # Sub-gang keeps ONLY group B (one replacement pod); group A ("frontend")
+    # is fully bound and thus absent from the sub-gang's pod_groups.
+    grp_a, grp_b = base.spec.pod_groups[0], base.spec.pod_groups[1]
+    replacement = grp_b.pod_references[0].name
+    sub = copy.deepcopy(base)
+    sub.spec.pod_groups = [copy.copy(grp_b)]
+    sub.spec.pod_groups[0].pod_references = [
+        r for r in grp_b.pod_references if r.name == replacement
+    ]
+    sub.spec.pod_groups[0].min_replicas = 1
+
+    bound_nodes = {
+        grp_a.name: [
+            snap.node_index(bindings["simple1-0"][r.name]) for r in grp_a.pod_references
+        ]
+    }
+    batch2, _ = encode_gangs(
+        [sub], pods, snap, bound_nodes_by_group={"simple1-0": bound_nodes}
+    )
+    assert (batch2.set_pinned >= 0).any(), (
+        "gang-level pin must anchor to the dropped bound group"
+    )
+    # And the pinned value is the home rack's ordinal at the rack level.
+    rack_level = next(
+        li for li, d in enumerate(snap.level_domains) if d == TopologyDomain.RACK
+    )
+    pinned_vals = batch2.set_pinned[batch2.set_pinned >= 0]
+    home_ordinal = snap.node_domain_id[
+        rack_level, snap.node_index(bindings["simple1-0"][grp_a.pod_references[0].name])
+    ]
+    assert (pinned_vals == home_ordinal).all()
