@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""North-star benchmark: drain a 10k-pod gang backlog on a 5k-node cluster.
+
+BASELINE.md target: 10k-pod mixed-size PodGang backlog on a 5120-node
+simulated cluster, solver on one TPU chip, p99 bind latency < 1s with
+all-or-nothing gang semantics and rack/block pack constraints. The reference
+publishes no numbers (SURVEY.md §6); this target is the baseline we set.
+
+Pipeline measured end to end: PodCliqueSet expansion is done up front (it is
+control-plane work the operator amortizes); the timed section is the
+scheduler hot loop — dense encode → jitted batched solve → decode — processed
+in arrival waves, with device-side capacity carried between waves.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+vs_baseline > 1.0 means beating the 1s-p99 target.
+
+Env knobs: GROVE_BENCH_SCALE (float, scales node+pod counts, default 1.0),
+GROVE_BENCH_WAVE (gangs per wave, default 64).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import (
+        bench_topology,
+        synthetic_backlog,
+        synthetic_cluster,
+    )
+    from grove_tpu.solver.core import decode_assignments, solve_batch
+    from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.state import build_snapshot
+
+    scale = float(os.environ.get("GROVE_BENCH_SCALE", "1.0"))
+    wave_size = int(os.environ.get("GROVE_BENCH_WAVE", "64"))
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(racks_per_block=max(1, round(16 * scale)))
+    backlog = synthetic_backlog(
+        n_disagg=max(1, round(350 * scale)),
+        n_agg=max(1, round(250 * scale)),
+        n_frontend=max(1, round(300 * scale)),
+    )
+
+    t_setup = time.perf_counter()
+    gangs = []
+    pods = {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    snapshot = build_snapshot(nodes, topo)
+    setup_s = time.perf_counter() - t_setup
+
+    n_pods = len(pods)
+    mg = max(len(g.spec.pod_groups) for g in gangs)
+    mp = max(g.total_pods() for g in gangs)
+    ms = mg + 2  # gang-level + group-config + per-group constraint sets
+    waves = [gangs[i : i + wave_size] for i in range(0, len(gangs), wave_size)]
+
+    def encode_wave(wave, scheduled):
+        return encode_gangs(
+            wave,
+            pods,
+            snapshot,
+            max_groups=mg,
+            max_sets=ms,
+            max_pods=mp,
+            pad_gangs_to=wave_size,
+            scheduled_gangs=scheduled,
+        )
+
+    capacity = np.asarray(snapshot.capacity)
+    schedulable = np.asarray(snapshot.schedulable)
+    node_domain_id = np.asarray(snapshot.node_domain_id)
+
+    # Warm-up: compile the wave-shaped program once (production keeps the
+    # compiled program cached across reconcile ticks; compile cost reported
+    # separately).
+    t_compile = time.perf_counter()
+    warm_batch, _ = encode_wave(waves[0], set())
+    warm = solve_batch(snapshot.free, capacity, schedulable, node_domain_id, warm_batch)
+    jax.block_until_ready(warm.ok)
+    compile_s = time.perf_counter() - t_compile
+
+    # Timed drain: all gangs queued at t0; a gang's bind latency is the wall
+    # time from t0 to completion of the wave that decided it.
+    scheduled: set[str] = set()
+    latencies: list[float] = []  # admitted gangs only — a bind must exist
+    admitted = 0
+    pods_bound = 0
+    t0 = time.perf_counter()
+    free_arr = snapshot.free
+    for wave in waves:
+        batch, decode = encode_wave(wave, scheduled)
+        result = solve_batch(free_arr, capacity, schedulable, node_domain_id, batch)
+        jax.block_until_ready(result.ok)
+        free_arr = result.free_after
+        # Decode is part of every production solve (controller.solve_pending
+        # always materializes pod->node bindings) — keep it in the timed path.
+        bindings = decode_assignments(result, decode, snapshot)
+        t = time.perf_counter() - t0
+        for name, pod_bindings in bindings.items():
+            scheduled.add(name)
+            admitted += 1
+            pods_bound += len(pod_bindings)
+            latencies.append(t)
+    total_s = time.perf_counter() - t0
+
+    rejected = len(gangs) - admitted
+    lat = np.asarray(latencies) if latencies else np.asarray([math.inf])
+    p50 = float(np.percentile(lat, 50))
+    p99 = float(np.percentile(lat, 99))
+    gangs_per_sec = admitted / total_s
+    pods_per_sec = pods_bound / total_s
+    platform = jax.devices()[0].platform
+
+    target_p99 = 1.0  # BASELINE.md north-star
+    # An undrained backlog must not flatter the headline: scale the score by
+    # the admitted fraction (rejected gangs have no bind latency at all).
+    admitted_frac = admitted / len(gangs) if gangs else 0.0
+    vs = (target_p99 / p99) * admitted_frac if p99 > 0 else math.inf
+    line = {
+        "metric": "gang_p99_bind_latency",
+        "value": round(p99, 4),
+        "unit": "s",
+        "vs_baseline": round(vs, 3),
+        "p50_s": round(p50, 4),
+        "total_drain_s": round(total_s, 3),
+        "gangs": len(gangs),
+        "gangs_admitted": admitted,
+        "gangs_rejected": rejected,
+        "pods": n_pods,
+        "pods_bound": pods_bound,
+        "gangs_per_sec": round(gangs_per_sec, 1),
+        "pods_per_sec": round(pods_per_sec, 1),
+        "nodes": len(nodes),
+        "wave_size": wave_size,
+        "compile_s": round(compile_s, 2),
+        "setup_s": round(setup_s, 2),
+        "platform": platform,
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

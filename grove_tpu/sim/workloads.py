@@ -1,0 +1,171 @@
+"""Synthetic clusters and workload backlogs for benchmarks and scale tests.
+
+Shapes mirror the reference's sample workloads and e2e fixtures
+(operator/samples/user-guide/01_core-concepts/*.yaml: single-node
+disaggregated, multi-node aggregated leader/worker, multi-node disaggregated;
+scale rig: KWOK fake nodes, operator/hack/kind-up.sh:252-265; topology label
+shape: operator/hack/e2e-cluster/create-e2e-cluster.py:133-135).
+
+The TPU analog of the GPU fleet: hosts carry `google.com/tpu` chips, racks are
+the ICI-domain analog (pack constraints target them), zones/blocks the DCN
+level.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from grove_tpu.api import PodCliqueSet, default_podcliqueset
+from grove_tpu.api.types import ClusterTopology, TopologyDomain, TopologyLevel
+from grove_tpu.state.cluster import Node
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+BLOCK_KEY = "topology.kubernetes.io/block"
+RACK_KEY = "topology.kubernetes.io/rack"
+
+
+def bench_topology() -> ClusterTopology:
+    return ClusterTopology(
+        name="bench",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, ZONE_KEY),
+            TopologyLevel(TopologyDomain.BLOCK, BLOCK_KEY),
+            TopologyLevel(TopologyDomain.RACK, RACK_KEY),
+        ],
+    )
+
+
+def synthetic_cluster(
+    zones: int = 4,
+    blocks_per_zone: int = 4,
+    racks_per_block: int = 16,
+    hosts_per_rack: int = 20,
+    cpu: float = 32.0,
+    memory: float = 128 * 2**30,
+    tpu: float = 8.0,
+) -> list[Node]:
+    """Defaults: 4*4*16*20 = 5120 hosts — the 5k-node north-star scale."""
+    nodes: list[Node] = []
+    for z in range(zones):
+        for b in range(blocks_per_zone):
+            for r in range(racks_per_block):
+                for h in range(hosts_per_rack):
+                    nodes.append(
+                        Node(
+                            name=f"z{z}b{b}r{r}h{h}",
+                            capacity={
+                                "cpu": cpu,
+                                "memory": memory,
+                                "google.com/tpu": tpu,
+                            },
+                            labels={
+                                ZONE_KEY: f"z{z}",
+                                BLOCK_KEY: f"b{b}",
+                                RACK_KEY: f"r{r}",
+                            },
+                        )
+                    )
+    return nodes
+
+
+def _clique(name: str, replicas: int, cpu: str, tpu: int = 0,
+            min_available: int | None = None) -> dict[str, Any]:
+    requests: dict[str, Any] = {"cpu": cpu, "memory": "1Gi"}
+    if tpu:
+        requests["google.com/tpu"] = str(tpu)
+    spec: dict[str, Any] = {
+        "roleName": name,
+        "replicas": replicas,
+        "podSpec": {
+            "containers": [
+                {"name": name, "image": f"registry.local/{name}:latest",
+                 "resources": {"requests": requests}}
+            ]
+        },
+    }
+    if min_available is not None:
+        spec["minAvailable"] = min_available
+    return {"name": name, "spec": spec}
+
+
+def _pcs(name: str, cliques: list[dict], scaling_groups: list[dict] | None = None,
+         constraint_domain: str | None = None, replicas: int = 1) -> PodCliqueSet:
+    template: dict[str, Any] = {
+        "cliques": cliques,
+        "startupType": "CliqueStartupTypeAnyOrder",
+    }
+    if scaling_groups:
+        template["podCliqueScalingGroups"] = scaling_groups
+    if constraint_domain:
+        template["topologyConstraint"] = {"packDomain": constraint_domain}
+    doc = {
+        "apiVersion": "grove.io/v1alpha1",
+        "kind": "PodCliqueSet",
+        "metadata": {"name": name},
+        "spec": {"replicas": replicas, "template": template},
+    }
+    return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+
+def disagg_pcs(name: str) -> PodCliqueSet:
+    """Single-node-disaggregated shape: prefill+decode scaled together behind a
+    router, PCSG rack-packed (single-node-disaggregated.yaml pattern)."""
+    return _pcs(
+        name,
+        cliques=[
+            _clique("router", 2, "500m"),
+            _clique("prefill", 4, "1", tpu=1),
+            _clique("decode", 4, "1", tpu=1),
+        ],
+        scaling_groups=[
+            {
+                "name": "workers",
+                "cliqueNames": ["prefill", "decode"],
+                "replicas": 2,
+                "minAvailable": 1,
+                "topologyConstraint": {"packDomain": "rack"},
+            }
+        ],
+    )
+
+
+def aggregated_pcs(name: str) -> PodCliqueSet:
+    """Multi-node-aggregated shape: leader + workers gang, rack-required
+    (multi-node-aggregated.yaml pattern)."""
+    return _pcs(
+        name,
+        cliques=[
+            _clique("frontend", 2, "500m"),
+            _clique("leader", 1, "1", tpu=2),
+            _clique("worker", 7, "1", tpu=2),
+        ],
+        scaling_groups=[
+            {
+                "name": "model",
+                "cliqueNames": ["leader", "worker"],
+                "replicas": 1,
+                "minAvailable": 1,
+                "topologyConstraint": {"packDomain": "rack"},
+            }
+        ],
+        constraint_domain="block",
+    )
+
+
+def frontend_pcs(name: str) -> PodCliqueSet:
+    """Small standalone-clique workload (simple1 frontend analog)."""
+    return _pcs(name, cliques=[_clique("frontend", 4, "250m")])
+
+
+def synthetic_backlog(
+    n_disagg: int = 350, n_agg: int = 250, n_frontend: int = 300
+) -> list[PodCliqueSet]:
+    """~10k pods with defaults: 350*18 + 250*10 + 300*4 = 10000."""
+    out: list[PodCliqueSet] = []
+    for i in range(n_disagg):
+        out.append(disagg_pcs(f"disagg-{i}"))
+    for i in range(n_agg):
+        out.append(aggregated_pcs(f"agg-{i}"))
+    for i in range(n_frontend):
+        out.append(frontend_pcs(f"fe-{i}"))
+    return out
