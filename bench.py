@@ -37,12 +37,18 @@ def main() -> None:
         synthetic_backlog,
         synthetic_cluster,
     )
-    from grove_tpu.solver.core import decode_assignments, solve_batch
+    from grove_tpu.solver.core import (
+        decode_assignments,
+        solve_batch,
+        solve_batch_speculative,
+    )
     from grove_tpu.solver.encode import encode_gangs
     from grove_tpu.state import build_snapshot
 
     scale = float(os.environ.get("GROVE_BENCH_SCALE", "1.0"))
     wave_size = int(os.environ.get("GROVE_BENCH_WAVE", "64"))
+    speculative = os.environ.get("GROVE_BENCH_SPECULATIVE", "1") == "1"
+    solver = solve_batch_speculative if speculative else solve_batch
 
     topo = bench_topology()
     nodes = synthetic_cluster(racks_per_block=max(1, round(16 * scale)))
@@ -89,7 +95,7 @@ def main() -> None:
     # separately).
     t_compile = time.perf_counter()
     warm_batch, _ = encode_wave(waves[0], set())
-    warm = solve_batch(snapshot.free, capacity, schedulable, node_domain_id, warm_batch)
+    warm = solver(snapshot.free, capacity, schedulable, node_domain_id, warm_batch)
     jax.block_until_ready(warm.ok)
     compile_s = time.perf_counter() - t_compile
 
@@ -103,7 +109,7 @@ def main() -> None:
     free_arr = snapshot.free
     for wave in waves:
         batch, decode = encode_wave(wave, scheduled)
-        result = solve_batch(free_arr, capacity, schedulable, node_domain_id, batch)
+        result = solver(free_arr, capacity, schedulable, node_domain_id, batch)
         jax.block_until_ready(result.ok)
         free_arr = result.free_after
         # Decode is part of every production solve (controller.solve_pending
@@ -129,13 +135,19 @@ def main() -> None:
     # An undrained backlog must not flatter the headline: scale the score by
     # the admitted fraction (rejected gangs have no bind latency at all).
     admitted_frac = admitted / len(gangs) if gangs else 0.0
-    vs = (target_p99 / p99) * admitted_frac if p99 > 0 else math.inf
+    vs = (target_p99 / p99) * admitted_frac if p99 > 0 else 0.0
+
+    def _num(x, nd):
+        # json.dumps emits non-RFC "Infinity" for inf — null keeps the line
+        # machine-readable exactly when a broken run most needs parsing.
+        return round(x, nd) if math.isfinite(x) else None
+
     line = {
         "metric": "gang_p99_bind_latency",
-        "value": round(p99, 4),
+        "value": _num(p99, 4),
         "unit": "s",
-        "vs_baseline": round(vs, 3),
-        "p50_s": round(p50, 4),
+        "vs_baseline": _num(vs, 3),
+        "p50_s": _num(p50, 4),
         "total_drain_s": round(total_s, 3),
         "gangs": len(gangs),
         "gangs_admitted": admitted,
@@ -145,7 +157,7 @@ def main() -> None:
         "gangs_per_sec": round(gangs_per_sec, 1),
         "pods_per_sec": round(pods_per_sec, 1),
         "nodes": len(nodes),
-        "wave_size": wave_size,
+        "wave_size": wave_size, "speculative": speculative,
         "compile_s": round(compile_s, 2),
         "setup_s": round(setup_s, 2),
         "platform": platform,

@@ -49,6 +49,34 @@ class SolverParams(NamedTuple):
     w_pref: jnp.float32 = 4.0  # preferred-domain bonus per matching pack-set
     w_reuse: jnp.float32 = 2.0  # gang locality: prefer nodes this gang already uses
     w_reserve: jnp.float32 = 8.0  # keep non-members out of committed pack domains
+    # Deterministic per-gang score jitter that decorrelates speculative
+    # parallel placements: without it every gang in a wave picks the same
+    # best-fit nodes/domains and the conflict chain degenerates to sequential
+    # commits. Zero by default — the sequential path gains nothing from it
+    # and would only pay bin-packing quality; solve_batch_speculative
+    # substitutes SPECULATIVE_JITTER when the caller leaves it at 0.
+    w_jitter: jnp.float32 = 0.0
+
+
+# Jitter used by the speculative path when params.w_jitter is 0 (measured
+# sweet spot: strong enough to spread colliding gangs across near-equal
+# domains, weak enough to keep packing tight).
+SPECULATIVE_JITTER = 0.15
+
+
+def _weyl_jitter(seed: jax.Array, count: int) -> jax.Array:
+    """Deterministic pseudo-jitter in [0, 1), shaped [count].
+
+    Hashed in uint32 integer space — a float32 Weyl sequence loses all
+    fractional resolution once seed*phi exceeds ~2^20 (exactly the
+    index + round*G seeds the speculative re-roll uses), silently turning
+    the decorrelation into a constant."""
+    idx = jnp.arange(count, dtype=jnp.uint32)
+    h = seed.astype(jnp.uint32) * jnp.uint32(2654435761) + idx * jnp.uint32(0x9E3779B9)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    return h.astype(jnp.float32) * jnp.float32(1.0 / 4294967296.0)
 
 
 class SolveResult(NamedTuple):
@@ -120,8 +148,58 @@ def _place_gang(free, used_carry, gang, *, schedulable, node_domain_id, cap_scal
         slots = _group_slots(free, group_req)  # [MG, N]
         slots = jnp.where(node_ok[None, :], slots, 0)
 
-        def pick_domain(level, extra_node_mask):
-            """Best-fit feasible domain at `level` among nodes passing masks."""
+        def nested_feasible(level, ok_nodes):
+            """[N_dom at `level`]: every NARROWER required set sharing a group
+            must have some feasible domain nested inside the candidate.
+
+            Without this, best-fit aggregate feasibility happily commits e.g.
+            a block whose total capacity fits the gang but whose racks are all
+            too fragmented for the rack-packed group — the narrow set then
+            fails and the whole gang is rejected despite feasible blocks
+            elsewhere (hierarchical bin-packing myopia).
+
+            Domain sums are precomputed once per topology LEVEL (not per set,
+            which would be O(MS^2) segment reductions) and indexed by each
+            set's level."""
+            seg, _ = seg_of(level)
+
+            def level_sums(lvl):
+                seg_l, _ = seg_of(lvl)
+                dom_free_l = _domain_sum(jnp.where(ok_nodes[:, None], free, 0.0), seg_l, n)
+                dom_slots_l = _domain_sum(jnp.where(ok_nodes[None, :], slots, 0).T, seg_l, n)
+                return dom_free_l, dom_slots_l
+
+            dom_free_L, dom_slots_L = jax.vmap(level_sums)(jnp.arange(levels))
+
+            def one(s2):
+                lvl2 = set_req_level[s2]
+                lvl2c = jnp.clip(lvl2, 0, levels - 1)
+                member2 = set_member[s2] & group_valid  # [MG]
+                active2 = (
+                    set_valid[s2]
+                    & (lvl2 > level)
+                    & (set_member[s2] & member).any()
+                )
+                demand2 = (
+                    group_req * (group_required * member2).astype(jnp.float32)[:, None]
+                ).sum(0)  # [R]
+                _, dom2 = seg_of(lvl2)
+                feas2 = (dom_free_L[lvl2c] >= demand2[None, :] - _EPS).all(axis=-1) & (
+                    (dom_slots_L[lvl2c] >= group_required[None, :]) | ~member2[None, :]
+                ).all(axis=-1)  # [N_dom2]
+                node_feas2 = (
+                    jnp.where(dom2 >= 0, feas2[jnp.clip(dom2, 0, n - 1)], False) & ok_nodes
+                )
+                nested_any = _domain_sum(node_feas2.astype(jnp.int32), seg, n) > 0
+                return jnp.where(active2, nested_any, True)  # [N_dom]
+
+            return jax.vmap(one)(jnp.arange(ms)).all(axis=0)
+
+        def pick_domain(level, extra_node_mask, check_nested=False):
+            """Best-fit feasible domain at `level` among nodes passing masks.
+
+            `check_nested` (required picks only — a failed preferred pick
+            cannot reject the gang) adds the hierarchical feasibility guard."""
             seg, _ = seg_of(level)
             ok_nodes = node_ok & extra_node_mask
             dom_free = _domain_sum(jnp.where(ok_nodes[:, None], free, 0.0), seg, n)  # [N_dom, R]
@@ -130,7 +208,14 @@ def _place_gang(free, used_carry, gang, *, schedulable, node_domain_id, cap_scal
             feas_slots = ((dom_slots >= group_required[None, :]) | ~memberf[None, :]).all(axis=-1)
             nonempty = _domain_sum(ok_nodes.astype(jnp.int32), seg, n) > 0
             feasible = feas_cap & feas_slots & nonempty
-            score = jnp.where(feasible, -dom_free.sum(axis=-1), -jnp.inf)
+            if check_nested:
+                feasible = feasible & nested_feasible(level, ok_nodes)
+            # Best fit on normalized free (raw sums would let memory bytes
+            # drown cpu/chip counts), perturbed by per-gang jitter so
+            # concurrent speculative gangs spread across near-equal domains.
+            norm_free = (dom_free / cap_scale[None, :]).sum(axis=-1)
+            dj = _weyl_jitter(gang["index"] * 7919 + level, n)
+            score = jnp.where(feasible, -norm_free * (1.0 + params.w_jitter * dj), -jnp.inf)
             return jnp.argmax(score), feasible.any()
 
         # Incremental re-solve pin: bound pods of this set already sit in a
@@ -140,7 +225,7 @@ def _place_gang(free, used_carry, gang, *, schedulable, node_domain_id, cap_scal
         pinned = set_pinned[s]
         pin_mask = jnp.where(pinned >= 0, req_dom == pinned, jnp.ones((n,), dtype=bool))
         has_req = active & (req_level >= 0)
-        req_choice, req_any = pick_domain(req_level, pin_mask)
+        req_choice, req_any = pick_domain(req_level, pin_mask, check_nested=True)
         new_req = jnp.where(has_req & req_any, req_choice, -1)
         fail = fail | (has_req & ~req_any)
 
@@ -203,6 +288,7 @@ def _place_gang(free, used_carry, gang, *, schedulable, node_domain_id, cap_scal
             + params.w_reuse * used.astype(jnp.float32)
             - params.w_tight * norm_free
             - params.w_reserve * reserved
+            + params.w_jitter * _weyl_jitter(gang["index"] * 31 + g, n)
         )
         order = jnp.argsort(-jnp.where(slots > 0, score, -jnp.inf))
         slots_sorted = slots[order]
@@ -318,6 +404,7 @@ def solve_batch(
         "gang_valid": batch.gang_valid,
         "group_order": batch.group_order,
         "depends_on": batch.depends_on,
+        "index": jnp.arange(g, dtype=jnp.int32),
     }
     (free_final, _), (assigned, ok, score) = jax.lax.scan(
         step, (free0, jnp.zeros((g,), dtype=bool)), (gang_dict, jnp.arange(g))
@@ -325,14 +412,157 @@ def solve_batch(
     return SolveResult(assigned=assigned, ok=ok, placement_score=score, free_after=free_final)
 
 
-def solve(snapshot, batch: GangBatch, params: SolverParams = SolverParams()) -> SolveResult:
+@jax.jit
+def solve_batch_speculative(
+    free0: jax.Array,  # f32 [N, R]
+    capacity: jax.Array,  # f32 [N, R]
+    schedulable: jax.Array,  # bool [N]
+    node_domain_id: jax.Array,  # i32 [L, N]
+    batch: GangBatch,
+    params: SolverParams = SolverParams(),
+) -> SolveResult:
+    """Speculative parallel commit: place the whole batch at once, keep the
+    conflict-free subset, loop on the rest.
+
+    The sequential scan in `solve_batch` pays O(G) per-gang latency because
+    each gang must see the previous gang's capacity updates. But placements
+    rarely collide on a large cluster — so place ALL undecided gangs in
+    parallel (vmap) against the current free capacity, then:
+
+      - prefix-feasible commit: with gangs in batch (priority) order, gang g
+        commits when, on every (node, resource) IT uses, the cumulative
+        speculative usage of gangs <= g fits within free capacity. The
+        committed set is jointly feasible: for any node, the last committed
+        gang using it has a cumulative that upper-bounds the committed total
+        there. The first admitted gang always commits (its cumulative is its
+        own feasible placement), so every round makes progress — and
+        independent sub-batches (different racks) commit concurrently
+      - the per-gang score jitter is re-rolled each round (seed folds in the
+        round number), so gangs that collided re-spread across near-equal
+        nodes/domains instead of re-picking the same ones — randomized
+        backoff for placement
+      - a placeable gang whose own placement failed is rejected finally
+        (free only shrinks; all-or-nothing is preserved exactly)
+      - a scaled gang waits (stays undecided, consumes nothing) until its
+        base gang is decided, then follows the same path (syncflow.go:347-387)
+
+    Worst case (every gang fighting for one node) degenerates toward the
+    sequential scan's behavior over `lax.while_loop` rounds; the common case
+    converges in a handful of rounds, each costing ~one parallel placement.
+    Admission can differ from `solve_batch` only in contended corners (commit
+    order differs); the gang invariants — all-or-nothing, capacity never
+    oversubscribed, dependency gating — hold identically.
+    """
+    n = free0.shape[0]
+    g = batch.gang_valid.shape[0]
+    mp = batch.pod_group.shape[1]
+    cap_scale = jnp.maximum(capacity.max(axis=0), 1e-9)
+    # Speculation needs score decorrelation; honor an explicit caller value.
+    params = params._replace(
+        w_jitter=jnp.where(
+            jnp.asarray(params.w_jitter) > 0, params.w_jitter, SPECULATIVE_JITTER
+        )
+    )
+
+    gang_dict = {
+        "group_req": batch.group_req,
+        "group_total": batch.group_total,
+        "group_required": batch.group_required,
+        "group_valid": batch.group_valid,
+        "set_member": batch.set_member,
+        "set_req_level": batch.set_req_level,
+        "set_pref_level": batch.set_pref_level,
+        "set_valid": batch.set_valid,
+        "set_pinned": batch.set_pinned,
+        "pod_group": batch.pod_group,
+        "pod_rank": batch.pod_rank,
+        "gang_valid": batch.gang_valid,
+        "group_order": batch.group_order,
+        "depends_on": batch.depends_on,
+        "index": jnp.arange(g, dtype=jnp.int32),
+    }
+
+    def place_one(free, gang_slices):
+        used0 = jnp.zeros((n,), dtype=bool)
+        free_out, _, assigned, ok, score = _place_gang(
+            free,
+            used0,
+            gang_slices,
+            schedulable=schedulable,
+            node_domain_id=node_domain_id,
+            cap_scale=cap_scale,
+            params=params,
+        )
+        usage = jnp.where(ok, free - free_out, 0.0)  # [N, R]
+        return usage, assigned, ok, score
+
+    place_all = jax.vmap(place_one, in_axes=(None, 0))
+
+    dep = batch.depends_on  # [G]
+    dep_idx = jnp.clip(dep, 0, g - 1)
+
+    def cond(state):
+        free, decided, ok_final, assigned, scores, rounds = state
+        return (~decided).any() & (rounds < g + 1)
+
+    def body(state):
+        free, decided, ok_final, assigned, scores, rounds = state
+        # Dependency gate: no dep, or dep decided (then its verdict applies).
+        dep_decided = jnp.where(dep >= 0, decided[dep_idx], True)
+        dep_ok = jnp.where(dep >= 0, ok_final[dep_idx], True)
+        placeable = ~decided & dep_decided
+        gd = dict(gang_dict)
+        gd["gang_valid"] = gd["gang_valid"] & placeable & dep_ok
+        gd["index"] = gang_dict["index"] + rounds * g  # re-roll jitter per round
+        usage, assigned_r, ok_r, scores_r = place_all(free, gd)
+
+        # Prefix-feasible commit (see docstring): cumulative usage in batch
+        # order; a gang commits iff its own footprint stays within free.
+        cum = jnp.cumsum(usage, axis=0)  # [G, N, R]
+        violates = ((usage > 0) & (cum > free[None, :, :] + _EPS)).any(axis=(1, 2))
+        commit = ok_r & ~violates
+
+        free = free - jnp.where(commit[:, None, None], usage, 0.0).sum(axis=0)
+        # Finalize: committed gangs, and placeable gangs that failed outright
+        # (incl. dep-rejected). Conflicted non-head gangs stay undecided.
+        rejected_now = placeable & ~ok_r
+        newly = commit | rejected_now
+        assigned = jnp.where((newly & ok_r)[:, None], assigned_r, assigned)
+        scores = jnp.where(newly & ok_r, scores_r, scores)
+        ok_final = ok_final | (newly & ok_r & commit)
+        decided = decided | newly
+        return (free, decided, ok_final, assigned, scores, rounds + 1)
+
+    init = (
+        free0,
+        ~batch.gang_valid,  # invalid/padding gangs are pre-decided as rejected
+        jnp.zeros((g,), dtype=bool),
+        jnp.full((g, mp), -1, dtype=jnp.int32),
+        jnp.zeros((g,), dtype=jnp.float32),
+        jnp.asarray(0, dtype=jnp.int32),
+    )
+    free_f, decided, ok_final, assigned, scores, _ = jax.lax.while_loop(cond, body, init)
+    assigned = jnp.where(ok_final[:, None], assigned, -1)
+    scores = jnp.where(ok_final, scores, 0.0)
+    return SolveResult(
+        assigned=assigned, ok=ok_final, placement_score=scores, free_after=free_f
+    )
+
+
+def solve(
+    snapshot,
+    batch: GangBatch,
+    params: SolverParams = SolverParams(),
+    speculative: bool = False,
+) -> SolveResult:
     """Convenience wrapper: snapshot (numpy) -> device -> solve_batch."""
     free0 = jnp.asarray(snapshot.free)
     capacity = jnp.asarray(snapshot.capacity)
     schedulable = jnp.asarray(snapshot.schedulable)
     node_domain_id = jnp.asarray(snapshot.node_domain_id)
     jbatch = GangBatch(*(jnp.asarray(x) for x in batch))
-    return solve_batch(free0, capacity, schedulable, node_domain_id, jbatch, params)
+    fn = solve_batch_speculative if speculative else solve_batch
+    return fn(free0, capacity, schedulable, node_domain_id, jbatch, params)
 
 
 def decode_assignments(result: SolveResult, decode_info, snapshot) -> dict[str, dict[str, str]]:

@@ -65,7 +65,7 @@ def test_population_slot0_is_base():
     base = SolverParams(w_tight=2.0, w_pref=3.0, w_reuse=1.0, w_reserve=5.0)
     pop = params_population(6, base=base)
     vec = np.asarray([float(w[0]) for w in pop])
-    np.testing.assert_allclose(vec, [2.0, 3.0, 1.0, 5.0], rtol=1e-6)
+    np.testing.assert_allclose(vec, [float(w) for w in base], rtol=1e-6)
     # other slots actually perturbed
     assert not np.allclose(np.asarray(pop.w_tight), 2.0)
 
