@@ -1,0 +1,21 @@
+"""Scheduler-backend boundary: gRPC sidecar + client (GREP-375 contract)."""
+
+from grove_tpu.backend.client import BackendClient
+from grove_tpu.backend.service import (
+    BACKEND_NAME,
+    PENDING_GATE,
+    SCHEDULER_NAME,
+    SERVICE_NAME,
+    TPUSchedulerBackend,
+    create_server,
+)
+
+__all__ = [
+    "BACKEND_NAME",
+    "BackendClient",
+    "PENDING_GATE",
+    "SCHEDULER_NAME",
+    "SERVICE_NAME",
+    "TPUSchedulerBackend",
+    "create_server",
+]

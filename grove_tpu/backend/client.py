@@ -1,0 +1,81 @@
+"""Thin typed client for the grove-tpu scheduler-backend sidecar.
+
+The operator-side half of the GREP-375 boundary: what the Go shim (or the
+Python orchestrator in simulation) calls. One unary stub per RPC, protobuf
+in/out — no generated stubs needed.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
+from grove_tpu.backend.service import SERVICE_NAME
+
+_RESPONSES = {
+    "Init": pb.InitResponse,
+    "SyncPodGang": pb.SyncPodGangResponse,
+    "OnPodGangDelete": pb.OnPodGangDeleteResponse,
+    "PreparePod": pb.PreparePodResponse,
+    "ValidatePodCliqueSet": pb.ValidatePodCliqueSetResponse,
+    "UpdateCluster": pb.UpdateClusterResponse,
+    "ReleasePods": pb.ReleasePodsResponse,
+    "Solve": pb.SolveResponse,
+}
+
+
+class BackendClient:
+    def __init__(self, address: str):
+        self._channel = grpc.insecure_channel(address)
+        self._stubs = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=lambda req: req.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+            for name, resp_cls in _RESPONSES.items()
+        }
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "BackendClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def init(self, topology: list[tuple[str, str]]) -> pb.InitResponse:
+        req = pb.InitRequest()
+        for domain, key in topology:
+            req.topology.append(pb.TopologyLevel(domain=domain, node_label_key=key))
+        return self._stubs["Init"](req)
+
+    def sync_pod_gang(self, spec: pb.PodGangSpec) -> pb.SyncPodGangResponse:
+        return self._stubs["SyncPodGang"](pb.SyncPodGangRequest(pod_gang=spec))
+
+    def on_pod_gang_delete(self, name: str, namespace: str = "default") -> pb.OnPodGangDeleteResponse:
+        return self._stubs["OnPodGangDelete"](
+            pb.OnPodGangDeleteRequest(name=name, namespace=namespace)
+        )
+
+    def prepare_pod(self, pod_name: str, pod_gang_name: str = "") -> pb.PreparePodResponse:
+        return self._stubs["PreparePod"](
+            pb.PreparePodRequest(pod_name=pod_name, pod_gang_name=pod_gang_name)
+        )
+
+    def validate_podcliqueset(self, pcs_yaml: str) -> pb.ValidatePodCliqueSetResponse:
+        return self._stubs["ValidatePodCliqueSet"](
+            pb.ValidatePodCliqueSetRequest(pcs_yaml=pcs_yaml)
+        )
+
+    def update_cluster(self, nodes: list[pb.Node], full_replace: bool = False) -> pb.UpdateClusterResponse:
+        return self._stubs["UpdateCluster"](
+            pb.UpdateClusterRequest(nodes=nodes, full_replace=full_replace)
+        )
+
+    def release_pods(self, pod_names: list[str]) -> pb.ReleasePodsResponse:
+        return self._stubs["ReleasePods"](pb.ReleasePodsRequest(pod_names=pod_names))
+
+    def solve(self, speculative: bool = False) -> pb.SolveResponse:
+        return self._stubs["Solve"](pb.SolveRequest(speculative=speculative))

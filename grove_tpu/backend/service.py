@@ -1,0 +1,379 @@
+"""The grove-tpu scheduler-backend sidecar: gRPC service around the solver.
+
+Implements the reference's SchedulerBackend boundary (GREP-375,
+docs/proposals/375-scheduler-backend-framework/README.md:158-202) as a
+standalone gRPC process an unmodified Go operator can talk to:
+
+  Init                 — topology handshake (ClusterTopology levels)
+  SyncPodGang          — register/refresh a gang (PodGang IR)
+  OnPodGangDelete      — drop a gang, release its bindings
+  PreparePod           — schedulerName + scheduling-gate injection
+                         (podclique/components/pod/pod.go:68,162)
+  ValidatePodCliqueSet — backend-specific admission checks
+
+plus the placement cycle KAI performs out-of-band in the reference:
+
+  UpdateCluster        — node snapshot feed (the informer-cache analog)
+  ReleasePods          — free capacity for externally deleted pods
+  Solve                — drain pending gangs through the JAX batched solver;
+                         whole-gang bindings + PlacementScore out
+
+The service is a thin, locked translation layer: proto -> PodGang IR ->
+dense encode -> jitted solve -> bindings. All placement state (nodes, gangs,
+bindings) lives here so repeated Solve calls are incremental: already-bound
+pods shrink group floors and pin required pack-sets to their domains.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from grove_tpu.api.pod import Pod
+from grove_tpu.api.podgang import (
+    IRTopologyConstraint,
+    NamespacedName,
+    PodGang,
+    PodGroup,
+    TopologyConstraintGroupConfig,
+    TopologyPackConstraint,
+)
+from grove_tpu.api.types import (
+    ClusterTopology,
+    Container,
+    PodSpec,
+    TopologyDomain,
+    TopologyLevel,
+)
+from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
+from grove_tpu.solver.core import decode_assignments, solve
+from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.state.cluster import Node, build_snapshot
+
+SERVICE_NAME = "grove_tpu.backend.v1.SchedulerBackend"
+BACKEND_NAME = "grove-tpu"
+SCHEDULER_NAME = "grove-tpu-scheduler"
+PENDING_GATE = "grove.io/podgang-pending-creation"
+LABEL_PODGANG = "grove.io/podgang"
+
+
+def _pack_constraint(p: Optional[pb.PackConstraint]) -> Optional[IRTopologyConstraint]:
+    if p is None or (not p.required_key and not p.preferred_key):
+        return None
+    return IRTopologyConstraint(
+        pack_constraint=TopologyPackConstraint(
+            required=p.required_key or None, preferred=p.preferred_key or None
+        )
+    )
+
+
+def _gang_from_proto(spec: pb.PodGangSpec) -> tuple[PodGang, dict[str, dict[str, float]]]:
+    """Proto -> PodGang IR + per-group per-pod request map."""
+    gang = PodGang(name=spec.name, namespace=spec.namespace or "default")
+    gang.spec.priority_class_name = spec.priority_class_name
+    gang.spec.topology_constraint = _pack_constraint(
+        spec.pack_constraint if spec.HasField("pack_constraint") else None
+    )
+    gang.base_podgang_name = spec.base_podgang_name or None
+    if spec.HasField("reuse_reservation_ref"):
+        gang.spec.reuse_reservation_ref = NamespacedName(
+            spec.reuse_reservation_ref.namespace, spec.reuse_reservation_ref.name
+        )
+    requests: dict[str, dict[str, float]] = {}
+    for grp in spec.pod_groups:
+        g = PodGroup(
+            name=grp.name,
+            pod_references=[
+                NamespacedName(r.namespace or "default", r.name) for r in grp.pod_references
+            ],
+            min_replicas=grp.min_replicas,
+            topology_constraint=_pack_constraint(
+                grp.pack_constraint if grp.HasField("pack_constraint") else None
+            ),
+        )
+        gang.spec.pod_groups.append(g)
+        requests[grp.name] = {q.name: q.value for q in grp.per_pod_requests}
+    for gc in spec.group_configs:
+        gang.spec.topology_constraint_group_configs.append(
+            TopologyConstraintGroupConfig(
+                name=gc.name,
+                pod_group_names=list(gc.pod_group_names),
+                topology_constraint=_pack_constraint(
+                    gc.pack_constraint if gc.HasField("pack_constraint") else None
+                ),
+            )
+        )
+    return gang, requests
+
+
+class TPUSchedulerBackend:
+    """Servicer: every RPC is a short critical section over the state."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._topology = ClusterTopology(name="backend", levels=[])
+        self._nodes: dict[str, Node] = {}
+        self._gangs: dict[str, PodGang] = {}
+        self._group_requests: dict[str, dict[str, dict[str, float]]] = {}  # gang -> group -> reqs
+        self._bindings: dict[str, tuple[str, str, str]] = {}  # pod -> (node, gang, group)
+        self._scheduled_gangs: set[str] = set()
+
+    # ---- GREP-375 surface --------------------------------------------------------
+
+    def Init(self, request: pb.InitRequest, context) -> pb.InitResponse:
+        levels = []
+        for lv in request.topology:
+            try:
+                levels.append(TopologyLevel(TopologyDomain(lv.domain), lv.node_label_key))
+            except ValueError:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, f"unknown topology domain {lv.domain!r}"
+                )
+        with self._lock:
+            self._topology = ClusterTopology(name="backend", levels=levels)
+        return pb.InitResponse(name=BACKEND_NAME)
+
+    def SyncPodGang(self, request: pb.SyncPodGangRequest, context) -> pb.SyncPodGangResponse:
+        gang, requests = _gang_from_proto(request.pod_gang)
+        if not gang.name:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "pod_gang.name required")
+        with self._lock:
+            self._gangs[gang.name] = gang
+            self._group_requests[gang.name] = requests
+            # Drop bindings of pods no longer referenced (spec shrink).
+            live = {r.name for g in gang.spec.pod_groups for r in g.pod_references}
+            for pod in [p for p, (_, gname, _) in self._bindings.items()
+                        if gname == gang.name and p not in live]:
+                del self._bindings[pod]
+        return pb.SyncPodGangResponse()
+
+    def OnPodGangDelete(self, request: pb.OnPodGangDeleteRequest, context) -> pb.OnPodGangDeleteResponse:
+        with self._lock:
+            self._gangs.pop(request.name, None)
+            self._group_requests.pop(request.name, None)
+            self._scheduled_gangs.discard(request.name)
+            for pod in [p for p, (_, gname, _) in self._bindings.items() if gname == request.name]:
+                del self._bindings[pod]
+        return pb.OnPodGangDeleteResponse()
+
+    def PreparePod(self, request: pb.PreparePodRequest, context) -> pb.PreparePodResponse:
+        resp = pb.PreparePodResponse(
+            scheduler_name=SCHEDULER_NAME, scheduling_gates=[PENDING_GATE]
+        )
+        if request.pod_gang_name:
+            resp.labels[LABEL_PODGANG] = request.pod_gang_name
+        return resp
+
+    def ValidatePodCliqueSet(self, request: pb.ValidatePodCliqueSetRequest, context) -> pb.ValidatePodCliqueSetResponse:
+        import yaml
+
+        from grove_tpu.api import (
+            PodCliqueSet,
+            default_podcliqueset,
+            validate_podcliqueset,
+        )
+
+        try:
+            doc = yaml.safe_load(request.pcs_yaml)
+            pcs = default_podcliqueset(PodCliqueSet.from_dict(doc))
+        except Exception as exc:  # malformed input is a validation error, not a crash
+            return pb.ValidatePodCliqueSetResponse(errors=[f"unparseable PodCliqueSet: {exc}"])
+        with self._lock:
+            topology = self._topology
+        errors = [str(e) for e in validate_podcliqueset(pcs, topology.with_host_level())]
+        return pb.ValidatePodCliqueSetResponse(errors=errors)
+
+    # ---- placement cycle ---------------------------------------------------------
+
+    def UpdateCluster(self, request: pb.UpdateClusterRequest, context) -> pb.UpdateClusterResponse:
+        with self._lock:
+            if request.full_replace:
+                self._nodes.clear()
+            for n in request.nodes:
+                self._nodes[n.name] = Node(
+                    name=n.name,
+                    capacity={q.name: q.value for q in n.capacity},
+                    labels=dict(n.labels),
+                    schedulable=n.schedulable,
+                )
+            return pb.UpdateClusterResponse(node_count=len(self._nodes))
+
+    def ReleasePods(self, request: pb.ReleasePodsRequest, context) -> pb.ReleasePodsResponse:
+        with self._lock:
+            for name in request.pod_names:
+                self._bindings.pop(name, None)
+        return pb.ReleasePodsResponse()
+
+    def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        t0 = time.perf_counter()
+        with self._lock:
+            result = self._solve_locked(speculative=request.speculative)
+        result.solve_micros = int((time.perf_counter() - t0) * 1e6)
+        return result
+
+    def _solve_locked(self, speculative: bool) -> pb.SolveResponse:
+        resp = pb.SolveResponse()
+        if not self._nodes:
+            return resp
+        # Sub-gangs over unbound pods, floors shrunk by bound pods — the same
+        # incremental discipline as the in-process controller
+        # (orchestrator/controller.py solve_pending).
+        pending: list[PodGang] = []
+        pods_by_name: dict[str, Pod] = {}
+        bound_nodes_by_group: dict[str, dict[str, list[str]]] = {}
+        for gang in sorted(
+            self._gangs.values(),
+            key=lambda g: (g.base_podgang_name is not None, g.name),
+        ):
+            reqs = self._group_requests.get(gang.name, {})
+            sub = PodGang(name=gang.name, namespace=gang.namespace)
+            sub.spec.topology_constraint = gang.spec.topology_constraint
+            sub.spec.priority_class_name = gang.spec.priority_class_name
+            sub.base_podgang_name = gang.base_podgang_name
+            groups_with_pending: set[str] = set()
+            per_group_bound: dict[str, list[str]] = {}
+            for grp in gang.spec.pod_groups:
+                unbound = [r for r in grp.pod_references if r.name not in self._bindings]
+                bound = [r for r in grp.pod_references if r.name in self._bindings]
+                if bound:
+                    per_group_bound[grp.name] = [self._bindings[r.name][0] for r in bound]
+                if not unbound:
+                    continue
+                sub_grp = PodGroup(
+                    name=grp.name,
+                    pod_references=unbound,
+                    min_replicas=max(0, grp.min_replicas - len(bound)),
+                    topology_constraint=grp.topology_constraint,
+                )
+                sub.spec.pod_groups.append(sub_grp)
+                groups_with_pending.add(grp.name)
+                group_reqs = reqs.get(grp.name, {})
+                for ref in unbound:
+                    pods_by_name[ref.name] = Pod(
+                        name=ref.name,
+                        namespace=ref.namespace,
+                        spec=PodSpec(containers=[Container(name="c", requests=dict(group_reqs))]),
+                    )
+            if not sub.spec.pod_groups:
+                continue
+            sub.spec.topology_constraint_group_configs = [
+                gc
+                for gc in gang.spec.topology_constraint_group_configs
+                if any(n in groups_with_pending for n in gc.pod_group_names)
+            ]
+            if per_group_bound:
+                bound_nodes_by_group[gang.name] = per_group_bound
+            pending.append(sub)
+        if not pending:
+            return resp
+
+        bound_pods = [
+            Pod(
+                name=pod,
+                node_name=node,
+                spec=PodSpec(containers=[Container(
+                    name="c",
+                    requests=dict(self._group_requests.get(gname, {}).get(group, {})),
+                )]),
+            )
+            for pod, (node, gname, group) in self._bindings.items()
+        ]
+        snapshot = build_snapshot(
+            list(self._nodes.values()),
+            self._topology,
+            bound_pods=[p for p in bound_pods if p.node_name in self._nodes],
+        )
+        bound_idx = {
+            gname: {
+                grp: [snapshot.node_index(n) for n in nodes if n in snapshot.node_index_map]
+                for grp, nodes in groups.items()
+            }
+            for gname, groups in bound_nodes_by_group.items()
+        }
+        batch, decode = encode_gangs(
+            pending,
+            pods_by_name,
+            snapshot,
+            scheduled_gangs=self._scheduled_gangs,
+            bound_nodes_by_group=bound_idx,
+        )
+        result = solve(snapshot, batch, speculative=speculative)
+        bindings = decode_assignments(result, decode, snapshot)
+
+        import numpy as np
+
+        ok = dict(zip(decode.gang_names, np.asarray(result.ok)))
+        scores = dict(zip(decode.gang_names, np.asarray(result.placement_score)))
+        group_of_pod = {
+            r.name: (g.name, grp.name)
+            for g in pending
+            for grp in g.spec.pod_groups
+            for r in grp.pod_references
+        }
+        for gang_name in decode.gang_names:
+            gr = pb.GangResult(
+                name=gang_name,
+                admitted=bool(ok.get(gang_name, False)),
+                placement_score=float(scores.get(gang_name, 0.0)),
+            )
+            for pod_name, node_name in bindings.get(gang_name, {}).items():
+                gr.bindings.append(pb.Binding(pod_name=pod_name, node_name=node_name))
+                _, group = group_of_pod[pod_name]
+                self._bindings[pod_name] = (node_name, gang_name, group)
+            if gr.admitted:
+                self._scheduled_gangs.add(gang_name)
+            resp.gangs.append(gr)
+        return resp
+
+
+def _handlers(servicer: TPUSchedulerBackend) -> grpc.GenericRpcHandler:
+    """Manual method table — grpc_tools codegen isn't in the image; the
+    generic-handler API with protobuf serializers is exactly what generated
+    stubs produce anyway."""
+    methods = {
+        "Init": pb.InitRequest,
+        "SyncPodGang": pb.SyncPodGangRequest,
+        "OnPodGangDelete": pb.OnPodGangDeleteRequest,
+        "PreparePod": pb.PreparePodRequest,
+        "ValidatePodCliqueSet": pb.ValidatePodCliqueSetRequest,
+        "UpdateCluster": pb.UpdateClusterRequest,
+        "ReleasePods": pb.ReleasePodsRequest,
+        "Solve": pb.SolveRequest,
+    }
+    table = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda resp: resp.SerializeToString(),
+        )
+        for name, req_cls in methods.items()
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, table)
+
+
+def create_server(port: int = 0, max_workers: int = 8) -> tuple[grpc.Server, int]:
+    """Build + start the sidecar server; returns (server, bound port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_handlers(TPUSchedulerBackend()),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="grove-tpu scheduler backend sidecar")
+    parser.add_argument("--port", type=int, default=50055)
+    args = parser.parse_args()
+    server, bound = create_server(port=args.port)
+    print(f"{BACKEND_NAME} backend listening on 127.0.0.1:{bound}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,7 @@ from grove_tpu.orchestrator.status import (
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.solver.core import SolverParams, decode_assignments, solve
 from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.solver.planner import build_pending_subgang, sort_pending
 from grove_tpu.state.cluster import build_snapshot
 
 
@@ -253,32 +254,23 @@ class GroveController:
         if not pending:
             return 0
 
-        def prio(g: PodGang) -> int:
-            return self.priority_classes.get(g.spec.priority_class_name, 0)
-
         scheduled_names = {
             g.name for g in c.podgangs.values() if g.is_base_gang_scheduled() and g.spec.pod_groups
         }
-        pending.sort(key=lambda g: (-prio(g), g.is_scaled, g.scaled_index, g.name))
+        pending = sort_pending(
+            pending, lambda g: self.priority_classes.get(g.spec.priority_class_name, 0)
+        )
 
-        # Partial gangs: encode only gated pods; floors shrink by bound pods.
-        # Bound pods' node NAMES are collected in the same pass (converted to
-        # snapshot indices below) so required pack-sets of a re-solved
-        # remainder pin to the domain the bound pods occupy.
+        # Partial gangs: encode only gated pods; floors shrink by bound pods
+        # (shared discipline: solver/planner.py). Bound pods' node NAMES are
+        # collected in the same pass (converted to snapshot indices below) so
+        # required pack-sets of a re-solved remainder pin to the domain the
+        # bound pods occupy.
         sub_gangs: list[PodGang] = []
         bound_node_names: dict[str, dict[str, list[str]]] = {}
         for gang in pending:
-            sub = PodGang(
-                name=gang.name,
-                namespace=gang.namespace,
-                pcs_name=gang.pcs_name,
-                pcs_replica_index=gang.pcs_replica_index,
-                base_podgang_name=gang.base_podgang_name,
-                scaled_index=gang.scaled_index,
-            )
-            sub.spec.topology_constraint = gang.spec.topology_constraint
-            sub.spec.priority_class_name = gang.spec.priority_class_name
-            group_names_with_gated = set()
+            unbound_refs: dict[str, list[NamespacedName]] = {}
+            bound_counts: dict[str, int] = {}
             per_group_nodes: dict[str, list[str]] = {}
             for grp in gang.spec.pod_groups:
                 pods = [p for p in c.pods_of_clique(grp.name) if p.is_active]
@@ -288,24 +280,15 @@ class GroveController:
                     per_group_nodes[grp.name] = [
                         p.node_name for p in scheduled_pods if p.node_name
                     ]
-                bound = len(scheduled_pods)
-                if not gated:
-                    continue
-                import copy as _copy
-
-                sub_grp = _copy.copy(grp)
-                sub_grp.pod_references = [
-                    NamespacedName(gang.namespace, p.name)
-                    for p in sorted(gated, key=lambda p: p.pod_index)
-                ]
-                sub_grp.min_replicas = max(0, grp.min_replicas - bound)
-                sub.spec.pod_groups.append(sub_grp)
-                group_names_with_gated.add(grp.name)
-            sub.spec.topology_constraint_group_configs = [
-                gc
-                for gc in gang.spec.topology_constraint_group_configs
-                if any(n in group_names_with_gated for n in gc.pod_group_names)
-            ]
+                bound_counts[grp.name] = len(scheduled_pods)
+                if gated:
+                    unbound_refs[grp.name] = [
+                        NamespacedName(gang.namespace, p.name)
+                        for p in sorted(gated, key=lambda p: p.pod_index)
+                    ]
+            sub = build_pending_subgang(gang, unbound_refs, bound_counts)
+            if sub is None:
+                continue
             sub_gangs.append(sub)
             if per_group_nodes:
                 bound_node_names[gang.name] = per_group_nodes

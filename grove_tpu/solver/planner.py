@@ -1,0 +1,71 @@
+"""Shared incremental re-solve discipline: pending sub-gang construction.
+
+Both placement drivers — the in-process controller
+(orchestrator/controller.py solve_pending) and the gRPC sidecar
+(backend/service.py Solve) — re-solve partially scheduled gangs the same way:
+encode only the unbound pods, shrink each group's floor by what is already
+bound, keep only the group-constraint configs that still cover a pending
+group, and order the batch by priority. That discipline lives here so the two
+paths cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from grove_tpu.api.podgang import NamespacedName, PodGang, PodGroup
+
+
+def build_pending_subgang(
+    gang: PodGang,
+    unbound_refs: dict[str, list[NamespacedName]],
+    bound_counts: dict[str, int],
+) -> Optional[PodGang]:
+    """Sub-gang over the unbound pods of `gang`; None if nothing is pending.
+
+    `unbound_refs`: group name -> pod references still needing a node.
+    `bound_counts`: group name -> pods already bound (shrinks the gang floor,
+    PodGroup.MinReplicas semantics, scheduler podgang.go:80-84).
+    """
+    sub = PodGang(
+        name=gang.name,
+        namespace=gang.namespace,
+        pcs_name=gang.pcs_name,
+        pcs_replica_index=gang.pcs_replica_index,
+        base_podgang_name=gang.base_podgang_name,
+        scaled_index=gang.scaled_index,
+    )
+    sub.spec.topology_constraint = gang.spec.topology_constraint
+    sub.spec.priority_class_name = gang.spec.priority_class_name
+    for grp in gang.spec.pod_groups:
+        refs = unbound_refs.get(grp.name) or []
+        if not refs:
+            continue
+        sub.spec.pod_groups.append(
+            PodGroup(
+                name=grp.name,
+                pod_references=list(refs),
+                min_replicas=max(0, grp.min_replicas - bound_counts.get(grp.name, 0)),
+                topology_constraint=grp.topology_constraint,
+            )
+        )
+    if not sub.spec.pod_groups:
+        return None
+    pending_groups = {g.name for g in sub.spec.pod_groups}
+    sub.spec.topology_constraint_group_configs = [
+        gc
+        for gc in gang.spec.topology_constraint_group_configs
+        if any(n in pending_groups for n in gc.pod_group_names)
+    ]
+    return sub
+
+
+def sort_pending(
+    gangs: list[PodGang], priority_of: Callable[[PodGang], int]
+) -> list[PodGang]:
+    """Priority order = solver batch order: higher priority first, base gangs
+    before their scaled gangs, then stable by scaled index and name."""
+    return sorted(
+        gangs,
+        key=lambda g: (-priority_of(g), g.is_scaled, g.scaled_index, g.name),
+    )

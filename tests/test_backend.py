@@ -1,0 +1,155 @@
+"""Scheduler-backend sidecar: the GREP-375 contract over real gRPC.
+
+Drives the full cycle an external operator would: Init (topology handshake),
+UpdateCluster (node feed), ValidatePodCliqueSet admission, SyncPodGang,
+PreparePod gate injection, Solve (all-or-nothing bindings + PlacementScore),
+ReleasePods incremental re-solve, OnPodGangDelete cleanup.
+"""
+
+import pytest
+
+from grove_tpu.backend import PENDING_GATE, SCHEDULER_NAME, BackendClient, create_server
+from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
+
+ZONE = "topology.kubernetes.io/zone"
+RACK = "topology.kubernetes.io/rack"
+
+
+@pytest.fixture(scope="module")
+def backend():
+    server, port = create_server(port=0)
+    client = BackendClient(f"127.0.0.1:{port}")
+    yield client
+    client.close()
+    server.stop(grace=None)
+
+
+def _nodes(count, cpu=4.0, racks=2):
+    out = []
+    for i in range(count):
+        n = pb.Node(name=f"n{i}", schedulable=True)
+        n.capacity.append(pb.ResourceQuantity(name="cpu", value=cpu))
+        n.capacity.append(pb.ResourceQuantity(name="memory", value=8 * 2**30))
+        n.labels[ZONE] = "z0"
+        n.labels[RACK] = f"r{i % racks}"
+        out.append(n)
+    return out
+
+
+def _gang(name, pods_per_group=3, min_replicas=2, rack_required=False, base=""):
+    spec = pb.PodGangSpec(name=name, namespace="default", base_podgang_name=base)
+    for gname in ("alpha", "beta"):
+        grp = pb.PodGroup(name=f"{name}-{gname}", min_replicas=min_replicas)
+        for i in range(pods_per_group):
+            grp.pod_references.append(
+                pb.NamespacedName(namespace="default", name=f"{name}-{gname}-{i}")
+            )
+        grp.per_pod_requests.append(pb.ResourceQuantity(name="cpu", value=0.5))
+        spec.pod_groups.append(grp)
+    if rack_required:
+        spec.pack_constraint.required_key = RACK
+    return spec
+
+
+def test_init_and_update_cluster(backend):
+    resp = backend.init([("zone", ZONE), ("rack", RACK)])
+    assert resp.name == "grove-tpu"
+    resp = backend.update_cluster(_nodes(8), full_replace=True)
+    assert resp.node_count == 8
+
+
+def test_prepare_pod_injects_gates(backend):
+    resp = backend.prepare_pod("mypod", pod_gang_name="g1")
+    assert resp.scheduler_name == SCHEDULER_NAME
+    assert list(resp.scheduling_gates) == [PENDING_GATE]
+    assert resp.labels["grove.io/podgang"] == "g1"
+
+
+def test_validate_podcliqueset(backend):
+    good = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata: {name: ok}
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: a
+        spec:
+          roleName: a
+          replicas: 2
+          podSpec: {containers: [{name: c, image: i}]}
+"""
+    assert list(backend.validate_podcliqueset(good).errors) == []
+    bad = good.replace("replicas: 2", "replicas: 2\n          minAvailable: 5")
+    assert backend.validate_podcliqueset(bad).errors
+    assert backend.validate_podcliqueset("{not a pcs").errors
+
+
+def test_solve_binds_whole_gang(backend):
+    backend.init([("zone", ZONE), ("rack", RACK)])
+    backend.update_cluster(_nodes(8), full_replace=True)
+    backend.sync_pod_gang(_gang("g1", rack_required=True))
+    resp = backend.solve()
+    assert len(resp.gangs) == 1
+    gr = resp.gangs[0]
+    assert gr.admitted and gr.name == "g1"
+    assert len(gr.bindings) == 6  # 2 groups x 3 pods, best-effort beyond floor
+    assert 0.0 < gr.placement_score <= 1.0
+    # rack-required: every binding in one rack
+    node_rack = {f"n{i}": f"r{i % 2}" for i in range(8)}
+    racks = {node_rack[b.node_name] for b in gr.bindings}
+    assert len(racks) == 1
+    assert resp.solve_micros > 0
+
+
+def test_incremental_resolve_after_release(backend):
+    """Release one pod; re-solve binds only it, inside the original rack."""
+    first = backend.solve()  # no pending work left
+    assert all(not g.bindings for g in first.gangs) or not first.gangs
+    backend.release_pods(["g1-alpha-0"])
+    resp = backend.solve()
+    gr = next(g for g in resp.gangs if g.name == "g1")
+    assert gr.admitted
+    assert [b.pod_name for b in gr.bindings] == ["g1-alpha-0"]
+    node_rack = {f"n{i}": f"r{i % 2}" for i in range(8)}
+    assert node_rack[gr.bindings[0].node_name] in {"r0", "r1"}
+
+
+def test_all_or_nothing_over_grpc(backend):
+    """A gang that cannot fit is rejected whole — zero bindings."""
+    backend.sync_pod_gang(_gang("g2", pods_per_group=40, min_replicas=40))
+    resp = backend.solve()
+    gr = next(g for g in resp.gangs if g.name == "g2")
+    assert not gr.admitted
+    assert len(gr.bindings) == 0
+
+
+def test_scaled_gang_waits_for_base(backend):
+    """A scaled gang whose base gang is unknown is gated out, then admitted
+    once the base gang is synced and scheduled."""
+    backend.sync_pod_gang(_gang("g3-scaled", base="g3-base"))
+    resp = backend.solve()
+    gr = next(g for g in resp.gangs if g.name == "g3-scaled")
+    assert not gr.admitted
+    backend.sync_pod_gang(_gang("g3-base"))
+    resp = backend.solve()
+    verdicts = {g.name: g.admitted for g in resp.gangs}
+    assert verdicts["g3-base"]
+    # base now scheduled -> scaled admitted (same call or the next)
+    if not verdicts.get("g3-scaled", False):
+        resp = backend.solve()
+        verdicts = {g.name: g.admitted for g in resp.gangs}
+        assert verdicts["g3-scaled"]
+
+
+def test_delete_gang_releases_capacity(backend):
+    backend.on_pod_gang_delete("g1")
+    backend.on_pod_gang_delete("g2")
+    backend.on_pod_gang_delete("g3-base")
+    backend.on_pod_gang_delete("g3-scaled")
+    # All capacity free again: a big gang that previously failed now fits.
+    backend.sync_pod_gang(_gang("g4", pods_per_group=8, min_replicas=8))
+    resp = backend.solve()
+    gr = next(g for g in resp.gangs if g.name == "g4")
+    assert gr.admitted and len(gr.bindings) == 16
