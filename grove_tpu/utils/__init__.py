@@ -1,0 +1,19 @@
+"""Cross-cutting runtime utilities (platform hardening, logging, metrics,
+errors, concurrency) — the analog of the reference's `operator/internal/utils`
++ `internal/logger` + `internal/errors` packages."""
+
+from grove_tpu.utils.platform import (
+    ensure_usable_backend,
+    force_cpu,
+    force_virtual_cpu_devices,
+    probe_default_platform,
+    scrubbed_cpu_env,
+)
+
+__all__ = [
+    "ensure_usable_backend",
+    "force_cpu",
+    "force_virtual_cpu_devices",
+    "probe_default_platform",
+    "scrubbed_cpu_env",
+]

@@ -2,25 +2,31 @@
 
 Mirrors the reference's test philosophy (SURVEY.md §4): multi-node behavior is
 tested without real hardware — fake client for logic, containerized nodes for
-integration, KWOK for scale. Here: CPU-JAX with 8 virtual devices stands in for
-a TPU slice; the same jitted code runs unmodified on real chips.
+integration, KWOK for scale. Here: CPU-JAX with 8 virtual devices stands in
+for a TPU slice; the same jitted code runs unmodified on real chips.
+
+Platform forcing must be config-level, not env-level: the TPU-tunnel relay in
+this environment registers at interpreter start and rewrites the jax
+``jax_platforms`` config to "axon,cpu", so ``os.environ["JAX_PLATFORMS"]``
+alone is ignored and first backend use can hang on a wedged relay (round-1
+failure: the suite wedged >600s when run with the driver's env). See
+grove_tpu/utils/platform.py for the full story.
 """
-
-import os
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import pathlib
 import sys
 
-import pytest
-import yaml
-
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
+
+# XLA reads XLA_FLAGS at first CPU-client creation, which happens strictly
+# after this module is imported — pytest loads conftest before any test module.
+from grove_tpu.utils.platform import force_virtual_cpu_devices  # noqa: E402
+
+force_virtual_cpu_devices(8)
+
+import pytest  # noqa: E402
+import yaml  # noqa: E402
 
 from grove_tpu.api import PodCliqueSet, default_podcliqueset  # noqa: E402
 
