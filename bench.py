@@ -70,6 +70,7 @@ def _arm_watchdog(budget_s: float) -> threading.Timer:
 
 def run_bench() -> dict:
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from grove_tpu.orchestrator import expand_podcliqueset
@@ -79,16 +80,26 @@ def run_bench() -> dict:
         synthetic_cluster,
     )
     from grove_tpu.solver.core import (
+        SolverParams,
         decode_assignments,
         solve_batch,
         solve_batch_speculative,
     )
     from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.solver.greedy import greedy_drain
     from grove_tpu.state import build_snapshot
 
     scale = float(os.environ.get("GROVE_BENCH_SCALE", "1.0"))
     wave_size = int(os.environ.get("GROVE_BENCH_WAVE", "64"))
-    speculative = os.environ.get("GROVE_BENCH_SPECULATIVE", "1") == "1"
+    # auto: speculative parallel commit on accelerators (rounds are wide but
+    # shallow — latency-bound hardware wins), sequential scan on CPU (same
+    # total flops, no speculative multiplier — throughput-bound hardware wins).
+    spec_env = os.environ.get("GROVE_BENCH_SPECULATIVE", "auto")
+    if spec_env == "auto":
+        speculative = jax.default_backend() not in ("cpu",)
+    else:
+        speculative = spec_env == "1"
+    run_baseline = os.environ.get("GROVE_BENCH_BASELINE", "1") == "1"
     solver = solve_batch_speculative if speculative else solve_batch
 
     topo = bench_topology()
@@ -114,8 +125,12 @@ def run_bench() -> dict:
     mp = max(g.total_pods() for g in gangs)
     ms = mg + 2  # gang-level + group-config + per-group constraint sets
     waves = [gangs[i : i + wave_size] for i in range(0, len(gangs), wave_size)]
+    # Global gang table: cross-wave base-gang gating resolves ON-DEVICE via
+    # the ok_global bitmap, so wave k+1 encodes/dispatches without waiting for
+    # wave k's verdicts — host encode and device solve fully pipeline.
+    gidx = {g.name: i for i, g in enumerate(gangs)}
 
-    def encode_wave(wave, scheduled):
+    def encode_wave(wave):
         return encode_gangs(
             wave,
             pods,
@@ -124,44 +139,81 @@ def run_bench() -> dict:
             max_sets=ms,
             max_pods=mp,
             pad_gangs_to=wave_size,
-            scheduled_gangs=scheduled,
+            global_index_of=gidx,
         )
 
-    capacity = np.asarray(snapshot.capacity)
-    schedulable = np.asarray(snapshot.schedulable)
-    node_domain_id = np.asarray(snapshot.node_domain_id)
+    capacity = jnp.asarray(snapshot.capacity)
+    schedulable = jnp.asarray(snapshot.schedulable)
+    node_domain_id = jnp.asarray(snapshot.node_domain_id)
+    params = SolverParams()
 
     # Warm-up: compile the wave-shaped program once (production keeps the
     # compiled program cached across reconcile ticks; compile cost reported
     # separately).
     t_compile = time.perf_counter()
-    warm_batch, _ = encode_wave(waves[0], set())
-    warm = solver(snapshot.free, capacity, schedulable, node_domain_id, warm_batch)
+    warm_batch, _ = encode_wave(waves[0])
+    warm = solver(
+        jnp.asarray(snapshot.free),
+        capacity,
+        schedulable,
+        node_domain_id,
+        warm_batch,
+        params,
+        jnp.zeros((len(gangs),), dtype=bool),
+    )
     jax.block_until_ready(warm.ok)
     compile_s = time.perf_counter() - t_compile
 
     # Timed drain: all gangs queued at t0; a gang's bind latency is the wall
-    # time from t0 to completion of the wave that decided it.
-    scheduled: set[str] = set()
+    # time from t0 through decode of the wave that decided it. Dispatch is
+    # async: the host encodes wave k+1 while the device solves wave k (device
+    # results chain device-side through free_after/ok_global); completed waves
+    # are harvested opportunistically so decode overlaps later solves.
     latencies: list[float] = []  # admitted gangs only — a bind must exist
     admitted = 0
     pods_bound = 0
+    solver_scores: list[float] = []
     t0 = time.perf_counter()
-    free_arr = snapshot.free
-    for wave in waves:
-        batch, decode = encode_wave(wave, scheduled)
-        result = solver(free_arr, capacity, schedulable, node_domain_id, batch)
-        jax.block_until_ready(result.ok)
-        free_arr = result.free_after
+    free_arr = jnp.asarray(snapshot.free)
+    ok_g = jnp.zeros((len(gangs),), dtype=bool)
+    inflight: list = []  # (result, decode_info) in dispatch order
+    harvested = 0
+
+    def harvest(entry):
+        nonlocal admitted, pods_bound
+        result, decode = entry
         # Decode is part of every production solve (controller.solve_pending
         # always materializes pod->node bindings) — keep it in the timed path.
         bindings = decode_assignments(result, decode, snapshot)
         t = time.perf_counter() - t0
-        for name, pod_bindings in bindings.items():
-            scheduled.add(name)
+        scores = np.asarray(result.placement_score)
+        ok_mask = np.asarray(result.ok)
+        solver_scores.extend(scores[ok_mask].tolist())
+        for _, pod_bindings in bindings.items():
             admitted += 1
             pods_bound += len(pod_bindings)
             latencies.append(t)
+
+    for wave in waves:
+        batch, decode = encode_wave(wave)
+        result = solver(
+            free_arr, capacity, schedulable, node_domain_id, batch, params, ok_g
+        )
+        free_arr = result.free_after
+        ok_g = result.ok_global
+        inflight.append((result, decode))
+        # Non-blocking harvest of any waves the device already finished.
+        while harvested < len(inflight):
+            ok_arr = inflight[harvested][0].ok
+            if hasattr(ok_arr, "is_ready") and not ok_arr.is_ready():
+                break
+            harvest(inflight[harvested])
+            inflight[harvested] = None  # release dead device buffers
+            harvested += 1
+    while harvested < len(inflight):
+        harvest(inflight[harvested])  # decode_assignments blocks as needed
+        inflight[harvested] = None
+        harvested += 1
     total_s = time.perf_counter() - t0
 
     rejected = len(gangs) - admitted
@@ -182,7 +234,7 @@ def run_bench() -> dict:
         # machine-readable exactly when a broken run most needs parsing.
         return round(x, nd) if math.isfinite(x) else None
 
-    return {
+    out = {
         "value": _num(p99, 4),
         "vs_baseline": _num(vs, 3),
         "p50_s": _num(p50, 4),
@@ -199,7 +251,24 @@ def run_bench() -> dict:
         "speculative": speculative,
         "compile_s": round(compile_s, 2),
         "setup_s": round(setup_s, 2),
+        "solver_score": round(float(np.mean(solver_scores)), 4)
+        if solver_scores
+        else None,
     }
+
+    if run_baseline:
+        # Quality yardstick (untimed for latency purposes): the reference-style
+        # per-pod greedy Filter/Score/Permit cycle on the SAME backlog+cluster.
+        # Makes BASELINE.md's "quality >= the Go/KAI path" falsifiable.
+        gstats = greedy_drain(gangs, pods, snapshot)
+        out["baseline_admitted"] = gstats.admitted
+        out["baseline_pods_bound"] = gstats.pods_bound
+        out["baseline_score"] = round(gstats.mean_score, 4)
+        out["baseline_elapsed_s"] = round(gstats.elapsed_s, 2)
+        out["quality_admitted_ratio"] = (
+            round(admitted / gstats.admitted, 3) if gstats.admitted else None
+        )
+    return out
 
 
 def main() -> int:

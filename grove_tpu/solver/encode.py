@@ -58,6 +58,14 @@ class GangBatch(NamedTuple):
     # (grove.io/base-podgang; podclique/components/pod/syncflow.go:347-387).
     # Index of the base gang within this batch (must be earlier), -1 = no dep.
     depends_on: np.ndarray  # i32 [G]
+    # Cross-batch chaining (pipelined waves): each gang's slot in a
+    # caller-defined global gang table, and the base gang's slot there when
+    # the base was solved in an EARLIER batch. The solver resolves these
+    # against the `ok_global` verdict bitmap it carries between waves, so
+    # wave k+1 can be encoded and dispatched before wave k's results reach
+    # the host. -1 = unset / no cross-batch dependency.
+    global_index: np.ndarray  # i32 [G]
+    depends_global: np.ndarray  # i32 [G]
 
     @property
     def n_gangs(self) -> int:
@@ -96,6 +104,7 @@ def encode_gangs(
     pad_gangs_to: int | None = None,
     scheduled_gangs: set[str] | None = None,
     bound_nodes_by_group: dict[str, dict[str, list[int]]] | None = None,
+    global_index_of: dict[str, int] | None = None,
 ) -> tuple[GangBatch, GangDecodeInfo]:
     """Flatten gang CRs into the padded batch + decode info.
 
@@ -108,6 +117,14 @@ def encode_gangs(
     that group already bound in earlier solves. Used to pin required pack-sets
     to the domain the bound pods occupy (incremental re-solve must not split a
     co-location guarantee across domains).
+
+    `global_index_of`: gang name -> slot in a caller-defined global gang table
+    (pipelined-wave chaining). When set, each gang's `global_index` is filled,
+    and a base-gang dependency on a gang OUTSIDE this batch becomes a
+    `depends_global` reference resolved on-device against the solver's
+    `ok_global` bitmap — instead of requiring the host-side `scheduled_gangs`
+    verdict at encode time. Bases in neither the batch nor the table still
+    fall back to the `scheduled_gangs` check.
     """
     g_count = pad_gangs_to if pad_gangs_to is not None else len(gangs)
     if g_count < len(gangs):
@@ -176,6 +193,8 @@ def encode_gangs(
         gang_valid=np.zeros((g_count,), dtype=bool),
         group_order=np.tile(np.arange(mg, dtype=np.int32), (g_count, 1)),
         depends_on=np.full((g_count,), -1, dtype=np.int32),
+        global_index=np.full((g_count,), -1, dtype=np.int32),
+        depends_global=np.full((g_count,), -1, dtype=np.int32),
     )
     decode = GangDecodeInfo(gang_names=[], pod_names=[], group_names=[])
     gang_index = {g.name: i for i, g in enumerate(gangs)}
@@ -193,10 +212,19 @@ def encode_gangs(
         pod_names: list[str] = []
         group_names: list[str] = []
         batch.gang_valid[gi] = sets_resolvable[gi]
+        if global_index_of is not None:
+            batch.global_index[gi] = global_index_of.get(gang.name, -1)
         if gang.base_podgang_name is not None:
             base_idx = gang_index.get(gang.base_podgang_name, -1)
             if 0 <= base_idx < gi:
                 batch.depends_on[gi] = base_idx
+            elif (
+                global_index_of is not None
+                and gang.base_podgang_name in global_index_of
+            ):
+                # Base solved in an earlier wave: resolve the verdict on-device
+                # via the solver's ok_global bitmap (pipelined chaining).
+                batch.depends_global[gi] = global_index_of[gang.base_podgang_name]
             elif gang.base_podgang_name not in scheduled_gangs:
                 # Base gang missing and not yet scheduled: gate this gang out.
                 batch.gang_valid[gi] = False

@@ -1,0 +1,75 @@
+"""The greedy per-pod baseline and the solver-vs-baseline quality comparison.
+
+BASELINE.md's bar "placement quality >= the Go/KAI path" is falsifiable only
+against an implementation of the reference's per-pod Filter/Score/Permit
+cycle (operator/e2e/utils/kai_topology.go:187-313 assertion semantics) —
+grove_tpu/solver/greedy.py. These tests pin the baseline's own semantics and
+assert the batched solver matches or beats it where the comparison is crisp.
+"""
+
+import numpy as np
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.solver import (
+    decode_assignments,
+    encode_gangs,
+    greedy_drain,
+    solve,
+)
+from grove_tpu.state import build_snapshot
+from tests.test_solver import mk_nodes, mk_topology
+
+
+def _expand(simple1, n_nodes=8, cpu=4.0, racks=2):
+    topo = mk_topology()
+    ds = expand_podcliqueset(simple1, topo)
+    snap = build_snapshot(mk_nodes(n_nodes, cpu=cpu, racks=racks), topo)
+    pods = {p.name: p for p in ds.pods}
+    return ds, snap, pods
+
+
+def test_greedy_admits_simple1(simple1):
+    ds, snap, pods = _expand(simple1)
+    stats = greedy_drain(ds.podgangs, pods, snap)
+    assert stats.admitted == len(ds.podgangs)
+    assert stats.rejected == 0
+    assert stats.pods_bound == len(ds.pods)
+    assert 0.0 < stats.mean_score <= 1.0
+    # all-or-nothing bookkeeping: every admitted gang fully bound
+    for gang in ds.podgangs:
+        assert gang.name in stats.bindings
+
+
+def test_greedy_all_or_nothing_under_shortfall(simple1):
+    """No capacity -> nothing binds, no partial placement leaks."""
+    ds, snap, pods = _expand(simple1, n_nodes=1, cpu=0.01)
+    stats = greedy_drain(ds.podgangs, pods, snap)
+    assert stats.admitted == 0
+    assert stats.pods_bound == 0
+    assert stats.bindings == {}
+
+
+def test_greedy_base_gang_gating(simple1):
+    """Scaled gang rejected when its base gang cannot admit."""
+    ds, snap, pods = _expand(simple1, n_nodes=1, cpu=0.01)
+    names = [g.name for g in ds.podgangs]
+    assert any("workers" in n for n in names)
+    stats = greedy_drain(ds.podgangs, pods, snap)
+    assert stats.rejected == len(ds.podgangs)
+
+
+def test_solver_quality_ge_greedy(simple1):
+    """The north-star comparison: solver admits >= greedy, score >= greedy."""
+    ds, snap, pods = _expand(simple1)
+    greedy = greedy_drain(ds.podgangs, pods, snap)
+
+    batch, decode = encode_gangs(ds.podgangs, pods, snap)
+    result = solve(snap, batch)
+    solver_admitted = int(np.asarray(result.ok).sum())
+    scores = np.asarray(result.placement_score)
+    solver_score = float(scores[np.asarray(result.ok)].mean()) if solver_admitted else 0.0
+
+    assert solver_admitted >= greedy.admitted
+    assert solver_score >= greedy.mean_score - 1e-6
+    bindings = decode_assignments(result, decode, snap)
+    assert sum(len(b) for b in bindings.values()) >= greedy.pods_bound

@@ -1,0 +1,91 @@
+"""Cross-wave chaining: the ok_global verdict bitmap.
+
+Pipelined drains encode wave k+1 before wave k's verdicts reach the host, so
+the base-gang gate (scaled gangs schedule only after their base gang —
+operator podclique/components/pod/syncflow.go:347-387) must resolve on-device:
+encode fills GangBatch.global_index / depends_global, and the solver threads a
+[T]-bool ok_global bitmap between waves.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.solver import decode_assignments, encode_gangs, solve
+from grove_tpu.state import build_snapshot
+from tests.test_solver import mk_nodes, mk_topology
+
+
+def _setup(simple1):
+    topo = mk_topology()
+    ds = expand_podcliqueset(simple1, topo)
+    snap = build_snapshot(mk_nodes(8), topo)
+    pods = {p.name: p for p in ds.pods}
+    base = [g for g in ds.podgangs if g.base_podgang_name is None]
+    scaled = [g for g in ds.podgangs if g.base_podgang_name is not None]
+    assert base and scaled, "simple1 must expand to base + scaled gangs"
+    gidx = {g.name: i for i, g in enumerate(ds.podgangs)}
+    return snap, pods, base, scaled, gidx, len(ds.podgangs)
+
+
+def test_chained_base_admitted_unblocks_scaled(simple1):
+    """Wave 1 admits the base; wave 2's scaled gang sees it via ok_global."""
+    snap, pods, base, scaled, gidx, total = _setup(simple1)
+    ok_g = jnp.zeros((total,), dtype=bool)
+
+    b1, d1 = encode_gangs(base, pods, snap, global_index_of=gidx)
+    r1 = solve(snap, b1, ok_global=ok_g)
+    assert bool(np.asarray(r1.ok).all())
+    assert np.asarray(r1.ok_global)[gidx[base[0].name]]
+
+    # Wave 2: base gang NOT in this batch; dep resolved via the bitmap.
+    b2, d2 = encode_gangs(scaled, pods, snap, global_index_of=gidx)
+    assert int(b2.depends_global[0]) == gidx[base[0].name]
+    assert bool(b2.gang_valid[0]), "gang must stay valid for on-device gating"
+    r2 = solve(snap, b2, free=r1.free_after, ok_global=r1.ok_global)
+    assert bool(np.asarray(r2.ok).all()), "scaled gang must admit once base did"
+    bindings = decode_assignments(r2, d2, snap)
+    assert set(bindings) == {scaled[0].name}
+
+
+def test_chained_base_rejected_gates_scaled(simple1):
+    """Base rejected in wave 1 -> scaled rejected in wave 2 despite capacity."""
+    snap, pods, base, scaled, gidx, total = _setup(simple1)
+    ok_g = jnp.zeros((total,), dtype=bool)
+
+    none_schedulable = np.zeros_like(snap.schedulable)
+    b1, _ = encode_gangs(base, pods, snap, global_index_of=gidx)
+    r1 = solve(snap, b1, schedulable=none_schedulable, ok_global=ok_g)
+    assert not bool(np.asarray(r1.ok).any())
+    assert not np.asarray(r1.ok_global)[gidx[base[0].name]]
+
+    # Wave 2 has full capacity, but the base verdict gates the scaled gang.
+    b2, _ = encode_gangs(scaled, pods, snap, global_index_of=gidx)
+    r2 = solve(snap, b2, ok_global=r1.ok_global)
+    assert not bool(np.asarray(r2.ok).any())
+
+
+def test_chained_speculative_matches(simple1):
+    """The speculative solver honors the same cross-wave gate."""
+    snap, pods, base, scaled, gidx, total = _setup(simple1)
+    ok_g = jnp.zeros((total,), dtype=bool)
+    b1, _ = encode_gangs(base, pods, snap, global_index_of=gidx)
+    r1 = solve(snap, b1, speculative=True, ok_global=ok_g)
+    assert bool(np.asarray(r1.ok).all())
+    b2, _ = encode_gangs(scaled, pods, snap, global_index_of=gidx)
+    r2 = solve(
+        snap, b2, speculative=True, free=r1.free_after, ok_global=r1.ok_global
+    )
+    assert bool(np.asarray(r2.ok).all())
+
+
+def test_no_global_map_falls_back_to_scheduled_gangs(simple1):
+    """Without global_index_of, encode keeps the host-side gating behavior."""
+    snap, pods, base, scaled, gidx, total = _setup(simple1)
+    b2, _ = encode_gangs(scaled, pods, snap)
+    assert int(b2.depends_global[0]) == -1
+    assert not bool(b2.gang_valid[0]), "base unknown -> gated out at encode"
+    b2b, _ = encode_gangs(
+        scaled, pods, snap, scheduled_gangs={base[0].name}
+    )
+    assert bool(b2b.gang_valid[0])
