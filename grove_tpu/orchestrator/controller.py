@@ -67,6 +67,9 @@ class GroveController:
     max_groups: int | None = None
     max_sets: int | None = None
     max_pods: int | None = None
+    pad_gangs_to: int | None = None
+    # speculative parallel commit (solve_batch_speculative) vs sequential scan
+    speculative: bool = False
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -251,7 +254,9 @@ class GroveController:
             pods = [p for p in c.pods_of_gang(gang.name) if p.is_active]
             if pods and any(p.is_gated for p in pods):
                 pending.append(gang)
-        if not pending:
+        if not pending or not c.nodes:
+            # No nodes: nothing can bind; an empty snapshot has no resource
+            # axes and would crash encode (max over empty capacity matrix).
             return 0
 
         scheduled_names = {

@@ -1,0 +1,48 @@
+"""Operator binary entry point: `python -m grove_tpu.runtime --config <yaml>`.
+
+Mirror of `operator/cmd/main.go:46-128` + `cmd/cli/cli.go`: parse flags, load
+and validate the OperatorConfiguration (exit non-zero listing every problem),
+boot the manager, run until SIGINT/SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="grove-tpu-operator")
+    parser.add_argument("--config", required=True, help="OperatorConfiguration YAML")
+    parser.add_argument(
+        "--run-for", type=float, default=None, help="exit after N seconds (testing)"
+    )
+    parser.add_argument("--version", action="version", version="grove-tpu 0.2")
+    args = parser.parse_args(argv)
+
+    from grove_tpu.runtime.config import load_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    try:
+        config = load_operator_config(args.config)
+    except (OSError, ValueError) as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+
+    manager = Manager(config)
+
+    def _stop(signum, frame):
+        manager.stop()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    try:
+        manager.run(stop_after_seconds=args.run_for)
+    finally:
+        manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,275 @@
+"""OperatorConfiguration: the single YAML that boots the whole stack.
+
+Mirror of `operator/api/config/v1alpha1/types.go:57-70` (+ defaults.go and
+api/config/validation/): leader election (types.go:73-104), server binds
+(types.go:120-151), per-controller concurrent syncs (types.go:180-208),
+log config, authorizer (types.go:211-220), topology-aware scheduling
+(types.go:223-230), network acceleration (types.go:233-240) — re-keyed for
+the TPU-native stack: the scheduler backend sidecar and the JAX solver get
+first-class sections, and network acceleration configures the TPU-slice (ICI
+domain) resource injection instead of MNNVL.
+
+Everything has a default; `validate_operator_config` returns a list of
+field-path errors (empty = valid), matching the reference's
+LoadAndValidateOperatorConfig boot contract (cmd/cli/cli.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+from grove_tpu.api.types import ClusterTopology, DEFAULT_CLUSTER_TOPOLOGY
+
+
+@dataclass
+class LeaderElectionConfig:
+    """types.go:73-104; lease-file analog of the k8s Lease object."""
+
+    enabled: bool = False
+    lease_file: str = "/tmp/grove-tpu-leader.lease"
+    lease_duration_seconds: float = 15.0
+    renew_deadline_seconds: float = 10.0
+    retry_period_seconds: float = 2.0
+
+
+@dataclass
+class ServerConfig:
+    """Bind addresses (types.go:120-151). Port 0 = auto-assign, -1 = disabled."""
+
+    health_port: int = 2751
+    metrics_port: int = 2752
+    profiling_enabled: bool = False  # pprof analog (manager.go:42-44)
+
+
+@dataclass
+class ControllerConfig:
+    """Reconcile loop knobs (types.go:180-208)."""
+
+    concurrent_syncs: int = 1
+    reconcile_interval_seconds: float = 1.0
+
+
+@dataclass
+class LogConfig:
+    level: str = "info"  # debug|info|error
+    format: str = "text"  # json|text
+
+
+@dataclass
+class AuthorizerConfig:
+    """types.go:211-220: block mutation of managed resources by non-operators."""
+
+    enabled: bool = False
+    exempt_actors: list[str] = field(default_factory=list)
+
+
+@dataclass
+class TopologyAwareSchedulingConfig:
+    """types.go:223-230: enable TAS + the level list (ClusterTopology source)."""
+
+    enabled: bool = True
+    # Each: {"domain": "rack", "nodeLabelKey": "topology.kubernetes.io/rack"}
+    levels: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class NetworkAccelerationConfig:
+    """types.go:233-240 MNNVL analog: auto TPU-slice/ICI resource injection."""
+
+    auto_slice_enabled: bool = False
+    slice_resource_name: str = "google.com/tpu"
+
+
+@dataclass
+class SolverConfig:
+    """The placement engine (no reference analog — the KAI replacement)."""
+
+    speculative: bool = False
+    max_groups: Optional[int] = None
+    max_sets: Optional[int] = None
+    max_pods: Optional[int] = None
+    pad_gangs_to: Optional[int] = None
+
+
+@dataclass
+class BackendConfig:
+    """Scheduler-backend sidecar (GREP-375 boundary)."""
+
+    enabled: bool = False
+    port: int = 0  # 0 = auto-assign
+    max_workers: int = 8
+
+
+@dataclass
+class PersistenceConfig:
+    """Control-plane state snapshot/restore (CR-status persistence analog)."""
+
+    enabled: bool = False
+    path: str = "/tmp/grove-tpu-state.json"
+    snapshot_interval_seconds: float = 10.0
+
+
+@dataclass
+class OperatorConfiguration:
+    leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
+    servers: ServerConfig = field(default_factory=ServerConfig)
+    controllers: ControllerConfig = field(default_factory=ControllerConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+    authorizer: AuthorizerConfig = field(default_factory=AuthorizerConfig)
+    topology_aware_scheduling: TopologyAwareSchedulingConfig = field(
+        default_factory=TopologyAwareSchedulingConfig
+    )
+    network_acceleration: NetworkAccelerationConfig = field(
+        default_factory=NetworkAccelerationConfig
+    )
+    solver: SolverConfig = field(default_factory=SolverConfig)
+    backend: BackendConfig = field(default_factory=BackendConfig)
+    persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
+
+    def cluster_topology(self) -> ClusterTopology:
+        """TAS levels -> ClusterTopology (clustertopology sync analog)."""
+        tas = self.topology_aware_scheduling
+        if not tas.levels:
+            return DEFAULT_CLUSTER_TOPOLOGY
+        topo = ClusterTopology.from_dict(
+            {"name": "operator-config", "levels": tas.levels}
+        )
+        # Auto-append the host level, as the operator's topology sync does
+        # (internal/clustertopology/clustertopology.go:102-107).
+        return topo.with_host_level()
+
+
+_SECTION_TYPES = {
+    "leaderElection": ("leader_election", LeaderElectionConfig),
+    "servers": ("servers", ServerConfig),
+    "controllers": ("controllers", ControllerConfig),
+    "log": ("log", LogConfig),
+    "authorizer": ("authorizer", AuthorizerConfig),
+    "topologyAwareScheduling": ("topology_aware_scheduling", TopologyAwareSchedulingConfig),
+    "networkAcceleration": ("network_acceleration", NetworkAccelerationConfig),
+    "solver": ("solver", SolverConfig),
+    "backend": ("backend", BackendConfig),
+    "persistence": ("persistence", PersistenceConfig),
+}
+
+_CAMEL_FIELDS = {
+    # camelCase YAML key -> snake_case dataclass field, per section type
+    "leaseFile": "lease_file",
+    "leaseDurationSeconds": "lease_duration_seconds",
+    "renewDeadlineSeconds": "renew_deadline_seconds",
+    "retryPeriodSeconds": "retry_period_seconds",
+    "healthPort": "health_port",
+    "metricsPort": "metrics_port",
+    "profilingEnabled": "profiling_enabled",
+    "concurrentSyncs": "concurrent_syncs",
+    "reconcileIntervalSeconds": "reconcile_interval_seconds",
+    "exemptActors": "exempt_actors",
+    "autoSliceEnabled": "auto_slice_enabled",
+    "sliceResourceName": "slice_resource_name",
+    "maxGroups": "max_groups",
+    "maxSets": "max_sets",
+    "maxPods": "max_pods",
+    "padGangsTo": "pad_gangs_to",
+    "maxWorkers": "max_workers",
+    "snapshotIntervalSeconds": "snapshot_interval_seconds",
+}
+
+
+def _build_section(cls, doc: dict, path: str, errors: list[str]):
+    if doc is not None and not isinstance(doc, dict):
+        errors.append(f"{path}: must be a mapping, got {type(doc).__name__}")
+        return cls()
+    kwargs = {}
+    valid_fields = set(cls.__dataclass_fields__)
+    for key, value in (doc or {}).items():
+        fname = _CAMEL_FIELDS.get(key, key)
+        if fname not in valid_fields:
+            errors.append(f"{path}.{key}: unknown field")
+            continue
+        kwargs[fname] = value
+    try:
+        return cls(**kwargs)
+    except TypeError as e:
+        errors.append(f"{path}: {e}")
+        return cls()
+
+
+def parse_operator_config(doc: dict) -> tuple[OperatorConfiguration, list[str]]:
+    """Dict -> config + field errors (unknown sections/fields are errors —
+    a typo'd knob silently ignored is the worst failure mode of config)."""
+    errors: list[str] = []
+    cfg = OperatorConfiguration()
+    for key, value in (doc or {}).items():
+        entry = _SECTION_TYPES.get(key)
+        if entry is None:
+            errors.append(f"{key}: unknown section")
+            continue
+        attr, cls = entry
+        setattr(cfg, attr, _build_section(cls, value, key, errors))
+    errors.extend(validate_operator_config(cfg))
+    return cfg, errors
+
+
+def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
+    """Semantic validation (api/config/validation analog)."""
+    errors: list[str] = []
+    if cfg.log.level not in ("debug", "info", "error"):
+        errors.append(f"log.level: {cfg.log.level!r} not in debug|info|error")
+    if cfg.log.format not in ("json", "text"):
+        errors.append(f"log.format: {cfg.log.format!r} not in json|text")
+    if cfg.controllers.concurrent_syncs < 1:
+        errors.append("controllers.concurrentSyncs: must be >= 1")
+    if cfg.controllers.reconcile_interval_seconds <= 0:
+        errors.append("controllers.reconcileIntervalSeconds: must be > 0")
+    le = cfg.leader_election
+    if le.enabled:
+        if le.renew_deadline_seconds >= le.lease_duration_seconds:
+            errors.append(
+                "leaderElection.renewDeadlineSeconds: must be < leaseDurationSeconds"
+            )
+        if le.retry_period_seconds <= 0:
+            errors.append("leaderElection.retryPeriodSeconds: must be > 0")
+    for port_name, port in (
+        ("servers.healthPort", cfg.servers.health_port),
+        ("servers.metricsPort", cfg.servers.metrics_port),
+        ("backend.port", cfg.backend.port),
+    ):
+        if port < -1 or port > 65535:
+            errors.append(f"{port_name}: {port} out of range")
+    tas = cfg.topology_aware_scheduling
+    seen_domains: set[str] = set()
+    for i, lvl in enumerate(tas.levels):
+        if not isinstance(lvl, dict) or "domain" not in lvl or "nodeLabelKey" not in lvl:
+            errors.append(
+                f"topologyAwareScheduling.levels[{i}]: want {{domain, nodeLabelKey}}"
+            )
+            continue
+        if lvl["domain"] in seen_domains:
+            errors.append(
+                f"topologyAwareScheduling.levels[{i}]: duplicate domain {lvl['domain']!r}"
+            )
+        seen_domains.add(lvl["domain"])
+    if tas.levels:
+        try:
+            cfg.cluster_topology()
+        except Exception as e:
+            errors.append(f"topologyAwareScheduling.levels: {e}")
+    if cfg.persistence.enabled and not cfg.persistence.path:
+        errors.append("persistence.path: required when persistence is enabled")
+    return errors
+
+
+def load_operator_config(path: str) -> OperatorConfiguration:
+    """YAML file -> validated config; raises ValueError listing every problem
+    (LoadAndValidateOperatorConfig boot contract, cmd/cli/cli.go)."""
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: config root must be a mapping")
+    cfg, errors = parse_operator_config(doc)
+    if errors:
+        raise ValueError(f"{path}: invalid operator config:\n  " + "\n  ".join(errors))
+    return cfg

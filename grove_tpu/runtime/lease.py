@@ -1,0 +1,64 @@
+"""File-lease leader election — the k8s Lease-object analog.
+
+Mirror of the reference's leader-election contract
+(`operator/api/config/v1alpha1/types.go:73-104`): one holder at a time,
+lease must be renewed within renewDeadline, a stale lease (past
+leaseDuration) can be stolen. Implemented over an atomic
+write-to-temp + rename on a shared filesystem path, which gives HA restarts
+on a single host or a shared volume — the deployment surfaces this stack
+actually targets (there is no kube-apiserver to host a Lease CR).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from grove_tpu.utils.fsio import atomic_write_json
+
+
+@dataclass
+class FileLease:
+    path: str
+    lease_duration_seconds: float = 15.0
+    identity: str = field(default_factory=lambda: f"{os.getpid()}-{uuid.uuid4().hex[:8]}")
+
+    def _read(self) -> dict | None:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _write(self, doc: dict) -> None:
+        atomic_write_json(self.path, doc)
+
+    def try_acquire(self, now: float | None = None) -> bool:
+        """Acquire or renew; returns True when this process holds the lease.
+
+        A different holder's lease is honored until it expires
+        (leaseDurationSeconds past its last renewal), then stolen.
+        """
+        now = time.time() if now is None else now
+        doc = self._read()
+        if doc is not None:
+            holder = doc.get("holder")
+            renewed = float(doc.get("renewed", 0.0))
+            if holder != self.identity and now - renewed < self.lease_duration_seconds:
+                return False
+        self._write({"holder": self.identity, "renewed": now})
+        # Re-read to confirm we won any racing rename (last writer wins; the
+        # loser observes the winner's identity here and stands down).
+        doc = self._read()
+        return bool(doc and doc.get("holder") == self.identity)
+
+    def release(self) -> None:
+        doc = self._read()
+        if doc and doc.get("holder") == self.identity:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass

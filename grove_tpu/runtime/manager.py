@@ -1,0 +1,271 @@
+"""The Manager: one config file boots the whole control plane.
+
+Mirror of `operator/internal/controller/manager.go:53-121` +
+`operator/cmd/main.go:46-128`: from a validated OperatorConfiguration it
+wires the logger, the metrics registry + HTTP exposition, health/readiness
+probes, leader election, the store + reconcile loop (with flow.go requeue
+semantics), optional control-plane persistence, and optionally hosts the
+scheduler-backend gRPC sidecar in-process.
+
+Differences from the reference, by design: there is no kube-apiserver —
+the store is fed by the simulator, the watch driver
+(grove_tpu/cluster/watch.py), or backend RPCs; webhook TLS/cert rotation is
+replaced by the admission pipeline being invoked in-process at object
+apply time (grove_tpu/api/validation.py), so cert management has no analog
+surface.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+from typing import Optional
+
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.runtime.config import OperatorConfiguration
+from grove_tpu.runtime.flow import (
+    FlowOutcome,
+    continue_reconcile,
+    run_reconcile_flow,
+)
+from grove_tpu.runtime.lease import FileLease
+from grove_tpu.solver.core import SolverParams
+from grove_tpu.utils.logging import Logger, new_logger
+from grove_tpu.utils.metrics import Registry
+
+
+class _ProbeHandler(http.server.BaseHTTPRequestHandler):
+    manager: "Manager"  # set per server instance
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path == "/healthz":
+            self._respond(200, "ok")
+        elif self.path == "/readyz":
+            ready = self.manager.ready
+            self._respond(200 if ready else 503, "ok" if ready else "not ready")
+        elif self.path == "/metrics":
+            self._respond(200, self.manager.metrics.render_text())
+        elif self.path == "/statusz":
+            self._respond(200, json.dumps(self.manager.statusz()), "application/json")
+        else:
+            self._respond(404, "not found")
+
+    def _respond(self, code: int, body: str, ctype: str = "text/plain"):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class Manager:
+    """Boots and runs the control plane from one OperatorConfiguration."""
+
+    def __init__(
+        self,
+        config: OperatorConfiguration,
+        cluster: Optional[Cluster] = None,
+        log: Optional[Logger] = None,
+    ):
+        self.config = config
+        self.log = log or new_logger(config.log.level, config.log.format)
+        self.metrics = Registry()
+        self.cluster = cluster or Cluster()
+        self.topology = config.cluster_topology()
+        self.controller = GroveController(
+            cluster=self.cluster,
+            topology=self.topology,
+            solver_params=SolverParams(),
+            tas_enabled=config.topology_aware_scheduling.enabled,
+            max_groups=config.solver.max_groups,
+            max_sets=config.solver.max_sets,
+            max_pods=config.solver.max_pods,
+        )
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._http_servers: list[http.server.ThreadingHTTPServer] = []
+        self._lease: Optional[FileLease] = None
+        self._is_leader = not config.leader_election.enabled
+        self._backend_server = None
+        self.backend_port: Optional[int] = None
+        self.health_port: Optional[int] = None
+        self._started = False
+        self._next_requeue: Optional[float] = None
+        self.persistence = None  # wired by start() when enabled
+
+        self._m_reconciles = self.metrics.counter(
+            "grove_reconcile_total", "Reconcile passes run"
+        )
+        self._m_reconcile_errors = self.metrics.counter(
+            "grove_reconcile_errors_total", "Reconcile step errors"
+        )
+        self._m_reconcile_seconds = self.metrics.histogram(
+            "grove_reconcile_duration_seconds", "Reconcile pass duration"
+        )
+        self._m_leader = self.metrics.gauge(
+            "grove_leader", "1 when this process holds the leader lease"
+        )
+        self._m_gangs_admitted = self.metrics.counter(
+            "grove_gangs_admitted_total", "Gangs admitted by the solver"
+        )
+
+    # --- lifecycle ---------------------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        """readyz: started, and (when electing) leadership state known."""
+        return self._started
+
+    def statusz(self) -> dict:
+        return {
+            "leader": self._is_leader,
+            "backend_port": self.backend_port,
+            "objects": {
+                "podcliquesets": len(self.cluster.podcliquesets),
+                "podcliques": len(self.cluster.podcliques),
+                "podgangs": len(self.cluster.podgangs),
+                "pods": len(self.cluster.pods),
+                "nodes": len(self.cluster.nodes),
+            },
+        }
+
+    def start(self) -> None:
+        """Start servers + background loops (mgr.Start analog); idempotent."""
+        if self._started:
+            return
+        cfg = self.config
+        if cfg.leader_election.enabled:
+            self._lease = FileLease(
+                path=cfg.leader_election.lease_file,
+                lease_duration_seconds=cfg.leader_election.lease_duration_seconds,
+            )
+            self._is_leader = self._lease.try_acquire()
+        self._m_leader.set(1.0 if self._is_leader else 0.0)
+
+        if cfg.servers.health_port >= 0:
+            self.health_port = self._serve_http(cfg.servers.health_port)
+        if cfg.backend.enabled:
+            from grove_tpu.backend.service import create_server
+
+            # create_server builds AND starts the gRPC server.
+            self._backend_server, self.backend_port = create_server(
+                port=cfg.backend.port, max_workers=cfg.backend.max_workers
+            )
+            self.log.info("backend sidecar listening", port=self.backend_port)
+        if cfg.persistence.enabled:
+            from grove_tpu.runtime.persistence import StatePersistence
+
+            self.persistence = StatePersistence(cfg.persistence.path)
+            restored = self.persistence.restore(self.cluster)
+            if restored:
+                self.log.info("restored control-plane state", path=cfg.persistence.path)
+        self._started = True
+        self.log.info(
+            "manager started",
+            leader=self._is_leader,
+            health_port=self.health_port,
+            backend_port=self.backend_port,
+        )
+
+    def _serve_http(self, port: int) -> int:
+        handler = type("Handler", (_ProbeHandler,), {"manager": self})
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._http_servers.append(server)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return server.server_address[1]
+
+    def reconcile_once(self, now: Optional[float] = None) -> FlowOutcome:
+        """One full reconcile pass through the flow runner (testable unit).
+
+        Steps mirror the reference's ordered component sync
+        (podcliqueset/reconcilespec.go:206-221), expressed as flow.go steps;
+        errors land in each PCS's status.last_errors via the recorder.
+        """
+        now = time.time() if now is None else now
+        ctrl = self.controller
+        admitted_box = {"n": 0}
+
+        def _step(fn):
+            def run():
+                fn(now)
+                return continue_reconcile()
+
+            return run
+
+        def _solve():
+            admitted_box["n"] = ctrl.solve_pending(now) or 0
+            return continue_reconcile()
+
+        def _record(errors):
+            msgs = [str(e) for e in errors]
+            for pcs in self.cluster.podcliquesets.values():
+                pcs.status.last_errors = list(msgs)
+
+        t0 = time.perf_counter()
+        outcome = run_reconcile_flow(
+            [
+                ("sync_workloads", _step(lambda n: [
+                    ctrl.sync_workload(pcs, n)
+                    for pcs in list(self.cluster.podcliquesets.values())
+                ])),
+                ("rolling_updates", _step(ctrl.rolling_updates)),
+                ("solve_pending", _solve),
+                ("update_statuses", _step(ctrl.update_statuses)),
+                ("gang_termination", _step(ctrl.gang_termination)),
+            ],
+            error_recorder=_record,
+        )
+        self._m_reconciles.inc()
+        self._m_reconcile_seconds.observe(time.perf_counter() - t0)
+        if outcome.has_errors:
+            self._m_reconcile_errors.inc(len(outcome.errors))
+            for e in outcome.errors:
+                self.log.error("reconcile step failed", step=e.operation, err=str(e))
+        if admitted_box["n"]:
+            self._m_gangs_admitted.inc(admitted_box["n"])
+        self._next_requeue = outcome.requeue_after_seconds
+        if self.persistence is not None:
+            self.persistence.maybe_snapshot(self.cluster, now)
+        return outcome
+
+    def run(self, stop_after_seconds: Optional[float] = None) -> None:
+        """The hot loop: lease renewal + periodic reconcile until stopped."""
+        self.start()
+        cfg = self.config
+        deadline = (
+            time.time() + stop_after_seconds if stop_after_seconds is not None else None
+        )
+        while not self._stop.is_set():
+            now = time.time()
+            if deadline is not None and now >= deadline:
+                break
+            if self._lease is not None:
+                self._is_leader = self._lease.try_acquire(now)
+                self._m_leader.set(1.0 if self._is_leader else 0.0)
+            if self._is_leader:
+                self.reconcile_once(now)
+            interval = cfg.controllers.reconcile_interval_seconds
+            if self._next_requeue is not None:
+                interval = min(interval, max(0.05, self._next_requeue))
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._backend_server is not None:
+            self._backend_server.stop(grace=1.0)
+        for server in self._http_servers:
+            server.shutdown()
+        if self._lease is not None:
+            self._lease.release()
+        if self.persistence is not None:
+            self.persistence.snapshot(self.cluster)
+        self.log.info("manager stopped")

@@ -1,0 +1,102 @@
+"""Typed JSON codec for control-plane objects (persistence + wire format).
+
+The reference persists all control-plane state in CR status through the
+apiserver (rolling-update progress survives operator restarts,
+`operator/api/core/v1alpha1/podcliqueset.go:96-118`). This stack has no
+apiserver, so the store itself must round-trip: this codec turns the
+dataclass object graph into plain JSON (with type tags) and back.
+
+Encoding rules:
+  dataclass -> {"!t": "<registered name>", <field>: <encoded>, ...}
+  Enum      -> {"!e": "<registered name>", "v": <value>}
+  set       -> {"!s": [..]}     tuple -> {"!u": [..]}
+  dict with non-str keys -> {"!d": [[k, v], ..]}
+  primitives/list/str-key dict pass through.
+
+Only registered classes decode — an unknown tag is a hard error, not a
+silent skip (state corruption must not truncate quietly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any
+
+_CLASSES: dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Register a dataclass/enum for decoding (idempotent; name-keyed)."""
+    _CLASSES[cls.__name__] = cls
+    return cls
+
+
+def register_module(module) -> None:
+    """Register every dataclass and Enum defined in `module`."""
+    for name in dir(module):
+        obj = getattr(module, name)
+        if isinstance(obj, type) and obj.__module__ == module.__name__:
+            if dataclasses.is_dataclass(obj) or issubclass(obj, enum.Enum):
+                register(obj)
+
+
+def encode(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return {"!e": type(obj).__name__, "v": obj.value}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        doc: dict[str, Any] = {"!t": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            doc[f.name] = encode(getattr(obj, f.name))
+        return doc
+    if isinstance(obj, (list,)):
+        return [encode(x) for x in obj]
+    if isinstance(obj, tuple):
+        return {"!u": [encode(x) for x in obj]}
+    if isinstance(obj, (set, frozenset)):
+        return {"!s": sorted(encode(x) for x in obj)}
+    if isinstance(obj, dict):
+        if all(isinstance(k, str) for k in obj):
+            return {k: encode(v) for k, v in obj.items()}
+        return {"!d": [[encode(k), encode(v)] for k, v in obj.items()]}
+    raise TypeError(f"cannot encode {type(obj).__name__}: {obj!r}")
+
+
+def decode(doc: Any) -> Any:
+    if doc is None or isinstance(doc, (bool, int, float, str)):
+        return doc
+    if isinstance(doc, list):
+        return [decode(x) for x in doc]
+    if isinstance(doc, dict):
+        if "!e" in doc:
+            cls = _lookup(doc["!e"])
+            return cls(doc["v"])
+        if "!t" in doc:
+            cls = _lookup(doc["!t"])
+            kwargs = {k: decode(v) for k, v in doc.items() if k != "!t"}
+            field_names = {f.name for f in dataclasses.fields(cls) if f.init}
+            no_init = {k: v for k, v in kwargs.items() if k not in field_names}
+            obj = cls(**{k: v for k, v in kwargs.items() if k in field_names})
+            for k, v in no_init.items():
+                setattr(obj, k, v)
+            return obj
+        if "!s" in doc:
+            return set(decode(x) for x in doc["!s"])
+        if "!u" in doc:
+            return tuple(decode(x) for x in doc["!u"])
+        if "!d" in doc:
+            return {decode(k): decode(v) for k, v in doc["!d"]}
+        return {k: decode(v) for k, v in doc.items()}
+    raise TypeError(f"cannot decode {type(doc).__name__}: {doc!r}")
+
+
+def _lookup(name: str) -> type:
+    cls = _CLASSES.get(name)
+    if cls is None:
+        raise KeyError(
+            f"serde: type {name!r} not registered — state file from a newer "
+            "schema, or register_module() missing for its module"
+        )
+    return cls

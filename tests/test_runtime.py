@@ -1,0 +1,340 @@
+"""Operator runtime: config loading, flow semantics, lease, manager boot.
+
+Reference contracts mirrored: OperatorConfiguration load+validate
+(operator/cmd/cli/cli.go, api/config/validation), flow.go step results
+(internal/controller/common/flow.go:34-116), leader election
+(types.go:73-104), manager boot with health/metrics endpoints
+(internal/controller/manager.go:53-121).
+"""
+
+import json
+import urllib.request
+
+import pytest
+import yaml
+
+from grove_tpu.runtime.config import (
+    OperatorConfiguration,
+    load_operator_config,
+    parse_operator_config,
+)
+from grove_tpu.runtime.flow import (
+    continue_and_requeue_after,
+    continue_reconcile,
+    reconcile_after,
+    reconcile_with_errors,
+    run_reconcile_flow,
+    short_circuit,
+)
+from grove_tpu.runtime.lease import FileLease
+from grove_tpu.runtime.manager import Manager
+from grove_tpu.utils.errors import GroveError, requeue_after
+from grove_tpu.utils.logging import new_logger
+from grove_tpu.utils.metrics import Registry
+
+
+# --- config --------------------------------------------------------------------
+
+
+def test_config_defaults_and_load(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump({"log": {"level": "debug"}}))
+    cfg = load_operator_config(str(p))
+    assert cfg.log.level == "debug"
+    assert cfg.controllers.reconcile_interval_seconds == 1.0  # default
+    assert cfg.servers.health_port == 2751
+
+
+def test_config_unknown_field_is_error():
+    _, errors = parse_operator_config({"servers": {"healtPort": 1}})
+    assert any("healtPort" in e for e in errors)
+
+
+def test_config_unknown_section_is_error():
+    _, errors = parse_operator_config({"webhooks": {}})
+    assert any("unknown section" in e for e in errors)
+
+
+def test_config_semantic_validation():
+    _, errors = parse_operator_config(
+        {
+            "log": {"level": "verbose"},
+            "controllers": {"concurrentSyncs": 0},
+            "leaderElection": {
+                "enabled": True,
+                "leaseDurationSeconds": 5,
+                "renewDeadlineSeconds": 10,
+            },
+        }
+    )
+    joined = "\n".join(errors)
+    assert "log.level" in joined
+    assert "concurrentSyncs" in joined
+    assert "renewDeadlineSeconds" in joined
+
+
+def test_config_topology_levels():
+    cfg, errors = parse_operator_config(
+        {
+            "topologyAwareScheduling": {
+                "enabled": True,
+                "levels": [
+                    {"domain": "zone", "nodeLabelKey": "z"},
+                    {"domain": "rack", "nodeLabelKey": "r"},
+                ],
+            }
+        }
+    )
+    assert not errors
+    topo = cfg.cluster_topology()
+    assert [lvl.domain.value for lvl in topo.levels] == ["zone", "rack", "host"]
+
+
+def test_config_duplicate_domain_rejected():
+    _, errors = parse_operator_config(
+        {
+            "topologyAwareScheduling": {
+                "levels": [
+                    {"domain": "rack", "nodeLabelKey": "a"},
+                    {"domain": "rack", "nodeLabelKey": "b"},
+                ]
+            }
+        }
+    )
+    assert any("duplicate domain" in e for e in errors)
+
+
+# --- flow ----------------------------------------------------------------------
+
+
+def test_flow_runs_steps_in_order():
+    seen = []
+    outcome = run_reconcile_flow(
+        [
+            ("a", lambda: (seen.append("a"), continue_reconcile())[1]),
+            ("b", lambda: (seen.append("b"), continue_reconcile())[1]),
+        ]
+    )
+    assert seen == ["a", "b"]
+    assert not outcome.has_errors
+    assert outcome.requeue_after_seconds is None
+
+
+def test_flow_short_circuit_stops():
+    seen = []
+    run_reconcile_flow(
+        [
+            ("a", lambda: short_circuit("done early")),
+            ("b", lambda: (seen.append("b"), continue_reconcile())[1]),
+        ]
+    )
+    assert seen == []
+
+
+def test_flow_requeue_after_stops_and_requeues():
+    outcome = run_reconcile_flow(
+        [
+            ("a", lambda: reconcile_after(7.5)),
+            ("b", lambda: pytest.fail("must not run")),
+        ]
+    )
+    assert outcome.requeue_after_seconds == 7.5
+
+
+def test_flow_continue_and_requeue_keeps_going_min_wins():
+    seen = []
+    outcome = run_reconcile_flow(
+        [
+            ("a", lambda: continue_and_requeue_after(30.0)),
+            ("b", lambda: (seen.append("b"), continue_and_requeue_after(3.0))[1]),
+        ]
+    )
+    assert seen == ["b"]
+    assert outcome.requeue_after_seconds == 3.0
+
+
+def test_flow_grove_error_sentinel_requeues():
+    outcome = run_reconcile_flow(
+        [("a", lambda: (_ for _ in ()).throw(requeue_after("a", 2.0)))]
+    )
+    assert outcome.requeue_after_seconds == 2.0
+    assert not outcome.has_errors  # sentinel, not a failure
+
+
+def test_flow_exception_recorded_and_requeued():
+    recorded = []
+    outcome = run_reconcile_flow(
+        [("boom", lambda: (_ for _ in ()).throw(RuntimeError("kaput")))],
+        error_recorder=lambda errs: recorded.extend(errs),
+    )
+    assert outcome.has_errors
+    assert recorded and "kaput" in str(recorded[0])
+    assert outcome.requeue_after_seconds == 5.0
+
+
+def test_flow_empty_errors_clear_recorder():
+    recorded = ["stale"]
+    run_reconcile_flow(
+        [("ok", continue_reconcile)],
+        error_recorder=lambda errs: (recorded.clear(), recorded.extend(errs)),
+    )
+    assert recorded == []
+
+
+def test_flow_with_errors_result():
+    e = GroveError(code="ERR_SOLVE", operation="solve", message="no capacity")
+    outcome = run_reconcile_flow([("solve", lambda: reconcile_with_errors("solve", e))])
+    assert outcome.errors == [e]
+
+
+# --- lease ---------------------------------------------------------------------
+
+
+def test_lease_acquire_renew_steal(tmp_path):
+    path = str(tmp_path / "leader.lease")
+    a = FileLease(path, lease_duration_seconds=10.0)
+    b = FileLease(path, lease_duration_seconds=10.0)
+    assert a.try_acquire(now=100.0)
+    assert not b.try_acquire(now=105.0)  # within lease duration
+    assert a.try_acquire(now=105.0)  # renewal
+    assert b.try_acquire(now=116.0)  # a's last renewal (105) + 10 < 116: steal
+    assert not a.try_acquire(now=117.0)  # a lost it
+
+
+def test_lease_release(tmp_path):
+    path = str(tmp_path / "leader.lease")
+    a = FileLease(path)
+    b = FileLease(path)
+    assert a.try_acquire(now=1.0)
+    a.release()
+    assert b.try_acquire(now=1.5)
+
+
+# --- logging & metrics ---------------------------------------------------------
+
+
+def test_logger_json_format(capsys):
+    import io
+
+    buf = io.StringIO()
+    log = new_logger("debug", "json", name="t1", stream=buf)
+    log.info("hello", pcs="a", replica=2)
+    doc = json.loads(buf.getvalue())
+    assert doc["msg"] == "hello" and doc["pcs"] == "a" and doc["replica"] == 2
+
+
+def test_logger_rejects_bad_level():
+    with pytest.raises(ValueError):
+        new_logger("verbose", "text")
+
+
+def test_metrics_render():
+    reg = Registry()
+    c = reg.counter("grove_test_total", "help text")
+    c.inc(controller="pcs")
+    c.inc(controller="pcs")
+    g = reg.gauge("grove_leader", "leader")
+    g.set(1.0)
+    h = reg.histogram("grove_dur_seconds", "dur", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = reg.render_text()
+    assert 'grove_test_total{controller="pcs"} 2' in text
+    assert "grove_leader 1" in text
+    assert 'grove_dur_seconds_bucket{le="0.1"} 1' in text
+    assert 'grove_dur_seconds_bucket{le="+Inf"} 2' in text
+    assert "grove_dur_seconds_count 2" in text
+
+
+# --- manager -------------------------------------------------------------------
+
+
+@pytest.fixture
+def booted_manager(tmp_path):
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": 0},  # auto-assign
+            "backend": {"enabled": False},
+            "leaderElection": {
+                "enabled": True,
+                "leaseFile": str(tmp_path / "l.lease"),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    yield m
+    m.stop()
+
+
+def test_manager_boot_health_endpoints(booted_manager):
+    m = booted_manager
+    base = f"http://127.0.0.1:{m.health_port}"
+    assert urllib.request.urlopen(f"{base}/healthz").status == 200
+    assert urllib.request.urlopen(f"{base}/readyz").status == 200
+    metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+    assert "grove_leader 1" in metrics
+    statusz = json.loads(urllib.request.urlopen(f"{base}/statusz").read())
+    assert statusz["leader"] is True
+
+
+def test_manager_reconcile_updates_metrics(booted_manager, simple1):
+    m = booted_manager
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+    outcome = m.reconcile_once(now=1.0)
+    assert not outcome.has_errors
+    assert m.metrics.counter("grove_reconcile_total").value() == 1
+    # expansion materialized objects into the store
+    assert m.cluster.podgangs and m.cluster.pods
+
+
+def test_manager_records_last_errors(booted_manager, simple1, monkeypatch):
+    m = booted_manager
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+
+    def boom(now):
+        raise RuntimeError("solver exploded")
+
+    monkeypatch.setattr(m.controller, "solve_pending", boom)
+    outcome = m.reconcile_once(now=1.0)
+    assert outcome.has_errors
+    assert any("solver exploded" in e for e in simple1.status.last_errors)
+    # next clean pass clears them
+    monkeypatch.undo()
+    m.reconcile_once(now=2.0)
+    assert simple1.status.last_errors == []
+
+
+def test_manager_backend_sidecar_boots(tmp_path):
+    cfg, errors = parse_operator_config(
+        {"servers": {"healthPort": 0}, "backend": {"enabled": True, "port": 0}}
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        assert m.backend_port and m.backend_port > 0
+    finally:
+        m.stop()
+
+
+def test_manager_non_leader_does_not_reconcile(tmp_path, simple1):
+    lease = str(tmp_path / "x.lease")
+    holder = FileLease(lease, lease_duration_seconds=60.0)
+    assert holder.try_acquire()
+    cfg, _ = parse_operator_config(
+        {
+            "servers": {"healthPort": -1},
+            "leaderElection": {"enabled": True, "leaseFile": lease},
+        }
+    )
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.cluster.podcliquesets[simple1.metadata.name] = simple1
+        m.run(stop_after_seconds=0.3)
+        assert not m.cluster.podgangs  # never reconciled: not the leader
+    finally:
+        m.stop()
+        holder.release()
