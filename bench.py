@@ -81,6 +81,7 @@ def run_bench() -> dict:
     )
     from grove_tpu.solver.core import (
         SolverParams,
+        coarse_dmax_of,
         decode_assignments,
         solve_batch,
         solve_batch_speculative,
@@ -146,6 +147,7 @@ def run_bench() -> dict:
     schedulable = jnp.asarray(snapshot.schedulable)
     node_domain_id = jnp.asarray(snapshot.node_domain_id)
     params = SolverParams()
+    dmax = coarse_dmax_of(snapshot)  # scatter-free aggregation path
 
     # Warm-up: compile the wave-shaped program once (production keeps the
     # compiled program cached across reconcile ticks; compile cost reported
@@ -160,6 +162,7 @@ def run_bench() -> dict:
         warm_batch,
         params,
         jnp.zeros((len(gangs),), dtype=bool),
+        coarse_dmax=dmax,
     )
     jax.block_until_ready(warm.ok)
     compile_s = time.perf_counter() - t_compile
@@ -197,7 +200,8 @@ def run_bench() -> dict:
     for wave in waves:
         batch, decode = encode_wave(wave)
         result = solver(
-            free_arr, capacity, schedulable, node_domain_id, batch, params, ok_g
+            free_arr, capacity, schedulable, node_domain_id, batch, params, ok_g,
+            coarse_dmax=dmax,
         )
         free_arr = result.free_after
         ok_g = result.ok_global

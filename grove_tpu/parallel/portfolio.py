@@ -65,7 +65,7 @@ def _objective(result: SolveResult) -> tuple[jax.Array, jax.Array]:
     return admitted, quality
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("coarse_dmax",))
 def portfolio_solve_batch(
     free0: jax.Array,
     capacity: jax.Array,
@@ -73,6 +73,7 @@ def portfolio_solve_batch(
     node_domain_id: jax.Array,
     batch: GangBatch,
     params_stack: SolverParams,
+    coarse_dmax: int | None = None,  # see solver/core.py coarse_dmax_of
 ) -> tuple[SolveResult, jax.Array, jax.Array]:
     """Solve the same batch under every weight vector; return the winner.
 
@@ -81,7 +82,10 @@ def portfolio_solve_batch(
     a two-stage argmax, NOT a packed float (which would quantize the quality
     tie-break away in f32 once admitted*1e6 dominates the mantissa).
     """
-    vsolve = jax.vmap(solve_batch, in_axes=(None, None, None, None, None, 0))
+    vsolve = jax.vmap(
+        lambda f, c, s, nd, b, p: solve_batch(f, c, s, nd, b, p, coarse_dmax=coarse_dmax),
+        in_axes=(None, None, None, None, None, 0),
+    )
     results = vsolve(free0, capacity, schedulable, node_domain_id, batch, params_stack)
     admitted, quality = jax.vmap(_objective)(results)
     max_admitted = admitted.max()
@@ -91,7 +95,7 @@ def portfolio_solve_batch(
     return best, winner, objectives
 
 
-@partial(jax.jit, static_argnames=("spread_seed",))
+@partial(jax.jit, static_argnames=("spread_seed", "coarse_dmax"))
 def tune_solve_step(
     free0: jax.Array,
     capacity: jax.Array,
@@ -100,6 +104,7 @@ def tune_solve_step(
     batch: GangBatch,
     params_stack: SolverParams,
     spread_seed: int = 7,
+    coarse_dmax: int | None = None,
 ) -> tuple[SolveResult, SolverParams, jax.Array]:
     """One evolutionary step: solve portfolio → pick winner → next generation.
 
@@ -109,7 +114,8 @@ def tune_solve_step(
     """
     p = params_stack[0].shape[0]
     best, winner, objectives = portfolio_solve_batch(
-        free0, capacity, schedulable, node_domain_id, batch, params_stack
+        free0, capacity, schedulable, node_domain_id, batch, params_stack,
+        coarse_dmax=coarse_dmax,
     )
     factors = jnp.asarray(_mutation_factors(p, seed=spread_seed))  # [P, W]
     winner_vec = jnp.stack([w[winner] for w in params_stack])  # [W]
@@ -147,7 +153,10 @@ def sharded_portfolio_solve(snapshot, batch: GangBatch, params_stack: SolverPara
     winner argmax → all-reduce over the portfolio axis).
     """
     mesh = mesh if mesh is not None else solver_mesh()
+    from grove_tpu.solver.core import coarse_dmax_of
+
     best, winner, objectives = portfolio_solve_batch(
-        *shard_inputs(mesh, snapshot, batch, params_stack)
+        *shard_inputs(mesh, snapshot, batch, params_stack),
+        coarse_dmax=coarse_dmax_of(snapshot),
     )
     return best, int(winner), np.asarray(objectives)

@@ -116,6 +116,10 @@ def build_snapshot(
     node_domain_id = np.full((len(levels), n), -1, dtype=np.int32)
     domain_names: list[list[str]] = []
     num_domains = np.zeros((len(levels),), dtype=np.int32)
+    # Invariant the solver's host-level identity fast path relies on
+    # (solver/core.py agg_by_domain): host-level domain ordinal == node index.
+    # Holds by construction: every node gets a host value (label or node name,
+    # unique), and ordinals are assigned in node-enumeration order.
     # Domain identity is the PATH of label values down the hierarchy, not the
     # raw value: rack "rack-1" in zone "z0" is a different physical rack than
     # "rack-1" in zone "z1" (labels are commonly only unique within a parent).
@@ -137,6 +141,15 @@ def build_snapshot(
             ["/".join(p) for p, _ in sorted(ordinals.items(), key=lambda kv: kv[1])]
         )
         num_domains[li] = len(ordinals)
+        if level.domain == TopologyDomain.HOST and len(ordinals) != n_real:
+            # Enforce, not just assume: a duplicate host label value would
+            # merge two nodes into one host domain on the segment-sum path
+            # while the TPU identity path keeps them separate — silent
+            # backend-dependent admission divergence.
+            raise ValueError(
+                f"duplicate host-level domain values: {len(ordinals)} host "
+                f"domains for {n_real} nodes (host labels must be unique)"
+            )
 
     allocated = np.zeros_like(capacity)
     snap = ClusterSnapshot(

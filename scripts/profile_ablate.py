@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Ablate _place_gang stages to find where per-gang device time goes.
+
+Times a full sequential 256-gang solve (one device call, tiny downloads) with
+stages selectively disabled. Clean measurement: only `ok` [G] is fetched.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.solver import core as C
+
+
+def build_problem(G=256):
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import bench_topology, synthetic_backlog, synthetic_cluster
+    from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.state import build_snapshot
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(racks_per_block=16)
+    backlog = synthetic_backlog(n_disagg=350, n_agg=250, n_frontend=300)
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    snapshot = build_snapshot(nodes, topo)
+    batch, _ = encode_gangs(
+        gangs[:G], pods, snapshot, max_groups=3, max_sets=5, max_pods=10,
+        pad_gangs_to=G,
+    )
+    return snapshot, batch
+
+
+def place_gang_ablated(free, gang, *, schedulable, node_domain_id, cap_scale,
+                       params, coarse_onehot, ablate):
+    """Copy of _place_gang with stage switches (profiling only)."""
+    n, r = free.shape
+    levels = node_domain_id.shape[0]
+    group_req = gang["group_req"]
+    group_total = gang["group_total"]
+    group_required = gang["group_required"]
+    group_valid = gang["group_valid"]
+    set_member = gang["set_member"]
+    set_req_level = gang["set_req_level"]
+    set_pref_level = gang["set_pref_level"]
+    set_valid = gang["set_valid"]
+    set_pinned = gang["set_pinned"]
+    mg = group_req.shape[0]
+    ms = set_member.shape[0]
+    mp_bound = gang["pod_group"].shape[0]
+
+    slots_all = C._group_slots(free, group_req)
+    dom_all = jax.vmap(
+        lambda lv: node_domain_id[jnp.clip(lv, 0, levels - 1)]
+    )(jnp.arange(levels))
+    ones_col = jnp.ones((free.shape[0], 1), dtype=jnp.float32)
+    feat = jnp.concatenate([free, slots_all.T.astype(jnp.float32), ones_col], axis=1)
+
+    def agg_by_domain(vals, level):
+        lc_count = coarse_onehot.shape[0]
+        dm = coarse_onehot.shape[1]
+        oh = coarse_onehot[jnp.clip(level, 0, lc_count - 1)]
+        coarse = jnp.matmul(oh, vals, precision=jax.lax.Precision.HIGHEST)
+        coarse = jnp.pad(coarse, ((0, n - dm), (0, 0)))
+        host_vals = jnp.where(dom_all[levels - 1][:, None] >= 0, vals, 0.0)
+        return jnp.where(level == levels - 1, host_vals, coarse)
+
+    def dom_tables(ok_nodes, level):
+        table = agg_by_domain(jnp.where(ok_nodes[:, None], feat, 0.0), level)
+        return table[:, :r], table[:, r : r + mg], table[:, r + mg]
+
+    if "stage1" in ablate:
+        committed_req = jnp.full((ms,), -1, dtype=jnp.int32)
+        committed_pref = jnp.full((ms,), -1, dtype=jnp.int32)
+        set_fail = jnp.asarray(False)
+    else:
+        def commit_set(carry, s):
+            committed_req, committed_pref, fail = carry
+            member = set_member[s]
+            req_level = set_req_level[s]
+            pref_level = set_pref_level[s]
+            active = set_valid[s]
+            overlap = (set_member & member[None, :]).any(axis=-1)
+
+            def mask_from(c_req, lvl, ov):
+                dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+                return jnp.where((c_req >= 0) & ov, dom == c_req, True)
+
+            masks = jax.vmap(mask_from)(committed_req, set_req_level, overlap)
+            node_ok = schedulable & masks.all(axis=0)
+            memberf = member & group_valid
+            demand = (group_req * (group_required * memberf).astype(jnp.float32)[:, None]).sum(0)
+
+            def nested_feasible(level, ok_nodes):
+                def level_sums(lvl):
+                    f, s_, _ = dom_tables(ok_nodes, lvl)
+                    return f, s_
+
+                dom_free_L, dom_slots_L = jax.vmap(level_sums)(jnp.arange(levels))
+
+                def one(s2):
+                    lvl2 = set_req_level[s2]
+                    lvl2c = jnp.clip(lvl2, 0, levels - 1)
+                    member2 = set_member[s2] & group_valid
+                    active2 = set_valid[s2] & (lvl2 > level) & (set_member[s2] & member).any()
+                    demand2 = (group_req * (group_required * member2).astype(jnp.float32)[:, None]).sum(0)
+                    dom2 = dom_all[lvl2c]
+                    feas2 = (dom_free_L[lvl2c] >= demand2[None, :] - C._EPS).all(axis=-1) & (
+                        (dom_slots_L[lvl2c] >= group_required[None, :]) | ~member2[None, :]
+                    ).all(axis=-1)
+                    node_feas2 = (
+                        jnp.where(dom2 >= 0, feas2[jnp.clip(dom2, 0, n - 1)], False) & ok_nodes
+                    )
+                    nested_any = agg_by_domain(node_feas2[:, None].astype(jnp.float32), level)[:, 0] > 0.5
+                    return jnp.where(active2, nested_any, True)
+
+                return jax.vmap(one)(jnp.arange(ms)).all(axis=0)
+
+            def pick_domain(level, extra_node_mask, check_nested=False):
+                ok_nodes = node_ok & extra_node_mask
+                dom_free, dom_slots, dom_count = dom_tables(ok_nodes, level)
+                feas_cap = (dom_free >= demand[None, :] - C._EPS).all(axis=-1)
+                feas_slots = ((dom_slots >= group_required[None, :]) | ~memberf[None, :]).all(axis=-1)
+                feasible = feas_cap & feas_slots & (dom_count > 0)
+                if check_nested and "nested" not in ablate:
+                    feasible = feasible & nested_feasible(level, ok_nodes)
+                norm_free = (dom_free / cap_scale[None, :]).sum(axis=-1)
+                dj = C._weyl_jitter(gang["index"] * 7919 + level, n)
+                score = jnp.where(feasible, -norm_free * (1.0 + params.w_jitter * dj), -jnp.inf)
+                return jnp.argmax(score), feasible.any()
+
+            req_dom = node_domain_id[jnp.clip(req_level, 0, levels - 1)]
+            pinned = set_pinned[s]
+            pin_mask = jnp.where(pinned >= 0, req_dom == pinned, jnp.ones((n,), dtype=bool))
+            has_req = active & (req_level >= 0)
+            req_choice, req_any = pick_domain(req_level, pin_mask, check_nested=True)
+            new_req = jnp.where(has_req & req_any, req_choice, -1)
+            fail = fail | (has_req & ~req_any)
+            inside_req = jnp.where(new_req >= 0, req_dom == new_req, True)
+            has_pref = active & (pref_level >= 0)
+            if "pref" in ablate:
+                new_pref = jnp.full((), -1, dtype=jnp.int32)
+            else:
+                pref_choice, pref_any = pick_domain(pref_level, inside_req)
+                new_pref = jnp.where(has_pref & pref_any, pref_choice, -1)
+            committed_req = committed_req.at[s].set(new_req)
+            committed_pref = committed_pref.at[s].set(new_pref)
+            return (committed_req, committed_pref, fail), None
+
+        init = (
+            jnp.full((ms,), -1, dtype=jnp.int32),
+            jnp.full((ms,), -1, dtype=jnp.int32),
+            jnp.asarray(False),
+        )
+        (committed_req, committed_pref, set_fail), _ = jax.lax.scan(
+            commit_set, init, jnp.arange(ms)
+        )
+
+    if "stage2" in ablate:
+        counts = jnp.zeros((mg, n), dtype=jnp.int32)
+        groups_ok = jnp.asarray(True)
+        free2 = free
+    else:
+        def alloc_group(carry, xs):
+            free_g, used, ok = carry
+            g_, phase = xs
+            valid = group_valid[g_]
+            req = group_req[g_]
+            total = jnp.where(phase == 0, group_required[g_], group_total[g_] - group_required[g_])
+            required = jnp.where(phase == 0, group_required[g_], 0)
+
+            def set_mask(c_req, lvl, memb):
+                dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+                return jnp.where(memb & (c_req >= 0), dom == c_req, True)
+
+            masks = jax.vmap(set_mask)(committed_req, set_req_level, set_member[:, g_])
+            node_ok = schedulable & masks.all(axis=0)
+            slots = C._group_slots(free_g, req[None, :])[0]
+            slots = jnp.where(node_ok, jnp.minimum(slots, total), 0)
+
+            def pref_hit(c_pref, lvl, memb):
+                dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+                return (memb & (c_pref >= 0) & (dom == c_pref)).astype(jnp.float32)
+
+            pref_bonus = jax.vmap(pref_hit)(committed_pref, set_pref_level, set_member[:, g_]).sum(0)
+
+            def reserved_hit(c_req, lvl, memb):
+                dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+                return (~memb & (c_req >= 0) & (dom == c_req)).astype(jnp.float32)
+
+            reserved = jax.vmap(reserved_hit)(committed_req, set_req_level, set_member[:, g_]).sum(0)
+            norm_free = (free_g / cap_scale[None, :]).mean(axis=-1)
+            score = (
+                params.w_pref * pref_bonus
+                - params.w_tight * norm_free
+                - params.w_reserve * reserved
+                + params.w_jitter * C._weyl_jitter(gang["index"] * 31 + g_, n)
+            )
+            k = min(n, mp_bound)
+            masked_score = jnp.where(slots > 0, score, -jnp.inf)
+            if "topk" in ablate:
+                take_top = jnp.zeros((k,), dtype=jnp.int32)
+                order = jnp.arange(k)
+                counts_n = jnp.zeros((n,), dtype=jnp.int32)
+            else:
+                top_score, order = jax.lax.top_k(masked_score, k)
+                slots_top = jnp.where(jnp.isfinite(top_score), slots[order], 0)
+                csum = jnp.cumsum(slots_top)
+                take_top = jnp.clip(total - (csum - slots_top), 0, slots_top)
+                counts_n = jnp.zeros((n,), dtype=jnp.int32).at[order].set(take_top)
+            counts_n = jnp.where(valid, counts_n, 0)
+            placed = counts_n.sum()
+            ok = ok & ((placed >= required) | ~valid)
+            free_g = free_g - counts_n.astype(jnp.float32)[:, None] * req[None, :]
+            return (free_g, used, ok), counts_n
+
+        order = gang["group_order"]
+        group_ids = jnp.concatenate([order, order])
+        phases = jnp.concatenate([jnp.zeros((mg,), jnp.int32), jnp.ones((mg,), jnp.int32)])
+        used0 = jnp.zeros((n,), dtype=bool)
+        (free2, _, groups_ok), counts2 = jax.lax.scan(
+            alloc_group, (free, used0, jnp.asarray(True)), (group_ids, phases)
+        )
+        counts = (
+            jnp.zeros((mg, free.shape[0]), dtype=jnp.int32)
+            .at[order].set(counts2[:mg])
+            .at[order].add(counts2[mg:])
+        )
+
+    gang_ok = gang["gang_valid"] & groups_ok & ~set_fail
+
+    if "stage3" in ablate:
+        assigned = jnp.full((mp_bound,), -1, dtype=jnp.int32)
+    else:
+        ccum = jnp.cumsum(counts, axis=1)
+        placed_per_group = counts.sum(axis=1)
+
+        def pod_node(pg, pr):
+            gidx = jnp.clip(pg, 0, mg - 1)
+            idx = jnp.searchsorted(ccum[gidx], pr, side="right")
+            live = (pg >= 0) & (pr < placed_per_group[gidx]) & gang_ok
+            return jnp.where(live, idx, -1)
+
+        assigned = jax.vmap(pod_node)(gang["pod_group"], gang["pod_rank"])
+
+    free_out = jnp.where(gang_ok, free2, free)
+    return free_out, assigned, gang_ok
+
+
+def main():
+    G = int(os.environ.get("G", "256"))
+    snapshot, batch = build_problem(G)
+    dmax = C.coarse_dmax_of(snapshot)
+    jb = C.GangBatch(*(jnp.asarray(x) for x in batch))
+    free0 = jnp.asarray(snapshot.free)
+    capacity = jnp.asarray(snapshot.capacity)
+    schedulable = jnp.asarray(snapshot.schedulable)
+    ndi = jnp.asarray(snapshot.node_domain_id)
+    params = C.SolverParams()
+    n = free0.shape[0]
+    cap_scale = jnp.maximum(capacity.max(axis=0), 1e-9)
+    print(f"backend={jax.default_backend()} G={G} N={n}")
+
+    def make_seq(ablate):
+        coh = C._coarse_onehot_stack(ndi, dmax)
+        gang_dict = {
+            "group_req": jb.group_req, "group_total": jb.group_total,
+            "group_required": jb.group_required, "group_valid": jb.group_valid,
+            "set_member": jb.set_member, "set_req_level": jb.set_req_level,
+            "set_pref_level": jb.set_pref_level, "set_valid": jb.set_valid,
+            "set_pinned": jb.set_pinned, "pod_group": jb.pod_group,
+            "pod_rank": jb.pod_rank, "gang_valid": jb.gang_valid,
+            "group_order": jb.group_order, "depends_on": jb.depends_on,
+            "index": jnp.arange(G, dtype=jnp.int32),
+        }
+
+        @jax.jit
+        def run(free):
+            def step(free_, gs):
+                free_out, assigned, ok = place_gang_ablated(
+                    free_, gs, schedulable=schedulable, node_domain_id=ndi,
+                    cap_scale=cap_scale, params=params, coarse_onehot=coh,
+                    ablate=ablate)
+                return free_out, ok
+
+            free_f, oks = jax.lax.scan(step, free, gang_dict)
+            return oks
+
+        return run
+
+    for ablate in (
+        (), ("nested",), ("pref",), ("stage1",), ("stage2",), ("stage3",),
+        ("topk",), ("stage1", "stage2", "stage3"),
+    ):
+        run = make_seq(frozenset(ablate))
+        t0 = time.perf_counter()
+        oks = run(free0)
+        np.asarray(oks)
+        compile_s = time.perf_counter() - t0
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(run(free0))
+            ts.append(time.perf_counter() - t0)
+        label = "+".join(ablate) if ablate else "full"
+        print(f"{label:28s}: min={min(ts)*1e3:7.1f}ms  compile={compile_s:5.1f}s")
+
+
+if __name__ == "__main__":
+    main()

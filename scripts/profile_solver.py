@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Localize TPU solve time: per-wave device time, sequential vs speculative,
+wave-size sweep, encode/decode host cost, speculative round count.
+
+Round-3 instrument for VERDICT.md weak #1 (p99 54.9s on chip vs 3.87s CPU).
+Usage: python scripts/profile_solver.py [--waves 4] [--sizes 16,64,256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--waves", type=int, default=4, help="timed waves per config")
+    ap.add_argument("--sizes", type=str, default="64")
+    ap.add_argument("--scale", type=float, default=1.0)
+    args = ap.parse_args()
+
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import (
+        bench_topology,
+        synthetic_backlog,
+        synthetic_cluster,
+    )
+    from grove_tpu.solver.core import (
+        SolverParams,
+        coarse_dmax_of,
+        decode_assignments,
+        solve_batch,
+        solve_batch_speculative,
+    )
+    from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.state import build_snapshot
+
+    print(f"backend: {jax.default_backend()}")
+    topo = bench_topology()
+    nodes = synthetic_cluster(racks_per_block=max(1, round(16 * args.scale)))
+    backlog = synthetic_backlog(
+        n_disagg=max(1, round(350 * args.scale)),
+        n_agg=max(1, round(250 * args.scale)),
+        n_frontend=max(1, round(300 * args.scale)),
+    )
+    gangs = []
+    pods = {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    snapshot = build_snapshot(nodes, topo)
+    print(f"nodes={len(nodes)} gangs={len(gangs)} pods={len(pods)}")
+
+    mg = max(len(g.spec.pod_groups) for g in gangs)
+    mp = max(g.total_pods() for g in gangs)
+    ms = mg + 2
+    gidx = {g.name: i for i, g in enumerate(gangs)}
+    capacity = jnp.asarray(snapshot.capacity)
+    schedulable = jnp.asarray(snapshot.schedulable)
+    node_domain_id = jnp.asarray(snapshot.node_domain_id)
+    params = SolverParams()
+    dmax = None if os.environ.get("GROVE_PROFILE_SEGSUM") else coarse_dmax_of(snapshot)
+    print(
+        f"MG={mg} MS={ms} MP={mp} N={snapshot.free.shape[0]} "
+        f"R={snapshot.free.shape[1]} coarse_dmax={dmax}"
+    )
+
+    for wave_size in [int(s) for s in args.sizes.split(",")]:
+        waves = [gangs[i : i + wave_size] for i in range(0, len(gangs), wave_size)]
+        nw = min(args.waves, len(waves))
+
+        # host encode cost
+        t0 = time.perf_counter()
+        encoded = []
+        for w in waves[:nw]:
+            encoded.append(
+                encode_gangs(
+                    w, pods, snapshot,
+                    max_groups=mg, max_sets=ms, max_pods=mp,
+                    pad_gangs_to=wave_size, global_index_of=gidx,
+                )
+            )
+        enc_s = (time.perf_counter() - t0) / nw
+
+        for name, solver in (("seq", solve_batch), ("spec", solve_batch_speculative)):
+            free_arr = jnp.asarray(snapshot.free)
+            ok_g = jnp.zeros((len(gangs),), dtype=bool)
+            # compile
+            t0 = time.perf_counter()
+            r = solver(free_arr, capacity, schedulable, node_domain_id,
+                       encoded[0][0], params, ok_g, coarse_dmax=dmax)
+            jax.block_until_ready(r.ok)
+            compile_s = time.perf_counter() - t0
+            # timed waves, fully synchronous per wave to get true device time
+            free_arr = jnp.asarray(snapshot.free)
+            ok_g = jnp.zeros((len(gangs),), dtype=bool)
+            per_wave = []
+            dec_s = 0.0
+            for i in range(nw):
+                batch, decode = encoded[i]
+                t0 = time.perf_counter()
+                r = solver(free_arr, capacity, schedulable, node_domain_id,
+                           batch, params, ok_g, coarse_dmax=dmax)
+                np.asarray(r.ok)  # forced sync: relay's block_until_ready returns early
+                per_wave.append(time.perf_counter() - t0)
+                free_arr = r.free_after
+                ok_g = r.ok_global
+                t0 = time.perf_counter()
+                b = decode_assignments(r, decode, snapshot)
+                dec_s += time.perf_counter() - t0
+            admitted = int(np.asarray(r.ok).sum())
+            print(
+                f"wave={wave_size:4d} {name:4s}: compile={compile_s:6.2f}s "
+                f"solve/wave={np.mean(per_wave):7.4f}s (min={min(per_wave):7.4f} "
+                f"max={max(per_wave):7.4f}) encode/wave={enc_s:6.4f}s "
+                f"decode/wave={dec_s/nw:6.4f}s last_admitted={admitted}/{wave_size}"
+            )
+
+
+if __name__ == "__main__":
+    main()

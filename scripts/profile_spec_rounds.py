@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Measure speculative-solve round count + per-round time, and raw D2H latency
+through the TPU relay (round-3 perf instrumentation)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import bench_topology, synthetic_backlog, synthetic_cluster
+    from grove_tpu.solver import core as C
+    from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.state import build_snapshot
+
+    print(f"backend: {jax.default_backend()}")
+
+    # --- raw D2H latency through the relay ---
+    x_small = jnp.zeros((64, 10), dtype=jnp.int32)
+    x_med = jnp.zeros((5120, 4), dtype=jnp.float32)
+    jax.block_until_ready(x_small); jax.block_until_ready(x_med)
+    for name, x in (("small [64,10] i32", x_small), ("med [5120,4] f32", x_med)):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(x)
+            ts.append(time.perf_counter() - t0)
+        print(f"D2H {name}: min={min(ts)*1e3:.2f}ms med={sorted(ts)[2]*1e3:.2f}ms")
+    # device_get of a pytree in one call
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get((x_small, x_med))
+        ts.append(time.perf_counter() - t0)
+    print(f"D2H tuple both: min={min(ts)*1e3:.2f}ms med={sorted(ts)[2]*1e3:.2f}ms")
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(racks_per_block=16)
+    backlog = synthetic_backlog(n_disagg=350, n_agg=250, n_frontend=300)
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    snapshot = build_snapshot(nodes, topo)
+    mg = max(len(g.spec.pod_groups) for g in gangs)
+    mp = max(g.total_pods() for g in gangs)
+    ms = mg + 2
+    gidx = {g.name: i for i, g in enumerate(gangs)}
+    wave_size = 64
+    batch, _ = encode_gangs(
+        gangs[:wave_size], pods, snapshot, max_groups=mg, max_sets=ms,
+        max_pods=mp, pad_gangs_to=wave_size, global_index_of=gidx,
+    )
+    free0 = jnp.asarray(snapshot.free)
+    capacity = jnp.asarray(snapshot.capacity)
+    schedulable = jnp.asarray(snapshot.schedulable)
+    node_domain_id = jnp.asarray(snapshot.node_domain_id)
+    params = C.SolverParams()._replace(w_jitter=C.SPECULATIVE_JITTER)
+    ok_global = jnp.zeros((len(gangs),), dtype=bool)
+
+    # Re-build the speculative loop with a jitted single-round body so we can
+    # count rounds and time each one from the host.
+    n = free0.shape[0]
+    g = batch.gang_valid.shape[0]
+    mp_b = batch.pod_group.shape[1]
+    cap_scale = jnp.maximum(capacity.max(axis=0), 1e-9)
+    jb = C.GangBatch(*(jnp.asarray(x) for x in batch))
+    gang_valid0 = C._apply_global_deps(jb, ok_global)
+
+    gang_dict = {
+        "group_req": jb.group_req, "group_total": jb.group_total,
+        "group_required": jb.group_required, "group_valid": jb.group_valid,
+        "set_member": jb.set_member, "set_req_level": jb.set_req_level,
+        "set_pref_level": jb.set_pref_level, "set_valid": jb.set_valid,
+        "set_pinned": jb.set_pinned, "pod_group": jb.pod_group,
+        "pod_rank": jb.pod_rank, "gang_valid": gang_valid0,
+        "group_order": jb.group_order, "depends_on": jb.depends_on,
+        "index": jnp.arange(g, dtype=jnp.int32),
+    }
+    dep = jb.depends_on
+    dep_idx = jnp.clip(dep, 0, g - 1)
+
+    def place_one(free, gang_slices):
+        used0 = jnp.zeros((n,), dtype=bool)
+        free_out, _, assigned, ok, score = C._place_gang(
+            free, used0, gang_slices, schedulable=schedulable,
+            node_domain_id=node_domain_id, cap_scale=cap_scale, params=params)
+        usage = jnp.where(ok, free - free_out, 0.0)
+        return usage, assigned, ok, score
+
+    place_all = jax.vmap(place_one, in_axes=(None, 0))
+
+    @jax.jit
+    def body(state):
+        free, decided, ok_final, assigned, scores, rounds = state
+        dep_decided = jnp.where(dep >= 0, decided[dep_idx], True)
+        dep_ok = jnp.where(dep >= 0, ok_final[dep_idx], True)
+        placeable = ~decided & dep_decided
+        gd = dict(gang_dict)
+        gd["gang_valid"] = gd["gang_valid"] & placeable & dep_ok
+        gd["index"] = gang_dict["index"] + rounds * g
+        usage, assigned_r, ok_r, scores_r = place_all(free, gd)
+        cum = jnp.cumsum(usage, axis=0)
+        violates = ((usage > 0) & (cum > free[None, :, :] + C._EPS)).any(axis=(1, 2))
+        commit = ok_r & ~violates
+        free = free - jnp.where(commit[:, None, None], usage, 0.0).sum(axis=0)
+        rejected_now = placeable & ~ok_r
+        newly = commit | rejected_now
+        assigned = jnp.where((newly & ok_r)[:, None], assigned_r, assigned)
+        scores = jnp.where(newly & ok_r, scores_r, scores)
+        ok_final = ok_final | (newly & ok_r & commit)
+        decided = decided | newly
+        return (free, decided, ok_final, assigned, scores, rounds + 1)
+
+    state = (
+        free0, ~gang_valid0, jnp.zeros((g,), dtype=bool),
+        jnp.full((g, mp_b), -1, dtype=jnp.int32),
+        jnp.zeros((g,), dtype=jnp.float32), jnp.asarray(0, dtype=jnp.int32),
+    )
+    # compile
+    s1 = body(state)
+    jax.block_until_ready(s1[0])
+    rounds = 0
+    t_all = time.perf_counter()
+    while True:
+        decided = np.asarray(state[1])
+        n_undecided = int((~decided).sum())
+        if n_undecided == 0 or rounds > g:
+            break
+        t0 = time.perf_counter()
+        state = body(state)
+        jax.block_until_ready(state[0])
+        dt = time.perf_counter() - t0
+        committed = int(np.asarray(state[1]).sum()) - int(decided.sum())
+        print(f"round {rounds}: undecided={n_undecided} newly_decided={committed} t={dt*1e3:.1f}ms")
+        rounds += 1
+    print(f"rounds={rounds} total={time.perf_counter()-t_all:.3f}s")
+
+
+if __name__ == "__main__":
+    main()
