@@ -1,0 +1,87 @@
+"""The admission gateway: defaulting → validation → authorization.
+
+In-process analog of the reference's webhook chain
+(`operator/internal/webhook/register.go:34-62`): a mutation enters through
+`admit_*` and passes the defaulting webhook
+(`admission/pcs/defaulting/podcliqueset.go:35-108`), the validating webhook
+(`admission/pcs/validation/`), and — when enabled — the authorizer
+(`admission/pcs/authorization/handler.go:60-80`), which blocks actors other
+than the operator (and configured exempt actors) from mutating resources the
+operator manages: PodCliques, PodCliqueScalingGroups, PodGangs, and Pods
+owned by a PodCliqueSet. Users create/update/delete PodCliqueSets; everything
+below them belongs to the reconciler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from grove_tpu.api.defaulting import default_podcliqueset
+from grove_tpu.api.types import ClusterTopology, PodCliqueSet
+from grove_tpu.api.validation import validate_podcliqueset, validate_update
+
+# The reconciler's own identity; always allowed to touch managed resources.
+OPERATOR_ACTOR = "system:grove-operator"
+
+# Kinds the operator owns end-to-end (authorization/handler.go exempt list is
+# the inverse: these kinds are protected FROM everyone else).
+MANAGED_KINDS = ("PodClique", "PodCliqueScalingGroup", "PodGang", "Pod")
+
+
+class AdmissionError(Exception):
+    """Mutation rejected by the admission chain."""
+
+    def __init__(self, errors: list):
+        self.errors = list(errors)
+        super().__init__("; ".join(str(e) for e in self.errors))
+
+
+@dataclass
+class Authorizer:
+    """authorizer webhook analog (types.go:211-220, handler.go:60-80)."""
+
+    enabled: bool = False
+    exempt_actors: tuple[str, ...] = ()
+
+    def check(self, actor: str, kind: str, name: str) -> None:
+        """Raise PermissionError for a non-exempt actor mutating a managed kind."""
+        if not self.enabled or kind not in MANAGED_KINDS:
+            return
+        if actor == OPERATOR_ACTOR or actor in self.exempt_actors:
+            return
+        raise PermissionError(
+            f"actor {actor!r} may not mutate managed resource {kind}/{name} "
+            f"(grove authorizer; exempt actors: {list(self.exempt_actors)})"
+        )
+
+
+@dataclass
+class AdmissionChain:
+    """defaulting + validation + authorization, invoked at apply time."""
+
+    topology: ClusterTopology | None = None
+    authorizer: Authorizer = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.authorizer is None:
+            self.authorizer = Authorizer()
+
+    def admit_podcliqueset(
+        self,
+        pcs: PodCliqueSet,
+        old: PodCliqueSet | None = None,
+    ) -> PodCliqueSet:
+        """Default + validate a PCS create/update; returns the mutated object.
+
+        `old` triggers update-path immutability checks
+        (validation/podcliqueset.go:440-508)."""
+        pcs = default_podcliqueset(pcs)
+        errors = validate_podcliqueset(pcs, self.topology)
+        if old is not None:
+            errors += validate_update(old, pcs)
+        if errors:
+            raise AdmissionError(errors)
+        return pcs
+
+    def admit_managed_mutation(self, actor: str, kind: str, name: str) -> None:
+        self.authorizer.check(actor, kind, name)

@@ -70,6 +70,9 @@ class GroveController:
     pad_gangs_to: int | None = None
     # speculative parallel commit (solve_batch_speculative) vs sequential scan
     speculative: bool = False
+    # MNNVL-analog TPU-slice injection (networkAcceleration config section)
+    auto_slice_enabled: bool = False
+    slice_resource_name: str = "google.com/tpu"
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -83,7 +86,10 @@ class GroveController:
 
     # --- workload sync (PCS controller analog) -----------------------------------
 
-    def sync_workload(self, pcs: PodCliqueSet, now: float) -> None:
+    def compute_desired(self, pcs: PodCliqueSet, rng: random.Random | None = None):
+        """Pure expansion for one PCS — no store mutation, safe to run on a
+        worker thread (the manager parallelizes this across PCSes with the
+        slow-start runner when controllers.concurrentSyncs > 1)."""
         c = self.cluster
         pcsg_overrides = {
             k: v
@@ -93,14 +99,21 @@ class GroveController:
                      for cfg in pcs.spec.template.pod_clique_scaling_group_configs}
         }
         pclq_overrides = dict(c.scale_overrides)
-        desired = exp.expand_podcliqueset(
+        return exp.expand_podcliqueset(
             pcs,
             self.topology,
             tas_enabled=self.tas_enabled,
             pcsg_replica_overrides=pcsg_overrides,
             pclq_replica_overrides=pclq_overrides,
-            rng=self.rng,
+            rng=rng if rng is not None else self.rng,
+            auto_slice_enabled=self.auto_slice_enabled,
+            slice_resource_name=self.slice_resource_name,
         )
+
+    def sync_workload(self, pcs: PodCliqueSet, now: float, desired=None) -> None:
+        c = self.cluster
+        if desired is None:
+            desired = self.compute_desired(pcs)
 
         c.headless_services.update(desired.headless_services)
         # Drop services of removed PCS replicas (scale-down leaves no orphans).
@@ -220,12 +233,17 @@ class GroveController:
             )
             # _build_pods makes spec.replicas pods indexed 0..n-1; keep only the
             # ones matching the free indices, re-pointing their index/hostname.
+            inject_slice = exp.slice_injection_active(
+                pcs, self.auto_slice_enabled
+            ) and exp.template_requests_slice(clique_tmpl, self.slice_resource_name)
             for pod, idx in zip(pods[:diff], new_indices):
                 pod.pod_index = idx
                 pod.spec.hostname = naming.pod_hostname(fqn, idx)
                 pod.name = naming.pod_name(fqn, self.rng)
                 pod.env[constants.ENV_PCLQ_POD_INDEX] = str(idx)
                 pod.labels[constants.LABEL_POD_INDEX] = str(idx)
+                if inject_slice:
+                    exp.inject_slice_claim(pod, self.slice_resource_name)
                 c.pods[pod.name] = pod
                 c.record_event(now, fqn, f"created pod {pod.name} (index {idx})")
         elif diff < 0:
@@ -317,6 +335,13 @@ class GroveController:
             if per_group:
                 bound_nodes[gname] = per_group
         pods_by_name = dict(c.pods)
+        # pad_gangs_to buckets the gang axis (round up to the next multiple)
+        # so recurring solve shapes reuse the compiled program.
+        pad_to = None
+        if self.pad_gangs_to:
+            pad_to = self.pad_gangs_to * max(
+                1, -(-len(sub_gangs) // self.pad_gangs_to)
+            )
         batch, decode = encode_gangs(
             sub_gangs,
             pods_by_name,
@@ -324,10 +349,11 @@ class GroveController:
             max_groups=self.max_groups,
             max_sets=self.max_sets,
             max_pods=self.max_pods,
+            pad_gangs_to=pad_to,
             scheduled_gangs=scheduled_names,
             bound_nodes_by_group=bound_nodes,
         )
-        result = solve(snapshot, batch, self.solver_params)
+        result = solve(snapshot, batch, self.solver_params, speculative=self.speculative)
         bindings = decode_assignments(result, decode, snapshot)
 
         admitted = 0

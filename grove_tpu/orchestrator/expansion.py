@@ -45,6 +45,7 @@ from grove_tpu.api.types import (
     PodCliqueSet,
     PodCliqueTemplateSpec,
     TopologyConstraint,
+    TopologyDomain,
 )
 
 
@@ -126,12 +127,22 @@ def expand_podcliqueset(
     pcsg_replica_overrides: dict[str, int] | None = None,
     pclq_replica_overrides: dict[str, int] | None = None,
     rng: random.Random | None = None,
+    auto_slice_enabled: bool = False,
+    slice_resource_name: str = "google.com/tpu",
 ) -> DesiredState:
     """Expand a defaulted PodCliqueSet into its full desired object set.
 
     `pcsg_replica_overrides` / `pclq_replica_overrides` carry HPA-mutated scale
     values keyed by FQN (analog of determinePodCliqueReplicas,
     podgang/syncflow.go:368-395).
+
+    `auto_slice_enabled` is the MNNVL-injection analog
+    (`internal/mnnvl/injection.go:30-74`): pods requesting the slice resource
+    get an ICI-slice resource claim, and their pod groups get a rack-level
+    (ICI-domain) required pack-set unless the workload authored one — TPU
+    pods of one gang land inside one interconnect domain the way MNNVL gangs
+    land inside one NVLink ComputeDomain. A PCS can opt out with the
+    annotation grove.io/auto-slice: "disabled" (mnnvl/helpers.go:30-98).
     """
     rng = rng or random.Random(0)
     pcsg_replica_overrides = pcsg_replica_overrides or {}
@@ -274,12 +285,97 @@ def expand_podcliqueset(
 
         out.podgangs.append(base_gang)
 
+    if slice_injection_active(pcs, auto_slice_enabled):
+        _inject_tpu_slices(out, pcs, topology, slice_resource_name, tas_enabled)
+
     # Stable ordering: base gangs in replica order, then scaled gangs by
     # numeric scaled index (NOT name — "-10" must sort after "-2").
     out.podgangs.sort(
         key=lambda g: (g.is_scaled, g.pcs_replica_index, g.scaled_index, g.name)
     )
     return out
+
+
+def slice_injection_active(pcs: PodCliqueSet, auto_slice_enabled: bool) -> bool:
+    """Config gate + per-PCS opt-out annotation (mnnvl/helpers.go:30-98)."""
+    return (
+        auto_slice_enabled
+        and pcs.metadata.annotations.get("grove.io/auto-slice") != "disabled"
+    )
+
+
+def template_requests_slice(
+    clique_tmpl: PodCliqueTemplateSpec, slice_resource_name: str
+) -> bool:
+    return clique_tmpl.spec.pod_spec.total_requests().get(slice_resource_name, 0.0) > 0
+
+
+def inject_slice_claim(pod: Pod, slice_resource_name: str) -> None:
+    """Attach the ICI-slice resource claim (ComputeDomain resourceClaim analog
+    — consumed by the node runtime, invisible to the bin-packing solver).
+    Idempotent: pod replacement re-runs the pod build path."""
+    if any(c.get("name") == "tpu-ici-slice" for c in pod.spec.resource_claims):
+        return
+    pod.spec.resource_claims.append(
+        {
+            "name": "tpu-ici-slice",
+            "source": {
+                "sliceResource": slice_resource_name,
+                "iciDomain": pod.podgang_name,
+            },
+        }
+    )
+
+
+def _inject_tpu_slices(
+    out: DesiredState,
+    pcs: PodCliqueSet,
+    topology: ClusterTopology | None,
+    slice_resource_name: str,
+    tas_enabled: bool,
+) -> None:
+    """MNNVL-injection analog (injection.go:30-74 + computedomain.go:90-111).
+
+    For every pod group whose template requests the slice resource:
+      - each pod gets a resource claim naming its gang's ICI slice;
+      - the group gets a required rack-level pack-set (rack == ICI domain in
+        the 7-level hierarchy, SURVEY.md §5.8) unless the workload already
+        authored a required constraint for it — and only while TAS is
+        enabled, matching translate_pack_constraint's nullification of all
+        other constraints when it is off.
+    """
+    rack_key = (
+        topology.label_key_for(TopologyDomain.RACK)
+        if topology is not None and tas_enabled
+        else None
+    )
+    slice_templates = {
+        c.name
+        for c in pcs.spec.template.cliques
+        if template_requests_slice(c, slice_resource_name)
+    }
+    if not slice_templates:
+        return
+    clique_by_name = {c.metadata.name: c for c in out.podcliques}
+    slice_groups: set[str] = set()
+    for gang in out.podgangs:
+        for group in gang.spec.pod_groups:
+            clique = clique_by_name.get(group.name)
+            if clique is None or clique.template_name not in slice_templates:
+                continue
+            slice_groups.add(group.name)
+            has_required = (
+                group.topology_constraint is not None
+                and group.topology_constraint.pack_constraint is not None
+                and group.topology_constraint.pack_constraint.required is not None
+            )
+            if rack_key is not None and not has_required:
+                group.topology_constraint = IRTopologyConstraint(
+                    pack_constraint=TopologyPackConstraint(required=rack_key)
+                )
+    for pod in out.pods:
+        if pod.pclq_fqn in slice_groups:
+            inject_slice_claim(pod, slice_resource_name)
 
 
 def _build_podclique(

@@ -232,6 +232,15 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             )
         if le.retry_period_seconds <= 0:
             errors.append("leaderElection.retryPeriodSeconds: must be > 0")
+        # The leader renews once per run-loop iteration, so the renewal gap is
+        # at least the reconcile interval; a deadline below it would make
+        # leadership flap every cycle (stand down -> re-acquire, forever).
+        if cfg.controllers.reconcile_interval_seconds >= le.renew_deadline_seconds:
+            errors.append(
+                "leaderElection.renewDeadlineSeconds: must be > "
+                "controllers.reconcileIntervalSeconds (renewal happens once "
+                "per reconcile cycle)"
+            )
     for port_name, port in (
         ("servers.healthPort", cfg.servers.health_port),
         ("servers.metricsPort", cfg.servers.metrics_port),

@@ -24,7 +24,13 @@ from grove_tpu.utils.fsio import atomic_write_json
 class FileLease:
     path: str
     lease_duration_seconds: float = 15.0
+    # Leader stands down if it failed to renew within this window (types.go:
+    # renewDeadline): a stalled reconcile loop must stop acting as leader
+    # BEFORE the lease can be stolen at lease_duration, so two leaders never
+    # overlap. None = no deadline enforcement.
+    renew_deadline_seconds: float | None = None
     identity: str = field(default_factory=lambda: f"{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    _last_renew: float | None = field(default=None, repr=False)
 
     def _read(self) -> dict | None:
         try:
@@ -48,12 +54,27 @@ class FileLease:
             holder = doc.get("holder")
             renewed = float(doc.get("renewed", 0.0))
             if holder != self.identity and now - renewed < self.lease_duration_seconds:
+                self._last_renew = None
                 return False
+        # Renew-deadline enforcement: if we held the lease but overslept the
+        # renewal window (e.g. a reconcile pass stalled), stand down for this
+        # tick instead of silently extending — the reference leader cancels
+        # itself rather than risk overlapping a successor (types.go:73-104).
+        if (
+            self.renew_deadline_seconds is not None
+            and self._last_renew is not None
+            and now - self._last_renew > self.renew_deadline_seconds
+        ):
+            self._last_renew = None
+            self.release()
+            return False
         self._write({"holder": self.identity, "renewed": now})
         # Re-read to confirm we won any racing rename (last writer wins; the
         # loser observes the winner's identity here and stands down).
         doc = self._read()
-        return bool(doc and doc.get("holder") == self.identity)
+        won = bool(doc and doc.get("holder") == self.identity)
+        self._last_renew = now if won else None
+        return won
 
     def release(self) -> None:
         doc = self._read()

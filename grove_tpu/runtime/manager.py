@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Optional
 
+from grove_tpu.api.admission import AdmissionChain, Authorizer
+from grove_tpu.api.types import PodCliqueSet
 from grove_tpu.orchestrator.controller import GroveController
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.runtime.config import OperatorConfiguration
@@ -50,6 +52,13 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
             self._respond(200, self.manager.metrics.render_text())
         elif self.path == "/statusz":
             self._respond(200, json.dumps(self.manager.statusz()), "application/json")
+        elif self.path == "/profilez":
+            # pprof analog (manager.go:42-44,114-119): reconcile-step timing
+            # breakdown; only served when servers.profilingEnabled.
+            if self.manager.config.servers.profiling_enabled:
+                self._respond(200, json.dumps(self.manager.profilez()), "application/json")
+            else:
+                self._respond(404, "profiling disabled")
         else:
             self._respond(404, "not found")
 
@@ -87,6 +96,10 @@ class Manager:
             max_groups=config.solver.max_groups,
             max_sets=config.solver.max_sets,
             max_pods=config.solver.max_pods,
+            pad_gangs_to=config.solver.pad_gangs_to,
+            speculative=config.solver.speculative,
+            auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
+            slice_resource_name=config.network_acceleration.slice_resource_name,
         )
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -99,6 +112,18 @@ class Manager:
         self._started = False
         self._next_requeue: Optional[float] = None
         self.persistence = None  # wired by start() when enabled
+        self.metrics_port: Optional[int] = None
+        # /profilez state: per-step cumulative seconds + call counts.
+        self._profile: dict[str, dict[str, float]] = {}
+        # Admission chain (webhook analog): defaulting + validation +
+        # authorizer-protected managed resources (config.authorizer).
+        self.admission = AdmissionChain(
+            topology=self.topology,
+            authorizer=Authorizer(
+                enabled=config.authorizer.enabled,
+                exempt_actors=tuple(config.authorizer.exempt_actors),
+            ),
+        )
 
         self._m_reconciles = self.metrics.counter(
             "grove_reconcile_total", "Reconcile passes run"
@@ -116,12 +141,40 @@ class Manager:
             "grove_gangs_admitted_total", "Gangs admitted by the solver"
         )
 
+    # --- object apply surface (admission-gated; kubectl-apply analog) -------------
+
+    def apply_podcliqueset(self, pcs: PodCliqueSet, actor: str = "user") -> PodCliqueSet:
+        """Create/update a PCS through the admission chain (defaulting +
+        validation + update immutability); raises AdmissionError on reject."""
+        old = self.cluster.podcliquesets.get(pcs.metadata.name)
+        pcs = self.admission.admit_podcliqueset(pcs, old=old)
+        self.cluster.podcliquesets[pcs.metadata.name] = pcs
+        return pcs
+
+    def delete_podcliqueset(self, name: str, actor: str = "user") -> None:
+        self.cluster.delete_pcs_cascade(name)
+
+    def mutate_managed(self, actor: str, kind: str, name: str, fn) -> None:
+        """Apply `fn(cluster)` as `actor` touching managed resource kind/name.
+        The authorizer (when enabled) blocks everyone but the operator and
+        exempt actors (authorization/handler.go:60-80)."""
+        self.admission.admit_managed_mutation(actor, kind, name)
+        fn(self.cluster)
+
     # --- lifecycle ---------------------------------------------------------------
 
     @property
     def ready(self) -> bool:
         """readyz: started, and (when electing) leadership state known."""
         return self._started
+
+    def profilez(self) -> dict:
+        """Reconcile-step timing breakdown (pprof analog, profilingEnabled)."""
+        return {
+            "steps": {
+                name: dict(rec) for name, rec in sorted(self._profile.items())
+            }
+        }
 
     def statusz(self) -> dict:
         return {
@@ -145,12 +198,17 @@ class Manager:
             self._lease = FileLease(
                 path=cfg.leader_election.lease_file,
                 lease_duration_seconds=cfg.leader_election.lease_duration_seconds,
+                renew_deadline_seconds=cfg.leader_election.renew_deadline_seconds,
             )
             self._is_leader = self._lease.try_acquire()
         self._m_leader.set(1.0 if self._is_leader else 0.0)
 
         if cfg.servers.health_port >= 0:
             self.health_port = self._serve_http(cfg.servers.health_port)
+        if cfg.servers.metrics_port >= 0:
+            # Dedicated metrics bind (manager.go:94-96); same handler class,
+            # so /metrics is the canonical path on this port.
+            self.metrics_port = self._serve_http(cfg.servers.metrics_port)
         if cfg.backend.enabled:
             from grove_tpu.backend.service import create_server
 
@@ -162,7 +220,10 @@ class Manager:
         if cfg.persistence.enabled:
             from grove_tpu.runtime.persistence import StatePersistence
 
-            self.persistence = StatePersistence(cfg.persistence.path)
+            self.persistence = StatePersistence(
+                cfg.persistence.path,
+                snapshot_interval_seconds=cfg.persistence.snapshot_interval_seconds,
+            )
             restored = self.persistence.restore(self.cluster)
             if restored:
                 self.log.info("restored control-plane state", path=cfg.persistence.path)
@@ -194,12 +255,59 @@ class Manager:
         ctrl = self.controller
         admitted_box = {"n": 0}
 
-        def _step(fn):
+        def _timed(name, body):
             def run():
+                t = time.perf_counter()
+                try:
+                    return body()
+                finally:
+                    rec = self._profile.setdefault(name, {"calls": 0, "seconds": 0.0, "last_seconds": 0.0})
+                    dt = time.perf_counter() - t
+                    rec["calls"] += 1
+                    rec["seconds"] += dt
+                    rec["last_seconds"] = dt
+
+            return run
+
+        def _step(name, fn):
+            def body():
                 fn(now)
                 return continue_reconcile()
 
-            return run
+            return _timed(name, body)
+
+        def _sync_workloads():
+            """Expansion in parallel (slow-start, concurrentSyncs workers),
+            store mutation serial — the store stays single-writer."""
+            pcs_list = list(self.cluster.podcliquesets.values())
+            workers = self.config.controllers.concurrent_syncs
+            if workers > 1 and len(pcs_list) > 1:
+                from random import Random
+
+                from grove_tpu.utils.concurrent import run_concurrently_with_slow_start
+
+                tasks = [
+                    (lambda p=pcs: ctrl.compute_desired(p, rng=Random(hash(p.metadata.name) & 0xFFFF)))
+                    for pcs in pcs_list
+                ]
+                results = run_concurrently_with_slow_start(
+                    tasks, max_workers=workers, stop_on_error=False
+                )
+                # Apply every healthy expansion first — one poisoned PCS must
+                # not starve the rest — then surface the first failure so the
+                # flow records it in status.last_errors.
+                first_error = None
+                for r in results:
+                    if r.error is not None:
+                        first_error = first_error or r.error
+                        continue
+                    ctrl.sync_workload(pcs_list[r.index], now, desired=r.value)
+                if first_error is not None:
+                    raise first_error
+            else:
+                for pcs in pcs_list:
+                    ctrl.sync_workload(pcs, now)
+            return continue_reconcile()
 
         def _solve():
             admitted_box["n"] = ctrl.solve_pending(now) or 0
@@ -213,14 +321,11 @@ class Manager:
         t0 = time.perf_counter()
         outcome = run_reconcile_flow(
             [
-                ("sync_workloads", _step(lambda n: [
-                    ctrl.sync_workload(pcs, n)
-                    for pcs in list(self.cluster.podcliquesets.values())
-                ])),
-                ("rolling_updates", _step(ctrl.rolling_updates)),
-                ("solve_pending", _solve),
-                ("update_statuses", _step(ctrl.update_statuses)),
-                ("gang_termination", _step(ctrl.gang_termination)),
+                ("sync_workloads", _timed("sync_workloads", _sync_workloads)),
+                ("rolling_updates", _step("rolling_updates", ctrl.rolling_updates)),
+                ("solve_pending", _timed("solve_pending", _solve)),
+                ("update_statuses", _step("update_statuses", ctrl.update_statuses)),
+                ("gang_termination", _step("gang_termination", ctrl.gang_termination)),
             ],
             error_recorder=_record,
         )
@@ -253,9 +358,13 @@ class Manager:
                 self._m_leader.set(1.0 if self._is_leader else 0.0)
             if self._is_leader:
                 self.reconcile_once(now)
-            interval = cfg.controllers.reconcile_interval_seconds
-            if self._next_requeue is not None:
-                interval = min(interval, max(0.05, self._next_requeue))
+                interval = cfg.controllers.reconcile_interval_seconds
+                if self._next_requeue is not None:
+                    interval = min(interval, max(0.05, self._next_requeue))
+            else:
+                # Non-leaders retry acquisition on the retry period, not the
+                # reconcile cadence (leaderElection.retryPeriodSeconds).
+                interval = cfg.leader_election.retry_period_seconds
             self._stop.wait(interval)
 
     def stop(self) -> None:

@@ -36,3 +36,12 @@ def simple1() -> PodCliqueSet:
     with open(REPO_ROOT / "examples" / "simple1.yaml") as f:
         doc = yaml.safe_load(f)
     return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+
+@pytest.fixture
+def simple1_variant() -> PodCliqueSet:
+    """A second, differently-named PCS (multi-workload scenarios)."""
+    with open(REPO_ROOT / "examples" / "simple1.yaml") as f:
+        doc = yaml.safe_load(f)
+    doc["metadata"]["name"] = "variant1"
+    return default_podcliqueset(PodCliqueSet.from_dict(doc))

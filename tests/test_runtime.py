@@ -253,7 +253,7 @@ def test_metrics_render():
 def booted_manager(tmp_path):
     cfg, errors = parse_operator_config(
         {
-            "servers": {"healthPort": 0},  # auto-assign
+            "servers": {"healthPort": 0, "metricsPort": 0},  # auto-assign
             "backend": {"enabled": False},
             "leaderElection": {
                 "enabled": True,
@@ -308,7 +308,7 @@ def test_manager_records_last_errors(booted_manager, simple1, monkeypatch):
 
 def test_manager_backend_sidecar_boots(tmp_path):
     cfg, errors = parse_operator_config(
-        {"servers": {"healthPort": 0}, "backend": {"enabled": True, "port": 0}}
+        {"servers": {"healthPort": 0, "metricsPort": 0}, "backend": {"enabled": True, "port": 0}}
     )
     assert not errors
     m = Manager(cfg)
@@ -325,7 +325,7 @@ def test_manager_non_leader_does_not_reconcile(tmp_path, simple1):
     assert holder.try_acquire()
     cfg, _ = parse_operator_config(
         {
-            "servers": {"healthPort": -1},
+            "servers": {"healthPort": -1, "metricsPort": -1},
             "leaderElection": {"enabled": True, "leaseFile": lease},
         }
     )

@@ -1,0 +1,104 @@
+"""TPU-slice injection (MNNVL analog, internal/mnnvl/injection.go:30-74).
+
+networkAcceleration.autoSliceEnabled must change expansion output: pods that
+request the slice resource get an ICI-slice resource claim, and their pod
+groups get a rack-level required pack-set unless the workload authored one.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.orchestrator.expansion import expand_podcliqueset
+from grove_tpu.sim.workloads import aggregated_pcs, bench_topology, frontend_pcs
+
+
+def test_slice_injection_adds_claims_and_rack_packset():
+    pcs = aggregated_pcs("agg")  # leader+workers request google.com/tpu
+    topo = bench_topology()
+    ds = expand_podcliqueset(pcs, topo, auto_slice_enabled=True)
+
+    claimed = [p for p in ds.pods if p.spec.resource_claims]
+    assert claimed, "expected slice claims on TPU-requesting pods"
+    for pod in claimed:
+        claim = pod.spec.resource_claims[0]
+        assert claim["name"] == "tpu-ici-slice"
+        assert claim["source"]["iciDomain"] == pod.podgang_name
+
+    from grove_tpu.api.types import TopologyDomain
+
+    rack_key = topo.label_key_for(TopologyDomain.RACK)
+    tpu_group_names = {p.pclq_fqn for p in claimed}
+    for gang in ds.podgangs:
+        for group in gang.spec.pod_groups:
+            if group.name in tpu_group_names:
+                assert group.topology_constraint is not None
+                assert group.topology_constraint.pack_constraint.required == rack_key
+
+    # Non-TPU pods (frontend) must be untouched.
+    fds = expand_podcliqueset(frontend_pcs("fe"), topo, auto_slice_enabled=True)
+    assert not any(p.spec.resource_claims for p in fds.pods)
+
+
+def test_slice_claims_reach_store_pods_via_controller():
+    """The controller's own pod-build path (not just expansion) injects claims
+    — store pods are built by _sync_clique_pods, a separate code path."""
+    from grove_tpu.orchestrator.controller import GroveController
+    from grove_tpu.orchestrator.store import Cluster
+
+    ctrl = GroveController(
+        cluster=Cluster(),
+        topology=bench_topology(),
+        auto_slice_enabled=True,
+    )
+    pcs = aggregated_pcs("agg")
+    ctrl.cluster.podcliquesets[pcs.metadata.name] = pcs
+    ctrl.sync_workload(pcs, now=1.0)
+    claimed = [p for p in ctrl.cluster.pods.values() if p.spec.resource_claims]
+    assert claimed, "store pods must carry the injected slice claim"
+    for pod in claimed:
+        assert pod.spec.resource_claims[0]["name"] == "tpu-ici-slice"
+
+
+def test_slice_injection_skips_packset_when_tas_disabled():
+    """TAS off nullifies all pack constraints — injection must not smuggle one
+    back in; the node-runtime claim is still attached."""
+    ds = expand_podcliqueset(
+        aggregated_pcs("agg"), bench_topology(), auto_slice_enabled=True,
+        tas_enabled=False,
+    )
+    assert any(p.spec.resource_claims for p in ds.pods)
+    for gang in ds.podgangs:
+        for group in gang.spec.pod_groups:
+            tc = group.topology_constraint
+            assert tc is None or tc.pack_constraint is None or (
+                tc.pack_constraint.required is None
+            )
+
+
+def test_slice_injection_off_by_default():
+    ds = expand_podcliqueset(aggregated_pcs("agg"), bench_topology())
+    assert not any(p.spec.resource_claims for p in ds.pods)
+
+
+def test_slice_injection_respects_optout_annotation():
+    pcs = aggregated_pcs("agg")
+    pcs.metadata.annotations["grove.io/auto-slice"] = "disabled"
+    ds = expand_podcliqueset(pcs, bench_topology(), auto_slice_enabled=True)
+    assert not any(p.spec.resource_claims for p in ds.pods)
+
+
+def test_slice_injection_keeps_authored_constraints():
+    """A workload-authored required constraint wins over the injected one."""
+    pcs = aggregated_pcs("agg")
+    topo = bench_topology()
+    plain = expand_podcliqueset(pcs, topo, auto_slice_enabled=False)
+    injected = expand_podcliqueset(pcs, topo, auto_slice_enabled=True)
+    for g_plain, g_inj in zip(
+        (g for gang in plain.podgangs for g in gang.spec.pod_groups),
+        (g for gang in injected.podgangs for g in gang.spec.pod_groups),
+    ):
+        tc = g_plain.topology_constraint
+        if tc is not None and tc.pack_constraint.required is not None:
+            assert (
+                g_inj.topology_constraint.pack_constraint.required
+                == tc.pack_constraint.required
+            )
