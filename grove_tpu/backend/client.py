@@ -12,6 +12,16 @@ import grpc
 from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
 from grove_tpu.backend.service import SERVICE_NAME
 
+def node_to_proto(node) -> pb.Node:
+    """state.cluster.Node -> pb.Node (watch-driver UpdateCluster feed)."""
+    return pb.Node(
+        name=node.name,
+        capacity=[pb.ResourceQuantity(name=k, value=v) for k, v in node.capacity.items()],
+        labels=dict(node.labels),
+        schedulable=node.schedulable,
+    )
+
+
 _RESPONSES = {
     "Init": pb.InitResponse,
     "SyncPodGang": pb.SyncPodGangResponse,
@@ -69,9 +79,11 @@ class BackendClient:
             pb.ValidatePodCliqueSetRequest(pcs_yaml=pcs_yaml)
         )
 
-    def update_cluster(self, nodes: list[pb.Node], full_replace: bool = False) -> pb.UpdateClusterResponse:
+    def update_cluster(self, nodes: list, full_replace: bool = False) -> pb.UpdateClusterResponse:
+        """Accepts pb.Node protos or state.cluster.Node objects."""
+        protos = [n if isinstance(n, pb.Node) else node_to_proto(n) for n in nodes]
         return self._stubs["UpdateCluster"](
-            pb.UpdateClusterRequest(nodes=nodes, full_replace=full_replace)
+            pb.UpdateClusterRequest(nodes=protos, full_replace=full_replace)
         )
 
     def release_pods(self, pod_names: list[str]) -> pb.ReleasePodsResponse:

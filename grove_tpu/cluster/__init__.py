@@ -1,0 +1,2 @@
+from grove_tpu.cluster.kwok import KwokCluster  # noqa: F401
+from grove_tpu.cluster.watch import EventType, WatchDriver, WatchEvent  # noqa: F401

@@ -1,0 +1,150 @@
+"""KWOK-shaped fake cluster: fabricated nodes + staged pod lifecycles.
+
+The reference scales its control plane against KWOK v0.7.0 fake nodes
+(`operator/hack/kind-up.sh:31,245-265`): nodes exist as API objects, and
+stage configs advance bound pods through Pending → Running → Ready on timers
+without any kubelet. This module is that mechanism for the TPU stack — an
+external "cluster" the control plane only sees through watch events:
+
+  control plane --> observe_binding(pod, node)    (the bind call)
+  cluster       --> WatchEvent stream             (node + pod state changes)
+
+`event_lag_s` models informer latency: an event becomes visible to pollers
+only lag seconds after it happened. This is the stale-read window that
+motivates the reference's ExpectationsStore
+(`operator/internal/expect/expectations.go:33-71`); the WatchDriver's apply
+discipline is tested against it.
+
+Clock discipline matches grove_tpu/sim: explicit `now` everywhere, no
+wall-clock reads, so tests are deterministic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from grove_tpu.cluster.watch import EventType, WatchEvent
+from grove_tpu.state.cluster import Node
+
+
+@dataclass
+class _KwokPod:
+    name: str
+    node: str
+    bound_at: float
+    running_at: float | None = None
+    ready_at: float | None = None
+    deleted: bool = False
+
+
+@dataclass
+class KwokCluster:
+    """Fake node fleet with staged pod lifecycles and lagged watch delivery."""
+
+    nodes: dict[str, Node] = field(default_factory=dict)
+    # Stage latencies (kind-up.sh:264-265 stage configs): bind -> Running,
+    # Running -> Ready.
+    running_delay_s: float = 0.5
+    ready_delay_s: float = 0.5
+    event_lag_s: float = 0.0
+
+    _pods: dict[str, _KwokPod] = field(default_factory=dict)
+    _queue: list[tuple[float, WatchEvent]] = field(default_factory=list)  # (visible_at, ev)
+
+    # ---- cluster-side mutations (the "real world") -------------------------------
+
+    def add_node(self, node: Node, now: float) -> None:
+        self.nodes[node.name] = node
+        self._emit(now, EventType.ADDED, "Node", node.name, self._node_payload(node))
+
+    def remove_node(self, name: str, now: float) -> None:
+        """Node disappears; its pods fail (terminated with the machine)."""
+        self.nodes.pop(name, None)
+        self._emit(now, EventType.DELETED, "Node", name, {})
+        for pod in self._pods.values():
+            if pod.node == name and not pod.deleted:
+                pod.deleted = True
+                self._emit(
+                    now, EventType.MODIFIED, "Pod", pod.name,
+                    {"phase": "Failed", "ready": False, "node": name},
+                )
+
+    def set_schedulable(self, name: str, schedulable: bool, now: float) -> None:
+        node = self.nodes[name]
+        node.schedulable = schedulable
+        self._emit(now, EventType.MODIFIED, "Node", name, self._node_payload(node))
+
+    def fail_pod(self, name: str, now: float) -> None:
+        pod = self._pods.get(name)
+        if pod is None or pod.deleted:
+            return
+        pod.deleted = True
+        self._emit(
+            now, EventType.MODIFIED, "Pod", name,
+            {"phase": "Failed", "ready": False, "node": pod.node},
+        )
+
+    # ---- control-plane side ------------------------------------------------------
+
+    def observe_binding(self, pod_name: str, node_name: str, now: float) -> None:
+        """The bind call: control plane placed pod on node; stages start."""
+        if pod_name in self._pods:
+            return
+        self._pods[pod_name] = _KwokPod(name=pod_name, node=node_name, bound_at=now)
+
+    def observe_deletion(self, pod_name: str, now: float) -> None:
+        """Control plane deleted the pod object; stop its lifecycle."""
+        pod = self._pods.pop(pod_name, None)
+        if pod is not None and not pod.deleted:
+            self._emit(now, EventType.DELETED, "Pod", pod_name, {"node": pod.node})
+
+    # ---- time + watch ------------------------------------------------------------
+
+    def tick(self, now: float) -> None:
+        """Advance pod stages up to `now` (KWOK stage controller analog)."""
+        for pod in self._pods.values():
+            if pod.deleted:
+                continue
+            if pod.running_at is None and now >= pod.bound_at + self.running_delay_s:
+                pod.running_at = pod.bound_at + self.running_delay_s
+                self._emit(
+                    pod.running_at, EventType.MODIFIED, "Pod", pod.name,
+                    {"phase": "Running", "ready": False, "node": pod.node},
+                )
+            if (
+                pod.running_at is not None
+                and pod.ready_at is None
+                and now >= pod.running_at + self.ready_delay_s
+            ):
+                pod.ready_at = pod.running_at + self.ready_delay_s
+                self._emit(
+                    pod.ready_at, EventType.MODIFIED, "Pod", pod.name,
+                    {"phase": "Running", "ready": True, "node": pod.node},
+                )
+
+    def poll(self, now: float) -> list[WatchEvent]:
+        """Deliver events whose lag window has passed, in emission order."""
+        self.tick(now)
+        due = [(t, e) for t, e in self._queue if t <= now]
+        self._queue = [(t, e) for t, e in self._queue if t > now]
+        return [e for _, e in due]
+
+    # ---- internals ---------------------------------------------------------------
+
+    def _node_payload(self, node: Node) -> dict:
+        return {
+            "capacity": dict(node.capacity),
+            "labels": dict(node.labels),
+            "schedulable": node.schedulable,
+        }
+
+    def _emit(self, at: float, etype: EventType, kind: str, name: str, obj: dict) -> None:
+        self._queue.append((at + self.event_lag_s, WatchEvent(etype, kind, name, obj)))
+
+
+def kwok_fleet(nodes: list[Node], now: float = 0.0, **kwargs) -> KwokCluster:
+    """Boot a KwokCluster pre-populated with `nodes` (events included)."""
+    cluster = KwokCluster(**kwargs)
+    for node in nodes:
+        cluster.add_node(node, now)
+    return cluster

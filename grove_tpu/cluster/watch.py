@@ -1,0 +1,153 @@
+"""The watch driver: external cluster events → store, bindings → cluster.
+
+The reference's controllers see the world exclusively through kube-apiserver
+watch streams (informers, SURVEY.md §5.8); the in-pod agent watches too
+(`operator/initc/internal/wait.go:111-164`). This module is that integration
+path for the TPU stack: a WatchSource (KwokCluster, or a real-cluster adapter)
+produces `WatchEvent`s, the WatchDriver applies them to the Manager's store,
+and control-plane decisions (bindings, deletions) flow back out.
+
+Apply discipline (the ExpectationsStore lesson,
+`operator/internal/expect/expectations.go:33-71`): watch events are DELAYED
+VIEWS, not commands. A pod event for an object the controller has deleted or
+replaced must not resurrect it — pod events only ever update fields of a pod
+that still exists in the store under the same binding. The store itself stays
+strongly consistent (single writer: the manager loop), so unlike the
+reference we need no create/delete expectation counters — the lag lives
+entirely on the inbound event side.
+
+Optionally forwards node state to a scheduler-backend sidecar via
+UpdateCluster, so an out-of-process solver sees the same fleet
+(backend/service.py; GREP-375).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from grove_tpu.api.pod import PodPhase
+from grove_tpu.state.cluster import Node
+
+
+class EventType(str, enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: EventType
+    kind: str  # "Node" | "Pod"
+    name: str
+    obj: dict
+
+
+class WatchSource(Protocol):
+    def poll(self, now: float) -> list[WatchEvent]: ...
+
+    def observe_binding(self, pod_name: str, node_name: str, now: float) -> None: ...
+
+    def observe_deletion(self, pod_name: str, now: float) -> None: ...
+
+
+@dataclass
+class WatchDriver:
+    """Pumps a WatchSource into a Cluster store and pushes decisions back."""
+
+    cluster: "object"  # orchestrator.store.Cluster (duck-typed to avoid cycle)
+    source: WatchSource
+    backend: Optional["object"] = None  # backend.client.BackendClient
+    # pods we've told the source about (bind pushed), and known-deleted pods
+    _pushed_bindings: set[str] = field(default_factory=set)
+    _nodes_dirty: bool = field(default=True)
+
+    # ---- inbound: events -> store --------------------------------------------------
+
+    def pump(self, now: float) -> int:
+        """Apply all due events; returns how many were applied."""
+        events = self.source.poll(now)
+        for ev in events:
+            if ev.kind == "Node":
+                self._apply_node(ev, now)
+            elif ev.kind == "Pod":
+                self._apply_pod(ev, now)
+        if events and self.backend is not None and self._nodes_dirty:
+            self._forward_nodes()
+        return len(events)
+
+    def _apply_node(self, ev: WatchEvent, now: float) -> None:
+        c = self.cluster
+        if ev.type == EventType.DELETED:
+            c.nodes.pop(ev.name, None)
+            # Pods on a vanished node are failed-with-the-machine; status
+            # rollup + gang termination handle recovery from there.
+            for pod in c.pods.values():
+                if pod.node_name == ev.name and pod.is_active:
+                    pod.phase = PodPhase.FAILED
+                    pod.ready = False
+        else:
+            c.nodes[ev.name] = Node(
+                name=ev.name,
+                capacity=dict(ev.obj.get("capacity", {})),
+                labels=dict(ev.obj.get("labels", {})),
+                schedulable=bool(ev.obj.get("schedulable", True)),
+            )
+        self._nodes_dirty = True
+
+    def _apply_pod(self, ev: WatchEvent, now: float) -> None:
+        """Stale-view discipline: only mutate a pod that still exists AND is
+        still bound where the event says — a lagged event for a deleted or
+        re-placed pod is dropped, never resurrected."""
+        pod = self.cluster.pods.get(ev.name)
+        if pod is None:
+            return  # controller already deleted it; lagged event is stale
+        if ev.type == EventType.DELETED:
+            return  # outbound deletions originate from the controller, not here
+        node = ev.obj.get("node")
+        if node is not None and pod.node_name != node:
+            return  # stale: the pod has been re-placed since this event
+        phase = ev.obj.get("phase")
+        if phase is not None:
+            try:
+                pod.phase = PodPhase(phase)
+            except ValueError:
+                return  # unknown phase string from a foreign source: drop
+        if "ready" in ev.obj:
+            pod.ready = bool(ev.obj["ready"])
+            if pod.ready and pod.started_at is None:
+                pod.started_at = now
+
+    # ---- outbound: store decisions -> source/backend -------------------------------
+
+    def push(self, now: float) -> int:
+        """Tell the source about new bindings and deletions; returns pushes."""
+        c = self.cluster
+        pushed = 0
+        live = set()
+        for pod in c.pods.values():
+            live.add(pod.name)
+            if pod.is_scheduled and pod.name not in self._pushed_bindings:
+                self.source.observe_binding(pod.name, pod.node_name, now)
+                self._pushed_bindings.add(pod.name)
+                pushed += 1
+        for name in list(self._pushed_bindings):
+            if name not in live:
+                self.source.observe_deletion(name, now)
+                self._pushed_bindings.discard(name)
+                pushed += 1
+        return pushed
+
+    def step(self, now: float) -> None:
+        """One full exchange: inbound events, then outbound decisions."""
+        self.pump(now)
+        self.push(now)
+
+    # ---- backend forwarding ---------------------------------------------------------
+
+    def _forward_nodes(self) -> None:
+        """Mirror the store's node fleet into the sidecar (UpdateCluster)."""
+        self.backend.update_cluster(list(self.cluster.nodes.values()), full_replace=True)
+        self._nodes_dirty = False

@@ -1,0 +1,58 @@
+"""`python -m grove_tpu.initc` — the init-container entry point.
+
+Exit codes mirror the reference binary (initc/cmd/main.go): 0 = all parent
+cliques ready, 1 = timeout waiting, 2 = bad arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from grove_tpu.initc.agent import http_fetch, parse_podcliques_arg, wait_until_ready
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="grove-initc")
+    parser.add_argument(
+        "--podcliques",
+        required=True,
+        help="comma-separated <cliqueFQN>:<minAvailable> gates",
+    )
+    parser.add_argument(
+        "--server",
+        default="http://127.0.0.1:2751",
+        help="manager HTTP API base (apiserver analog)",
+    )
+    parser.add_argument("--poll-interval", type=float, default=1.0)
+    parser.add_argument("--timeout", type=float, default=900.0)
+    args = parser.parse_args(argv)
+
+    try:
+        reqs = parse_podcliques_arg(args.podcliques)
+    except ValueError as e:
+        print(f"grove-initc: {e}", file=sys.stderr)
+        return 2
+    if not reqs:
+        return 0
+
+    def log_poll(n: int) -> None:
+        if n == 1 or n % 30 == 0:
+            print(f"grove-initc: waiting on {len(reqs)} parent clique(s)", flush=True)
+
+    ok = wait_until_ready(
+        http_fetch(args.server),
+        reqs,
+        timeout_s=args.timeout,
+        poll_interval_s=args.poll_interval,
+        on_poll=log_poll,
+    )
+    if not ok:
+        print("grove-initc: timed out waiting for parent cliques", file=sys.stderr)
+        return 1
+    print("grove-initc: all parent cliques ready", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""grove-initc: the startup-ordering agent.
+
+Executable analog of the reference's `grove-initc` binary
+(`operator/initc/cmd/main.go`, `operator/initc/internal/wait.go:111-275`):
+injected as an init container into pods of cliques with startup parents, it
+blocks the user containers until every parent PodClique has at least
+minAvailable Ready pods, then exits 0.
+
+Arg format matches the reference injection
+(`podclique/components/pod/initcontainer.go:142-158`):
+
+    python -m grove_tpu.initc --podcliques=<fqn>:<minAvailable>[,<fqn>:<min>...] \
+        --server http://127.0.0.1:2751 [--poll-interval 1.0] [--timeout 900]
+
+Where the reference informer-watches gang pods through the apiserver with the
+pod's projected ServiceAccount token, this agent polls the manager's HTTP API
+(`/api/v1/podcliques/<fqn>`) — the apiserver analog in this stack. The wait
+loop itself is a pure function over a `fetch` callable so the simulator
+drives the exact same code against the in-process store.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """One parent gate: clique FQN must have >= min_available Ready pods."""
+
+    fqn: str
+    min_available: int
+
+
+def parse_podcliques_arg(value: str) -> list[Requirement]:
+    """`a-0-prefill:2,a-0-router:1` -> [Requirement(...), ...] (options.go)."""
+    reqs: list[Requirement] = []
+    for part in value.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(f"--podcliques entry {part!r}: want <fqn>:<minAvailable>")
+        fqn, _, min_s = part.rpartition(":")
+        try:
+            min_avail = int(min_s)
+        except ValueError:
+            raise ValueError(f"--podcliques entry {part!r}: minAvailable not an int")
+        if not fqn or min_avail < 0:
+            raise ValueError(f"--podcliques entry {part!r}: invalid")
+        reqs.append(Requirement(fqn=fqn, min_available=min_avail))
+    return reqs
+
+
+# fetch: fqn -> (ready_count, exists). Missing cliques gate (wait.go treats a
+# not-yet-created parent as not ready).
+FetchFn = Callable[[str], tuple[int, bool]]
+
+
+def requirements_met(fetch: FetchFn, reqs: Iterable[Requirement]) -> bool:
+    for req in reqs:
+        ready, exists = fetch(req.fqn)
+        if not exists or ready < req.min_available:
+            return False
+    return True
+
+
+def wait_until_ready(
+    fetch: FetchFn,
+    reqs: list[Requirement],
+    *,
+    timeout_s: float = 900.0,
+    poll_interval_s: float = 1.0,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    on_poll: Optional[Callable[[int], None]] = None,
+) -> bool:
+    """Block until all requirements are met; False on timeout (exit 1)."""
+    deadline = clock() + timeout_s
+    polls = 0
+    while True:
+        if requirements_met(fetch, reqs):
+            return True
+        polls += 1
+        if on_poll is not None:
+            on_poll(polls)
+        if clock() >= deadline:
+            return False
+        sleep(poll_interval_s)
+
+
+def http_fetch(server: str, timeout_s: float = 5.0) -> FetchFn:
+    """Poll the manager's HTTP API (the apiserver analog)."""
+
+    def fetch(fqn: str) -> tuple[int, bool]:
+        url = f"{server.rstrip('/')}/api/v1/podcliques/{fqn}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                doc = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 503):
+                return 0, False
+            raise
+        except (OSError, TimeoutError, ValueError):
+            # URLError/ConnectionReset/RemoteDisconnected/short-read JSON —
+            # the manager being briefly unreachable means: keep gating, keep
+            # retrying. An init container must never crash on a blip.
+            return 0, False
+        return int(doc.get("ready", 0)), True
+
+    return fetch
+
+
+def store_fetch(cluster) -> FetchFn:
+    """In-process fetch over the store — the simulator's agent path uses the
+    same wait/requirements code as the binary."""
+
+    def fetch(fqn: str) -> tuple[int, bool]:
+        clique = cluster.podcliques.get(fqn)
+        if clique is None:
+            return 0, False
+        ready = sum(1 for p in cluster.pods_of_clique(fqn) if p.ready and p.is_active)
+        return ready, True
+
+    return fetch

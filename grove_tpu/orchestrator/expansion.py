@@ -38,6 +38,7 @@ from grove_tpu.api.podgang import (
 )
 from grove_tpu.api.types import (
     ClusterTopology,
+    Container,
     ObjectMeta,
     PodClique,
     PodCliqueScalingGroup,
@@ -440,6 +441,45 @@ def _build_pod_group(
     )
 
 
+INITC_CONTAINER_NAME = "grove-initc"
+
+
+def initc_args(
+    pcs: PodCliqueSet, pclq: PodClique, clique_tmpl: PodCliqueTemplateSpec
+) -> list[str] | None:
+    """Startup-ordering agent args for one clique's pods, or None when the
+    clique has no parents (initcontainer.go:142-158). Invariant across the
+    replica loop — compute once per clique."""
+    from grove_tpu.orchestrator.startup import parent_template_names, resolve_parent_fqns
+
+    parents = parent_template_names(pcs, clique_tmpl.name)
+    if not parents:
+        return None
+    reqs: list[str] = []
+    for parent_tmpl in parents:
+        parent = pcs.clique_template(parent_tmpl)
+        min_avail = parent.spec.min_available if parent is not None else 1
+        for parent_fqn in resolve_parent_fqns(None, pcs, pclq, parent_tmpl):
+            reqs.append(f"{parent_fqn}:{min_avail}")
+    return [f"--podcliques={','.join(reqs)}"]
+
+
+def _inject_initc(spec, args: list[str]) -> None:
+    """Inject the startup-ordering init container (initcontainer.go:51,98-126);
+    its args are exactly what the agent binary consumes (python -m
+    grove_tpu.initc)."""
+    if any(c.name == INITC_CONTAINER_NAME for c in spec.init_containers):
+        return
+    spec.init_containers.append(
+        Container(
+            name=INITC_CONTAINER_NAME,
+            image="grove-initc",
+            command=["python", "-m", "grove_tpu.initc"],
+            args=list(args),
+        )
+    )
+
+
 def _build_pods(
     pcs: PodCliqueSet,
     pclq: PodClique,
@@ -461,6 +501,7 @@ def _build_pods(
     if tmpl_hash is None:
         tmpl_hash = compute_pod_template_hash(clique_tmpl)
     fqn = pclq.metadata.name
+    startup_args = initc_args(pcs, pclq, clique_tmpl)
     for idx in range(pclq.spec.replicas):
         env = {
             constants.ENV_PCS_NAME: pcs.metadata.name,
@@ -489,6 +530,8 @@ def _build_pods(
         spec = copy.deepcopy(clique_tmpl.spec.pod_spec)
         spec.hostname = naming.pod_hostname(fqn, idx)
         spec.subdomain = headless_service
+        if startup_args is not None:
+            _inject_initc(spec, startup_args)
         pods.append(
             Pod(
                 name=naming.pod_name(fqn, rng),

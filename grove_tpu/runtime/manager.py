@@ -30,9 +30,11 @@ from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.runtime.config import OperatorConfiguration
 from grove_tpu.runtime.flow import (
     FlowOutcome,
+    ReconcileStepResult,
     continue_reconcile,
     run_reconcile_flow,
 )
+from grove_tpu.utils.errors import GroveError
 from grove_tpu.runtime.lease import FileLease
 from grove_tpu.solver.core import SolverParams
 from grove_tpu.utils.logging import Logger, new_logger
@@ -52,6 +54,41 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
             self._respond(200, self.manager.metrics.render_text())
         elif self.path == "/statusz":
             self._respond(200, json.dumps(self.manager.statusz()), "application/json")
+        elif self.path.startswith("/api/v1/podcliques/"):
+            # apiserver analog the grove-initc agent polls (initc/agent.py).
+            # Readiness definition is store_fetch — the simulator's agent gate
+            # and this endpoint must never diverge. This handler runs on an
+            # HTTP thread while the reconcile thread mutates the pod dict;
+            # retry the (GIL-atomic-per-step, but not per-iteration) scan on
+            # the rare mid-iteration resize.
+            from grove_tpu.initc.agent import store_fetch
+
+            fqn = self.path[len("/api/v1/podcliques/"):]
+            clique = self.manager.cluster.podcliques.get(fqn)
+            if clique is None:
+                self._respond(404, "not found")
+            else:
+                fetch = store_fetch(self.manager.cluster)
+                for _ in range(8):
+                    try:
+                        ready, _exists = fetch(fqn)
+                        break
+                    except RuntimeError:  # dict changed size during iteration
+                        continue
+                else:
+                    self._respond(503, "store busy")
+                    return
+                self._respond(
+                    200,
+                    json.dumps(
+                        {
+                            "name": fqn,
+                            "minAvailable": clique.min_available,
+                            "ready": ready,
+                        }
+                    ),
+                    "application/json",
+                )
         elif self.path == "/profilez":
             # pprof analog (manager.go:42-44,114-119): reconcile-step timing
             # breakdown; only served when servers.profilingEnabled.
@@ -115,6 +152,9 @@ class Manager:
         self.metrics_port: Optional[int] = None
         # /profilez state: per-step cumulative seconds + call counts.
         self._profile: dict[str, dict[str, float]] = {}
+        # Watch driver (cluster integration path): attached via attach_watch;
+        # pumped before and pushed after every reconcile pass.
+        self.watch = None
         # Admission chain (webhook analog): defaulting + validation +
         # authorizer-protected managed resources (config.authorizer).
         self.admission = AdmissionChain(
@@ -160,6 +200,14 @@ class Manager:
         exempt actors (authorization/handler.go:60-80)."""
         self.admission.admit_managed_mutation(actor, kind, name)
         fn(self.cluster)
+
+    def attach_watch(self, source, backend=None) -> "object":
+        """Feed the store from an external cluster's watch stream
+        (grove_tpu/cluster/watch.py). Returns the WatchDriver."""
+        from grove_tpu.cluster.watch import WatchDriver
+
+        self.watch = WatchDriver(cluster=self.cluster, source=source, backend=backend)
+        return self.watch
 
     # --- lifecycle ---------------------------------------------------------------
 
@@ -252,6 +300,14 @@ class Manager:
         errors land in each PCS's status.last_errors via the recorder.
         """
         now = time.time() if now is None else now
+        if self.watch is not None:
+            # Same containment discipline as flow steps: a flaky watch source
+            # or sidecar must degrade to a retry, never kill the run loop.
+            try:
+                self.watch.pump(now)
+            except Exception as e:  # noqa: BLE001
+                self._m_reconcile_errors.inc()
+                self.log.error("watch pump failed", err=str(e))
         ctrl = self.controller
         admitted_box = {"n": 0}
 
@@ -294,16 +350,28 @@ class Manager:
                     tasks, max_workers=workers, stop_on_error=False
                 )
                 # Apply every healthy expansion first — one poisoned PCS must
-                # not starve the rest — then surface the first failure so the
-                # flow records it in status.last_errors.
-                first_error = None
+                # not starve the rest — then record failures WITHOUT stopping
+                # the flow (continue_reconcile=True): solve/status/termination
+                # must still run for the healthy PCSes this pass.
+                errors = []
                 for r in results:
                     if r.error is not None:
-                        first_error = first_error or r.error
+                        errors.append(
+                            GroveError(
+                                code="ERR_SYNC_RESOURCE",
+                                operation="sync_workloads",
+                                message=f"{pcs_list[r.index].metadata.name}: {r.error}",
+                                cause=r.error,
+                            )
+                        )
                         continue
                     ctrl.sync_workload(pcs_list[r.index], now, desired=r.value)
-                if first_error is not None:
-                    raise first_error
+                if errors:
+                    return ReconcileStepResult(
+                        continue_reconcile=True,
+                        requeue_after_seconds=5.0,
+                        errors=errors,
+                    )
             else:
                 for pcs in pcs_list:
                     ctrl.sync_workload(pcs, now)
@@ -338,6 +406,12 @@ class Manager:
         if admitted_box["n"]:
             self._m_gangs_admitted.inc(admitted_box["n"])
         self._next_requeue = outcome.requeue_after_seconds
+        if self.watch is not None:
+            try:
+                self.watch.push(now)
+            except Exception as e:  # noqa: BLE001
+                self._m_reconcile_errors.inc()
+                self.log.error("watch push failed", err=str(e))
         if self.persistence is not None:
             self.persistence.maybe_snapshot(self.cluster, now)
         return outcome

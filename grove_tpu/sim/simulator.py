@@ -22,6 +22,11 @@ from grove_tpu.orchestrator.store import Cluster
 class SimConfig:
     start_delay: float = 2.0  # bound -> containers running (image pull etc.)
     ready_delay: float = 3.0  # running -> Ready probes pass
+    # Startup gate evaluation: "agent" drives the grove-initc code path (the
+    # injected init container's own --podcliques args through
+    # initc/agent.requirements_met, exactly what the binary runs); "predicate"
+    # keeps the legacy pure-predicate gate (orchestrator/startup.may_start).
+    startup_gate: str = "agent"
 
 
 @dataclass
@@ -65,7 +70,7 @@ class Simulator:
                 pod.is_scheduled
                 and pod.phase == PodPhase.PENDING
                 and self.now - self._bound_at.get(pod.name, self.now) >= self.config.start_delay
-                and may_start(self.cluster, pod)  # initc gate (wait.go:240-275)
+                and self._startup_gate_open(pod)  # initc gate (wait.go:240-275)
             ):
                 pod.phase = PodPhase.RUNNING
                 pod.started_at = self.now
@@ -77,6 +82,33 @@ class Simulator:
                 and self.now - self._running_at.get(pod.name, self.now) >= self.config.ready_delay
             ):
                 pod.ready = True
+
+    def _startup_gate_open(self, pod) -> bool:
+        """Agent path: run the injected grove-initc container's own args
+        through the agent's wait logic (one poll) against the store — sim pods
+        start through the agent, not a parallel predicate. Pods without the
+        container have no gate, exactly like the reference (initcontainer.go
+        only injects for cliques with parents)."""
+        if self.config.startup_gate != "agent":
+            return may_start(self.cluster, pod)
+        from grove_tpu.initc.agent import (
+            parse_podcliques_arg,
+            requirements_met,
+            store_fetch,
+        )
+        from grove_tpu.orchestrator.expansion import INITC_CONTAINER_NAME
+
+        initc = next(
+            (c for c in pod.spec.init_containers if c.name == INITC_CONTAINER_NAME),
+            None,
+        )
+        if initc is None:
+            return True
+        arg = next(
+            (a for a in initc.args if a.startswith("--podcliques=")), "--podcliques="
+        )
+        reqs = parse_podcliques_arg(arg[len("--podcliques="):])
+        return requirements_met(store_fetch(self.cluster), reqs)
 
     # --- fault injection ----------------------------------------------------------
 

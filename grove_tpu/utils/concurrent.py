@@ -34,10 +34,10 @@ def run_concurrently_with_slow_start(
 ) -> list[TaskResult]:
     """Run `tasks`, doubling the batch size after each fully-successful batch.
 
-    Returns one TaskResult per task, in task order. With `stop_on_error`, a
-    failing batch records its own errors, and the remaining tasks are left
-    un-run (error=None, value=None, recognizable by `ran=False` semantics:
-    their TaskResult is simply absent from the returned list).
+    Returns one TaskResult per task that RAN, in task order. With
+    `stop_on_error`, a failing batch records its own errors and the remaining
+    tasks are never started — they simply have no TaskResult in the returned
+    list (compare indices against range(len(tasks)) to find them).
     """
     results: list[TaskResult] = []
     max_workers = max(1, int(max_workers))

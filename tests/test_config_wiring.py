@@ -236,11 +236,15 @@ def test_concurrent_syncs_poisoned_pcs_does_not_starve_others(tmp_path, simple1,
     monkeypatch.setattr(m.controller, "compute_desired", poisoned)
     outcome = m.reconcile_once(now=1.0)
     assert outcome.has_errors  # the failure is surfaced...
-    # ...but the healthy PCS still materialized its objects.
+    # ...but the healthy PCS still materialized its objects...
     assert any(
         c.pcs_name == simple1_variant.metadata.name
         for c in m.cluster.podcliques.values()
     )
+    # ...and the REST of the flow still ran (solve/status/termination must not
+    # be starved by one poisoned PCS).
+    assert "solve_pending" in outcome.steps_run
+    assert "gang_termination" in outcome.steps_run
 
 
 def test_lease_without_deadline_keeps_renewing(tmp_path):

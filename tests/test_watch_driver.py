@@ -1,0 +1,194 @@
+"""Watch-driver integration: external cluster -> store -> solver -> cluster.
+
+Round-1 ask #6 / round-2 missing #1: nothing could populate the store from an
+external cluster's watch streams. These tests drive the full loop against the
+KWOK-shaped fake cluster (`operator/hack/kind-up.sh:252-265` analog):
+
+  KwokCluster --events--> WatchDriver --> store --> reconcile/solve
+       ^--------bindings-------------------------------'
+
+including the stale-read discipline the reference's ExpectationsStore exists
+for (`operator/internal/expect/expectations.go:33-71`).
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api.pod import PodPhase
+from grove_tpu.backend.client import BackendClient
+from grove_tpu.backend.service import create_server
+from grove_tpu.cluster.kwok import KwokCluster, kwok_fleet
+from grove_tpu.runtime.config import parse_operator_config
+from grove_tpu.runtime.manager import Manager
+from grove_tpu.sim.workloads import bench_topology, synthetic_cluster
+
+
+def _mgr(extra=None):
+    doc = {"servers": {"healthPort": -1, "metricsPort": -1}}
+    doc.update(extra or {})
+    cfg, errors = parse_operator_config(doc)
+    assert not errors, errors
+    m = Manager(cfg)
+    m.controller.topology = bench_topology()
+    m.topology = m.controller.topology
+    return m
+
+
+def _nodes(n=12):
+    return synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=2,
+                             hosts_per_rack=max(1, n // 2))[:n]
+
+
+def test_nodes_flow_from_watch_to_store():
+    m = _mgr()
+    kwok = kwok_fleet(_nodes(8), now=0.0)
+    driver = m.attach_watch(kwok)
+    driver.pump(now=0.1)
+    assert len(m.cluster.nodes) == 8
+    kwok.set_schedulable("z0b0r0h0", False, now=0.2)
+    kwok.remove_node("z0b0r1h0", now=0.2)
+    driver.pump(now=0.3)
+    assert not m.cluster.nodes["z0b0r0h0"].schedulable
+    assert "z0b0r1h0" not in m.cluster.nodes
+
+
+def test_end_to_end_schedule_through_watch(simple1):
+    """Gated pods bind against watch-fed nodes; KWOK stages drive them Ready;
+    readiness flows back through events into the store."""
+    m = _mgr()
+    kwok = kwok_fleet(_nodes(12), now=0.0)
+    m.attach_watch(kwok)
+    m.apply_podcliqueset(simple1)
+
+    t = 0.5
+    for _ in range(10):
+        m.reconcile_once(now=t)
+        t += 0.6
+    pods = list(m.cluster.pods.values())
+    assert pods and all(p.is_scheduled for p in pods)
+    assert all(p.ready for p in pods), "KWOK ready events must reach the store"
+    assert all(p.phase == PodPhase.RUNNING for p in pods)
+
+
+def test_stale_event_does_not_resurrect_deleted_pod(simple1):
+    """A lagged ready event for a pod the controller already deleted must be
+    dropped (the informer stale-read window, expectations.go motivation)."""
+    m = _mgr()
+    kwok = kwok_fleet(_nodes(12), now=0.0, event_lag_s=5.0)
+    m.attach_watch(kwok)
+    m.apply_podcliqueset(simple1)
+
+    m.reconcile_once(now=1.0)   # nodes not visible yet (lag 5s): no binds
+    assert not any(p.is_scheduled for p in m.cluster.pods.values())
+    m.reconcile_once(now=6.0)   # nodes arrive; pods bind; binds pushed
+    bound = [p for p in m.cluster.pods.values() if p.is_scheduled]
+    assert bound
+    # Kill one pod's object controller-side; its Running/Ready events are
+    # still in flight (lag) and must not resurrect or mutate it.
+    victim = bound[0].name
+    m.cluster.delete_pod(victim)
+    for t in (7.0, 12.0, 13.0, 14.0):
+        m.reconcile_once(now=t)
+    # The victim was recreated under a NEW name by the replica diff; the old
+    # name must stay gone.
+    assert victim not in m.cluster.pods
+
+
+def test_stale_event_for_replaced_binding_dropped():
+    """An event naming the pod's OLD node must not touch the re-placed pod."""
+    from grove_tpu.orchestrator.store import Cluster
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.cluster.watch import EventType, WatchDriver, WatchEvent
+
+    class StubSource:
+        def __init__(self):
+            self.events = []
+
+        def poll(self, now):
+            out, self.events = self.events, []
+            return out
+
+        def observe_binding(self, *a):
+            pass
+
+        def observe_deletion(self, *a):
+            pass
+
+    c = Cluster()
+    c.pods["p1"] = Pod(name="p1", node_name="node-NEW")
+    src = StubSource()
+    driver = WatchDriver(cluster=c, source=src)
+    src.events.append(
+        WatchEvent(EventType.MODIFIED, "Pod", "p1",
+                   {"phase": "Running", "ready": True, "node": "node-OLD"})
+    )
+    driver.pump(now=1.0)
+    assert c.pods["p1"].ready is False  # stale view dropped
+
+    src.events.append(
+        WatchEvent(EventType.MODIFIED, "Pod", "p1",
+                   {"phase": "Running", "ready": True, "node": "node-NEW"})
+    )
+    driver.pump(now=2.0)
+    assert c.pods["p1"].ready is True
+
+
+def test_node_death_fails_pods_and_gang_recovers(simple1):
+    m = _mgr()
+    kwok = kwok_fleet(_nodes(12), now=0.0)
+    m.attach_watch(kwok)
+    m.apply_podcliqueset(simple1)
+    t = 0.5
+    for _ in range(6):
+        m.reconcile_once(now=t)
+        t += 0.6
+    bound = [p for p in m.cluster.pods.values() if p.is_scheduled]
+    assert bound and all(p.ready for p in bound)
+    dead_node = bound[0].node_name
+    kwok.remove_node(dead_node, now=t)
+    m.reconcile_once(now=t + 0.1)
+    # Pods on the dead node were failed by the event apply...
+    assert dead_node not in m.cluster.nodes
+    # ...and subsequent passes replace them and re-bind on surviving nodes.
+    for _ in range(10):
+        t += 0.6
+        m.reconcile_once(now=t)
+    active = [p for p in m.cluster.pods.values() if p.is_active]
+    assert active and all(p.is_scheduled for p in active)
+    assert all(p.node_name != dead_node for p in active)
+
+
+def test_watch_feeds_sidecar_via_update_cluster(simple1):
+    """Driver forwards the watch-fed fleet to the gRPC sidecar; the sidecar
+    solves a gang against exactly that fleet (manager + sidecar + driver e2e)."""
+    import yaml
+
+    from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
+
+    server, port = create_server(port=0, max_workers=4)
+    try:
+        with BackendClient(f"127.0.0.1:{port}") as client:
+            topo = bench_topology()
+            client.init([(lv.domain.value, lv.node_label_key) for lv in topo.levels])
+            m = _mgr()
+            kwok = kwok_fleet(_nodes(12), now=0.0)
+            m.attach_watch(kwok, backend=client)
+            m.reconcile_once(now=0.5)  # pump forwards nodes to the sidecar
+
+            spec = pb.PodGangSpec(name="wg", namespace="default")
+            grp = spec.pod_groups.add()
+            grp.name = "wg-workers"
+            grp.min_replicas = 2
+            for i in range(2):
+                r = grp.pod_references.add()
+                r.name = f"wg-w{i}"
+            q = grp.per_pod_requests.add()
+            q.name = "cpu"
+            q.value = 1.0
+            client.sync_pod_gang(spec)
+            resp = client.solve()
+            gang = next(g for g in resp.gangs if g.name == "wg")
+            assert gang.admitted and len(gang.bindings) == 2
+            fleet_names = {n.name for n in m.cluster.nodes.values()}
+            assert all(b.node_name in fleet_names for b in gang.bindings)
+    finally:
+        server.stop(grace=0.5)
