@@ -102,6 +102,11 @@ class PodGangStatus:
     # podclique/components/pod/syncflow.go:303-345 checks
     # ScheduledReplicas >= MinReplicas for every group of the base gang).
     scheduled_replicas: dict[str, int] = field(default_factory=dict)
+    # Latch: the gang achieved Scheduled at least once. Distinguishes a gang
+    # that LOST its placement (Unhealthy, podgang.go:155-168) from one that
+    # never had any (merely Pending) — the live Scheduled condition flips back
+    # to False in both cases.
+    ever_scheduled: bool = False
 
 
 @dataclass

@@ -294,12 +294,27 @@ class TPUSchedulerBackend:
             }
             for gname, groups in bound_nodes_by_group.items()
         }
+        # ReuseReservationRef (podgang.go:65-71): bias a replacement gang
+        # toward the nodes its referenced reservation occupies/occupied.
+        reuse_by_gang: dict[str, list[int]] = {}
+        for sub in pending:
+            ref = self._gangs[sub.name].spec.reuse_reservation_ref
+            if ref is None:
+                continue
+            idxs = {
+                snapshot.node_index(node)
+                for pod, (node, gname, _) in self._bindings.items()
+                if gname == ref.name and node in snapshot.node_index_map
+            }
+            if idxs:
+                reuse_by_gang[sub.name] = sorted(idxs)
         batch, decode = encode_gangs(
             pending,
             pods_by_name,
             snapshot,
             scheduled_gangs=self._scheduled_gangs,
             bound_nodes_by_group=bound_idx,
+            reuse_nodes_by_gang=reuse_by_gang,
         )
         result = solve(snapshot, batch, speculative=speculative)
         bindings = decode_assignments(result, decode, snapshot)

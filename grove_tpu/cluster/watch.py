@@ -74,7 +74,10 @@ class WatchDriver:
                 self._apply_node(ev, now)
             elif ev.kind == "Pod":
                 self._apply_pod(ev, now)
-        if events and self.backend is not None and self._nodes_dirty:
+        # Dirty-flag, not event-count, gates forwarding: a failed UpdateCluster
+        # (sidecar briefly down) must retry on the NEXT pump even if no new
+        # node events arrive in between.
+        if self.backend is not None and self._nodes_dirty:
             self._forward_nodes()
         return len(events)
 

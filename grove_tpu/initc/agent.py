@@ -101,10 +101,10 @@ def http_fetch(server: str, timeout_s: float = 5.0) -> FetchFn:
         try:
             with urllib.request.urlopen(url, timeout=timeout_s) as resp:
                 doc = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code in (404, 503):
-                return 0, False
-            raise
+        except urllib.error.HTTPError:
+            # 404 = clique not created yet; 5xx = manager restarting. Either
+            # way: keep gating, keep retrying — never crash the init phase.
+            return 0, False
         except (OSError, TimeoutError, ValueError):
             # URLError/ConnectionReset/RemoteDisconnected/short-read JSON —
             # the manager being briefly unreachable means: keep gating, keep

@@ -73,6 +73,11 @@ class GroveController:
     # MNNVL-analog TPU-slice injection (networkAcceleration config section)
     auto_slice_enabled: bool = False
     slice_resource_name: str = "google.com/tpu"
+    # Preemption flap guard: a gang whose rejection is NOT capacity-caused
+    # (e.g. a required rack that can never fit it) must not evict fresh
+    # victims every pass — one preemption attempt per contender per window.
+    preemption_cooldown_seconds: float = 30.0
+    _preempted_for_at: dict = field(default_factory=dict)
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -320,6 +325,20 @@ class GroveController:
         snapshot = build_snapshot(
             list(c.nodes.values()), self.topology, bound_pods=bound_pods
         )
+        # ReuseReservationRef (podgang.go:65-71): a gang replacing another is
+        # biased toward the old gang's nodes via the solver's w_reuse seed.
+        reuse_nodes: dict[str, list[int]] = {}
+        for gang in pending:
+            ref = gang.spec.reuse_reservation_ref
+            if ref is None:
+                continue
+            idxs = {
+                snapshot.node_index(p.node_name)
+                for p in c.pods_of_gang(ref.name)
+                if p.node_name is not None and p.node_name in snapshot.node_index_map
+            }
+            if idxs:
+                reuse_nodes[gang.name] = sorted(idxs)
         # Convert the bound-pod node names collected above to snapshot indices.
         bound_nodes: dict[str, dict[str, list[int]]] = {}
         for gname, groups in bound_node_names.items():
@@ -352,6 +371,7 @@ class GroveController:
             pad_gangs_to=pad_to,
             scheduled_gangs=scheduled_names,
             bound_nodes_by_group=bound_nodes,
+            reuse_nodes_by_gang=reuse_nodes,
         )
         result = solve(snapshot, batch, self.solver_params, speculative=self.speculative)
         bindings = decode_assignments(result, decode, snapshot)
@@ -359,6 +379,7 @@ class GroveController:
         admitted = 0
         import numpy as np
 
+        ok_by_name = dict(zip(decode.gang_names, np.asarray(result.ok)))
         scores = dict(zip(decode.gang_names, np.asarray(result.placement_score)))
         for gang_name, pod_bindings in bindings.items():
             gang = c.podgangs[gang_name]
@@ -372,7 +393,113 @@ class GroveController:
             gang.status.placement_score = float(scores.get(gang_name, 0.0))
             c.record_event(now, gang_name, f"gang admitted ({len(pod_bindings)} pods bound)")
             admitted += 1
+
+        # Priority preemption: a rejected gang that outranks placed gangs may
+        # evict the lowest-priority ones (whole gangs — gang semantics) to
+        # make room; it re-solves first next pass (sort_pending is
+        # priority-ordered). One preemption action per pass keeps the cascade
+        # observable and bounded.
+        valid_by_name = dict(zip(decode.gang_names, np.asarray(batch.gang_valid)))
+        rejected = [
+            g
+            for g in sub_gangs
+            if not ok_by_name.get(g.name, False)
+            and valid_by_name.get(g.name, False)  # gated/unresolvable can't preempt
+            and g.name in c.podgangs
+        ]
+        if rejected:
+            self._preempt_for_rejected(rejected, now)
         return admitted
+
+    def _priority_of(self, gang: PodGang) -> int:
+        return self.priority_classes.get(gang.spec.priority_class_name, 0)
+
+    def _preempt_for_rejected(self, rejected: list[PodGang], now: float) -> bool:
+        """Evict lower-priority placed gangs so the highest-priority rejected
+        gang can fit (KAI priority-preemption analog; victims get the
+        DisruptionTarget condition, podgang.go:160-167)."""
+        c = self.cluster
+        # Prune cooldown entries for gangs that no longer exist (rolling
+        # updates churn gang names; this dict must not grow unboundedly).
+        for name in [n for n in self._preempted_for_at if n not in c.podgangs]:
+            del self._preempted_for_at[name]
+        # Highest-priority contender NOT in cooldown — a permanently-rejected
+        # high-priority gang must not block lower-priority gangs whose
+        # preemption would succeed.
+        contender_sub = None
+        for cand in sorted(rejected, key=self._priority_of, reverse=True):
+            last = self._preempted_for_at.get(cand.name)
+            if last is None or now - last >= self.preemption_cooldown_seconds:
+                contender_sub = cand
+                break
+        if contender_sub is None:
+            return False
+        contender = c.podgangs[contender_sub.name]
+        prio = self._priority_of(contender)
+        # Demand of the unmet remainder (the sub-gang carries shrunken floors).
+        demand: dict[str, float] = {}
+        for grp in contender_sub.spec.pod_groups:
+            first = grp.pod_references[0].name if grp.pod_references else None
+            pod = c.pods.get(first) if first else None
+            if pod is None:
+                continue
+            for res, qty in pod.spec.total_requests().items():
+                demand[res] = demand.get(res, 0.0) + qty * grp.min_replicas
+        if not demand:
+            return False
+
+        def placed_gangs():
+            for gang in c.podgangs.values():
+                pods = [
+                    p for p in c.pods_of_gang(gang.name) if p.is_active and p.is_scheduled
+                ]
+                if pods:
+                    yield gang, pods
+
+        victims = sorted(
+            (
+                (gang, pods)
+                for gang, pods in placed_gangs()
+                if self._priority_of(gang) < prio
+            ),
+            key=lambda gp: (self._priority_of(gp[0]), len(gp[1])),
+        )
+        if not victims:
+            return False
+        released: dict[str, float] = {res: 0.0 for res in demand}
+        chosen: list[tuple[PodGang, list[Pod]]] = []
+        for gang, pods in victims:
+            chosen.append((gang, pods))
+            for p in pods:
+                for res, qty in p.spec.total_requests().items():
+                    if res in released:
+                        released[res] += qty
+            if all(released[res] >= demand[res] for res in demand):
+                break
+        else:
+            return False  # even evicting everything eligible cannot fit it
+        from grove_tpu.api.types import Condition, set_condition
+
+        self._preempted_for_at[contender.name] = now
+        for gang, pods in chosen:
+            gang.status.conditions = set_condition(
+                gang.status.conditions,
+                Condition(
+                    type=constants.PODGANG_CONDITION_DISRUPTION_TARGET,
+                    status="True",
+                    reason="Preempted",
+                    message=f"preempted by higher-priority gang {contender.name}",
+                ),
+                now,
+            )
+            for p in pods:
+                self._release_pod(
+                    p, now, reason=f"preempted by {contender.name}"
+                )
+            c.record_event(
+                now, gang.name, f"gang preempted by {contender.name} ({len(pods)} pods)"
+            )
+        return True
 
     # --- statuses ----------------------------------------------------------------
 

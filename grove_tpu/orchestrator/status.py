@@ -179,6 +179,8 @@ def compute_podgang_status(cluster: Cluster, gang: PodGang, now: float) -> None:
         gang.status.phase = PodGangPhase.STARTING
     else:
         gang.status.phase = PodGangPhase.PENDING
+    if scheduled_ok:
+        gang.status.ever_scheduled = True
     gang.status.conditions = set_condition(
         gang.status.conditions,
         Condition(
@@ -192,6 +194,33 @@ def compute_podgang_status(cluster: Cluster, gang: PodGang, now: float) -> None:
         Condition(
             type=constants.PODGANG_CONDITION_READY,
             status="True" if all_ready else "False",
+        ),
+        now,
+    )
+    # Unhealthy (podgang.go:155-168): the gang HAS been scheduled but some
+    # group can no longer hold its floor — pods failed with their node,
+    # crash-loop, or were evicted. Distinct from a never-scheduled gang
+    # (that is just Pending) and from a healthy one still starting (starting
+    # pods count toward the floor, like MinAvailableBreached's grace).
+    was_scheduled = gang.status.ever_scheduled
+    unhealthy = (
+        was_scheduled
+        and bool(gang.spec.pod_groups)
+        and any(
+            sum(
+                1
+                for p in by_group.get(grp.name, [])
+                if p.is_scheduled and not p.crashlooping
+            )
+            < grp.min_replicas
+            for grp in gang.spec.pod_groups
+        )
+    )
+    gang.status.conditions = set_condition(
+        gang.status.conditions,
+        Condition(
+            type=constants.PODGANG_CONDITION_UNHEALTHY,
+            status="True" if unhealthy else "False",
         ),
         now,
     )

@@ -136,7 +136,9 @@ def shard_inputs(mesh, snapshot, batch: GangBatch, params_stack: SolverParams):
     node_domain_id = jax.device_put(
         jnp.asarray(snapshot.node_domain_id), node_sharding(mesh, 1, 2)
     )
-    jbatch = GangBatch(*(jax.device_put(jnp.asarray(x), rep) for x in batch))
+    jbatch = GangBatch(
+        *(None if x is None else jax.device_put(jnp.asarray(x), rep) for x in batch)
+    )
     pstack = SolverParams(
         *(jax.device_put(jnp.asarray(x), portfolio_sharding(mesh)) for x in params_stack)
     )

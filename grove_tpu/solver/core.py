@@ -92,6 +92,14 @@ class SolveResult(NamedTuple):
     ok_global: jax.Array | None = None
 
 
+def _reuse_of(batch: GangBatch, n: int) -> jax.Array:
+    """ReuseReservationRef node seed [G, N]; zeros when the batch predates the
+    field (older pickled batches) or carries none."""
+    if batch.reuse_nodes is None:
+        return jnp.zeros((batch.gang_valid.shape[0], n), dtype=bool)
+    return batch.reuse_nodes
+
+
 def _apply_global_deps(batch: GangBatch, ok_global: jax.Array | None) -> jax.Array:
     """gang_valid with cross-batch base-gang verdicts folded in."""
     if ok_global is None:
@@ -488,7 +496,9 @@ def solve_batch(
         dep_ok = jnp.where(dep >= 0, ok_vec[jnp.clip(dep, 0, g - 1)], True)
         gang_slices = dict(gang_slices)
         gang_slices["gang_valid"] = gang_slices["gang_valid"] & dep_ok
-        used0 = jnp.zeros((n,), dtype=bool)  # per-gang locality resets each gang
+        # Per-gang locality seed: the previous incarnation's nodes
+        # (ReuseReservationRef, podgang.go:65-71) attract via w_reuse.
+        used0 = gang_slices["reuse"]
         free_out, _, assigned, ok, score = _place_gang(
             free,
             used0,
@@ -518,6 +528,7 @@ def solve_batch(
         "group_order": batch.group_order,
         "depends_on": batch.depends_on,
         "index": jnp.arange(g, dtype=jnp.int32),
+        "reuse": _reuse_of(batch, n),
     }
     (free_final, _), (assigned, ok, score) = jax.lax.scan(
         step, (free0, jnp.zeros((g,), dtype=bool)), (gang_dict, jnp.arange(g))
@@ -605,10 +616,11 @@ def solve_batch_speculative(
         "group_order": batch.group_order,
         "depends_on": batch.depends_on,
         "index": jnp.arange(g, dtype=jnp.int32),
+        "reuse": _reuse_of(batch, n),
     }
 
     def place_one(free, gang_slices):
-        used0 = jnp.zeros((n,), dtype=bool)
+        used0 = gang_slices["reuse"]  # ReuseReservationRef seed (see solve_batch)
         free_out, _, assigned, ok, score = _place_gang(
             free,
             used0,
@@ -718,7 +730,7 @@ def solve(
     capacity = jnp.asarray(snapshot.capacity)
     sched = jnp.asarray(snapshot.schedulable if schedulable is None else schedulable)
     node_domain_id = jnp.asarray(snapshot.node_domain_id)
-    jbatch = GangBatch(*(jnp.asarray(x) for x in batch))
+    jbatch = GangBatch(*(None if x is None else jnp.asarray(x) for x in batch))
     fn = solve_batch_speculative if speculative else solve_batch
     return fn(
         free0,

@@ -66,6 +66,10 @@ class GangBatch(NamedTuple):
     # the host. -1 = unset / no cross-batch dependency.
     global_index: np.ndarray  # i32 [G]
     depends_global: np.ndarray  # i32 [G]
+    # ReuseReservationRef bias (podgang.go:65-71): nodes the gang's previous
+    # incarnation occupied. Seeds the solver's per-gang locality (w_reuse), so
+    # a rolling-updated gang prefers its old placement when capacity allows.
+    reuse_nodes: np.ndarray = None  # bool [G, N]
 
     @property
     def n_gangs(self) -> int:
@@ -105,6 +109,7 @@ def encode_gangs(
     scheduled_gangs: set[str] | None = None,
     bound_nodes_by_group: dict[str, dict[str, list[int]]] | None = None,
     global_index_of: dict[str, int] | None = None,
+    reuse_nodes_by_gang: dict[str, list[int]] | None = None,
 ) -> tuple[GangBatch, GangDecodeInfo]:
     """Flatten gang CRs into the padded batch + decode info.
 
@@ -117,6 +122,10 @@ def encode_gangs(
     that group already bound in earlier solves. Used to pin required pack-sets
     to the domain the bound pods occupy (incremental re-solve must not split a
     co-location guarantee across domains).
+
+    `reuse_nodes_by_gang`: gang name -> snapshot node indices its previous
+    incarnation occupied (ReuseReservationRef, podgang.go:65-71); seeds the
+    solver's w_reuse locality bonus toward the old placement.
 
     `global_index_of`: gang name -> slot in a caller-defined global gang table
     (pipelined-wave chaining). When set, each gang's `global_index` is filled,
@@ -195,6 +204,7 @@ def encode_gangs(
         depends_on=np.full((g_count,), -1, dtype=np.int32),
         global_index=np.full((g_count,), -1, dtype=np.int32),
         depends_global=np.full((g_count,), -1, dtype=np.int32),
+        reuse_nodes=np.zeros((g_count, snapshot.capacity.shape[0]), dtype=bool),
     )
     decode = GangDecodeInfo(gang_names=[], pod_names=[], group_names=[])
     gang_index = {g.name: i for i, g in enumerate(gangs)}
@@ -212,6 +222,9 @@ def encode_gangs(
         pod_names: list[str] = []
         group_names: list[str] = []
         batch.gang_valid[gi] = sets_resolvable[gi]
+        for node_idx in (reuse_nodes_by_gang or {}).get(gang.name, []):
+            if 0 <= node_idx < batch.reuse_nodes.shape[1]:
+                batch.reuse_nodes[gi, node_idx] = True
         if global_index_of is not None:
             batch.global_index[gi] = global_index_of.get(gang.name, -1)
         if gang.base_podgang_name is not None:

@@ -1,0 +1,231 @@
+"""Disruption model: Unhealthy/DisruptionTarget conditions, priority
+preemption, and ReuseReservationRef placement bias (round-2 missing #4/#5).
+
+Reference: scheduler PodGang conditions (podgang.go:155-168), KAI priority
+preemption, reservation reuse hint (podgang.go:65-71).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grove_tpu.api import constants
+from grove_tpu.api.podgang import NamespacedName
+from grove_tpu.api.types import get_condition
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.sim.simulator import Simulator
+from grove_tpu.sim.workloads import _clique, _pcs, bench_topology, synthetic_cluster
+
+
+def _small_cluster(hosts=4, cpu=4.0):
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=1, racks_per_block=1, hosts_per_rack=hosts,
+        cpu=cpu, tpu=0.0,
+    ):
+        cluster.nodes[n.name] = n
+    return cluster
+
+
+def _one_clique_pcs(name, replicas=4, cpu="2", priority=""):
+    pcs = _pcs(name, cliques=[_clique("w", replicas, cpu)])
+    if priority:
+        pcs.spec.template.priority_class_name = priority
+    return pcs
+
+
+def _setup(cluster, priority_classes=None):
+    ctrl = GroveController(
+        cluster=cluster,
+        topology=bench_topology(),
+        priority_classes=priority_classes or {},
+    )
+    return ctrl, Simulator(cluster=cluster, controller=ctrl)
+
+
+# --- Unhealthy condition ----------------------------------------------------------
+
+
+def test_unhealthy_condition_set_on_floor_breach():
+    cluster = _small_cluster(hosts=4)
+    ctrl, sim = _setup(cluster)
+    pcs = _one_clique_pcs("a", replicas=2, cpu="2")
+    cluster.podcliquesets["a"] = pcs
+    assert sim.run_until(
+        lambda: all(p.ready for p in cluster.pods.values() if p.is_active), 60
+    )
+    gang = next(iter(cluster.podgangs.values()))
+    assert get_condition(
+        gang.status.conditions, constants.PODGANG_CONDITION_UNHEALTHY
+    ).status == "False"
+    # Fail enough pods to breach the floor; the gang becomes Unhealthy.
+    for p in list(cluster.pods.values()):
+        sim.fail_pod(p.name)
+    ctrl.update_statuses(sim.now)
+    assert get_condition(
+        gang.status.conditions, constants.PODGANG_CONDITION_UNHEALTHY
+    ).status == "True"
+    # The condition must hold across passes while the gang stays broken, even
+    # though the live Scheduled condition has flipped to False (latch via
+    # status.ever_scheduled, not the overwritten condition).
+    ctrl.update_statuses(sim.now + 1)
+    ctrl.update_statuses(sim.now + 2)
+    assert get_condition(
+        gang.status.conditions, constants.PODGANG_CONDITION_UNHEALTHY
+    ).status == "True"
+
+
+def test_unscheduled_gang_is_pending_not_unhealthy():
+    cluster = _small_cluster(hosts=1, cpu=1.0)  # too small: gang never places
+    ctrl, sim = _setup(cluster)
+    cluster.podcliquesets["a"] = _one_clique_pcs("a", replicas=4, cpu="2")
+    sim.run(10)
+    gang = next(iter(cluster.podgangs.values()))
+    cond = get_condition(gang.status.conditions, constants.PODGANG_CONDITION_UNHEALTHY)
+    assert cond is None or cond.status == "False"
+
+
+# --- priority preemption ----------------------------------------------------------
+
+
+def test_high_priority_gang_preempts_lower():
+    cluster = _small_cluster(hosts=4, cpu=4.0)  # 16 cpu total
+    ctrl, sim = _setup(cluster, priority_classes={"critical": 100, "batch": 0})
+    low = _one_clique_pcs("low", replicas=4, cpu="4", priority="batch")
+    cluster.podcliquesets["low"] = low
+    assert sim.run_until(
+        lambda: all(p.is_scheduled for p in cluster.pods.values()), 60
+    )
+    # Cluster is full. A critical gang arrives and cannot fit.
+    high = _one_clique_pcs("high", replicas=4, cpu="4", priority="critical")
+    cluster.podcliquesets["high"] = high
+    assert sim.run_until(
+        lambda: all(
+            p.is_scheduled
+            for p in cluster.pods.values()
+            if p.is_active and p.pclq_fqn.startswith("high")
+        ),
+        60,
+    ), "critical gang must preempt its way in"
+    low_gang = next(g for g in cluster.podgangs.values() if g.pcs_name == "low")
+    cond = get_condition(
+        low_gang.status.conditions, constants.PODGANG_CONDITION_DISRUPTION_TARGET
+    )
+    assert cond is not None and cond.status == "True"
+    assert "high" in cond.message
+
+
+def test_equal_priority_never_preempts():
+    cluster = _small_cluster(hosts=4, cpu=4.0)
+    ctrl, sim = _setup(cluster, priority_classes={})
+    cluster.podcliquesets["first"] = _one_clique_pcs("first", replicas=4, cpu="4")
+    assert sim.run_until(
+        lambda: all(p.is_scheduled for p in cluster.pods.values()), 60
+    )
+    cluster.podcliquesets["second"] = _one_clique_pcs("second", replicas=4, cpu="4")
+    sim.run(20)
+    # First gang keeps its placement; second stays pending.
+    assert all(
+        p.is_scheduled
+        for p in cluster.pods.values()
+        if p.is_active and p.pclq_fqn.startswith("first")
+    )
+    assert not any(
+        p.is_scheduled
+        for p in cluster.pods.values()
+        if p.is_active and p.pclq_fqn.startswith("second")
+    )
+
+
+def test_preemption_cooldown_limits_evictions():
+    """A contender whose rejection is not capacity-caused must not drain the
+    cluster: preemption for the same gang is limited per cooldown window."""
+    cluster = _small_cluster(hosts=4, cpu=4.0)
+    ctrl, sim = _setup(cluster, priority_classes={"critical": 100})
+    cluster.podcliquesets["low"] = _one_clique_pcs("low", replicas=2, cpu="4")
+    assert sim.run_until(
+        lambda: all(p.is_scheduled for p in cluster.pods.values()), 60
+    )
+    # Impossible contender: demands more cpu than the whole cluster has.
+    cluster.podcliquesets["impossible"] = _one_clique_pcs(
+        "impossible", replicas=8, cpu="4", priority="critical"
+    )
+    evictions_before = len(
+        [e for e in cluster.events if "preempted" in e[2]]
+    )
+    sim.run(10)  # many passes inside one cooldown window
+    evictions = [e for e in cluster.events if "gang preempted" in e[2]]
+    # At most one preemption action in the window (cooldown 30s > 10s sim).
+    assert len(evictions) - evictions_before <= 1
+
+
+# --- ReuseReservationRef ----------------------------------------------------------
+
+
+def test_reuse_reservation_biases_placement():
+    """Solver-level: a gang with reuse_nodes seeded lands on exactly those
+    nodes when capacity allows (w_reuse beats the default tie-break)."""
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.solver.core import decode_assignments, solve
+    from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.state import build_snapshot
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(
+        zones=1, blocks_per_zone=1, racks_per_block=2, hosts_per_rack=8, tpu=0.0
+    )
+    snapshot = build_snapshot(nodes, topo)
+    pcs = _one_clique_pcs("b", replicas=4, cpu="2")
+    ds = expand_podcliqueset(pcs, topo)
+    gang = ds.podgangs[0]
+    pods = {p.name: p for p in ds.pods}
+
+    # Without the seed the solver picks its default nodes.
+    batch0, dec0 = encode_gangs([gang], pods, snapshot)
+    r0 = solve(snapshot, batch0)
+    default_nodes = set(decode_assignments(r0, dec0, snapshot)[gang.name].values())
+
+    # Seed reuse toward the LAST rack's nodes — far from the default pick.
+    target_idx = list(range(len(nodes) - 4, len(nodes)))
+    target_names = {nodes[i].name for i in target_idx}
+    assert target_names != default_nodes
+    batch1, dec1 = encode_gangs(
+        [gang], pods, snapshot, reuse_nodes_by_gang={gang.name: target_idx}
+    )
+    r1 = solve(snapshot, batch1)
+    placed = set(decode_assignments(r1, dec1, snapshot)[gang.name].values())
+    # Bin-packing may stack pods on fewer nodes, but every chosen node must be
+    # a reuse node, and the choice must differ from the unseeded default.
+    assert placed and placed <= target_names
+    assert placed != default_nodes
+
+
+def test_controller_collects_reuse_nodes_from_ref():
+    """A gang whose ReuseReservationRef names a torn-down gang re-lands on the
+    old gang's nodes."""
+    cluster = _small_cluster(hosts=8, cpu=4.0)
+    ctrl, sim = _setup(cluster)
+    cluster.podcliquesets["old"] = _one_clique_pcs("old", replicas=2, cpu="2")
+    assert sim.run_until(
+        lambda: all(p.is_scheduled for p in cluster.pods.values()), 60
+    )
+    old_gang = next(g for g in cluster.podgangs.values() if g.pcs_name == "old")
+    old_nodes = {
+        p.node_name for p in cluster.pods_of_gang(old_gang.name) if p.node_name
+    }
+    # Old pods fail (capacity freed) but their objects linger briefly.
+    for p in list(cluster.pods.values()):
+        sim.fail_pod(p.name)
+    # New workload whose gang references the old reservation.
+    cluster.podcliquesets["newg"] = _one_clique_pcs("newg", replicas=2, cpu="2")
+    ctrl.sync_workload(cluster.podcliquesets["newg"], sim.now)
+    new_gang = next(g for g in cluster.podgangs.values() if g.pcs_name == "newg")
+    new_gang.spec.reuse_reservation_ref = NamespacedName("default", old_gang.name)
+    ctrl.solve_pending(sim.now)
+    new_nodes = {
+        p.node_name
+        for p in cluster.pods.values()
+        if p.pclq_fqn.startswith("newg") and p.node_name
+    }
+    assert new_nodes and new_nodes <= old_nodes
