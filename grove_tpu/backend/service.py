@@ -111,16 +111,35 @@ def _gang_from_proto(spec: pb.PodGangSpec) -> tuple[PodGang, dict[str, dict[str,
 
 
 class TPUSchedulerBackend:
-    """Servicer: every RPC is a short critical section over the state."""
+    """Servicer: control RPCs are short critical sections; Solve snapshots
+    state under the lock, runs encode + device solve UNLOCKED, then
+    re-acquires to commit — concurrent SyncPodGang/UpdateCluster RPCs are
+    never blocked behind a device execution (GREP-375 contract,
+    docs/proposals/375-scheduler-backend-framework/README.md:158-202)."""
 
-    def __init__(self) -> None:
+    def __init__(self, solver_config=None) -> None:
+        from grove_tpu.runtime.config import SolverConfig
+
         self._lock = threading.Lock()
+        # One solve at a time (capacity accounting is sequential); control
+        # RPCs use _lock only.
+        self._solve_lock = threading.Lock()
         self._topology = ClusterTopology(name="backend", levels=[])
         self._nodes: dict[str, Node] = {}
         self._gangs: dict[str, PodGang] = {}
         self._group_requests: dict[str, dict[str, dict[str, float]]] = {}  # gang -> group -> reqs
         self._bindings: dict[str, tuple[str, str, str]] = {}  # pod -> (node, gang, group)
         self._scheduled_gangs: set[str] = set()
+        self._solver_config = solver_config or SolverConfig()
+
+    @staticmethod
+    def _bucket(value: int, configured: Optional[int]) -> int:
+        """Stable encode shapes: the configured bound, else the next power of
+        two — recurring solve shapes reuse the compiled program instead of
+        recompiling per pending-set size."""
+        if configured:
+            return max(configured, value)
+        return max(1, 1 << (max(value, 1) - 1).bit_length())
 
     # ---- GREP-375 surface --------------------------------------------------------
 
@@ -210,15 +229,27 @@ class TPUSchedulerBackend:
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         t0 = time.perf_counter()
-        with self._lock:
-            result = self._solve_locked(speculative=request.speculative)
+        speculative = request.speculative or self._solver_config.speculative
+        with self._solve_lock:  # one device solve at a time
+            with self._lock:
+                work = self._collect_pending()
+            if work is None:
+                result = pb.SolveResponse()
+            else:
+                # UNLOCKED device phase: encode + jitted solve + decode run
+                # without blocking control RPCs. The state may drift
+                # meanwhile; _commit re-validates every binding against the
+                # live state before applying it.
+                solved = self._solve_unlocked(work, speculative)
+                with self._lock:
+                    result = self._commit(work, *solved)
         result.solve_micros = int((time.perf_counter() - t0) * 1e6)
         return result
 
-    def _solve_locked(self, speculative: bool) -> pb.SolveResponse:
-        resp = pb.SolveResponse()
+    def _collect_pending(self):
+        """Under lock: snapshot everything the solve needs into plain values."""
         if not self._nodes:
-            return resp
+            return None
         # Sub-gangs over unbound pods, floors shrunk by bound pods — the same
         # incremental discipline as the in-process controller
         # (orchestrator/controller.py solve_pending).
@@ -269,7 +300,7 @@ class TPUSchedulerBackend:
                 bound_nodes_by_group[gang.name] = per_group_bound
             pending.append(sub)
         if not pending:
-            return resp
+            return None
 
         bound_pods = [
             Pod(
@@ -281,38 +312,94 @@ class TPUSchedulerBackend:
                 )]),
             )
             for pod, (node, gname, group) in self._bindings.items()
+            if node in self._nodes
         ]
+        # ReuseReservationRef inputs (node NAMES; indices resolved after the
+        # snapshot is built outside the lock).
+        reuse_names_by_gang: dict[str, set[str]] = {}
+        for sub in pending:
+            ref = self._gangs[sub.name].spec.reuse_reservation_ref
+            if ref is None:
+                continue
+            names = {
+                node
+                for pod, (node, gname, _) in self._bindings.items()
+                if gname == ref.name
+            }
+            if names:
+                reuse_names_by_gang[sub.name] = names
+        return {
+            "pending": pending,
+            "pods_by_name": pods_by_name,
+            "bound_nodes_by_group": bound_nodes_by_group,
+            "bound_pods": bound_pods,
+            "nodes": list(self._nodes.values()),
+            "topology": self._topology,
+            "scheduled_gangs": set(self._scheduled_gangs),
+            "reuse_names_by_gang": reuse_names_by_gang,
+        }
+
+    def _solve_unlocked(self, work: dict, speculative: bool):
+        """No lock held: snapshot build, bucketed encode, device solve, decode."""
+        pending = work["pending"]
         snapshot = build_snapshot(
-            list(self._nodes.values()),
-            self._topology,
-            bound_pods=[p for p in bound_pods if p.node_name in self._nodes],
+            work["nodes"], work["topology"], bound_pods=work["bound_pods"]
         )
         bound_idx = {
             gname: {
                 grp: [snapshot.node_index(n) for n in nodes if n in snapshot.node_index_map]
                 for grp, nodes in groups.items()
             }
-            for gname, groups in bound_nodes_by_group.items()
+            for gname, groups in work["bound_nodes_by_group"].items()
         }
-        # ReuseReservationRef (podgang.go:65-71): bias a replacement gang
-        # toward the nodes its referenced reservation occupies/occupied.
-        reuse_by_gang: dict[str, list[int]] = {}
-        for sub in pending:
-            ref = self._gangs[sub.name].spec.reuse_reservation_ref
-            if ref is None:
-                continue
-            idxs = {
-                snapshot.node_index(node)
-                for pod, (node, gname, _) in self._bindings.items()
-                if gname == ref.name and node in snapshot.node_index_map
-            }
-            if idxs:
-                reuse_by_gang[sub.name] = sorted(idxs)
+        reuse_by_gang = {
+            gname: sorted(
+                snapshot.node_index(n)
+                for n in names
+                if n in snapshot.node_index_map
+            )
+            for gname, names in work["reuse_names_by_gang"].items()
+        }
+        # Bucketed shapes (SolverConfig or next-pow2): repeated Solve calls
+        # with drifting pending-set sizes hit the warm compiled program.
+        cfg = self._solver_config
+        mg = self._bucket(max(len(g.spec.pod_groups) for g in pending), cfg.max_groups)
+        mp = self._bucket(max(g.total_pods() for g in pending), cfg.max_pods)
+
+        def set_count(g: PodGang) -> int:
+            tc = g.spec.topology_constraint
+            n = 1 if tc is not None and tc.pack_constraint is not None else 0
+            n += sum(
+                1
+                for gc in g.spec.topology_constraint_group_configs
+                if gc.topology_constraint is not None
+                and gc.topology_constraint.pack_constraint is not None
+            )
+            n += sum(
+                1
+                for grp in g.spec.pod_groups
+                if grp.topology_constraint is not None
+                and grp.topology_constraint.pack_constraint is not None
+            )
+            return n
+
+        # Like mg/mp, the configured bound is a floor preference, never a cap
+        # below the real demand — an undersized bucket would make encode raise
+        # and wedge every subsequent Solve.
+        ms = self._bucket(max(max(set_count(g) for g in pending), 1), cfg.max_sets)
+        if cfg.pad_gangs_to:
+            pad_to = cfg.pad_gangs_to * max(1, -(-len(pending) // cfg.pad_gangs_to))
+        else:
+            pad_to = self._bucket(len(pending), None)
         batch, decode = encode_gangs(
             pending,
-            pods_by_name,
+            work["pods_by_name"],
             snapshot,
-            scheduled_gangs=self._scheduled_gangs,
+            max_groups=mg,
+            max_sets=ms,
+            max_pods=mp,
+            pad_gangs_to=pad_to,
+            scheduled_gangs=work["scheduled_gangs"],
             bound_nodes_by_group=bound_idx,
             reuse_nodes_by_gang=reuse_by_gang,
         )
@@ -323,23 +410,55 @@ class TPUSchedulerBackend:
 
         ok = dict(zip(decode.gang_names, np.asarray(result.ok)))
         scores = dict(zip(decode.gang_names, np.asarray(result.placement_score)))
+        return bindings, ok, scores
+
+    def _commit(self, work: dict, bindings, ok, scores) -> pb.SolveResponse:
+        """Under lock again: re-validate against live state, apply bindings.
+
+        The state may have drifted during the unlocked device phase; a gang
+        deleted or re-synced mid-solve gets its stale result dropped (the
+        next Solve sees the new truth) — same discipline as the reference
+        scheduler racing the apiserver."""
+        resp = pb.SolveResponse()
         group_of_pod = {
             r.name: (g.name, grp.name)
-            for g in pending
+            for g in work["pending"]
             for grp in g.spec.pod_groups
             for r in grp.pod_references
         }
-        for gang_name in decode.gang_names:
+        for sub in work["pending"]:
+            gang_name = sub.name
+            live = self._gangs.get(gang_name)
+            if live is None:
+                continue  # deleted mid-solve: drop the stale result
+            live_refs = {
+                r.name for grp in live.spec.pod_groups for r in grp.pod_references
+            }
             gr = pb.GangResult(
                 name=gang_name,
-                admitted=bool(ok.get(gang_name, False)),
                 placement_score=float(scores.get(gang_name, 0.0)),
             )
+            valid: list[tuple[str, str]] = []
+            dropped = 0
             for pod_name, node_name in bindings.get(gang_name, {}).items():
-                gr.bindings.append(pb.Binding(pod_name=pod_name, node_name=node_name))
-                _, group = group_of_pod[pod_name]
-                self._bindings[pod_name] = (node_name, gang_name, group)
+                if (
+                    pod_name not in live_refs  # gang re-synced mid-solve
+                    or pod_name in self._bindings  # concurrently bound
+                    or node_name not in self._nodes  # node removed mid-solve
+                ):
+                    dropped += 1
+                else:
+                    valid.append((pod_name, node_name))
+            # Admission holds only if the ENTIRE solved placement survived
+            # revalidation — a partially-dropped result must not bind a
+            # remnant, ungate the gang, or unblock scaled gangs waiting on it
+            # (all-or-nothing); the next Solve re-places it whole.
+            gr.admitted = bool(ok.get(gang_name, False)) and dropped == 0
             if gr.admitted:
+                for pod_name, node_name in valid:
+                    gr.bindings.append(pb.Binding(pod_name=pod_name, node_name=node_name))
+                    _, group = group_of_pod[pod_name]
+                    self._bindings[pod_name] = (node_name, gang_name, group)
                 self._scheduled_gangs.add(gang_name)
             resp.gangs.append(gr)
         return resp
@@ -370,10 +489,14 @@ def _handlers(servicer: TPUSchedulerBackend) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler(SERVICE_NAME, table)
 
 
-def create_server(port: int = 0, max_workers: int = 8) -> tuple[grpc.Server, int]:
+def create_server(
+    port: int = 0, max_workers: int = 8, solver_config=None
+) -> tuple[grpc.Server, int]:
     """Build + start the sidecar server; returns (server, bound port)."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((_handlers(TPUSchedulerBackend()),))
+    server.add_generic_rpc_handlers(
+        (_handlers(TPUSchedulerBackend(solver_config=solver_config)),)
+    )
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
     return server, bound

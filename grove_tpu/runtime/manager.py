@@ -260,9 +260,12 @@ class Manager:
         if cfg.backend.enabled:
             from grove_tpu.backend.service import create_server
 
-            # create_server builds AND starts the gRPC server.
+            # create_server builds AND starts the gRPC server; the solver
+            # section configures its bucketing + speculative defaults.
             self._backend_server, self.backend_port = create_server(
-                port=cfg.backend.port, max_workers=cfg.backend.max_workers
+                port=cfg.backend.port,
+                max_workers=cfg.backend.max_workers,
+                solver_config=cfg.solver,
             )
             self.log.info("backend sidecar listening", port=self.backend_port)
         if cfg.persistence.enabled:

@@ -1,0 +1,204 @@
+"""Sidecar production hardening (round-2 next-round #3).
+
+- Bucketed encode shapes: repeated Solve calls with drifting pending-set
+  sizes reuse the warm compiled program (no per-shape recompile storm).
+- Lock discipline: control RPCs (SyncPodGang) are not blocked behind an
+  in-flight device solve (GREP-375 sidecar contract,
+  docs/proposals/375-scheduler-backend-framework/README.md:158-202).
+- Mid-solve drift: a gang deleted while the device solves gets its stale
+  result dropped, never committed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
+from grove_tpu.backend.service import TPUSchedulerBackend
+from grove_tpu.runtime.config import SolverConfig
+from grove_tpu.sim.workloads import bench_topology
+
+
+class _Ctx:
+    def abort(self, code, msg):
+        raise AssertionError(f"abort: {code} {msg}")
+
+
+def _backend(cfg=None, nodes=16):
+    b = TPUSchedulerBackend(solver_config=cfg)
+    topo = bench_topology()
+    b.Init(
+        pb.InitRequest(
+            topology=[
+                pb.TopologyLevel(domain=lv.domain.value, node_label_key=lv.node_label_key)
+                for lv in topo.levels
+            ]
+        ),
+        _Ctx(),
+    )
+    req = pb.UpdateClusterRequest(full_replace=True)
+    for i in range(nodes):
+        n = req.nodes.add()
+        n.name = f"n{i}"
+        n.schedulable = True
+        for res, val in (("cpu", 16.0), ("memory", 64.0 * 2**30)):
+            q = n.capacity.add()
+            q.name = res
+            q.value = val
+        n.labels["topology.gke.io/zone"] = "z0"
+        n.labels["topology.gke.io/block"] = f"b{i // 8}"
+        n.labels["topology.gke.io/rack"] = f"r{i // 4}"
+    b.UpdateCluster(req, _Ctx())
+    return b
+
+
+def _gang_spec(name, n_pods=2, cpu=1.0, groups=1):
+    spec = pb.PodGangSpec(name=name, namespace="default")
+    for gi in range(groups):
+        grp = spec.pod_groups.add()
+        grp.name = f"{name}-g{gi}"
+        grp.min_replicas = n_pods
+        for i in range(n_pods):
+            r = grp.pod_references.add()
+            r.name = f"{name}-g{gi}-p{i}"
+        q = grp.per_pod_requests.add()
+        q.name = "cpu"
+        q.value = cpu
+    return spec
+
+
+def test_bucketed_shapes_reuse_compiled_program():
+    from grove_tpu.solver.core import solve_batch
+
+    b = _backend(cfg=SolverConfig(pad_gangs_to=8))
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("a", n_pods=2)), _Ctx())
+    b.Solve(pb.SolveRequest(), _Ctx())  # warms the (8-gang, pow2-pod) bucket
+
+    before = solve_batch._cache_size()
+    # Different pending-set sizes, same buckets: 3 more gangs (still <= 8),
+    # pod counts 1 and 2 (both bucket to 2).
+    for i, pods in enumerate((1, 2, 2)):
+        b.SyncPodGang(
+            pb.SyncPodGangRequest(pod_gang=_gang_spec(f"x{i}", n_pods=pods)), _Ctx()
+        )
+    resp = b.Solve(pb.SolveRequest(), _Ctx())
+    assert {g.name for g in resp.gangs if g.admitted} == {"x0", "x1", "x2"}
+    assert solve_batch._cache_size() == before, "drifting shapes must hit the warm cache"
+
+
+def test_sync_not_blocked_by_inflight_solve(monkeypatch):
+    b = _backend()
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("slow", n_pods=2)), _Ctx())
+
+    release = threading.Event()
+    entered = threading.Event()
+    orig = b._solve_unlocked
+
+    def slow_solve(work, speculative):
+        entered.set()
+        assert release.wait(timeout=30), "test deadlock"
+        return orig(work, speculative)
+
+    monkeypatch.setattr(b, "_solve_unlocked", slow_solve)
+    t = threading.Thread(target=lambda: b.Solve(pb.SolveRequest(), _Ctx()))
+    t.start()
+    try:
+        assert entered.wait(timeout=30)
+        # Device solve is in flight and parked; a control RPC must complete.
+        t0 = time.perf_counter()
+        b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("fast")), _Ctx())
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        release.set()
+        t.join(timeout=60)
+    assert not t.is_alive()
+
+
+def test_gang_deleted_mid_solve_not_committed(monkeypatch):
+    b = _backend()
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("doomed", n_pods=2)), _Ctx())
+
+    orig = b._solve_unlocked
+
+    def delete_during_solve(work, speculative):
+        out = orig(work, speculative)
+        # The gang vanishes between the device phase and the commit phase.
+        b.OnPodGangDelete(pb.OnPodGangDeleteRequest(name="doomed"), _Ctx())
+        return out
+
+    monkeypatch.setattr(b, "_solve_unlocked", delete_during_solve)
+    resp = b.Solve(pb.SolveRequest(), _Ctx())
+    assert not [g for g in resp.gangs if g.name == "doomed"]
+    assert "doomed" not in {g for _, g, _ in b._bindings.values()}
+
+
+def test_node_removed_mid_solve_drops_whole_gang(monkeypatch):
+    """A binding to a node that vanished during the device phase must not be
+    committed — and the gang must not be reported admitted with a remnant."""
+    b = _backend(nodes=16)
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("g", n_pods=2)), _Ctx())
+
+    orig = b._solve_unlocked
+    fired = {"done": False}
+
+    def shrink_during_solve(work, speculative):
+        out = orig(work, speculative)
+        if fired["done"]:
+            return out
+        fired["done"] = True
+        bindings, ok, scores = out
+        used = set(bindings.get("g", {}).values())
+        assert used
+        # Remove one node the solve used, via a full fleet replace.
+        victim = next(iter(used))
+        req = pb.UpdateClusterRequest(full_replace=True)
+        for name, node in b._nodes.items():
+            if name == victim:
+                continue
+            n = req.nodes.add()
+            n.name = name
+            n.schedulable = node.schedulable
+            for res, val in node.capacity.items():
+                q = n.capacity.add()
+                q.name = res
+                q.value = val
+            n.labels.update(node.labels)
+        b.UpdateCluster(req, _Ctx())
+        return out
+
+    monkeypatch.setattr(b, "_solve_unlocked", shrink_during_solve)
+    resp = b.Solve(pb.SolveRequest(), _Ctx())
+    g = next(x for x in resp.gangs if x.name == "g")
+    assert not g.admitted and not g.bindings
+    assert not b._bindings  # no remnant committed
+    # The next solve re-places the whole gang on surviving nodes.
+    resp2 = b.Solve(pb.SolveRequest(), _Ctx())
+    g2 = next(x for x in resp2.gangs if x.name == "g")
+    assert g2.admitted and len(g2.bindings) == 2
+
+
+def test_oversized_set_count_buckets_instead_of_crashing():
+    """A gang whose pack-set count exceeds groups+2 must still encode (the
+    set bucket floors at the real demand, never the configured value)."""
+    b = _backend(cfg=SolverConfig(max_sets=1))
+    spec = _gang_spec("many-sets", n_pods=1, groups=2)
+    pc = spec.pack_constraint
+    pc.required_key = "topology.gke.io/block"
+    for gi, grp in enumerate(spec.pod_groups):
+        grp.pack_constraint.required_key = "topology.gke.io/rack"
+    for gi in range(2):
+        gc = spec.group_configs.add()
+        gc.name = f"gc{gi}"
+        gc.pod_group_names.append(spec.pod_groups[gi].name)
+        gc.pack_constraint.required_key = "topology.gke.io/rack"
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=spec), _Ctx())
+    resp = b.Solve(pb.SolveRequest(), _Ctx())  # 5 sets > max_sets=1: must not raise
+    assert [g for g in resp.gangs if g.name == "many-sets"]
+
+
+def test_config_speculative_default_applies():
+    b = _backend(cfg=SolverConfig(speculative=True))
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("s", n_pods=2)), _Ctx())
+    resp = b.Solve(pb.SolveRequest(), _Ctx())  # request leaves speculative unset
+    assert [g for g in resp.gangs if g.admitted and g.name == "s"]
