@@ -272,6 +272,29 @@ def run_bench() -> dict:
         out["quality_admitted_ratio"] = (
             round(admitted / gstats.admitted, 3) if gstats.admitted else None
         )
+        # Contended variant (round-2 weak #5): fragmented trap-block cluster
+        # where admission actually costs something — the hierarchical
+        # nested-feasibility guard is the divergence under test
+        # (sim/workloads.contended_cluster; tests/test_quality_contended.py).
+        from grove_tpu.sim.workloads import contended_backlog, contended_cluster
+
+        cn, csq = contended_cluster()
+        cbacklog = contended_backlog(n_gangs=48)
+        cgangs, cpods = [], {}
+        for pcs in cbacklog:
+            ds = expand_podcliqueset(pcs, topo)
+            cgangs.extend(ds.podgangs)
+            cpods.update({p.name: p for p in ds.pods})
+        csnap = build_snapshot(cn, topo, bound_pods=csq)
+        cg = greedy_drain(cgangs, cpods, csnap)
+        cbatch, cdecode = encode_gangs(cgangs, cpods, csnap)
+        from grove_tpu.solver.core import solve as solve_wrapper
+
+        cresult = solve_wrapper(csnap, cbatch, params)
+        c_admitted = len(decode_assignments(cresult, cdecode, csnap))
+        out["contended_gangs"] = len(cgangs)
+        out["contended_solver_admitted"] = c_admitted
+        out["contended_baseline_admitted"] = cg.admitted
     return out
 
 

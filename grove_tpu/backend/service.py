@@ -134,12 +134,40 @@ class TPUSchedulerBackend:
 
     @staticmethod
     def _bucket(value: int, configured: Optional[int]) -> int:
-        """Stable encode shapes: the configured bound, else the next power of
-        two — recurring solve shapes reuse the compiled program instead of
-        recompiling per pending-set size."""
-        if configured:
-            return max(configured, value)
-        return max(1, 1 << (max(value, 1) - 1).bit_length())
+        """Stable encode shapes: the configured bound (a floor, never a cap),
+        with overflow still rounded to the next power of two — recurring
+        solve shapes reuse the compiled program instead of recompiling per
+        pending-set size."""
+        pow2 = max(1, 1 << (max(value, 1) - 1).bit_length())
+        return max(configured, pow2) if configured else pow2
+
+    @staticmethod
+    def _gang_fingerprint(gang: PodGang, reqs: dict) -> tuple:
+        """Spec identity for mid-solve drift detection (see _commit): pods,
+        floors, per-group requests, and every pack-constraint key."""
+
+        def pc(tc):
+            if tc is None or tc.pack_constraint is None:
+                return None
+            return (tc.pack_constraint.required, tc.pack_constraint.preferred)
+
+        return (
+            tuple(
+                (
+                    grp.name,
+                    grp.min_replicas,
+                    tuple(sorted(r.name for r in grp.pod_references)),
+                    tuple(sorted((reqs.get(grp.name) or {}).items())),
+                    pc(grp.topology_constraint),
+                )
+                for grp in gang.spec.pod_groups
+            ),
+            pc(gang.spec.topology_constraint),
+            tuple(
+                (gc.name, tuple(gc.pod_group_names), pc(gc.topology_constraint))
+                for gc in gang.spec.topology_constraint_group_configs
+            ),
+        )
 
     # ---- GREP-375 surface --------------------------------------------------------
 
@@ -315,19 +343,16 @@ class TPUSchedulerBackend:
             if node in self._nodes
         ]
         # ReuseReservationRef inputs (node NAMES; indices resolved after the
-        # snapshot is built outside the lock).
+        # snapshot is built outside the lock). One pass over _bindings, not
+        # one per pending gang — this runs under the control-RPC lock.
+        nodes_by_gang: dict[str, set[str]] = {}
+        for pod, (node, gname, _) in self._bindings.items():
+            nodes_by_gang.setdefault(gname, set()).add(node)
         reuse_names_by_gang: dict[str, set[str]] = {}
         for sub in pending:
             ref = self._gangs[sub.name].spec.reuse_reservation_ref
-            if ref is None:
-                continue
-            names = {
-                node
-                for pod, (node, gname, _) in self._bindings.items()
-                if gname == ref.name
-            }
-            if names:
-                reuse_names_by_gang[sub.name] = names
+            if ref is not None and ref.name in nodes_by_gang:
+                reuse_names_by_gang[sub.name] = nodes_by_gang[ref.name]
         return {
             "pending": pending,
             "pods_by_name": pods_by_name,
@@ -337,6 +362,13 @@ class TPUSchedulerBackend:
             "topology": self._topology,
             "scheduled_gangs": set(self._scheduled_gangs),
             "reuse_names_by_gang": reuse_names_by_gang,
+            # Spec fingerprints for drift detection at commit time.
+            "fingerprints": {
+                sub.name: self._gang_fingerprint(
+                    self._gangs[sub.name], self._group_requests.get(sub.name, {})
+                )
+                for sub in pending
+            },
         }
 
     def _solve_unlocked(self, work: dict, speculative: bool):
@@ -431,20 +463,27 @@ class TPUSchedulerBackend:
             live = self._gangs.get(gang_name)
             if live is None:
                 continue  # deleted mid-solve: drop the stale result
-            live_refs = {
-                r.name for grp in live.spec.pod_groups for r in grp.pod_references
-            }
+            # Spec drift: a re-sync that changed requests, floors, refs, or
+            # constraints invalidates the solved placement even when pod
+            # names are unchanged — comparing names alone would commit
+            # bindings solved for the OLD spec.
+            live_fp = self._gang_fingerprint(
+                live, self._group_requests.get(gang_name, {})
+            )
+            spec_drifted = live_fp != work["fingerprints"].get(gang_name)
             gr = pb.GangResult(
                 name=gang_name,
                 placement_score=float(scores.get(gang_name, 0.0)),
             )
             valid: list[tuple[str, str]] = []
-            dropped = 0
+            dropped = 1 if spec_drifted else 0
             for pod_name, node_name in bindings.get(gang_name, {}).items():
+                node = self._nodes.get(node_name)
                 if (
-                    pod_name not in live_refs  # gang re-synced mid-solve
+                    spec_drifted
                     or pod_name in self._bindings  # concurrently bound
-                    or node_name not in self._nodes  # node removed mid-solve
+                    or node is None  # node removed mid-solve
+                    or not node.schedulable  # node cordoned mid-solve
                 ):
                     dropped += 1
                 else:

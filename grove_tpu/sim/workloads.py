@@ -169,3 +169,112 @@ def synthetic_backlog(
     for i in range(n_frontend):
         out.append(frontend_pcs(f"fe-{i}"))
     return out
+
+
+# --- contended quality scenario (round-2 weak #5) ---------------------------------
+#
+# The uncontended bench admits 100% both ways, proving nothing. This scenario
+# makes the batched solver and the per-pod greedy cycle diverge on a property
+# the reference path genuinely lacks: HIERARCHICAL feasibility. "Trap" blocks
+# are the best-fit choice by aggregate capacity/slots, but their free hosts
+# are spread one-per-rack, so a rack-packed group can never fit inside them;
+# "good" blocks look worse (more free) but hold whole racks of empty hosts.
+# A scheduler that commits the block before checking rack nesting (greedy,
+# KAI-style Filter/Score/Permit) strands every gang on a trap; the solver's
+# nested-feasibility guard (solver/core.py) skips traps outright.
+
+
+def contended_cluster(
+    trap_blocks: int = 8,
+    good_blocks: int = 8,
+    racks_per_block: int = 4,
+    hosts_per_rack: int = 4,
+    cpu: float = 8.0,
+    memory: float = 32 * 2**30,
+) -> tuple[list[Node], list]:
+    """Returns (nodes, squatter_pods). Squatters pre-occupy capacity:
+
+    - trap blocks: every rack keeps ONE empty host (block slots ample,
+      rack slots insufficient for a 2-pod rack-packed gang)
+    - good blocks: every rack keeps TWO empty hosts (gang fits), total free
+      2x the trap's — so best-fit aggregate ordering prefers traps
+    """
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.api.types import Container as C, PodSpec
+
+    nodes: list[Node] = []
+    squatters: list = []
+
+    def host(b: int, r: int, h: int) -> Node:
+        return Node(
+            name=f"cb{b}r{r}h{h}",
+            capacity={"cpu": cpu, "memory": memory},
+            labels={ZONE_KEY: "z0", BLOCK_KEY: f"b{b}", RACK_KEY: f"r{r}"},
+        )
+
+    def squat(node: Node, frac: float, idx: int) -> None:
+        squatters.append(
+            Pod(
+                name=f"squat-{idx}",
+                spec=PodSpec(
+                    containers=[
+                        C(name="s", requests={"cpu": cpu * frac, "memory": memory * frac})
+                    ]
+                ),
+                node_name=node.name,
+                pclq_fqn="squatters",
+            )
+        )
+
+    si = 0
+    for b in range(trap_blocks + good_blocks):
+        empty_per_rack = 1 if b < trap_blocks else 2
+        for r in range(racks_per_block):
+            for h in range(hosts_per_rack):
+                node = host(b, r, h)
+                nodes.append(node)
+                if h >= empty_per_rack:  # fully squat the non-empty hosts
+                    squat(node, 1.0, si)
+                    si += 1
+    return nodes, squatters
+
+
+def contended_backlog(n_gangs: int = 24) -> list[PodCliqueSet]:
+    """Rack-packed 2-pod full-host gangs under a block-level gang constraint."""
+    out = []
+    for i in range(n_gangs):
+        doc = {
+            "apiVersion": "grove.io/v1alpha1",
+            "kind": "PodCliqueSet",
+            "metadata": {"name": f"packed-{i}"},
+            "spec": {
+                "replicas": 1,
+                "template": {
+                    "startupType": "CliqueStartupTypeAnyOrder",
+                    "topologyConstraint": {"packDomain": "block"},
+                    "cliques": [
+                        {
+                            "name": "w",
+                            "topologyConstraint": {"packDomain": "rack"},
+                            "spec": {
+                                "roleName": "w",
+                                "replicas": 2,
+                                "podSpec": {
+                                    "containers": [
+                                        {
+                                            "name": "w",
+                                            "image": "registry.local/w:latest",
+                                            "resources": {
+                                                "requests": {"cpu": "8", "memory": "32Gi"}
+                                            },
+                                        }
+                                    ]
+                                },
+                            },
+                        }
+                    ],
+                },
+            },
+        }
+        out.append(default_podcliqueset(PodCliqueSet.from_dict(doc)))
+    return out

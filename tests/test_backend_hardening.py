@@ -197,6 +197,64 @@ def test_oversized_set_count_buckets_instead_of_crashing():
     assert [g for g in resp.gangs if g.name == "many-sets"]
 
 
+def test_spec_drift_mid_solve_not_committed(monkeypatch):
+    """Re-syncing a gang with the SAME pod names but different requests
+    mid-solve must drop the stale placement (name equality is not spec
+    equality)."""
+    b = _backend()
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("g", n_pods=2, cpu=1.0)), _Ctx())
+
+    orig = b._solve_unlocked
+    fired = {"done": False}
+
+    def resync_during_solve(work, speculative):
+        out = orig(work, speculative)
+        if not fired["done"]:
+            fired["done"] = True
+            b.SyncPodGang(
+                pb.SyncPodGangRequest(pod_gang=_gang_spec("g", n_pods=2, cpu=16.0)),
+                _Ctx(),
+            )
+        return out
+
+    monkeypatch.setattr(b, "_solve_unlocked", resync_during_solve)
+    resp = b.Solve(pb.SolveRequest(), _Ctx())
+    g = next(x for x in resp.gangs if x.name == "g")
+    assert not g.admitted and not g.bindings and not b._bindings
+    # Next solve places it under the NEW spec.
+    resp2 = b.Solve(pb.SolveRequest(), _Ctx())
+    g2 = next(x for x in resp2.gangs if x.name == "g")
+    assert g2.admitted and len(g2.bindings) == 2
+
+
+def test_cordon_mid_solve_not_committed(monkeypatch):
+    b = _backend()
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("g", n_pods=2)), _Ctx())
+
+    orig = b._solve_unlocked
+    fired = {"done": False}
+
+    def cordon_during_solve(work, speculative):
+        out = orig(work, speculative)
+        if not fired["done"]:
+            fired["done"] = True
+            used = set(out[0].get("g", {}).values())
+            for name in used:
+                b._nodes[name].schedulable = False
+        return out
+
+    monkeypatch.setattr(b, "_solve_unlocked", cordon_during_solve)
+    resp = b.Solve(pb.SolveRequest(), _Ctx())
+    g = next(x for x in resp.gangs if x.name == "g")
+    assert not g.admitted and not b._bindings
+
+
+def test_bucket_overflow_still_rounds():
+    assert TPUSchedulerBackend._bucket(9, 8) == 16  # overflow -> next pow2
+    assert TPUSchedulerBackend._bucket(5, 8) == 8  # configured floor
+    assert TPUSchedulerBackend._bucket(5, None) == 8  # pow2 fallback
+
+
 def test_config_speculative_default_applies():
     b = _backend(cfg=SolverConfig(speculative=True))
     b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("s", n_pods=2)), _Ctx())

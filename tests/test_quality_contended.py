@@ -1,0 +1,75 @@
+"""Contended quality: batched solver vs per-pod greedy where they CAN diverge
+(round-2 weak #5 — the uncontended bench admits 100% both ways).
+
+The trap-block scenario (sim/workloads.contended_cluster) makes hierarchical
+feasibility decisive: greedy commits best-fit blocks whose racks are too
+fragmented for a rack-packed gang and rejects; the solver's nested guard
+skips traps and admits.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.sim.workloads import (
+    bench_topology,
+    contended_backlog,
+    contended_cluster,
+)
+from grove_tpu.solver.core import decode_assignments, solve
+from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.solver.greedy import greedy_drain
+from grove_tpu.state import build_snapshot
+
+
+def _expand_all(backlog, topo):
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    return gangs, pods
+
+
+def test_solver_beats_greedy_under_fragmentation():
+    topo = bench_topology()
+    nodes, squatters = contended_cluster(trap_blocks=4, good_blocks=4)
+    backlog = contended_backlog(n_gangs=12)
+    gangs, pods = _expand_all(backlog, topo)
+    snapshot = build_snapshot(nodes, topo, bound_pods=squatters)
+
+    gstats = greedy_drain(gangs, pods, snapshot)
+    batch, decode = encode_gangs(gangs, pods, snapshot)
+    result = solve(snapshot, batch)
+    bindings = decode_assignments(result, decode, snapshot)
+
+    solver_admitted = len(bindings)
+    # Capacity ceiling: 4 good blocks x 4 racks x 1 gang per rack = 16 >= 12.
+    assert solver_admitted == 12, f"solver admitted {solver_admitted}/12"
+    # Greedy's best-fit aggregate choice strands gangs on trap blocks.
+    assert gstats.admitted < solver_admitted, (
+        f"expected divergence: greedy {gstats.admitted} vs solver {solver_admitted}"
+    )
+    # Sanity of the thesis: everything the solver placed honors the rack pack.
+    for gang_name, pod_bindings in bindings.items():
+        racks = {
+            snapshot.domain_of_node(node, topo.levels[2].domain)
+            for node in pod_bindings.values()
+        }
+        assert len(racks) == 1, f"{gang_name} split across racks {racks}"
+
+
+def test_solver_never_loses_to_greedy_uncontended():
+    """On the plain bench workload both should admit everything (parity)."""
+    from grove_tpu.sim.workloads import synthetic_backlog, synthetic_cluster
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=2, racks_per_block=4)
+    backlog = synthetic_backlog(n_disagg=6, n_agg=4, n_frontend=4)
+    gangs, pods = _expand_all(backlog, topo)
+    snapshot = build_snapshot(nodes, topo)
+
+    gstats = greedy_drain(gangs, pods, snapshot)
+    batch, decode = encode_gangs(gangs, pods, snapshot)
+    result = solve(snapshot, batch)
+    bindings = decode_assignments(result, decode, snapshot)
+    assert len(bindings) >= gstats.admitted
