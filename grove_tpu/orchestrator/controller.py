@@ -268,9 +268,18 @@ class GroveController:
     # --- solver integration (scheduler-backend analog) ---------------------------
 
     def solve_pending(self, now: float) -> int:
-        """Encode gangs with gated pods, run the solver, bind admitted pods.
+        """Two solve waves: gang FLOORS first (the guarantee), best-effort
+        extras second against leftover capacity.
 
-        Returns the number of newly admitted gangs."""
+        One combined wave would let an earlier gang's extras strand the
+        capacity a later gang's floor needs — GS-7/GS-8 pin the reference
+        behavior (gang_scheduling_test.go:537-786): every gang floor binds
+        before ANY best-effort pod. Returns newly admitted gangs."""
+        admitted = self._solve_wave(now, floors_only=True)
+        self._solve_wave(now, floors_only=False)
+        return admitted
+
+    def _solve_wave(self, now: float, floors_only: bool) -> int:
         c = self.cluster
         pending: list[PodGang] = []
         for gang in c.podgangs.values():
@@ -310,16 +319,35 @@ class GroveController:
                     ]
                 bound_counts[grp.name] = len(scheduled_pods)
                 if gated:
-                    unbound_refs[grp.name] = [
+                    refs = [
                         NamespacedName(gang.namespace, p.name)
                         for p in sorted(gated, key=lambda p: p.pod_index)
                     ]
+                    if floors_only:
+                        # Encode ONLY up to the unmet floor; extras wait for
+                        # the second wave.
+                        needed = max(0, grp.min_replicas - len(scheduled_pods))
+                        refs = refs[:needed]
+                    if refs:
+                        unbound_refs[grp.name] = refs
+            if not floors_only and any(
+                grp.min_replicas > bound_counts.get(grp.name, 0)
+                for grp in gang.spec.pod_groups
+            ):
+                # Extras wave takes only gangs whose floors are MET: a
+                # floor-rejected gang must not re-solve (guaranteed no-op
+                # against the unchanged snapshot — it would double solver
+                # cost in the contended steady state) and must never bind
+                # extras before its floor.
+                continue
             sub = build_pending_subgang(gang, unbound_refs, bound_counts)
             if sub is None:
                 continue
             sub_gangs.append(sub)
             if per_group_nodes:
                 bound_node_names[gang.name] = per_group_nodes
+        if not sub_gangs:
+            return 0
 
         bound_pods = [p for p in c.pods.values() if p.is_scheduled and p.is_active]
         snapshot = build_snapshot(
@@ -390,25 +418,34 @@ class GroveController:
                 pod.node_name = node_name
                 pod.scheduling_gates = []
                 pod.phase = PodPhase.PENDING
-            gang.status.placement_score = float(scores.get(gang_name, 0.0))
-            c.record_event(now, gang_name, f"gang admitted ({len(pod_bindings)} pods bound)")
-            admitted += 1
+            if gang_name not in scheduled_names:
+                # First admission only: extras top-ups of an already-admitted
+                # gang must not re-emit the admission event, inflate the
+                # admitted count, or overwrite the floor solve's score.
+                gang.status.placement_score = float(scores.get(gang_name, 0.0))
+                c.record_event(
+                    now, gang_name, f"gang admitted ({len(pod_bindings)} pods bound)"
+                )
+                admitted += 1
 
         # Priority preemption: a rejected gang that outranks placed gangs may
         # evict the lowest-priority ones (whole gangs — gang semantics) to
         # make room; it re-solves first next pass (sort_pending is
         # priority-ordered). One preemption action per pass keeps the cascade
         # observable and bounded.
-        valid_by_name = dict(zip(decode.gang_names, np.asarray(batch.gang_valid)))
-        rejected = [
-            g
-            for g in sub_gangs
-            if not ok_by_name.get(g.name, False)
-            and valid_by_name.get(g.name, False)  # gated/unresolvable can't preempt
-            and g.name in c.podgangs
-        ]
-        if rejected:
-            self._preempt_for_rejected(rejected, now)
+        # Preemption considers FLOOR rejections only — a gang denied best-effort
+        # extras has its guarantee met and must not evict anyone.
+        if floors_only:
+            valid_by_name = dict(zip(decode.gang_names, np.asarray(batch.gang_valid)))
+            rejected = [
+                g
+                for g in sub_gangs
+                if not ok_by_name.get(g.name, False)
+                and valid_by_name.get(g.name, False)  # gated/unresolvable can't preempt
+                and g.name in c.podgangs
+            ]
+            if rejected:
+                self._preempt_for_rejected(rejected, now)
         return admitted
 
     def _priority_of(self, gang: PodGang) -> int:
@@ -661,9 +698,38 @@ class GroveController:
         current = min(remaining, key=order_key)
         prog.current_replica_index = current
         # Replace stale pods of the current replica: unscheduled/not-ready pods
-        # all at once, ready pods one at a time (scalinggroup.go:117-120).
+        # all at once, ready pods one at a time (scalinggroup.go:117-120) —
+        # and only when no replacement is still in flight: the next ready pod
+        # may be disrupted only after the previous replacement is back Ready
+        # (RU-10 delete-first: exactly ONE pod down at a time under no
+        # capacity, rolling_updates_test.go:210-258).
         stale = stale_pods(current)
-        ready_deleted = False
+
+        def _replacement_in_flight() -> bool:
+            """A replacement pod (new hash, in a clique the update touches)
+            that is not back Ready yet. Scoped to CHANGED cliques — a
+            never-ready pod in an untouched clique (e.g. crashlooping) is a
+            health problem for replica_updated to hold on, not a replacement
+            — and crashlooping pods never count (they will never come Ready;
+            waiting on them would wedge the update forever)."""
+            for clique in c.cliques_of_pcs_replica(pcs.metadata.name, current):
+                want = desired_hash(clique)
+                pods = [p for p in c.pods_of_clique(clique.metadata.name) if p.is_active]
+                changed = any(p.pod_template_hash != want for p in pods) or (
+                    clique.status.current_pod_template_hash not in (None, want)
+                )
+                if not changed:
+                    continue
+                if any(
+                    not p.ready
+                    and not p.crashlooping
+                    and p.pod_template_hash == want
+                    for p in pods
+                ):
+                    return True
+            return False
+
+        ready_deleted = _replacement_in_flight()
         for pod in stale:
             if pod.ready:
                 if ready_deleted:

@@ -199,6 +199,27 @@ def _place_gang(
     ones_col = jnp.ones((free.shape[0], 1), dtype=jnp.float32)
     feat = jnp.concatenate([free, slots_all.T.astype(jnp.float32), ones_col], axis=1)
 
+    def _joint_slots_ok(dom_slots, members):
+        """Joint slot feasibility for a set's member groups [N_dom].
+
+        Per-group slot floors are independently satisfiable yet jointly
+        impossible when groups COMPETE for the same nodes (4 pods of two
+        2-pod groups vs 3 one-pod nodes: each group sees 3 >= 2, together
+        they need 4). When every member group shares one request vector the
+        joint check is exact: min member slots >= summed floors. For
+        heterogeneous members it stays optimistic (per-group only) — a
+        conservative joint bound would wrongly reject feasible mixes."""
+        membersf = members.astype(jnp.float32)  # [MG]
+        any_member = members.any()
+        req_lo = jnp.where(members[:, None], group_req, jnp.inf).min(axis=0)  # [R]
+        req_hi = jnp.where(members[:, None], group_req, -jnp.inf).max(axis=0)
+        homogeneous = any_member & ((req_hi - req_lo) <= _EPS).all()
+        joint_need = (group_required.astype(jnp.float32) * membersf).sum()
+        min_slots = jnp.where(
+            members[None, :], dom_slots, jnp.inf
+        ).min(axis=-1)  # [N_dom]
+        return jnp.where(homogeneous, min_slots >= joint_need, True)
+
     def agg_by_domain(vals, level):
         """Per-domain sums of pre-masked per-node rows `vals` [N, C] at
         `level`, padded to [N, C] rows (ordinal -> row; rows >= D are zero).
@@ -243,9 +264,13 @@ def _place_gang(
             group_req * (group_required * member2).astype(jnp.float32)[:, None]
         ).sum(0)  # [R]
         t2 = tables_L[lvl2c]  # [N, C]
-        return (t2[:, :r] >= demand2[None, :] - _EPS).all(axis=-1) & (
-            (t2[:, r : r + mg] >= group_required[None, :]) | ~member2[None, :]
-        ).all(axis=-1)  # [N] domain rows at lvl2
+        return (
+            (t2[:, :r] >= demand2[None, :] - _EPS).all(axis=-1)
+            & (
+                (t2[:, r : r + mg] >= group_required[None, :]) | ~member2[None, :]
+            ).all(axis=-1)
+            & _joint_slots_ok(t2[:, r : r + mg], member2)
+        )  # [N] domain rows at lvl2
 
     feas2_all = jax.vmap(_set_dom_feasible)(jnp.arange(ms))  # [MS, N]
     # Per-node view of each narrow set's domain feasibility (one batched
@@ -302,6 +327,16 @@ def _place_gang(
                 (nested_cnt > 0.5) | ~active2[None, :]
             ).all(axis=-1)  # [N_dom]
 
+        # Nodes inside domains committed by earlier DISJOINT sets (no shared
+        # group). Stage 1 commits against un-decremented free, so two
+        # same-level sibling sets would otherwise both pick the one best-fit
+        # domain and collide in stage 2 (the whole gang then rejects even
+        # though distinct domains fit — TAS-4/TAS-15 shape). Penalizing, not
+        # forbidding: sharing stays possible when it is the only option.
+        def _taken_mask(c_req, lvl, ov, act):
+            dom = node_domain_id[jnp.clip(lvl, 0, levels - 1)]
+            return act & ~ov & (c_req >= 0) & (dom == c_req)
+
         def pick_domain(level, extra_node_mask, check_nested=False):
             """Best-fit feasible domain at `level` among nodes passing masks.
 
@@ -311,15 +346,30 @@ def _place_gang(
             dom_free, dom_slots, dom_count = dom_tables(ok_nodes, level)
             feas_cap = (dom_free >= demand[None, :] - _EPS).all(axis=-1)
             feas_slots = ((dom_slots >= group_required[None, :]) | ~memberf[None, :]).all(axis=-1)
-            feasible = feas_cap & feas_slots & (dom_count > 0)
+            feasible = (
+                feas_cap
+                & feas_slots
+                & _joint_slots_ok(dom_slots, memberf)
+                & (dom_count > 0)
+            )
             if check_nested:
                 feasible = feasible & nested_feasible(level, ok_nodes)
+            taken_node = jax.vmap(_taken_mask)(
+                committed_req, set_req_level, overlap, set_valid
+            ).any(axis=0)  # [N]
+            taken_frac = agg_by_domain(
+                jnp.where(ok_nodes & taken_node, 1.0, 0.0)[:, None], level
+            )[:, 0] / jnp.maximum(dom_count, 1.0)
             # Best fit on normalized free (raw sums would let memory bytes
             # drown cpu/chip counts), perturbed by per-gang jitter so
             # concurrent speculative gangs spread across near-equal domains.
             norm_free = (dom_free / cap_scale[None, :]).sum(axis=-1)
             dj = _weyl_jitter(gang["index"] * 7919 + level, n)
-            score = jnp.where(feasible, -norm_free * (1.0 + params.w_jitter * dj), -jnp.inf)
+            score = jnp.where(
+                feasible,
+                -norm_free * (1.0 + params.w_jitter * dj) - params.w_reserve * taken_frac,
+                -jnp.inf,
+            )
             return jnp.argmax(score), feasible.any()
 
         # Incremental re-solve pin: bound pods of this set already sit in a

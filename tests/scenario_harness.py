@@ -1,0 +1,333 @@
+"""Shared harness for the reference e2e behavior matrices (SURVEY.md §4).
+
+Reproduces the reference's test environment in the simulator:
+  - workloads WL1–WL6 (operator/e2e/yaml/workload{1..6}.yaml): pc-a standalone
+    + sg-x scaling group {pc-b x1, pc-c x3}, memory-only requests sized so
+    exactly ONE pod fits per node (80Mi requests vs 150Mi nodes)
+  - capacity manipulation by cordoning (gang_scheduling_test.go setup)
+  - node fleets with zone/block/rack labels for the TAS matrix
+
+Each scenario test names the reference case it mirrors.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from grove_tpu.api import PodCliqueSet, default_podcliqueset
+from grove_tpu.api.types import ClusterTopology, TopologyDomain, TopologyLevel
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.sim.simulator import SimConfig, Simulator
+from grove_tpu.state.cluster import Node
+
+MI = 2**20
+POD_MEM = "80Mi"  # workload pods request 80Mi...
+NODE_MEM = 150 * MI  # ...nodes hold 150Mi: exactly one pod per node
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+BLOCK_KEY = "topology.kubernetes.io/block"
+RACK_KEY = "topology.kubernetes.io/rack"
+
+
+def e2e_topology() -> ClusterTopology:
+    return ClusterTopology(
+        name="e2e",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, ZONE_KEY),
+            TopologyLevel(TopologyDomain.BLOCK, BLOCK_KEY),
+            TopologyLevel(TopologyDomain.RACK, RACK_KEY),
+        ],
+    )
+
+
+def e2e_nodes(
+    count: int,
+    *,
+    hosts_per_rack: int = 7,
+    racks_per_block: int = 2,
+    blocks_per_zone: int = 2,
+    mem: float = NODE_MEM,
+) -> list[Node]:
+    """`count` one-pod nodes labeled with the k3d-style topology shape
+    (create-e2e-cluster.py:133-135: zone/block/rack labels)."""
+    nodes = []
+    for i in range(count):
+        rack = i // hosts_per_rack
+        block = rack // racks_per_block
+        zone = block // blocks_per_zone
+        nodes.append(
+            Node(
+                name=f"w{i}",
+                capacity={"cpu": 8.0, "memory": mem},
+                labels={
+                    ZONE_KEY: f"z{zone}",
+                    BLOCK_KEY: f"bl{block}",
+                    RACK_KEY: f"r{rack}",
+                },
+            )
+        )
+    return nodes
+
+
+def clique(
+    name: str,
+    replicas: int,
+    min_available: int | None = None,
+    starts_after: list[str] | None = None,
+    mem: str = POD_MEM,
+    pack: str | None = None,
+) -> dict[str, Any]:
+    spec: dict[str, Any] = {
+        "roleName": name,
+        "replicas": replicas,
+        "podSpec": {
+            "containers": [
+                {
+                    "name": name,
+                    "image": f"registry.local/{name}:v1",
+                    "resources": {"requests": {"memory": mem}},
+                }
+            ]
+        },
+    }
+    if min_available is not None:
+        spec["minAvailable"] = min_available
+    if starts_after:
+        spec["startsAfter"] = list(starts_after)
+    out: dict[str, Any] = {"name": name, "spec": spec}
+    if pack:
+        out["topologyConstraint"] = {"packDomain": pack}
+    return out
+
+
+def build_pcs(
+    name: str,
+    cliques: list[dict],
+    scaling_groups: list[dict] | None = None,
+    replicas: int = 1,
+    startup: str = "CliqueStartupTypeAnyOrder",
+    pack: str | None = None,
+) -> PodCliqueSet:
+    template: dict[str, Any] = {"cliques": cliques, "startupType": startup}
+    if scaling_groups:
+        template["podCliqueScalingGroups"] = scaling_groups
+    if pack:
+        template["topologyConstraint"] = {"packDomain": pack}
+    doc = {
+        "apiVersion": "grove.io/v1alpha1",
+        "kind": "PodCliqueSet",
+        "metadata": {"name": name},
+        "spec": {"replicas": replicas, "template": template},
+    }
+    return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+
+def wl1(name: str = "pcs", replicas: int = 1) -> PodCliqueSet:
+    """workload1.yaml: full minAvailable (gang = everything)."""
+    return build_pcs(
+        name,
+        cliques=[
+            clique("pc-a", 2, 2),
+            clique("pc-b", 1, 1),
+            clique("pc-c", 3, 3),
+        ],
+        scaling_groups=[
+            {"name": "sg-x", "cliqueNames": ["pc-b", "pc-c"], "replicas": 2,
+             "minAvailable": 2}
+        ],
+        replicas=replicas,
+    )
+
+
+def wl2(name: str = "pcs") -> PodCliqueSet:
+    """workload2.yaml: minAvailable=1 everywhere (partial gangs + scaled gangs)."""
+    return build_pcs(
+        name,
+        cliques=[
+            clique("pc-a", 2, 1),
+            clique("pc-b", 1, 1),
+            clique("pc-c", 3, 1),
+        ],
+        scaling_groups=[
+            {"name": "sg-x", "cliqueNames": ["pc-b", "pc-c"], "replicas": 2,
+             "minAvailable": 1}
+        ],
+    )
+
+
+def wl3(name: str = "pcs") -> PodCliqueSet:
+    """workload3.yaml: InOrder startup, full minAvailable (SO-1)."""
+    return build_pcs(
+        name,
+        cliques=[clique("pc-a", 2, 2), clique("pc-b", 1, 1), clique("pc-c", 3, 3)],
+        scaling_groups=[
+            {"name": "sg-x", "cliqueNames": ["pc-b", "pc-c"], "replicas": 2,
+             "minAvailable": 2}
+        ],
+        startup="CliqueStartupTypeInOrder",
+    )
+
+
+def wl4(name: str = "pcs") -> PodCliqueSet:
+    """workload4.yaml: InOrder startup with scaled gangs (SO-2)."""
+    return build_pcs(
+        name,
+        cliques=[clique("pc-a", 2, 1), clique("pc-b", 1, 1), clique("pc-c", 3, 1)],
+        scaling_groups=[
+            {"name": "sg-x", "cliqueNames": ["pc-b", "pc-c"], "replicas": 2,
+             "minAvailable": 1}
+        ],
+        startup="CliqueStartupTypeInOrder",
+    )
+
+
+def wl5(name: str = "pcs") -> PodCliqueSet:
+    """workload5.yaml: Explicit startup, pc-b startsAfter pc-c (SO-3)."""
+    return build_pcs(
+        name,
+        cliques=[
+            clique("pc-a", 2, 2),
+            clique("pc-b", 1, 1, starts_after=["pc-c"]),
+            clique("pc-c", 3, 3, starts_after=["pc-a"]),
+        ],
+        scaling_groups=[
+            {"name": "sg-x", "cliqueNames": ["pc-b", "pc-c"], "replicas": 2,
+             "minAvailable": 2}
+        ],
+        startup="CliqueStartupTypeExplicit",
+    )
+
+
+def wl6(name: str = "pcs") -> PodCliqueSet:
+    """workload6.yaml: Explicit startup with scaled gangs (SO-4)."""
+    return build_pcs(
+        name,
+        cliques=[
+            clique("pc-a", 2, 1),
+            clique("pc-b", 1, 1, starts_after=["pc-a"]),
+            clique("pc-c", 3, 1, starts_after=["pc-b"]),
+        ],
+        scaling_groups=[
+            {"name": "sg-x", "cliqueNames": ["pc-b", "pc-c"], "replicas": 2,
+             "minAvailable": 1}
+        ],
+        startup="CliqueStartupTypeExplicit",
+    )
+
+
+class Scenario:
+    """One running scenario: cluster + controller + simulator + helpers."""
+
+    def __init__(self, n_nodes: int, *, topology: ClusterTopology | None = None,
+                 nodes: list[Node] | None = None, priority_classes=None):
+        self.cluster = Cluster()
+        for node in nodes if nodes is not None else e2e_nodes(n_nodes):
+            self.cluster.nodes[node.name] = node
+        self.topology = topology or e2e_topology()
+        self.controller = GroveController(
+            cluster=self.cluster,
+            topology=self.topology,
+            priority_classes=priority_classes or {},
+        )
+        self.sim = Simulator(
+            cluster=self.cluster,
+            controller=self.controller,
+            config=SimConfig(start_delay=1.0, ready_delay=1.0),
+        )
+
+    # -- setup ---------------------------------------------------------------------
+
+    def deploy(self, pcs: PodCliqueSet) -> PodCliqueSet:
+        self.cluster.podcliquesets[pcs.metadata.name] = pcs
+        self.controller.sync_workload(pcs, self.sim.now)
+        return pcs
+
+    def cordon_n(self, n: int) -> list[str]:
+        names = [name for name in self.cluster.nodes][:n]
+        for name in names:
+            self.sim.cordon(name)
+        return names
+
+    def cordon_all(self) -> list[str]:
+        return self.cordon_n(len(self.cluster.nodes))
+
+    def uncordon_n(self, n: int) -> list[str]:
+        cordoned = [
+            name for name, node in self.cluster.nodes.items() if not node.schedulable
+        ]
+        for name in cordoned[:n]:
+            self.sim.uncordon(name)
+        return cordoned[:n]
+
+    # -- observations --------------------------------------------------------------
+
+    def pods(self, prefix: str = "") -> list:
+        return [
+            p
+            for p in self.cluster.pods.values()
+            if p.is_active and p.pclq_fqn.startswith(prefix)
+        ]
+
+    def scheduled(self, prefix: str = "") -> list:
+        return [p for p in self.pods(prefix) if p.is_scheduled]
+
+    def pending_unscheduled(self, prefix: str = "") -> list:
+        return [p for p in self.pods(prefix) if not p.is_scheduled]
+
+    def ready(self, prefix: str = "") -> list:
+        return [p for p in self.pods(prefix) if p.ready]
+
+    def nodes_of(self, prefix: str = "") -> set[str]:
+        return {p.node_name for p in self.scheduled(prefix)}
+
+    def domain_of_pods(self, prefix: str, level: TopologyDomain) -> set[str]:
+        """Distinct topology domains the scoped pods landed in."""
+        key = {
+            TopologyDomain.ZONE: ZONE_KEY,
+            TopologyDomain.BLOCK: BLOCK_KEY,
+            TopologyDomain.RACK: RACK_KEY,
+        }[level]
+        return {
+            self.cluster.nodes[p.node_name].labels.get(key)
+            for p in self.scheduled(prefix)
+        }
+
+    # -- progression ---------------------------------------------------------------
+
+    def settle(self, seconds: float = 20.0) -> None:
+        self.sim.run(seconds)
+
+    def until(self, predicate, timeout: float = 120.0) -> bool:
+        return self.sim.run_until(predicate, timeout=timeout)
+
+    def until_scheduled(self, n: int, prefix: str = "", timeout: float = 120.0) -> bool:
+        return self.until(lambda: len(self.scheduled(prefix)) >= n, timeout)
+
+    def until_ready(self, n: int, prefix: str = "", timeout: float = 120.0) -> bool:
+        return self.until(lambda: len(self.ready(prefix)) >= n, timeout)
+
+    # -- mutations -----------------------------------------------------------------
+
+    def scale_pcsg(self, pcs_name: str, sg: str, replicas: int, pcs_replica: int = 0):
+        from grove_tpu.api import naming
+
+        fqn = naming.scaling_group_name(pcs_name, pcs_replica, sg)
+        self.cluster.scale_overrides[fqn] = replicas
+
+    def scale_pcs(self, pcs: PodCliqueSet, replicas: int):
+        pcs.spec.replicas = replicas
+
+    def scale_pclq(self, pcs_name: str, clique_tmpl: str, replicas: int,
+                   pcs_replica: int = 0):
+        from grove_tpu.api import naming
+
+        fqn = naming.podclique_name(pcs_name, pcs_replica, clique_tmpl)
+        self.cluster.scale_overrides[fqn] = replicas
+
+    def change_clique_spec(self, pcs: PodCliqueSet, *clique_names: str):
+        """Template change (new image tag) — triggers the rolling update."""
+        for tmpl in pcs.spec.template.cliques:
+            if tmpl.name in clique_names:
+                for c in tmpl.spec.pod_spec.containers:
+                    c.image = c.image.rsplit(":", 1)[0] + ":v2"

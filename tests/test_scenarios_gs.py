@@ -1,0 +1,261 @@
+"""Gang-scheduling behavior matrix GS1–GS12.
+
+Each test mirrors the named reference case in
+`operator/e2e/tests/gang_scheduling_test.go:34-1187` (scenario step comments
+quoted there): capacity is manipulated by cordoning one-pod-per-node workers,
+and the all-or-nothing / minAvailable / scaled-gang semantics are asserted at
+each capacity step.
+
+WL1 (full minAvailable): 10 pods, the whole PCS replica is one gang.
+WL2 (minAvailable=1): base gang floors {pc-a 1, sg-x-0: pc-b 1 + pc-c 1},
+scaled gang per extra PCSG replica.
+"""
+
+from __future__ import annotations
+
+from scenario_harness import Scenario, wl1, wl2
+
+
+def test_gs1_full_replicas_all_or_nothing():
+    """GS-1 (gang_scheduling_test.go:34): 10 nodes, 1 cordoned -> 9 free for
+    10 pods: NOTHING schedules; uncordon -> all 10 bind, one per node."""
+    s = Scenario(10)
+    s.cordon_n(1)
+    s.deploy(wl1())
+    s.settle(10)
+    assert len(s.pods()) == 10
+    assert not s.scheduled(), "9 nodes for a 10-pod gang must bind nothing"
+    s.uncordon_n(1)
+    assert s.until_scheduled(10)
+    nodes = [p.node_name for p in s.scheduled()]
+    assert len(set(nodes)) == 10, "one pod per node (80Mi vs 150Mi)"
+
+
+def test_gs2_pcsg_scale_out_full_replicas():
+    """GS-2 (:96): schedule WL1 on 10 of 14; scale sg-x to 3 -> 4 new pending
+    pods; uncordon the rest -> all scheduled."""
+    s = Scenario(14)
+    s.cordon_n(5)
+    s.deploy(wl1())
+    s.settle(10)
+    assert not s.scheduled()
+    s.uncordon_n(1)  # 10 free
+    assert s.until_scheduled(10)
+    assert s.until_ready(10)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    s.settle(5)
+    new_pending = s.pending_unscheduled()
+    assert len(new_pending) == 4, f"expected 4 new pending, got {len(new_pending)}"
+    s.uncordon_n(4)
+    assert s.until_scheduled(14)
+
+
+def test_gs3_pcs_scale_out_full_replicas():
+    """GS-3 (:176): scale PCS replicas to 2 -> 10 new pending pods; uncordon
+    -> all 20 scheduled."""
+    s = Scenario(20)
+    s.cordon_n(11)
+    pcs = s.deploy(wl1())
+    s.settle(10)
+    assert not s.scheduled()
+    s.uncordon_n(1)  # 10 free
+    assert s.until_scheduled(10)
+    assert s.until_ready(10)
+    s.scale_pcs(pcs, 2)
+    s.settle(5)
+    assert len(s.pods()) == 20
+    assert len(s.pending_unscheduled()) == 10
+    s.uncordon_n(10)
+    assert s.until_scheduled(20)
+
+
+def test_gs4_pcs_and_pcsg_scale_full_replicas():
+    """GS-4 (:252): PCSG scale on replica 0, then PCS scale to 2, then PCSG
+    scale again; each wave gangs all-or-nothing as capacity allows."""
+    s = Scenario(28)
+    s.cordon_n(19)
+    pcs = s.deploy(wl1())
+    s.settle(10)
+    assert not s.scheduled()
+    s.uncordon_n(1)  # 10 free
+    assert s.until_scheduled(10) and s.until_ready(10)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    s.settle(5)
+    assert len(s.pending_unscheduled()) == 4
+    s.uncordon_n(4)
+    assert s.until_scheduled(14)
+    s.scale_pcs(pcs, 2)
+    s.scale_pcsg("pcs", "sg-x", 3, pcs_replica=1)
+    s.settle(5)
+    assert len(s.pods()) == 28
+    s.uncordon_n(14)
+    assert s.until_scheduled(28)
+
+
+def test_gs5_min_replicas_partial_admission():
+    """GS-5 (:329): WL2 floors {pc-a 1, pc-b 1, pc-c 1}: with 3 free nodes
+    exactly 3 pods bind (the floor), extras stay pending; full capacity binds
+    the rest."""
+    s = Scenario(10)
+    s.cordon_n(8)  # 2 free
+    s.deploy(wl2())
+    s.settle(10)
+    assert len(s.pods()) == 10
+    assert not s.scheduled(), "2 nodes < 3-pod floor: nothing binds"
+    s.uncordon_n(1)  # 3 free
+    assert s.until_scheduled(3)
+    assert len(s.scheduled()) == 3
+    assert len(s.scheduled("pcs-0-pc-a")) == 1
+    assert len(s.scheduled("pcs-0-sg-x-0-pc-b")) == 1
+    assert len(s.scheduled("pcs-0-sg-x-0-pc-c")) == 1
+    assert s.until_ready(3)
+    s.uncordon_n(7)
+    assert s.until_scheduled(10)
+
+
+def test_gs6_scaled_gang_after_base_min_replicas():
+    """GS-6 (:408): WL2 + PCSG scale to 3: the scaled replica's 2-pod floor
+    binds only once 2 more nodes free up, independent of best-effort extras."""
+    s = Scenario(14)
+    s.cordon_n(12)  # 2 free
+    s.deploy(wl2())
+    s.settle(10)
+    assert not s.scheduled()
+    s.uncordon_n(1)  # 3 free: the base floor
+    assert s.until_scheduled(3)
+    assert s.until_ready(3)
+    s.uncordon_n(7)
+    assert s.until_scheduled(10)
+    assert s.until_ready(10)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    s.settle(5)
+    assert len(s.pending_unscheduled()) == 4  # new replica: pc-b 1 + pc-c 3
+    s.uncordon_n(2)
+    assert s.until_scheduled(12)
+    assert len(s.scheduled("pcs-0-sg-x-2-pc-b")) == 1
+    assert len(s.scheduled("pcs-0-sg-x-2-pc-c")) == 1
+    s.uncordon_n(2)
+    assert s.until_scheduled(14)
+
+
+def test_gs7_incremental_scaled_replicas():
+    """GS-7 (:537): scaled PCSG replica 1 floor binds with 2 freed nodes
+    before the rest; then scale to 3 and repeat."""
+    s = Scenario(14)
+    s.cordon_n(12)
+    s.deploy(wl2())
+    s.settle(10)
+    s.uncordon_n(1)  # 3 free
+    assert s.until_scheduled(3) and s.until_ready(3)
+    s.uncordon_n(2)  # room for the scaled replica's floor
+    assert s.until(lambda: len(s.scheduled("pcs-0-sg-x-1-pc-b")) >= 1
+                   and len(s.scheduled("pcs-0-sg-x-1-pc-c")) >= 1)
+    assert s.until_ready(5)
+    s.uncordon_n(5)
+    assert s.until_scheduled(10) and s.until_ready(10)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    s.settle(5)
+    s.uncordon_n(2)
+    assert s.until(lambda: len(s.scheduled("pcs-0-sg-x-2-pc-b")) >= 1
+                   and len(s.scheduled("pcs-0-sg-x-2-pc-c")) >= 1)
+    s.uncordon_n(2)
+    assert s.until_scheduled(14)
+
+
+def test_gs8_scale_while_everything_pending():
+    """GS-8 (:675): scale the PCSG while the whole workload is pending; the
+    base floor binds first, scaled floors next, extras last."""
+    s = Scenario(14)
+    s.cordon_n(12)
+    s.deploy(wl2())
+    s.settle(5)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    s.settle(5)
+    assert len(s.pods()) == 14
+    assert not s.scheduled()
+    s.uncordon_n(1)  # 3 free: base floor only
+    assert s.until_scheduled(3)
+    assert len(s.scheduled()) == 3
+    assert s.until_ready(3)
+    s.uncordon_n(4)
+    assert s.until(lambda: all(
+        len(s.scheduled(f"pcs-0-sg-x-{j}-pc-b")) >= 1
+        and len(s.scheduled(f"pcs-0-sg-x-{j}-pc-c")) >= 1
+        for j in (1, 2)
+    ))
+    s.uncordon_n(7)
+    assert s.until_scheduled(14)
+
+
+def test_gs9_pcs_scale_min_replicas():
+    """GS-9 (:787): PCS scaled to 2 with minAvailable floors: each replica's
+    base floor binds independently as capacity allows."""
+    s = Scenario(20)
+    s.cordon_n(18)  # 2 free
+    pcs = s.deploy(wl2())
+    s.settle(10)
+    assert not s.scheduled()
+    s.uncordon_n(1)  # 3 free
+    assert s.until_scheduled(3) and s.until_ready(3)
+    s.scale_pcs(pcs, 2)
+    s.settle(5)
+    assert len(s.pods()) == 20
+    s.uncordon_n(3)  # room for replica 1's floor
+    assert s.until(lambda: len(s.scheduled("pcs-1-")) >= 3)
+    s.uncordon_n(14)
+    assert s.until_scheduled(20)
+
+
+def test_gs10_pcs_scale_min_replicas_advanced():
+    """GS-10 (:907): both PCS replicas pending together; floors bind replica
+    by replica with 3-node grants."""
+    s = Scenario(20)
+    s.cordon_n(20)
+    pcs = s.deploy(wl2())
+    s.scale_pcs(pcs, 2)
+    s.settle(5)
+    assert len(s.pods()) == 20 and not s.scheduled()
+    s.uncordon_n(3)
+    assert s.until(lambda: len(s.scheduled()) >= 3)
+    assert s.until_ready(3)
+    s.uncordon_n(3)
+    assert s.until(
+        lambda: len(s.scheduled("pcs-0-")) >= 3 and len(s.scheduled("pcs-1-")) >= 3
+    )
+    s.uncordon_n(14)
+    assert s.until_scheduled(20)
+
+
+def test_gs11_pcs_and_pcsg_scale_min_replicas():
+    """GS-11 (:1028): PCS x2 and PCSG x3 under minAvailable floors; every
+    floor binds before any full drain."""
+    s = Scenario(28)
+    s.cordon_n(28)
+    pcs = s.deploy(wl2())
+    s.scale_pcs(pcs, 2)
+    s.scale_pcsg("pcs", "sg-x", 3, pcs_replica=0)
+    s.scale_pcsg("pcs", "sg-x", 3, pcs_replica=1)
+    s.settle(5)
+    assert len(s.pods()) == 28 and not s.scheduled()
+    s.uncordon_n(6)
+    assert s.until(
+        lambda: len(s.scheduled("pcs-0-")) >= 3 and len(s.scheduled("pcs-1-")) >= 3
+    )
+    s.uncordon_n(22)
+    assert s.until_scheduled(28)
+
+
+def test_gs12_complex_pcsg_scaling():
+    """GS-12 (:1187): repeated PCSG scale-out/scale-in keeps gang floors and
+    never strands capacity."""
+    s = Scenario(18)
+    s.deploy(wl2())
+    assert s.until_scheduled(10) and s.until_ready(10)
+    s.scale_pcsg("pcs", "sg-x", 4)
+    s.settle(5)
+    assert s.until_scheduled(18)
+    s.scale_pcsg("pcs", "sg-x", 2)
+    assert s.until(lambda: len(s.pods()) == 10)
+    # scale back out: freed capacity is reusable
+    s.scale_pcsg("pcs", "sg-x", 3)
+    assert s.until_scheduled(14)

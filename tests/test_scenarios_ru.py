@@ -1,0 +1,298 @@
+"""Rolling-update behavior matrix RU7–RU21.
+
+Each test mirrors the named reference case in
+`operator/e2e/tests/rolling_updates_test.go:38-889`. The invariants under
+test: one ready pod replaced at a time, one PCS replica fully updated before
+the next starts, delete-first under no capacity, and scale-out/scale-in
+interactions mid-update.
+"""
+
+from __future__ import annotations
+
+from scenario_harness import Scenario, wl1
+
+
+def _deploy_ready(s: Scenario, pcs, n_pods: int):
+    s.deploy(pcs)
+    assert s.until(lambda: len(s.ready()) == n_pods, timeout=240), (
+        f"ready {len(s.ready())}/{n_pods}"
+    )
+    return pcs
+
+
+def _updated_hash(s: Scenario, pcs, clique_tmpl: str) -> str:
+    from grove_tpu.orchestrator import expansion as exp
+
+    return exp.compute_pod_template_hash(
+        pcs.clique_template(clique_tmpl), pcs.spec.template.priority_class_name
+    )
+
+
+def _stale(s: Scenario, pcs, names=("pc-a", "pc-b", "pc-c")):
+    want = {n: _updated_hash(s, pcs, n) for n in names}
+    out = []
+    for p in s.pods():
+        for n, h in want.items():
+            if f"-{n}" in p.pclq_fqn and p.pod_template_hash != h:
+                out.append(p)
+    return out
+
+
+def _run_update_tracking(s: Scenario, pcs, *cliques, max_seconds=300):
+    """Drive the update to completion, recording per-step deltas. Returns the
+    per-step lists of deleted ready pods."""
+    s.change_clique_spec(pcs, *cliques)
+    deleted_ready_steps = []
+    prev = {p.name: p.ready for p in s.pods()}
+    for _ in range(int(max_seconds)):
+        s.sim.step(1.0)
+        cur = {p.name for p in s.pods()}
+        gone_ready = [n for n, was_ready in prev.items() if was_ready and n not in cur]
+        deleted_ready_steps.append(gone_ready)
+        prev = {p.name: p.ready for p in s.pods()}
+        prog = pcs.status.rolling_update_progress
+        if prog is not None and prog.update_ended_at is not None:
+            break
+    prog = pcs.status.rolling_update_progress
+    assert prog is not None and prog.update_ended_at is not None, "update must finish"
+    assert not _stale(s, pcs), "every pod carries the new template hash"
+    return deleted_ready_steps
+
+
+def test_ru7_single_clique_one_pod_at_a_time():
+    """RU-7 (rolling_updates_test.go:38): change pc-a only; at most one ready
+    pod deleted per step; single PCS replica (trivially) updated in order."""
+    s = Scenario(10)
+    pcs = _deploy_ready(s, wl1(), 10)
+    steps = _run_update_tracking(s, pcs, "pc-a")
+    assert all(len(x) <= 1 for x in steps), "one ready pod at a time"
+
+
+def test_ru8_pcsg_clique_one_replica_at_a_time():
+    """RU-8 (:~95): change pc-b (PCSG member); deletions never touch two PCSG
+    replicas in the same step."""
+    s = Scenario(10)
+    pcs = _deploy_ready(s, wl1(), 10)
+    s.change_clique_spec(pcs, "pc-b")
+    prev = {p.name: p.pclq_fqn for p in s.pods()}
+    for _ in range(240):
+        s.sim.step(1.0)
+        cur = {p.name for p in s.pods()}
+        gone_fqns = {prev[n] for n in prev if n not in cur}
+        sg_replicas_touched = {
+            fqn.split("-pc-")[0] for fqn in gone_fqns if "sg-x" in fqn
+        }
+        assert len(sg_replicas_touched) <= 1, (
+            f"two PCSG replicas disrupted at once: {sg_replicas_touched}"
+        )
+        prev = {p.name: p.pclq_fqn for p in s.pods()}
+        prog = pcs.status.rolling_update_progress
+        if prog is not None and prog.update_ended_at is not None:
+            break
+    assert not _stale(s, pcs)
+
+
+def test_ru9_all_cliques_bounded_disruption():
+    """RU-9 (:~150): change pc-a + pc-b + pc-c; per step at most one READY
+    pod is deleted; the update completes with all pods on the new hash."""
+    s = Scenario(10)
+    pcs = _deploy_ready(s, wl1(), 10)
+    steps = _run_update_tracking(s, pcs, "pc-a", "pc-b", "pc-c")
+    assert all(len(x) <= 1 for x in steps)
+
+
+def test_ru10_delete_first_without_capacity():
+    """RU-10 (:~210): cordon everything, change pc-a: exactly one pod is
+    deleted and its replacement is created Pending (delete-first); uncordon
+    completes the update."""
+    s = Scenario(10)
+    pcs = _deploy_ready(s, wl1(), 10)
+    s.cordon_all()
+    s.change_clique_spec(pcs, "pc-a")
+    s.settle(5)
+    pending = s.pending_unscheduled()
+    assert len(pending) == 1, "delete-first: one replacement pod, pending"
+    assert "pc-a" in pending[0].pclq_fqn
+    for name in list(s.cluster.nodes):
+        s.sim.uncordon(name)
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=300,
+    )
+    assert not _stale(s, pcs)
+
+
+def test_ru11_pcs_scale_out_during_update():
+    """RU-11 (:~260): scale the PCS out mid-update; the new replica is born
+    on the NEW spec and is not rolled again."""
+    s = Scenario(30)
+    pcs = _deploy_ready(s, wl1(replicas=2), 20)
+    s.change_clique_spec(pcs, "pc-a")
+    s.settle(3)
+    s.scale_pcs(pcs, 3)
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None
+        and len(s.ready()) == 30,
+        timeout=400,
+    )
+    assert not _stale(s, pcs)
+
+
+def test_ru12_pcs_scale_in_during_update():
+    """RU-12 (:~310): scale the PCS in while the final ordinal updates; the
+    update still completes."""
+    s = Scenario(30)
+    pcs = _deploy_ready(s, wl1(replicas=2), 20)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    s.settle(6)
+    s.scale_pcs(pcs, 1)
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=400,
+    )
+    assert len(s.pods()) == 10 and not _stale(s, pcs)
+
+
+def test_ru13_pcs_scale_in_after_final_ordinal():
+    """RU-13 (:~360): let replica 1 finish updating, then scale in."""
+    s = Scenario(20)
+    pcs = _deploy_ready(s, wl1(replicas=2), 20)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    assert s.until(
+        lambda: 1 in (pcs.status.rolling_update_progress.updated_replica_indices or []),
+        timeout=400,
+    )
+    s.scale_pcs(pcs, 1)
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=400,
+    )
+    assert not _stale(s, pcs)
+
+
+def test_ru14_pcsg_scale_out_during_update():
+    """RU-14 (:~410): scale sg-x out mid-update; the scaled replica is born
+    on the new spec (single update, no double roll)."""
+    s = Scenario(28)
+    pcs = _deploy_ready(s, wl1(), 10)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    s.settle(3)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None
+        and len(s.ready()) == 14,
+        timeout=400,
+    )
+    assert not _stale(s, pcs)
+
+
+def test_ru15_pcsg_scale_out_before_update():
+    """RU-15 (:~460): scale sg-x out FIRST, then update; scaled replica rolls
+    exactly once with everyone else."""
+    s = Scenario(28)
+    pcs = _deploy_ready(s, wl1(), 10)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    assert s.until(lambda: len(s.ready()) == 14, timeout=300)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=400,
+    )
+    assert not _stale(s, pcs) and len(s.pods()) == 14
+
+
+def test_ru16_pcsg_scale_in_during_update():
+    """RU-16 (:~510): sg-x at 3, update, scale back to 2 mid-update."""
+    s = Scenario(28)
+    pcs = _deploy_ready(s, wl1(), 10)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    assert s.until(lambda: len(s.ready()) == 14, timeout=300)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    s.settle(4)
+    s.scale_pcsg("pcs", "sg-x", 2)
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=400,
+    )
+    assert not _stale(s, pcs) and len(s.pods()) == 10
+
+
+def test_ru17_pcsg_scale_in_before_update():
+    """RU-17 (:~560): scale in first, then update."""
+    s = Scenario(28)
+    pcs = _deploy_ready(s, wl1(), 10)
+    s.scale_pcsg("pcs", "sg-x", 3)
+    assert s.until(lambda: len(s.ready()) == 14, timeout=300)
+    s.scale_pcsg("pcs", "sg-x", 2)
+    assert s.until(lambda: len(s.pods()) == 10, timeout=120)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=400,
+    )
+    assert not _stale(s, pcs)
+
+
+def test_ru18_pclq_scale_out_during_update():
+    """RU-18 (:~610): scale standalone pc-a out mid-update; scaled pods carry
+    the new spec and don't roll twice."""
+    s = Scenario(24)
+    pcs = _deploy_ready(s, wl1(replicas=2), 20)
+    s.change_clique_spec(pcs, "pc-a")
+    s.settle(3)
+    s.scale_pclq("pcs", "pc-a", 3, pcs_replica=0)
+    s.scale_pclq("pcs", "pc-a", 3, pcs_replica=1)
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None
+        and len(s.ready()) == 22,
+        timeout=400,
+    )
+    assert not _stale(s, pcs)
+
+
+def test_ru19_pclq_scale_out_before_update():
+    """RU-19 (:~660): scale pc-a out first, then update everything."""
+    s = Scenario(24)
+    pcs = _deploy_ready(s, wl1(replicas=2), 20)
+    s.scale_pclq("pcs", "pc-a", 3, pcs_replica=0)
+    s.scale_pclq("pcs", "pc-a", 3, pcs_replica=1)
+    assert s.until(lambda: len(s.ready()) == 22, timeout=300)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=500,
+    )
+    assert not _stale(s, pcs)
+
+
+def test_ru20_pclq_scale_in_during_update():
+    """RU-20 (:~710): pc-a at 3 (above minAvailable 2), update, scale back to
+    2 mid-update."""
+    s = Scenario(22)
+    pcs = _deploy_ready(s, wl1(), 10)
+    s.scale_pclq("pcs", "pc-a", 3)
+    assert s.until(lambda: len(s.ready()) == 11, timeout=300)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    s.settle(4)
+    s.scale_pclq("pcs", "pc-a", 2)
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=400,
+    )
+    assert not _stale(s, pcs) and len(s.pods()) == 10
+
+
+def test_ru21_pclq_scale_in_before_update():
+    """RU-21 (:~760): scale pc-a 3 -> 2 first, then update."""
+    s = Scenario(22)
+    pcs = _deploy_ready(s, wl1(), 10)
+    s.scale_pclq("pcs", "pc-a", 3)
+    assert s.until(lambda: len(s.ready()) == 11, timeout=300)
+    s.scale_pclq("pcs", "pc-a", 2)
+    assert s.until(lambda: len(s.pods()) == 10, timeout=120)
+    s.change_clique_spec(pcs, "pc-a", "pc-b", "pc-c")
+    assert s.until(
+        lambda: pcs.status.rolling_update_progress.update_ended_at is not None,
+        timeout=400,
+    )
+    assert not _stale(s, pcs)

@@ -1,0 +1,102 @@
+"""Startup-ordering behavior matrix SO1–SO4.
+
+Each test mirrors the named reference case in
+`operator/e2e/tests/startup_ordering_test.go:57-243`. The gate under test is
+the grove-initc agent path (injected init-container args evaluated through
+initc/agent, sim/simulator.py startup_gate="agent") — the same code the
+`python -m grove_tpu.initc` binary runs.
+"""
+
+from __future__ import annotations
+
+from scenario_harness import Scenario, wl3, wl4, wl5, wl6
+
+
+def _start_time(s: Scenario, fqn_prefix: str):
+    ts = [p.started_at for p in s.pods(fqn_prefix) if p.started_at is not None]
+    return min(ts) if ts else None
+
+
+def _all_started(s: Scenario, fqn_prefix: str) -> bool:
+    pods = s.pods(fqn_prefix)
+    return bool(pods) and all(p.started_at is not None for p in pods)
+
+
+def test_so1_inorder_full_replicas():
+    """SO-1 (startup_ordering_test.go:57): InOrder with full minAvailable:
+    pc-a starts, THEN sg-x pc-b (both replicas), THEN sg-x pc-c."""
+    s = Scenario(10)
+    s.deploy(wl3())
+    assert s.until(lambda: len(s.ready()) == 10, timeout=180)
+    a_ready = max(p.started_at for p in s.pods("pcs-0-pc-a"))
+    for j in (0, 1):
+        b_start = _start_time(s, f"pcs-0-sg-x-{j}-pc-b")
+        c_start = _start_time(s, f"pcs-0-sg-x-{j}-pc-c")
+        assert b_start is not None and b_start > a_ready
+        assert c_start is not None and c_start > b_start
+
+
+def test_so2_inorder_scaled_gangs_independent():
+    """SO-2 (:~120): with minAvailable=1 the scaled PCSG replica is its own
+    gang: order holds WITHIN each replica; sg-x-1 does not wait for sg-x-0's
+    full readiness."""
+    s = Scenario(10)
+    s.deploy(wl4())
+    assert s.until(lambda: len(s.ready()) == 10, timeout=240)
+    a_first = _start_time(s, "pcs-0-pc-a")
+    for j in (0, 1):
+        b_start = _start_time(s, f"pcs-0-sg-x-{j}-pc-b")
+        c_start = _start_time(s, f"pcs-0-sg-x-{j}-pc-c")
+        assert a_first is not None and b_start is not None and c_start is not None
+        assert a_first < b_start, "pc-b waits for pc-a (InOrder parent)"
+        assert b_start < c_start, "pc-c waits for its replica's pc-b"
+
+
+def test_so3_explicit_order_c_before_b():
+    """SO-3 (:~170): Explicit DAG pc-c startsAfter pc-a, pc-b startsAfter
+    pc-c — the REVERSE of template order: pc-a, then all pc-c, then pc-b."""
+    s = Scenario(10)
+    s.deploy(wl5())
+    assert s.until(lambda: len(s.ready()) == 10, timeout=240)
+    a_ready = max(p.started_at for p in s.pods("pcs-0-pc-a"))
+    for j in (0, 1):
+        c_start = _start_time(s, f"pcs-0-sg-x-{j}-pc-c")
+        b_start = _start_time(s, f"pcs-0-sg-x-{j}-pc-b")
+        assert c_start is not None and c_start > a_ready
+        assert b_start is not None and b_start > c_start, (
+            "explicit startsAfter must invert template order"
+        )
+
+
+def test_so4_explicit_scaled_gangs():
+    """SO-4 (:~210): explicit chain a -> b -> c with scaled gangs; order
+    holds within each PCSG replica independently."""
+    s = Scenario(10)
+    s.deploy(wl6())
+    assert s.until(lambda: len(s.ready()) == 10, timeout=240)
+    a_first = _start_time(s, "pcs-0-pc-a")
+    for j in (0, 1):
+        b_start = _start_time(s, f"pcs-0-sg-x-{j}-pc-b")
+        c_start = _start_time(s, f"pcs-0-sg-x-{j}-pc-c")
+        assert a_first is not None and b_start is not None and c_start is not None
+        assert a_first < b_start < c_start
+
+
+def test_so_gates_are_agent_driven():
+    """The ordering above must come from injected grove-initc containers, not
+    a hidden predicate: ordered cliques carry the agent container, first
+    cliques do not (initcontainer.go:51,98-126)."""
+    from grove_tpu.orchestrator.expansion import INITC_CONTAINER_NAME
+
+    s = Scenario(10)
+    s.deploy(wl3())
+    gated = [
+        p for p in s.pods()
+        if any(c.name == INITC_CONTAINER_NAME for c in p.spec.init_containers)
+    ]
+    ungated = [
+        p for p in s.pods()
+        if not any(c.name == INITC_CONTAINER_NAME for c in p.spec.init_containers)
+    ]
+    assert {p.pclq_fqn for p in ungated} == {"pcs-0-pc-a"}
+    assert gated and all("sg-x" in p.pclq_fqn for p in gated)

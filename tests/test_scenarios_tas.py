@@ -1,0 +1,306 @@
+"""Topology-aware-scheduling behavior matrix TAS1–TAS16.
+
+Each test mirrors the named reference case in
+`operator/e2e/tests/topology_test.go:104-995` (workload fixtures
+`operator/e2e/yaml/tas-*.yaml`): constraints at PCS (template), PCSG, and
+PCLQ levels translate into pack-sets, and the assertion is always the same
+shape as the reference's (`e2e/utils/topology.go:139-243`): pods of a
+constrained scope landed in exactly ONE domain at the constrained level.
+
+Cluster shape mirrors the k3d rig (create-e2e-cluster.py:133-135):
+hosts_per_rack=7, racks_per_block=2, blocks_per_zone=2.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api.types import TopologyDomain
+from scenario_harness import MI, Scenario, build_pcs, clique
+
+
+def _multi_pod_nodes(count: int, pods_per_node: int = 4):
+    """Nodes that fit several pods (host-level constraints need >1 per node)."""
+    from scenario_harness import e2e_nodes
+
+    return e2e_nodes(count, mem=pods_per_node * 100 * MI)
+
+
+def _pcs_sg(name, *, pcs_pack=None, sg_pack=None, clique_packs=(None, None),
+            sg_replicas=1, b_repl=2, c_repl=2, mem="80Mi"):
+    return build_pcs(
+        name,
+        cliques=[
+            clique("pc-b", b_repl, b_repl, mem=mem, pack=clique_packs[0]),
+            clique("pc-c", c_repl, c_repl, mem=mem, pack=clique_packs[1]),
+        ],
+        scaling_groups=[
+            {
+                "name": "sg-x",
+                "cliqueNames": ["pc-b", "pc-c"],
+                "replicas": sg_replicas,
+                "minAvailable": sg_replicas,
+                **({"topologyConstraint": {"packDomain": sg_pack}} if sg_pack else {}),
+            }
+        ],
+        pack=pcs_pack,
+    )
+
+
+def test_tas1_topology_infrastructure():
+    """TAS-1 (topology_test.go:104): the ClusterTopology the operator syncs
+    from config exposes the configured levels plus the auto host level
+    (clustertopology.go:102-107)."""
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {
+            "topologyAwareScheduling": {
+                "enabled": True,
+                "levels": [
+                    {"domain": "zone", "nodeLabelKey": "topology.kubernetes.io/zone"},
+                    {"domain": "block", "nodeLabelKey": "topology.kubernetes.io/block"},
+                    {"domain": "rack", "nodeLabelKey": "topology.kubernetes.io/rack"},
+                ],
+            }
+        }
+    )
+    assert not errors
+    topo = cfg.cluster_topology()
+    domains = [lv.domain for lv in topo.sorted_levels()]
+    assert domains[-1] == TopologyDomain.HOST
+    assert TopologyDomain.RACK in domains and TopologyDomain.BLOCK in domains
+
+
+def test_tas2_multiple_cliques_different_constraints():
+    """TAS-2 (:174): two cliques with different PCLQ-level constraints — each
+    clique packs its own domain independently."""
+    s = Scenario(28)
+    pcs = build_pcs(
+        "tas2",
+        cliques=[
+            clique("rackers", 3, 3, pack="rack"),
+            clique("blockers", 4, 4, pack="block"),
+        ],
+    )
+    s.deploy(pcs)
+    assert s.until_scheduled(7)
+    assert len(s.domain_of_pods("tas2-0-rackers", TopologyDomain.RACK)) == 1
+    assert len(s.domain_of_pods("tas2-0-blockers", TopologyDomain.BLOCK)) == 1
+
+
+def test_tas3_pcs_only_constraint():
+    """TAS-3 (:226): PCS-level block constraint — ALL pods of the replica in
+    one block, cliques free within it."""
+    s = Scenario(28)
+    s.deploy(_pcs_sg("tas3", pcs_pack="block", b_repl=3, c_repl=3))
+    assert s.until_scheduled(6)
+    assert len(s.domain_of_pods("tas3-0-", TopologyDomain.BLOCK)) == 1
+
+
+def test_tas4_pcsg_only_constraint():
+    """TAS-4 (:273): PCSG-level rack constraint — each PCSG replica's pods in
+    one rack; different replicas may use different racks."""
+    s = Scenario(28)
+    s.deploy(_pcs_sg("tas4", sg_pack="rack", sg_replicas=2, b_repl=2, c_repl=2))
+    assert s.until_scheduled(8)
+    for j in (0, 1):
+        assert len(s.domain_of_pods(f"tas4-0-sg-x-{j}-", TopologyDomain.RACK)) == 1
+
+
+def test_tas5_host_level_constraint():
+    """TAS-5 (:321): PCLQ host-level constraint — all the clique's pods on
+    ONE node (needs multi-pod nodes)."""
+    s = Scenario(0, nodes=_multi_pod_nodes(8))
+    pcs = build_pcs("tas5", cliques=[clique("co", 3, 3, pack="host")])
+    s.deploy(pcs)
+    assert s.until_scheduled(3)
+    assert len(s.nodes_of("tas5-0-co")) == 1
+
+
+def test_tas6_standalone_pclq_pcs_zone():
+    """TAS-6 (:376): standalone clique under a PCS zone constraint."""
+    s = Scenario(56)  # spans 2 zones
+    pcs = build_pcs("tas6", cliques=[clique("lone", 5, 5)], pack="zone")
+    s.deploy(pcs)
+    assert s.until_scheduled(5)
+    assert len(s.domain_of_pods("tas6-0-", TopologyDomain.ZONE)) == 1
+
+
+def test_tas7_no_constraint_spreads_fine():
+    """TAS-7 (:417): no constraints — everything schedules with no packing
+    requirement (and may spread)."""
+    s = Scenario(14)
+    s.deploy(_pcs_sg("tas7", b_repl=3, c_repl=3))
+    assert s.until_scheduled(6)
+
+
+def test_tas8_full_hierarchy_cascading():
+    """TAS-8 (:463, tas-hierarchy.yaml): PCS block ⊃ PCSG rack ⊃ PCLQ host —
+    every level honored at once."""
+    s = Scenario(0, nodes=_multi_pod_nodes(28))
+    pcs = build_pcs(
+        "tas8",
+        cliques=[
+            clique("prefill", 2, 2, pack="host"),
+            clique("decode", 2, 2, pack="host"),
+        ],
+        scaling_groups=[
+            {
+                "name": "inference-group",
+                "cliqueNames": ["prefill", "decode"],
+                "replicas": 2,
+                "minAvailable": 2,
+                "topologyConstraint": {"packDomain": "rack"},
+            }
+        ],
+        pack="block",
+    )
+    s.deploy(pcs)
+    assert s.until_scheduled(8)
+    assert len(s.domain_of_pods("tas8-0-", TopologyDomain.BLOCK)) == 1
+    for j in (0, 1):
+        prefix = f"tas8-0-inference-group-{j}-"
+        assert len(s.domain_of_pods(prefix, TopologyDomain.RACK)) == 1
+        assert len(s.nodes_of(prefix + "prefill")) == 1
+        assert len(s.nodes_of(prefix + "decode")) == 1
+
+
+def test_tas9_pcs_plus_pclq():
+    """TAS-9 (:533, tas-pcs-pclq.yaml): PCS block + PCLQ host."""
+    s = Scenario(0, nodes=_multi_pod_nodes(16))
+    pcs = build_pcs(
+        "tas9", cliques=[clique("worker", 2, 2, pack="host")], pack="block"
+    )
+    s.deploy(pcs)
+    assert s.until_scheduled(2)
+    assert len(s.nodes_of("tas9-0-worker")) == 1
+    assert len(s.domain_of_pods("tas9-0-", TopologyDomain.BLOCK)) == 1
+
+
+def test_tas10_pcsg_scaling_with_constraints():
+    """TAS-10 (:576): scale a rack-constrained PCSG; every replica (original
+    and scaled) packs its own rack."""
+    s = Scenario(28)
+    s.deploy(_pcs_sg("tas10", sg_pack="rack", sg_replicas=2, b_repl=2, c_repl=2))
+    assert s.until_scheduled(8)
+    s.scale_pcsg("tas10", "sg-x", 3)
+    assert s.until_scheduled(12)
+    for j in (0, 1, 2):
+        assert len(s.domain_of_pods(f"tas10-0-sg-x-{j}-", TopologyDomain.RACK)) == 1
+
+
+def test_tas11_pcsg_pclq_no_parent_constraint():
+    """TAS-11 (:647): PCSG rack + member PCLQ host, NO PCS constraint."""
+    s = Scenario(0, nodes=_multi_pod_nodes(16))
+    pcs = build_pcs(
+        "tas11",
+        cliques=[
+            clique("ldr", 1, 1, pack="host"),
+            clique("wrk", 2, 2, pack="host"),
+        ],
+        scaling_groups=[
+            {
+                "name": "sg-y",
+                "cliqueNames": ["ldr", "wrk"],
+                "replicas": 1,
+                "minAvailable": 1,
+                "topologyConstraint": {"packDomain": "rack"},
+            }
+        ],
+    )
+    s.deploy(pcs)
+    assert s.until_scheduled(3)
+    assert len(s.domain_of_pods("tas11-0-sg-y-0-", TopologyDomain.RACK)) == 1
+    assert len(s.nodes_of("tas11-0-sg-y-0-wrk")) == 1
+
+
+def test_tas12_large_scaling_ratio():
+    """TAS-12 (:699): many rack-packed PCSG replicas at once — each gets its
+    own rack, all admitted while racks remain."""
+    s = Scenario(28)  # 4 racks of 7
+    s.deploy(_pcs_sg("tas12", sg_pack="rack", sg_replicas=4, b_repl=2, c_repl=2))
+    assert s.until_scheduled(16)
+    racks = [
+        next(iter(s.domain_of_pods(f"tas12-0-sg-x-{j}-", TopologyDomain.RACK)))
+        for j in range(4)
+    ]
+    assert all(r is not None for r in racks)
+
+
+def test_tas13_insufficient_nodes_for_constraint():
+    """TAS-13 (:786, tas-insuffic.yaml): a rack can hold 7 pods; a 10-pod
+    rack-packed gang must stay Pending — never split across racks."""
+    s = Scenario(28)
+    pcs = build_pcs("tas13", cliques=[clique("worker", 10, 10)], pack="rack")
+    s.deploy(pcs)
+    s.settle(15)
+    assert not s.scheduled(), "10 pods cannot pack one 7-host rack"
+    gang = next(iter(s.cluster.podgangs.values()))
+    from grove_tpu.api.podgang import PodGangPhase
+
+    assert gang.status.phase == PodGangPhase.PENDING
+
+
+def test_tas14_multi_replica_rack_constraint():
+    """TAS-14 (:839, tas-multirep.yaml): PCS replicas=3 with a rack
+    constraint: each replica packs ITS OWN rack."""
+    s = Scenario(28)
+    pcs = build_pcs(
+        "tas14", cliques=[clique("w", 3, 3)], pack="rack", replicas=3
+    )
+    s.deploy(pcs)
+    assert s.until_scheduled(9)
+    for i in range(3):
+        assert len(s.domain_of_pods(f"tas14-{i}-", TopologyDomain.RACK)) == 1
+
+
+def test_tas15_disaggregated_multiple_pcsgs():
+    """TAS-15 (:890, tas-pcs-multi-pcsg-multi-replica.yaml analog): prefill
+    and decode PCSGs, each rack-packed, plus an unconstrained router, under a
+    PCS block constraint."""
+    s = Scenario(28)
+    pcs = build_pcs(
+        "tas15",
+        cliques=[
+            clique("router", 1, 1),
+            clique("p-ldr", 1, 1),
+            clique("p-wrk", 2, 2),
+            clique("d-ldr", 1, 1),
+            clique("d-wrk", 2, 2),
+        ],
+        scaling_groups=[
+            {"name": "prefill", "cliqueNames": ["p-ldr", "p-wrk"], "replicas": 1,
+             "minAvailable": 1, "topologyConstraint": {"packDomain": "rack"}},
+            {"name": "decode", "cliqueNames": ["d-ldr", "d-wrk"], "replicas": 1,
+             "minAvailable": 1, "topologyConstraint": {"packDomain": "rack"}},
+        ],
+        pack="block",
+    )
+    s.deploy(pcs)
+    assert s.until_scheduled(7)
+    assert len(s.domain_of_pods("tas15-0-", TopologyDomain.BLOCK)) == 1
+    assert len(s.domain_of_pods("tas15-0-prefill-0-", TopologyDomain.RACK)) == 1
+    assert len(s.domain_of_pods("tas15-0-decode-0-", TopologyDomain.RACK)) == 1
+
+
+def test_tas16_multi_replica_three_level_hierarchy():
+    """TAS-16 (:995): PCS replicas=2, block PCS constraint + rack PCSG
+    constraint — the full hierarchy per replica."""
+    s = Scenario(56)
+    pcs = build_pcs(
+        "tas16",
+        cliques=[clique("pc-b", 2, 2), clique("pc-c", 2, 2)],
+        scaling_groups=[
+            {"name": "sg-x", "cliqueNames": ["pc-b", "pc-c"], "replicas": 2,
+             "minAvailable": 2, "topologyConstraint": {"packDomain": "rack"}},
+        ],
+        pack="block",
+        replicas=2,
+    )
+    s.deploy(pcs)
+    assert s.until_scheduled(16)
+    for i in (0, 1):
+        assert len(s.domain_of_pods(f"tas16-{i}-", TopologyDomain.BLOCK)) == 1
+        for j in (0, 1):
+            assert len(
+                s.domain_of_pods(f"tas16-{i}-sg-x-{j}-", TopologyDomain.RACK)
+            ) == 1
