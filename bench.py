@@ -91,15 +91,17 @@ def run_bench() -> dict:
     from grove_tpu.state import build_snapshot
 
     scale = float(os.environ.get("GROVE_BENCH_SCALE", "1.0"))
-    wave_size = int(os.environ.get("GROVE_BENCH_WAVE", "64"))
-    # auto: speculative parallel commit on accelerators (rounds are wide but
-    # shallow — latency-bound hardware wins), sequential scan on CPU (same
-    # total flops, no speculative multiplier — throughput-bound hardware wins).
+    # Wave 256 measured best on TPU (round 3: p99 3.59s vs 4.85s at 64 and
+    # 5.36s at 1280 — bigger waves amortize the ~75ms relay dispatch per
+    # call until scan length dominates); CPU is flat across 64-256.
+    wave_size = int(os.environ.get("GROVE_BENCH_WAVE", "256"))
+    # auto: sequential scan EVERYWHERE. Round-2 assumed accelerators want the
+    # speculative parallel commit; round-3 measurement on the chip refuted it
+    # (speculative 0.86s vs sequential 0.19s per 64-gang wave after the
+    # scatter-free aggregation landed — the speculative path's per-round
+    # re-placement multiplier costs more than the sequential scan's depth).
     spec_env = os.environ.get("GROVE_BENCH_SPECULATIVE", "auto")
-    if spec_env == "auto":
-        speculative = jax.default_backend() not in ("cpu",)
-    else:
-        speculative = spec_env == "1"
+    speculative = spec_env == "1"
     run_baseline = os.environ.get("GROVE_BENCH_BASELINE", "1") == "1"
     solver = solve_batch_speculative if speculative else solve_batch
 
