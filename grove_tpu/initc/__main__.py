@@ -26,7 +26,22 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument("--poll-interval", type=float, default=1.0)
     parser.add_argument("--timeout", type=float, default=900.0)
+    parser.add_argument(
+        "--token", default="", help="SA bearer token (authorizer-enabled managers)"
+    )
+    parser.add_argument(
+        "--token-file", default="", help="file holding the SA token (mount analog)"
+    )
     args = parser.parse_args(argv)
+    token = args.token
+    if args.token_file:
+        try:
+            with open(args.token_file) as f:
+                token = f.read().strip()
+        except OSError as e:
+            # Mount missing (authorizer likely off): proceed tokenless — the
+            # 401 fail-fast path catches a genuinely required credential.
+            print(f"grove-initc: no token file ({e}); proceeding without", file=sys.stderr)
 
     try:
         reqs = parse_podcliques_arg(args.podcliques)
@@ -40,13 +55,17 @@ def main(argv: list[str] | None = None) -> int:
         if n == 1 or n % 30 == 0:
             print(f"grove-initc: waiting on {len(reqs)} parent clique(s)", flush=True)
 
-    ok = wait_until_ready(
-        http_fetch(args.server),
-        reqs,
-        timeout_s=args.timeout,
-        poll_interval_s=args.poll_interval,
-        on_poll=log_poll,
-    )
+    try:
+        ok = wait_until_ready(
+            http_fetch(args.server, token=token or None),
+            reqs,
+            timeout_s=args.timeout,
+            poll_interval_s=args.poll_interval,
+            on_poll=log_poll,
+        )
+    except PermissionError as e:
+        print(f"grove-initc: {e}", file=sys.stderr)
+        return 2
     if not ok:
         print("grove-initc: timed out waiting for parent cliques", file=sys.stderr)
         return 1

@@ -93,15 +93,26 @@ def wait_until_ready(
         sleep(poll_interval_s)
 
 
-def http_fetch(server: str, timeout_s: float = 5.0) -> FetchFn:
-    """Poll the manager's HTTP API (the apiserver analog)."""
+def http_fetch(server: str, timeout_s: float = 5.0, token: str | None = None) -> FetchFn:
+    """Poll the manager's HTTP API (the apiserver analog). `token` is the
+    per-PCS SA token (api/resources.TokenSecret) sent as a bearer credential
+    — required when the manager runs with the authorizer enabled."""
 
     def fetch(fqn: str) -> tuple[int, bool]:
         url = f"{server.rstrip('/')}/api/v1/podcliques/{fqn}"
+        req = urllib.request.Request(url)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         try:
-            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                 doc = json.loads(resp.read())
-        except urllib.error.HTTPError:
+        except urllib.error.HTTPError as e:
+            if e.code in (401, 403):
+                # A rejected credential never fixes itself by polling — fail
+                # fast with a diagnosis instead of gating until timeout.
+                raise PermissionError(
+                    f"manager rejected the SA token ({e.code}) for {fqn}"
+                ) from e
             # 404 = clique not created yet; 5xx = manager restarting. Either
             # way: keep gating, keep retrying — never crash the init phase.
             return 0, False

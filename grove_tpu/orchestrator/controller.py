@@ -120,17 +120,30 @@ class GroveController:
         if desired is None:
             desired = self.compute_desired(pcs)
 
-        c.headless_services.update(desired.headless_services)
-        # Drop services of removed PCS replicas (scale-down leaves no orphans).
-        prefix = pcs.metadata.name + "-"
-        stale_svcs = {
-            s
-            for s in c.headless_services
-            if s.startswith(prefix)
-            and s[len(prefix):].isdigit()
-            and s not in desired.headless_services
-        }
-        c.headless_services -= stale_svcs
+        # Auxiliary managed resources: upsert (spec refresh) + stale GC per
+        # owning PCS (ordered kinds, reconcilespec.go:206-221). The single
+        # exception: an EXISTING token secret keeps its token value across
+        # re-syncs — it is a long-lived credential, not spec.
+        sa, role, binding, secret = desired.rbac
+        for coll, want in (
+            (c.services, {o.name: o for o in desired.services}),
+            (c.hpas, {o.name: o for o in desired.hpas}),
+            (c.service_accounts, {sa.name: sa}),
+            (c.roles, {role.name: role}),
+            (c.role_bindings, {binding.name: binding}),
+            (c.secrets, {secret.name: secret}),
+        ):
+            for name, obj in want.items():
+                existing = coll.get(name)
+                if existing is not None and coll is c.secrets:
+                    obj.token = existing.token
+                coll[name] = obj
+            for name in [
+                n
+                for n, obj in coll.items()
+                if getattr(obj, "pcs_name", None) == pcs.metadata.name and n not in want
+            ]:
+                del coll[name]
 
         desired_clique_names = {x.metadata.name for x in desired.podcliques}
         desired_pcsg_names = {x.metadata.name for x in desired.scaling_groups}
@@ -740,39 +753,24 @@ class GroveController:
     # --- autoscaling (hpa component analog) --------------------------------------
 
     def autoscale(self, metrics: dict[str, float], now: float) -> None:
-        """Evaluate HPA targets. `metrics` maps target FQN (standalone clique or
-        PCSG) -> current average metric utilization, normalized so that 1.0 ==
-        the target value (classic HPA ratio scaling)."""
+        """Evaluate the store's HPA OBJECTS (components/hpa/hpa.go analog).
+
+        `metrics` maps HPA target FQN -> current average utilization,
+        normalized so 1.0 == the target value (classic HPA ratio scaling).
+        Scaling writes the target's scale subresource (scale_overrides),
+        which the next expansion consumes — exactly the reference flow
+        HPA -> CR scale subresource -> determinePodCliqueReplicas."""
         c = self.cluster
-        for pcs in c.podcliquesets.values():
-            for i in range(pcs.spec.replicas):
-                for clique_tmpl in pcs.standalone_clique_templates():
-                    sc = clique_tmpl.spec.scale_config
-                    if sc is None:
-                        continue
-                    fqn = naming.podclique_name(pcs.metadata.name, i, clique_tmpl.name)
-                    if fqn not in metrics:
-                        continue
-                    current = c.scale_overrides.get(fqn, clique_tmpl.spec.replicas)
-                    desired = math.ceil(current * metrics[fqn])
-                    lo = sc.min_replicas if sc.min_replicas is not None else clique_tmpl.spec.replicas
-                    desired = max(lo, min(sc.max_replicas, desired))
-                    if desired != current:
-                        c.scale_overrides[fqn] = desired
-                        c.record_event(now, fqn, f"HPA scaled {current} -> {desired}")
-                for cfg in pcs.spec.template.pod_clique_scaling_group_configs:
-                    if cfg.scale_config is None:
-                        continue
-                    fqn = naming.scaling_group_name(pcs.metadata.name, i, cfg.name)
-                    if fqn not in metrics:
-                        continue
-                    current = c.scale_overrides.get(fqn, cfg.replicas)
-                    desired = math.ceil(current * metrics[fqn])
-                    lo = cfg.scale_config.min_replicas if cfg.scale_config.min_replicas is not None else cfg.replicas
-                    desired = max(lo, min(cfg.scale_config.max_replicas, desired))
-                    if desired != current:
-                        c.scale_overrides[fqn] = desired
-                        c.record_event(now, fqn, f"HPA scaled {current} -> {desired}")
+        for hpa in c.hpas.values():
+            fqn = hpa.target_name
+            if fqn not in metrics:
+                continue
+            current = c.scale_overrides.get(fqn, hpa.target_spec_replicas)
+            desired = math.ceil(current * metrics[fqn])
+            desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+            if desired != current:
+                c.scale_overrides[fqn] = desired
+                c.record_event(now, fqn, f"HPA scaled {current} -> {desired}")
 
 
 def _merge_pod_groups(existing, desired):

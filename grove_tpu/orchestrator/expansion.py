@@ -52,13 +52,19 @@ from grove_tpu.api.types import (
 
 @dataclass
 class DesiredState:
-    """Everything one PodCliqueSet materializes into."""
+    """Everything one PodCliqueSet materializes into (the reference's ordered
+    component kinds, podcliqueset/reconcilespec.go:206-221)."""
 
     headless_services: list[str] = field(default_factory=list)
     podcliques: list[PodClique] = field(default_factory=list)
     scaling_groups: list[PodCliqueScalingGroup] = field(default_factory=list)
     podgangs: list[PodGang] = field(default_factory=list)
     pods: list[Pod] = field(default_factory=list)
+    # Auxiliary managed resources (api/resources.py): per-replica headless
+    # Service objects, HPAs for auto-scaled targets, per-PCS RBAC + SA token.
+    services: list = field(default_factory=list)
+    hpas: list = field(default_factory=list)
+    rbac: list = field(default_factory=list)  # [sa, role, binding, secret]
 
     def podgang(self, name: str) -> Optional[PodGang]:
         for g in self.podgangs:
@@ -180,9 +186,29 @@ def expand_podcliqueset(
             ),
         )
 
+    # Per-PCS RBAC + SA token credential objects (serviceaccount/role/
+    # rolebinding/satokensecret components).
+    from grove_tpu.api.resources import HeadlessService, build_pcs_rbac
+
+    out.rbac = list(build_pcs_rbac(pcs_name, ns))
+    _collect_hpas(out, pcs)
+
     for i in range(pcs.spec.replicas):
         svc = naming.headless_service_name(pcs_name, i)
         out.headless_services.append(svc)
+        out.services.append(
+            HeadlessService(
+                name=svc,
+                namespace=ns,
+                pcs_name=pcs_name,
+                pcs_replica_index=i,
+                publish_not_ready_addresses=True,
+                selector={
+                    constants.LABEL_PART_OF: pcs_name,
+                    constants.LABEL_PCS_REPLICA_INDEX: str(i),
+                },
+            )
+        )
         base_gang = _new_podgang(naming.base_podgang_name(pcs_name, i), i)
 
         # Standalone cliques — always members of the base gang.
@@ -295,6 +321,57 @@ def expand_podcliqueset(
         key=lambda g: (g.is_scaled, g.pcs_replica_index, g.scaled_index, g.name)
     )
     return out
+
+
+def _collect_hpas(out: DesiredState, pcs: PodCliqueSet) -> None:
+    """HPA objects per auto-scaled standalone clique and PCSG
+    (components/hpa/hpa.go:130,249-259): ScaleTargetRef -> the FQN whose
+    scale subresource (cluster.scale_overrides) the controller adjusts."""
+    from grove_tpu.api.resources import HorizontalPodAutoscaler
+
+    ns = pcs.metadata.namespace
+    for i in range(pcs.spec.replicas):
+        for tmpl in pcs.standalone_clique_templates():
+            sc = tmpl.spec.scale_config
+            if sc is None:
+                continue
+            fqn = naming.podclique_name(pcs.metadata.name, i, tmpl.name)
+            out.hpas.append(
+                HorizontalPodAutoscaler(
+                    name=f"{fqn}-hpa",
+                    namespace=ns,
+                    pcs_name=pcs.metadata.name,
+                    target_kind="PodClique",
+                    target_name=fqn,
+                    min_replicas=(
+                        sc.min_replicas if sc.min_replicas is not None else tmpl.spec.replicas
+                    ),
+                    max_replicas=sc.max_replicas,
+                    target_spec_replicas=tmpl.spec.replicas,
+                    metrics=list(sc.metrics),
+                )
+            )
+        for cfg in pcs.spec.template.pod_clique_scaling_group_configs:
+            if cfg.scale_config is None:
+                continue
+            fqn = naming.scaling_group_name(pcs.metadata.name, i, cfg.name)
+            out.hpas.append(
+                HorizontalPodAutoscaler(
+                    name=f"{fqn}-hpa",
+                    namespace=ns,
+                    pcs_name=pcs.metadata.name,
+                    target_kind="PodCliqueScalingGroup",
+                    target_name=fqn,
+                    min_replicas=(
+                        cfg.scale_config.min_replicas
+                        if cfg.scale_config.min_replicas is not None
+                        else cfg.replicas
+                    ),
+                    max_replicas=cfg.scale_config.max_replicas,
+                    target_spec_replicas=cfg.replicas,
+                    metrics=list(cfg.scale_config.metrics),
+                )
+            )
 
 
 def slice_injection_active(pcs: PodCliqueSet, auto_slice_enabled: bool) -> bool:
@@ -464,10 +541,16 @@ def initc_args(
     return [f"--podcliques={','.join(reqs)}"]
 
 
-def _inject_initc(spec, args: list[str]) -> None:
+# Where the runtime mounts the PCS's SA token secret inside the pod (the
+# projected-token volume analog); the injected agent reads it from here.
+INITC_TOKEN_MOUNT = "/var/run/secrets/grove.io/sa-token/token"
+
+
+def _inject_initc(spec, args: list[str], pcs_name: str) -> None:
     """Inject the startup-ordering init container (initcontainer.go:51,98-126);
     its args are exactly what the agent binary consumes (python -m
-    grove_tpu.initc)."""
+    grove_tpu.initc), including --token-file pointing at the mounted SA token
+    secret (named in env for the runtime to mount)."""
     if any(c.name == INITC_CONTAINER_NAME for c in spec.init_containers):
         return
     spec.init_containers.append(
@@ -475,7 +558,10 @@ def _inject_initc(spec, args: list[str]) -> None:
             name=INITC_CONTAINER_NAME,
             image="grove-initc",
             command=["python", "-m", "grove_tpu.initc"],
-            args=list(args),
+            args=list(args) + [f"--token-file={INITC_TOKEN_MOUNT}"],
+            env={
+                "GROVE_SA_TOKEN_SECRET": naming.initc_sa_token_secret_name(pcs_name)
+            },
         )
     )
 
@@ -531,7 +617,7 @@ def _build_pods(
         spec.hostname = naming.pod_hostname(fqn, idx)
         spec.subdomain = headless_service
         if startup_args is not None:
-            _inject_initc(spec, startup_args)
+            _inject_initc(spec, startup_args, pcs.metadata.name)
         pods.append(
             Pod(
                 name=naming.pod_name(fqn, rng),

@@ -35,10 +35,23 @@ class Cluster:
     scaling_groups: dict[str, PodCliqueScalingGroup] = field(default_factory=dict)
     podgangs: dict[str, PodGang] = field(default_factory=dict)
     pods: dict[str, Pod] = field(default_factory=dict)
-    headless_services: set[str] = field(default_factory=set)
+    # Managed auxiliary resource objects (api/resources.py; the reference's
+    # ordered component kinds, podcliqueset/reconcilespec.go:206-221).
+    services: dict[str, object] = field(default_factory=dict)  # HeadlessService
+    hpas: dict[str, object] = field(default_factory=dict)  # HorizontalPodAutoscaler
+    service_accounts: dict[str, object] = field(default_factory=dict)
+    roles: dict[str, object] = field(default_factory=dict)
+    role_bindings: dict[str, object] = field(default_factory=dict)
+    secrets: dict[str, object] = field(default_factory=dict)  # TokenSecret
     # HPA scale subresource values, keyed by target FQN (pclq or pcsg).
     scale_overrides: dict[str, int] = field(default_factory=dict)
     events: list[tuple[float, str, str]] = field(default_factory=list)  # (time, obj, msg)
+
+    @property
+    def headless_services(self) -> set[str]:
+        """Service-name view over the Service objects — one source of truth
+        (the dict); kept for the discovery-by-name callers."""
+        return {svc.name for svc in self.services.values()}
 
     # --- queries (componentutils analogs) ---------------------------------------
 
@@ -92,8 +105,16 @@ class Cluster:
             self.scaling_groups.pop(g, None)
         for g in [g.name for g in self.gangs_of_pcs(pcs_name)]:
             self.podgangs.pop(g, None)
-        for svc in [s for s in self.headless_services if s.startswith(pcs_name + "-")]:
-            self.headless_services.discard(svc)
+        for coll in (
+            self.services,
+            self.hpas,
+            self.service_accounts,
+            self.roles,
+            self.role_bindings,
+            self.secrets,
+        ):
+            for name in [n for n, obj in coll.items() if getattr(obj, "pcs_name", None) == pcs_name]:
+                del coll[name]
         for key in [k for k in self.scale_overrides if k.startswith(pcs_name + "-")]:
             del self.scale_overrides[key]
 

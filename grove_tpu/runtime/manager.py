@@ -65,7 +65,11 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
 
             fqn = self.path[len("/api/v1/podcliques/"):]
             clique = self.manager.cluster.podcliques.get(fqn)
-            if clique is None:
+            # Auth first: an unauthenticated caller must not learn which
+            # clique FQNs exist (404 only after a valid credential).
+            if not self._authorized(clique):
+                self._respond(401, "unauthorized")
+            elif clique is None:
                 self._respond(404, "not found")
             else:
                 fetch = store_fetch(self.manager.cluster)
@@ -98,6 +102,32 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
                 self._respond(404, "profiling disabled")
         else:
             self._respond(404, "not found")
+
+    def _authorized(self, clique) -> bool:
+        """SA-token check (satokensecret component made real): when the
+        authorizer is on, the initc credential for the OWNING PCS must be
+        presented as a bearer token — the RBAC scope is per-PCS, so one
+        workload's token cannot read another's cliques. Unknown cliques
+        require SOME valid token (any PCS's) so existence isn't probeable
+        without a credential."""
+        if not self.manager.config.authorizer.enabled:
+            return True
+        import hmac
+
+        from grove_tpu.api import naming
+
+        auth = self.headers.get("Authorization", "")
+        if clique is None:
+            return any(
+                hmac.compare_digest(auth, f"Bearer {s.token}")
+                for s in self.manager.cluster.secrets.values()
+            )
+        secret = self.manager.cluster.secrets.get(
+            naming.initc_sa_token_secret_name(clique.pcs_name)
+        )
+        if secret is None:
+            return False
+        return hmac.compare_digest(auth, f"Bearer {secret.token}")
 
     def _respond(self, code: int, body: str, ctype: str = "text/plain"):
         data = body.encode()

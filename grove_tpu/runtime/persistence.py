@@ -17,6 +17,7 @@ from typing import Optional
 
 from grove_tpu.api import pod as pod_mod
 from grove_tpu.api import podgang as podgang_mod
+from grove_tpu.api import resources as resources_mod
 from grove_tpu.api import types as types_mod
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.state import cluster as state_mod
@@ -25,7 +26,7 @@ from grove_tpu.utils.fsio import atomic_write_json
 
 SCHEMA_VERSION = 1
 
-for _m in (types_mod, pod_mod, podgang_mod, state_mod):
+for _m in (types_mod, pod_mod, podgang_mod, state_mod, resources_mod):
     serde.register_module(_m)
 
 # The store fields that constitute durable control-plane state. `events` is
@@ -38,7 +39,12 @@ _STATE_FIELDS = (
     "scaling_groups",
     "podgangs",
     "pods",
-    "headless_services",
+    "services",
+    "hpas",
+    "service_accounts",
+    "roles",
+    "role_bindings",
+    "secrets",  # token material IS control-plane state (long-lived SA tokens)
     "scale_overrides",
 )
 

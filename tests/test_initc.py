@@ -116,10 +116,16 @@ def test_expansion_injects_initc_container():
     worker_pods = [p for p in ds.pods if "workers" in p.pclq_fqn]
     leader_pods = [p for p in ds.pods if "leader" in p.pclq_fqn]
     assert worker_pods and leader_pods
+    from grove_tpu.orchestrator.expansion import INITC_TOKEN_MOUNT
+
     for p in worker_pods:
         initc = [c for c in p.spec.init_containers if c.name == INITC_CONTAINER_NAME]
         assert len(initc) == 1
-        assert initc[0].args == ["--podcliques=ordered-0-leader:1"]
+        assert initc[0].args == [
+            "--podcliques=ordered-0-leader:1",
+            f"--token-file={INITC_TOKEN_MOUNT}",
+        ]
+        assert initc[0].env["GROVE_SA_TOKEN_SECRET"]
     for p in leader_pods:  # first clique: no parents, no agent
         assert not any(
             c.name == INITC_CONTAINER_NAME for c in p.spec.init_containers

@@ -1,0 +1,174 @@
+"""Auxiliary managed resources: Service/HPA/RBAC/SA-token objects
+(round-2 §2 rows "service component partial", "hpa partial", "rbac/
+satokensecret absent", "controller utils / managed-resource protection").
+
+Reference: ordered component kinds (podcliqueset/reconcilespec.go:206-221),
+service.go:137-155, hpa.go:130,249-259, serviceaccount/role/rolebinding/
+satokensecret components; the token is LIVE credential material the manager
+API verifies when the authorizer is on.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from grove_tpu.api import naming
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.runtime.config import parse_operator_config
+from grove_tpu.runtime.manager import Manager
+from grove_tpu.sim.workloads import aggregated_pcs, bench_topology
+
+
+def _ctrl():
+    c = Cluster()
+    return GroveController(cluster=c, topology=bench_topology()), c
+
+
+def test_sync_materializes_service_and_rbac_objects():
+    ctrl, c = _ctrl()
+    pcs = aggregated_pcs("agg")
+    pcs.spec.replicas = 2
+    c.podcliquesets["agg"] = pcs
+    ctrl.sync_workload(pcs, now=1.0)
+    # Per-replica headless Service objects with replica-scoped selectors.
+    assert set(c.services) == {
+        naming.headless_service_name("agg", 0),
+        naming.headless_service_name("agg", 1),
+    }
+    svc = c.services[naming.headless_service_name("agg", 0)]
+    assert svc.cluster_ip == "None" and svc.selector
+    # Per-PCS RBAC chain + token secret, reference-named.
+    assert naming.pod_service_account_name("agg") in c.service_accounts
+    assert naming.pod_role_name("agg") in c.roles
+    binding = c.role_bindings[naming.pod_role_binding_name("agg")]
+    assert binding.role_name == naming.pod_role_name("agg")
+    secret = c.secrets[naming.initc_sa_token_secret_name("agg")]
+    assert len(secret.token) == 32
+
+
+def test_scale_down_gcs_stale_services():
+    ctrl, c = _ctrl()
+    pcs = aggregated_pcs("agg")
+    pcs.spec.replicas = 2
+    c.podcliquesets["agg"] = pcs
+    ctrl.sync_workload(pcs, now=1.0)
+    pcs.spec.replicas = 1
+    ctrl.sync_workload(pcs, now=2.0)
+    assert set(c.services) == {naming.headless_service_name("agg", 0)}
+
+
+def test_token_survives_resync_and_cascade_deletes_all():
+    ctrl, c = _ctrl()
+    pcs = aggregated_pcs("agg")
+    c.podcliquesets["agg"] = pcs
+    ctrl.sync_workload(pcs, now=1.0)
+    token1 = c.secrets[naming.initc_sa_token_secret_name("agg")].token
+    ctrl.sync_workload(pcs, now=2.0)
+    assert c.secrets[naming.initc_sa_token_secret_name("agg")].token == token1
+    c.delete_pcs_cascade("agg")
+    assert not c.secrets and not c.services and not c.roles
+
+
+def test_hpa_objects_drive_autoscale():
+    """The autoscale pass consumes HPA OBJECTS (hpa.go analog), not template
+    configs directly."""
+    from grove_tpu.api.types import AutoScalingConfig
+
+    ctrl, c = _ctrl()
+    pcs = aggregated_pcs("agg")
+    # Attach a scale config to the PCSG (min from replicas, max 6).
+    cfg = pcs.spec.template.pod_clique_scaling_group_configs[0]
+    cfg.scale_config = AutoScalingConfig(max_replicas=6)
+    c.podcliquesets["agg"] = pcs
+    ctrl.sync_workload(pcs, now=1.0)
+    fqn = naming.scaling_group_name("agg", 0, cfg.name)
+    hpa = c.hpas[f"{fqn}-hpa"]
+    assert hpa.target_kind == "PodCliqueScalingGroup"
+    assert hpa.max_replicas == 6
+    # Ratio scaling: utilization 2.0 doubles replicas (capped at max).
+    ctrl.autoscale({fqn: 2.0}, now=2.0)
+    assert c.scale_overrides[fqn] == min(6, cfg.replicas * 2)
+    # Scale-to-min: utilization 0 collapses to minReplicas.
+    ctrl.autoscale({fqn: 0.0}, now=3.0)
+    assert c.scale_overrides[fqn] == hpa.min_replicas
+
+
+def test_manager_api_enforces_sa_token():
+    """With the authorizer on, the initc endpoint requires the owning PCS's
+    bearer token (RBAC made real, authorization/handler.go analog)."""
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": 0, "metricsPort": -1},
+            "authorizer": {"enabled": True},
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        pcs = aggregated_pcs("agg")
+        m.cluster.podcliquesets["agg"] = pcs
+        m.reconcile_once(now=1.0)
+        fqn = next(iter(m.cluster.podcliques))
+        url = f"http://127.0.0.1:{m.health_port}/api/v1/podcliques/{fqn}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)
+        assert ei.value.code == 401
+        token = m.cluster.secrets[naming.initc_sa_token_secret_name("agg")].token
+        req = urllib.request.Request(url)
+        req.add_header("Authorization", f"Bearer {token}")
+        assert urllib.request.urlopen(req).status == 200
+        # Wrong PCS's shape of token: rejected.
+        req2 = urllib.request.Request(url)
+        req2.add_header("Authorization", "Bearer deadbeef")
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            urllib.request.urlopen(req2)
+        assert ei2.value.code == 401
+    finally:
+        m.stop()
+
+
+def test_initc_binary_authenticates_with_token_file(tmp_path):
+    """End to end: the agent presents the SA token from a file (the secret
+    mount analog) against an authorizer-enabled manager."""
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": 0, "metricsPort": -1},
+            "authorizer": {"enabled": True},
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        pcs = aggregated_pcs("agg")
+        m.cluster.podcliquesets["agg"] = pcs
+        m.reconcile_once(now=1.0)
+        fqn = next(iter(m.cluster.podcliques))
+        for pod in m.cluster.pods.values():
+            if pod.pclq_fqn == fqn:
+                pod.ready = True
+        token = m.cluster.secrets[naming.initc_sa_token_secret_name("agg")].token
+        tf = tmp_path / "token"
+        tf.write_text(token + "\n")
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "grove_tpu.initc",
+                f"--podcliques={fqn}:1",
+                "--server", f"http://127.0.0.1:{m.health_port}",
+                "--token-file", str(tf),
+                "--poll-interval", "0.2",
+                "--timeout", "20",
+            ],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    finally:
+        m.stop()
