@@ -169,6 +169,9 @@ class Container:
     requests: dict[str, float] = field(default_factory=dict)  # base units
     limits: dict[str, float] = field(default_factory=dict)
     ports: list[int] = field(default_factory=list)
+    # [{"name": ..., "mountPath": ...}] — fulfilled by the node runtime
+    # against PodSpec.volumes (the kubelet contract).
+    volume_mounts: list[dict] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Container":
@@ -211,6 +214,9 @@ class PodSpec:
     subdomain: str = ""
     tolerations: list[dict] = field(default_factory=list)
     resource_claims: list[dict] = field(default_factory=list)  # MNNVL/ICI analog
+    # Declared volumes ([{"name": ..., "secret": {"secretName": ...}}, ...]);
+    # the runtime materializes them for the containers' volume_mounts.
+    volumes: list[dict] = field(default_factory=list)
 
     def total_requests(self) -> dict[str, float]:
         """Aggregate resource requests across containers (max with init containers)."""

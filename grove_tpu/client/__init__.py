@@ -1,0 +1,1 @@
+from grove_tpu.client.typed import FakeGroveClient, GroveApiError, GroveClient  # noqa: F401

@@ -1,0 +1,236 @@
+"""Deployment manifest rendering — the Helm-chart analog (L9).
+
+The reference ships a Helm chart (`operator/charts/templates/*.yaml`:
+deployment, services, RBAC, priorityclass, operator ConfigMap). This module
+renders the equivalent Kubernetes manifests for the TPU stack straight from
+a validated OperatorConfiguration, so one config file is both the runtime
+input and the deployment source of truth:
+
+    python -m grove_tpu.deploy --config examples/operator-config.yaml [--out dir]
+
+Rendered objects: Namespace, ConfigMap (the operator config, mounted at
+/etc/grove/config.yaml), operator ServiceAccount + minimal RBAC, Deployment
+(manager container; the scheduler-backend sidecar runs in-process when
+backend.enabled — GREP-375's sidecar model), and Services for the
+health/metrics/backend ports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import pathlib
+import sys
+
+import yaml
+
+from grove_tpu.runtime.config import OperatorConfiguration, load_operator_config
+
+APP = "grove-tpu-operator"
+IMAGE = "grove-tpu/operator:latest"
+
+
+def _labels() -> dict:
+    return {"app.kubernetes.io/name": APP, "app.kubernetes.io/managed-by": "grove-tpu"}
+
+
+def render_manifests(
+    cfg: OperatorConfiguration,
+    config_yaml: str,
+    *,
+    namespace: str = "grove-system",
+    image: str = IMAGE,
+    replicas: int | None = None,
+) -> list[dict]:
+    """OperatorConfiguration -> list of Kubernetes manifest documents."""
+    if replicas is None:
+        # HA needs leader election; without it a second replica would
+        # double-reconcile (charts run a single replica by default too).
+        replicas = 2 if cfg.leader_election.enabled else 1
+
+    ports = []
+    for name, port, enabled in (
+        ("health", cfg.servers.health_port, cfg.servers.health_port >= 0),
+        ("metrics", cfg.servers.metrics_port, cfg.servers.metrics_port >= 0),
+        ("backend", cfg.backend.port, cfg.backend.enabled),
+    ):
+        if not enabled:
+            continue
+        if port == 0:
+            # 0 = auto-assign, fine for local runs but unroutable in a
+            # manifest: probes and Services would point at a port the
+            # manager never binds. Fail loudly instead of rendering lies.
+            raise ValueError(
+                f"{name} port is 0 (auto-assign); set an explicit port in the "
+                "config before rendering deployment manifests"
+            )
+        ports.append({"name": name, "containerPort": port})
+
+    # Content-addressed ConfigMap: a config change renames the ConfigMap,
+    # which changes the pod template, which rolls the Deployment — the
+    # checksum-annotation pattern charts use, compatible with immutability.
+    config_hash = hashlib.sha256(config_yaml.encode()).hexdigest()[:8]
+    configmap_name = f"{APP}-config-{config_hash}"
+
+    docs: list[dict] = [
+        {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": namespace, "labels": _labels()},
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": configmap_name,
+                "namespace": namespace,
+                "labels": _labels(),
+            },
+            "immutable": True,  # safe: a config change renames the ConfigMap
+            "data": {"config.yaml": config_yaml},
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": APP, "namespace": namespace, "labels": _labels()},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "Role",
+            "metadata": {"name": APP, "namespace": namespace, "labels": _labels()},
+            "rules": [
+                {
+                    "apiGroups": [""],
+                    "resources": ["pods", "nodes", "services", "secrets"],
+                    "verbs": ["get", "list", "watch", "create", "update", "delete"],
+                },
+                {
+                    "apiGroups": ["coordination.k8s.io"],
+                    "resources": ["leases"],
+                    "verbs": ["get", "create", "update"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": APP, "namespace": namespace, "labels": _labels()},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "Role",
+                "name": APP,
+            },
+            "subjects": [
+                {"kind": "ServiceAccount", "name": APP, "namespace": namespace}
+            ],
+        },
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": APP, "namespace": namespace, "labels": _labels()},
+            "spec": {
+                "replicas": replicas,
+                "selector": {"matchLabels": {"app.kubernetes.io/name": APP}},
+                "template": {
+                    "metadata": {"labels": _labels()},
+                    "spec": {
+                        "serviceAccountName": APP,
+                        "containers": [
+                            {
+                                "name": "manager",
+                                "image": image,
+                                "command": [
+                                    "python",
+                                    "-m",
+                                    "grove_tpu.runtime",
+                                    "--config",
+                                    "/etc/grove/config.yaml",
+                                ],
+                                "ports": ports,
+                                "volumeMounts": [
+                                    {"name": "config", "mountPath": "/etc/grove"}
+                                ],
+                                **(
+                                    {
+                                        "readinessProbe": {
+                                            "httpGet": {
+                                                "path": "/readyz",
+                                                "port": "health",
+                                            }
+                                        },
+                                        "livenessProbe": {
+                                            "httpGet": {
+                                                "path": "/healthz",
+                                                "port": "health",
+                                            }
+                                        },
+                                    }
+                                    if cfg.servers.health_port >= 0
+                                    else {}
+                                ),
+                            }
+                        ],
+                        "volumes": [
+                            {
+                                "name": "config",
+                                "configMap": {"name": configmap_name},
+                            }
+                        ],
+                    },
+                },
+            },
+        },
+    ]
+    if ports:
+        docs.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {"name": APP, "namespace": namespace, "labels": _labels()},
+                "spec": {
+                    "selector": {"app.kubernetes.io/name": APP},
+                    "ports": [
+                        {
+                            "name": p["name"],
+                            "port": p["containerPort"],
+                            "targetPort": p["name"],
+                        }
+                        for p in ports
+                    ],
+                },
+            }
+        )
+    return docs
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="grove-tpu-deploy")
+    parser.add_argument("--config", required=True, help="operator config YAML")
+    parser.add_argument("--namespace", default="grove-system")
+    parser.add_argument("--image", default=IMAGE)
+    parser.add_argument("--out", default="", help="directory for per-doc files; default stdout")
+    args = parser.parse_args(argv)
+
+    try:
+        cfg = load_operator_config(args.config)
+        config_yaml = pathlib.Path(args.config).read_text()
+        docs = render_manifests(
+            cfg, config_yaml, namespace=args.namespace, image=args.image
+        )
+    except ValueError as e:
+        print(f"grove-tpu-deploy: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        out = pathlib.Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        for doc in docs:
+            name = f"{doc['kind'].lower()}-{doc['metadata']['name']}.yaml"
+            (out / name).write_text(yaml.safe_dump(doc, sort_keys=False))
+        print(f"wrote {len(docs)} manifests to {out}")
+    else:
+        print(yaml.safe_dump_all(docs, sort_keys=False))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

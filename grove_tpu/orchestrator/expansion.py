@@ -55,7 +55,6 @@ class DesiredState:
     """Everything one PodCliqueSet materializes into (the reference's ordered
     component kinds, podcliqueset/reconcilespec.go:206-221)."""
 
-    headless_services: list[str] = field(default_factory=list)
     podcliques: list[PodClique] = field(default_factory=list)
     scaling_groups: list[PodCliqueScalingGroup] = field(default_factory=list)
     podgangs: list[PodGang] = field(default_factory=list)
@@ -65,6 +64,11 @@ class DesiredState:
     services: list = field(default_factory=list)
     hpas: list = field(default_factory=list)
     rbac: list = field(default_factory=list)  # [sa, role, binding, secret]
+
+    @property
+    def headless_services(self) -> list[str]:
+        """Name view over the Service objects — one source of truth."""
+        return [svc.name for svc in self.services]
 
     def podgang(self, name: str) -> Optional[PodGang]:
         for g in self.podgangs:
@@ -195,7 +199,6 @@ def expand_podcliqueset(
 
     for i in range(pcs.spec.replicas):
         svc = naming.headless_service_name(pcs_name, i)
-        out.headless_services.append(svc)
         out.services.append(
             HeadlessService(
                 name=svc,
@@ -543,25 +546,34 @@ def initc_args(
 
 # Where the runtime mounts the PCS's SA token secret inside the pod (the
 # projected-token volume analog); the injected agent reads it from here.
-INITC_TOKEN_MOUNT = "/var/run/secrets/grove.io/sa-token/token"
+INITC_TOKEN_MOUNT_DIR = "/var/run/secrets/grove.io/sa-token"
+INITC_TOKEN_MOUNT = f"{INITC_TOKEN_MOUNT_DIR}/token"
+INITC_TOKEN_VOLUME = "grove-sa-token"
 
 
 def _inject_initc(spec, args: list[str], pcs_name: str) -> None:
     """Inject the startup-ordering init container (initcontainer.go:51,98-126);
     its args are exactly what the agent binary consumes (python -m
-    grove_tpu.initc), including --token-file pointing at the mounted SA token
-    secret (named in env for the runtime to mount)."""
+    grove_tpu.initc). The SA-token distribution is DECLARED in the pod spec
+    the way the reference declares it: a secret volume + mount the node
+    runtime fulfills (satokensecret component + projected volume); the agent
+    reads the mounted file via --token-file."""
     if any(c.name == INITC_CONTAINER_NAME for c in spec.init_containers):
         return
+    secret_name = naming.initc_sa_token_secret_name(pcs_name)
+    if not any(v.get("name") == INITC_TOKEN_VOLUME for v in spec.volumes):
+        spec.volumes.append(
+            {"name": INITC_TOKEN_VOLUME, "secret": {"secretName": secret_name}}
+        )
     spec.init_containers.append(
         Container(
             name=INITC_CONTAINER_NAME,
             image="grove-initc",
             command=["python", "-m", "grove_tpu.initc"],
             args=list(args) + [f"--token-file={INITC_TOKEN_MOUNT}"],
-            env={
-                "GROVE_SA_TOKEN_SECRET": naming.initc_sa_token_secret_name(pcs_name)
-            },
+            volume_mounts=[
+                {"name": INITC_TOKEN_VOLUME, "mountPath": INITC_TOKEN_MOUNT_DIR}
+            ],
         )
     )
 

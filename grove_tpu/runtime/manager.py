@@ -100,8 +100,105 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
                 self._respond(200, json.dumps(self.manager.profilez()), "application/json")
             else:
                 self._respond(404, "profiling disabled")
+        elif self.path.startswith("/api/v1/"):
+            # Same credential gate as the initc endpoint: with the authorizer
+            # on, the WHOLE object API requires a valid workload token (the
+            # apiserver-authn analog) — otherwise pod names would leak the
+            # clique FQNs the 401-before-404 design protects.
+            if not self._authorized(None):
+                self._respond(401, "unauthorized")
+            else:
+                self._api_get(self.path[len("/api/v1/"):])
         else:
             self._respond(404, "not found")
+
+    # ---- object API (typed-client surface; generated-clientset analog) ----------
+
+    _COLLECTIONS = {
+        "podcliquesets": "podcliquesets",
+        "podgangs": "podgangs",
+        "pods": "pods",
+        "nodes": "nodes",
+        "services": "services",
+        "hpas": "hpas",
+        "events": None,  # special-cased
+    }
+
+    def _api_get(self, rest: str) -> None:
+        from grove_tpu.utils import serde
+
+        parts = [p for p in rest.split("/") if p]
+        if not parts or parts[0] not in self._COLLECTIONS:
+            self._respond(404, "not found")
+            return
+        kind = parts[0]
+        c = self.manager.cluster
+        if kind == "events":
+            self._respond(
+                200,
+                json.dumps([list(e) for e in c.events[-200:]]),
+                "application/json",
+            )
+            return
+        coll = getattr(c, self._COLLECTIONS[kind])
+        if len(parts) == 1:
+            self._respond(200, json.dumps(sorted(coll)), "application/json")
+            return
+        obj = coll.get("/".join(parts[1:]))
+        if obj is None:
+            self._respond(404, "not found")
+            return
+        self._respond(200, json.dumps(serde.encode(obj)), "application/json")
+
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        """Apply a PodCliqueSet through the admission chain (kubectl-apply
+        analog). Body: YAML or JSON PCS document."""
+        if self.path != "/api/v1/podcliquesets":
+            self._respond(404, "not found")
+            return
+        if not self._authorized(None):
+            self._respond(401, "unauthorized")
+            return
+        import yaml as _yaml
+
+        from grove_tpu.api.admission import AdmissionError
+        from grove_tpu.api.types import PodCliqueSet
+
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length).decode()
+        actor = self.headers.get("X-Grove-Actor", "user")
+        try:
+            doc = _yaml.safe_load(body)
+            pcs = self.manager.apply_podcliqueset(
+                PodCliqueSet.from_dict(doc), actor=actor
+            )
+        except AdmissionError as e:
+            self._respond(
+                422,
+                json.dumps({"errors": [str(x) for x in e.errors]}),
+                "application/json",
+            )
+            return
+        except Exception as e:  # malformed body is a client error, not a crash
+            self._respond(400, json.dumps({"errors": [str(e)]}), "application/json")
+            return
+        self._respond(200, json.dumps({"name": pcs.metadata.name}), "application/json")
+
+    def do_DELETE(self):  # noqa: N802 (stdlib API)
+        prefix = "/api/v1/podcliquesets/"
+        if not self.path.startswith(prefix):
+            self._respond(404, "not found")
+            return
+        if not self._authorized(None):
+            self._respond(401, "unauthorized")
+            return
+        name = self.path[len(prefix):]
+        actor = self.headers.get("X-Grove-Actor", "user")
+        if name not in self.manager.cluster.podcliquesets:
+            self._respond(404, "not found")
+            return
+        self.manager.delete_podcliqueset(name, actor=actor)
+        self._respond(200, json.dumps({"deleted": name}), "application/json")
 
     def _authorized(self, clique) -> bool:
         """SA-token check (satokensecret component made real): when the

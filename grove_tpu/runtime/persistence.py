@@ -24,7 +24,9 @@ from grove_tpu.state import cluster as state_mod
 from grove_tpu.utils import serde
 from grove_tpu.utils.fsio import atomic_write_json
 
-SCHEMA_VERSION = 1
+# v2: headless_services (derived set) replaced by typed aux-resource
+# collections (services/hpas/service_accounts/roles/role_bindings/secrets).
+SCHEMA_VERSION = 2
 
 for _m in (types_mod, pod_mod, podgang_mod, state_mod, resources_mod):
     serde.register_module(_m)
@@ -57,11 +59,16 @@ def dump_cluster(cluster: Cluster) -> dict:
 
 
 def load_cluster(doc: dict, into: Optional[Cluster] = None) -> Cluster:
-    if doc.get("schema") != SCHEMA_VERSION:
-        raise ValueError(f"state schema {doc.get('schema')} != {SCHEMA_VERSION}")
+    schema = doc.get("schema")
+    if schema not in (1, SCHEMA_VERSION):
+        raise ValueError(f"state schema {schema} not in (1, {SCHEMA_VERSION})")
     cluster = into if into is not None else Cluster()
     for f in _STATE_FIELDS:
         setattr(cluster, f, serde.decode(doc.get(f) or type(getattr(cluster, f))()))
+    # v1 migration: aux-resource collections did not exist (loaded empty
+    # above); the next sync_workload re-materializes them — including FRESH
+    # SA tokens, so in-flight agents holding old credentials re-auth via
+    # their next mount read, not via this restore.
     return cluster
 
 

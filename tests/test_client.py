@@ -1,0 +1,126 @@
+"""Typed clients (generated-clientset analog, round-2 §2 'Generated clients:
+absent'): HTTP GroveClient over the manager object API + in-process fake with
+the same surface.
+"""
+
+from __future__ import annotations
+
+import pytest
+import yaml
+
+from grove_tpu.client import FakeGroveClient, GroveApiError, GroveClient
+from grove_tpu.runtime.config import parse_operator_config
+from grove_tpu.runtime.manager import Manager
+from grove_tpu.sim.workloads import bench_topology, synthetic_cluster
+
+SIMPLE = """
+metadata: {name: cl}
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: web
+        spec:
+          roleName: web
+          replicas: 2
+          podSpec:
+            containers:
+              - name: c
+                resources: {requests: {cpu: "1", memory: 1Gi}}
+"""
+
+
+def _manager():
+    cfg, errors = parse_operator_config(
+        {"servers": {"healthPort": 0, "metricsPort": -1}}
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.controller.topology = bench_topology()
+    m.topology = m.controller.topology
+    for n in synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=1,
+                               hosts_per_rack=6):
+        m.cluster.nodes[n.name] = n
+    m.start()
+    return m
+
+
+@pytest.fixture
+def served():
+    m = _manager()
+    yield m, GroveClient(f"http://127.0.0.1:{m.health_port}")
+    m.stop()
+
+
+def test_apply_list_get_delete_roundtrip(served):
+    m, client = served
+    name = client.apply_podcliqueset(SIMPLE)
+    assert name == "cl"
+    m.reconcile_once(now=1.0)
+    assert client.list_podcliquesets() == ["cl"]
+    pcs = client.get_podcliqueset("cl")
+    assert pcs.spec.template.cliques[0].name == "web"
+    assert pcs.spec.template.cliques[0].spec.min_available >= 1  # defaulted
+    gangs = client.list_podgangs()
+    assert gangs and all(g.startswith("cl-") for g in gangs)
+    gang = client.get_podgang(gangs[0])
+    assert gang.spec.pod_groups
+    pods = client.list_pods()
+    assert len(pods) == 2
+    pod = client.get_pod(pods[0])
+    assert pod.pclq_fqn == "cl-0-web"
+    assert client.list_services() == ["cl-0"]
+    assert len(client.list_nodes()) == 6
+    assert any("created pod" in msg for _, _, msg in client.events())
+    client.delete_podcliqueset("cl")
+    assert client.list_podcliquesets() == []
+
+
+def test_apply_rejects_invalid_through_admission(served):
+    _, client = served
+    bad = yaml.safe_load(SIMPLE)
+    bad["spec"]["template"]["cliques"][0]["spec"]["minAvailable"] = 99
+    with pytest.raises(GroveApiError) as ei:
+        client.apply_podcliqueset(bad)
+    assert ei.value.status == 422
+    assert any("minAvailable" in e for e in ei.value.errors)
+
+
+def test_get_missing_is_404(served):
+    _, client = served
+    with pytest.raises(GroveApiError) as ei:
+        client.get_podcliqueset("ghost")
+    assert ei.value.status == 404
+
+
+def test_fake_client_same_surface():
+    m = _manager()
+    try:
+        fake = FakeGroveClient(m)
+        assert fake.apply_podcliqueset(SIMPLE) == "cl"
+        m.reconcile_once(now=1.0)
+        assert fake.list_podcliquesets() == ["cl"]
+        assert fake.get_pod(fake.list_pods()[0]).pclq_fqn == "cl-0-web"
+        with pytest.raises(GroveApiError):
+            fake.get_podgang("nope")
+        bad = yaml.safe_load(SIMPLE)
+        bad["spec"]["template"]["cliques"][0]["spec"]["replicas"] = 0
+        with pytest.raises(GroveApiError) as ei:
+            fake.apply_podcliqueset(bad)
+        assert ei.value.status == 422
+        fake.delete_podcliqueset("cl")
+        assert fake.list_podcliquesets() == []
+    finally:
+        m.stop()
+
+
+def test_http_and_fake_agree(served):
+    m, http_client = served
+    fake = FakeGroveClient(m)
+    http_client.apply_podcliqueset(SIMPLE)
+    m.reconcile_once(now=1.0)
+    assert http_client.list_pods() == fake.list_pods()
+    assert http_client.list_podgangs() == fake.list_podgangs()
+    a = http_client.get_podcliqueset("cl")
+    b = fake.get_podcliqueset("cl")
+    assert a.spec.replicas == b.spec.replicas

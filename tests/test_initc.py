@@ -116,7 +116,11 @@ def test_expansion_injects_initc_container():
     worker_pods = [p for p in ds.pods if "workers" in p.pclq_fqn]
     leader_pods = [p for p in ds.pods if "leader" in p.pclq_fqn]
     assert worker_pods and leader_pods
-    from grove_tpu.orchestrator.expansion import INITC_TOKEN_MOUNT
+    from grove_tpu.orchestrator.expansion import (
+        INITC_TOKEN_MOUNT,
+        INITC_TOKEN_MOUNT_DIR,
+        INITC_TOKEN_VOLUME,
+    )
 
     for p in worker_pods:
         initc = [c for c in p.spec.init_containers if c.name == INITC_CONTAINER_NAME]
@@ -125,7 +129,13 @@ def test_expansion_injects_initc_container():
             "--podcliques=ordered-0-leader:1",
             f"--token-file={INITC_TOKEN_MOUNT}",
         ]
-        assert initc[0].env["GROVE_SA_TOKEN_SECRET"]
+        # Token distribution is DECLARED in the pod spec: secret volume +
+        # mount the node runtime fulfills (the projected-token analog).
+        assert initc[0].volume_mounts == [
+            {"name": INITC_TOKEN_VOLUME, "mountPath": INITC_TOKEN_MOUNT_DIR}
+        ]
+        vol = next(v for v in p.spec.volumes if v["name"] == INITC_TOKEN_VOLUME)
+        assert vol["secret"]["secretName"].startswith("ordered")
     for p in leader_pods:  # first clique: no parents, no agent
         assert not any(
             c.name == INITC_CONTAINER_NAME for c in p.spec.init_containers
