@@ -43,12 +43,32 @@ class GroveApiError(Exception):
 
 
 class GroveClient:
-    """HTTP typed client (apiserver-analog surface)."""
+    """HTTP(S) typed client (apiserver-analog surface).
 
-    def __init__(self, base_url: str, actor: str = "user", timeout_s: float = 10.0):
+    `cafile` pins the manager's serving cert (the auto-mode self-signed cert
+    doubles as the CA bundle: <tlsCertDir>/tls.crt); `token` is the bearer
+    credential for authorizer-enabled managers."""
+
+    def __init__(
+        self,
+        base_url: str,
+        actor: str = "user",
+        timeout_s: float = 10.0,
+        cafile: str | None = None,
+        token: str | None = None,
+    ):
         self.base_url = base_url.rstrip("/")
         self.actor = actor
         self.timeout_s = timeout_s
+        self.token = token
+        self._ssl_ctx = None
+        if cafile is not None:
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context(cafile=cafile)
+            # Self-signed serving certs carry CN, not necessarily the client's
+            # chosen host string; the pin IS the trust anchor.
+            self._ssl_ctx.check_hostname = False
 
     # -- transport ------------------------------------------------------------------
 
@@ -57,8 +77,12 @@ class GroveClient:
             f"{self.base_url}{path}", data=body, method=method
         )
         req.add_header("X-Grove-Actor", self.actor)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout_s, context=self._ssl_ctx
+            ) as resp:
                 return json.loads(resp.read() or b"null")
         except urllib.error.HTTPError as e:
             try:

@@ -66,6 +66,10 @@ def render_manifests(
             )
         ports.append({"name": name, "containerPort": port})
 
+    # TLS-enabled managers serve HTTPS on every port; probes must say so or
+    # the kubelet handshakes plaintext and the pod never goes Ready.
+    probe_scheme = {"scheme": "HTTPS"} if cfg.servers.tls_mode != "disabled" else {}
+
     # Content-addressed ConfigMap: a config change renames the ConfigMap,
     # which changes the pod template, which rolls the Deployment — the
     # checksum-annotation pattern charts use, compatible with immutability.
@@ -156,12 +160,14 @@ def render_manifests(
                                             "httpGet": {
                                                 "path": "/readyz",
                                                 "port": "health",
+                                                **probe_scheme,
                                             }
                                         },
                                         "livenessProbe": {
                                             "httpGet": {
                                                 "path": "/healthz",
                                                 "port": "health",
+                                                **probe_scheme,
                                             }
                                         },
                                     }

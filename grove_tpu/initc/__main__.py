@@ -32,6 +32,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--token-file", default="", help="file holding the SA token (mount analog)"
     )
+    parser.add_argument(
+        "--cafile", default="", help="CA bundle pinning an https manager's cert"
+    )
     args = parser.parse_args(argv)
     token = args.token
     if args.token_file:
@@ -57,7 +60,7 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         ok = wait_until_ready(
-            http_fetch(args.server, token=token or None),
+            http_fetch(args.server, token=token or None, cafile=args.cafile or None),
             reqs,
             timeout_s=args.timeout,
             poll_interval_s=args.poll_interval,

@@ -93,10 +93,23 @@ def wait_until_ready(
         sleep(poll_interval_s)
 
 
-def http_fetch(server: str, timeout_s: float = 5.0, token: str | None = None) -> FetchFn:
-    """Poll the manager's HTTP API (the apiserver analog). `token` is the
+def http_fetch(
+    server: str,
+    timeout_s: float = 5.0,
+    token: str | None = None,
+    cafile: str | None = None,
+) -> FetchFn:
+    """Poll the manager's HTTP(S) API (the apiserver analog). `token` is the
     per-PCS SA token (api/resources.TokenSecret) sent as a bearer credential
-    — required when the manager runs with the authorizer enabled."""
+    — required when the manager runs with the authorizer enabled. `cafile`
+    pins the manager's serving cert for https servers (tls auto mode's
+    self-signed cert doubles as the CA bundle)."""
+    ssl_ctx = None
+    if cafile is not None:
+        import ssl
+
+        ssl_ctx = ssl.create_default_context(cafile=cafile)
+        ssl_ctx.check_hostname = False  # the pin is the trust anchor
 
     def fetch(fqn: str) -> tuple[int, bool]:
         url = f"{server.rstrip('/')}/api/v1/podcliques/{fqn}"
@@ -104,7 +117,7 @@ def http_fetch(server: str, timeout_s: float = 5.0, token: str | None = None) ->
         if token:
             req.add_header("Authorization", f"Bearer {token}")
         try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            with urllib.request.urlopen(req, timeout=timeout_s, context=ssl_ctx) as resp:
                 doc = json.loads(resp.read())
         except urllib.error.HTTPError as e:
             if e.code in (401, 403):

@@ -42,6 +42,12 @@ class ServerConfig:
     health_port: int = 2751
     metrics_port: int = 2752
     profiling_enabled: bool = False  # pprof analog (manager.go:42-44)
+    # TLS for the HTTP surface (cert mode auto/manual, types.go:154-169):
+    # disabled | auto (self-signed into tlsCertDir) | manual (provided files).
+    tls_mode: str = "disabled"
+    tls_cert_dir: str = "/tmp/grove-tpu-certs"
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
 
 
 @dataclass
@@ -164,6 +170,10 @@ _CAMEL_FIELDS = {
     "healthPort": "health_port",
     "metricsPort": "metrics_port",
     "profilingEnabled": "profiling_enabled",
+    "tlsMode": "tls_mode",
+    "tlsCertDir": "tls_cert_dir",
+    "tlsCertFile": "tls_cert_file",
+    "tlsKeyFile": "tls_key_file",
     "concurrentSyncs": "concurrent_syncs",
     "reconcileIntervalSeconds": "reconcile_interval_seconds",
     "exemptActors": "exempt_actors",
@@ -241,6 +251,14 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
                 "controllers.reconcileIntervalSeconds (renewal happens once "
                 "per reconcile cycle)"
             )
+    if cfg.servers.tls_mode not in ("disabled", "auto", "manual"):
+        errors.append(
+            f"servers.tlsMode: {cfg.servers.tls_mode!r} not in disabled|auto|manual"
+        )
+    if cfg.servers.tls_mode == "manual" and not (
+        cfg.servers.tls_cert_file and cfg.servers.tls_key_file
+    ):
+        errors.append("servers.tlsCertFile/tlsKeyFile: required for tlsMode manual")
     for port_name, port in (
         ("servers.healthPort", cfg.servers.health_port),
         ("servers.metricsPort", cfg.servers.metrics_port),

@@ -277,6 +277,7 @@ class Manager:
         self._next_requeue: Optional[float] = None
         self.persistence = None  # wired by start() when enabled
         self.metrics_port: Optional[int] = None
+        self._tls_paths: Optional[tuple[str, str]] = None  # (cert, key) once ensured
         # /profilez state: per-step cumulative seconds + call counts.
         self._profile: dict[str, dict[str, float]] = {}
         # Watch driver (cluster integration path): attached via attach_watch;
@@ -414,8 +415,35 @@ class Manager:
         )
 
     def _serve_http(self, port: int) -> int:
+        cfg = self.config.servers
+        ctx = None
+        if cfg.tls_mode != "disabled":
+            # Cert management (cert.go:46-98 analog): certs are ensured
+            # BEFORE the port binds — a CertError fails the boot without
+            # leaking a bound socket, and nothing ever serves plaintext.
+            import ssl
+
+            from grove_tpu.runtime.certs import ensure_serving_certs
+
+            if self._tls_paths is None:
+                self._tls_paths = ensure_serving_certs(
+                    cfg.tls_mode,
+                    cfg.tls_cert_dir,
+                    cert_file=cfg.tls_cert_file,
+                    key_file=cfg.tls_key_file,
+                )
+            cert, key = self._tls_paths
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert, key)
         handler = type("Handler", (_ProbeHandler,), {"manager": self})
         server = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+        if ctx is not None:
+            # Handshake lazily in the per-connection handler thread
+            # (do_handshake_on_connect=False): a slow client must not park
+            # the accept loop and starve /healthz for everyone else.
+            server.socket = ctx.wrap_socket(
+                server.socket, server_side=True, do_handshake_on_connect=False
+            )
         self._http_servers.append(server)
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()

@@ -1,0 +1,86 @@
+"""Cert management (round-2 §2 'Cert management: absent'): auto self-signed
+generation + manual mode for the manager's HTTP surface
+(internal/controller/cert/cert.go:46-98 analog).
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.request
+
+import pytest
+
+from grove_tpu.runtime.certs import CertError, ensure_serving_certs
+from grove_tpu.runtime.config import parse_operator_config
+from grove_tpu.runtime.manager import Manager
+
+
+def test_auto_mode_generates_and_reuses(tmp_path):
+    cert, key = ensure_serving_certs("auto", str(tmp_path / "certs"))
+    assert cert.endswith("tls.crt") and key.endswith("tls.key")
+    mtime = (tmp_path / "certs" / "tls.crt").stat().st_mtime_ns
+    cert2, _ = ensure_serving_certs("auto", str(tmp_path / "certs"))
+    assert cert2 == cert
+    assert (tmp_path / "certs" / "tls.crt").stat().st_mtime_ns == mtime  # reused
+
+
+def test_manual_mode_requires_files(tmp_path):
+    with pytest.raises(CertError):
+        ensure_serving_certs("manual", "", cert_file=str(tmp_path / "no.crt"),
+                             key_file=str(tmp_path / "no.key"))
+    cert, key = ensure_serving_certs("auto", str(tmp_path / "gen"))
+    c2, k2 = ensure_serving_certs("manual", "", cert_file=cert, key_file=key)
+    assert (c2, k2) == (cert, key)
+
+
+def test_config_validates_tls_mode():
+    _, errors = parse_operator_config({"servers": {"tlsMode": "sideways"}})
+    assert any("tlsMode" in e for e in errors)
+    _, errors = parse_operator_config({"servers": {"tlsMode": "manual"}})
+    assert any("tlsCertFile" in e for e in errors)
+
+
+def test_manager_serves_https_with_pinned_self_signed_cert(tmp_path):
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {
+                "healthPort": 0,
+                "metricsPort": -1,
+                "tlsMode": "auto",
+                "tlsCertDir": str(tmp_path / "certs"),
+            }
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        # Client pins the generated self-signed cert as its CA bundle.
+        ctx = ssl.create_default_context(cafile=str(tmp_path / "certs" / "tls.crt"))
+        url = f"https://127.0.0.1:{m.health_port}/statusz"
+        doc = json.loads(urllib.request.urlopen(url, context=ctx).read())
+        assert doc["leader"] is True
+        # Plain HTTP against the TLS port fails (no accidental plaintext).
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{m.health_port}/healthz", timeout=3
+            )
+        # The typed client pins the same cert and works end-to-end.
+        from grove_tpu.client import GroveClient
+
+        client = GroveClient(
+            f"https://127.0.0.1:{m.health_port}",
+            cafile=str(tmp_path / "certs" / "tls.crt"),
+        )
+        assert client.list_podcliquesets() == []
+        # ...and the initc fetch path does too.
+        from grove_tpu.initc.agent import http_fetch
+
+        fetch = http_fetch(
+            f"https://127.0.0.1:{m.health_port}",
+            cafile=str(tmp_path / "certs" / "tls.crt"),
+        )
+        assert fetch("nonexistent") == (0, False)
+    finally:
+        m.stop()
