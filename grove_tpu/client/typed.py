@@ -63,12 +63,9 @@ class GroveClient:
         self.token = token
         self._ssl_ctx = None
         if cafile is not None:
-            import ssl
+            from grove_tpu.runtime.certs import pinned_client_context
 
-            self._ssl_ctx = ssl.create_default_context(cafile=cafile)
-            # Self-signed serving certs carry CN, not necessarily the client's
-            # chosen host string; the pin IS the trust anchor.
-            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx = pinned_client_context(cafile)
 
     # -- transport ------------------------------------------------------------------
 

@@ -48,6 +48,13 @@ def render_manifests(
         # double-reconcile (charts run a single replica by default too).
         replicas = 2 if cfg.leader_election.enabled else 1
 
+    if cfg.servers.bind_address.startswith("127."):
+        # Probes and Services reach the POD IP; a loopback bind would render
+        # manifests whose probes can never connect.
+        raise ValueError(
+            "servers.bindAddress is loopback; set 0.0.0.0 (or a pod-routable "
+            "address) before rendering deployment manifests"
+        )
     ports = []
     for name, port, enabled in (
         ("health", cfg.servers.health_port, cfg.servers.health_port >= 0),
@@ -69,6 +76,26 @@ def render_manifests(
     # TLS-enabled managers serve HTTPS on every port; probes must say so or
     # the kubelet handshakes plaintext and the pod never goes Ready.
     probe_scheme = {"scheme": "HTTPS"} if cfg.servers.tls_mode != "disabled" else {}
+    # Manual TLS: the cert/key must arrive via a Secret volume; require paths
+    # under the mount so the rendered pod can actually read them.
+    TLS_MOUNT = "/etc/grove/tls"
+    TLS_SECRET = f"{APP}-tls"
+    extra_volumes: list[dict] = []
+    extra_mounts: list[dict] = []
+    if cfg.servers.tls_mode == "manual":
+        for label, path in (
+            ("tlsCertFile", cfg.servers.tls_cert_file),
+            ("tlsKeyFile", cfg.servers.tls_key_file),
+        ):
+            if not path.startswith(TLS_MOUNT + "/"):
+                raise ValueError(
+                    f"servers.{label} must live under {TLS_MOUNT} (delivered by "
+                    f"Secret {TLS_SECRET!r}) for deployment rendering; got {path!r}"
+                )
+        extra_volumes.append(
+            {"name": "tls", "secret": {"secretName": TLS_SECRET}}
+        )
+        extra_mounts.append({"name": "tls", "mountPath": TLS_MOUNT, "readOnly": True})
 
     # Content-addressed ConfigMap: a config change renames the ConfigMap,
     # which changes the pod template, which rolls the Deployment — the
@@ -153,7 +180,7 @@ def render_manifests(
                                 "ports": ports,
                                 "volumeMounts": [
                                     {"name": "config", "mountPath": "/etc/grove"}
-                                ],
+                                ] + extra_mounts,
                                 **(
                                     {
                                         "readinessProbe": {
@@ -181,7 +208,7 @@ def render_manifests(
                                 "name": "config",
                                 "configMap": {"name": configmap_name},
                             }
-                        ],
+                        ] + extra_volumes,
                     },
                 },
             },

@@ -106,10 +106,9 @@ def http_fetch(
     self-signed cert doubles as the CA bundle)."""
     ssl_ctx = None
     if cafile is not None:
-        import ssl
+        from grove_tpu.runtime.certs import pinned_client_context
 
-        ssl_ctx = ssl.create_default_context(cafile=cafile)
-        ssl_ctx.check_hostname = False  # the pin is the trust anchor
+        ssl_ctx = pinned_client_context(cafile)
 
     def fetch(fqn: str) -> tuple[int, bool]:
         url = f"{server.rstrip('/')}/api/v1/podcliques/{fqn}"

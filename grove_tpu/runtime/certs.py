@@ -63,21 +63,36 @@ def ensure_serving_certs(
     if cert.is_file() and key.is_file() and _still_valid(cert, days):
         os.chmod(key, 0o600)
         return str(cert), str(key)
-    proc = subprocess.run(
-        [
-            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-            "-keyout", str(key), "-out", str(cert),
-            "-days", str(days),
-            "-subj", f"/CN={common_name}",
-            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
-        ],
-        capture_output=True,
-        text=True,
-    )
+    try:
+        proc = subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", str(key), "-out", str(cert),
+                "-days", str(days),
+                "-subj", f"/CN={common_name}",
+                "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+            ],
+            capture_output=True,
+            text=True,
+        )
+    except OSError as e:  # openssl missing: keep the CertError boot contract
+        raise CertError(f"cannot run openssl: {e}") from e
     if proc.returncode != 0:
         raise CertError(f"self-signed cert generation failed: {proc.stderr.strip()}")
     os.chmod(key, 0o600)
     return str(cert), str(key)
+
+
+def pinned_client_context(cafile: str):
+    """ssl context trusting exactly the pinned serving cert (auto mode's
+    self-signed cert doubles as the CA bundle). Hostname checking is off —
+    the pin itself is the trust anchor. The ONE place the client-side TLS
+    policy lives (GroveClient and the initc agent both use it)."""
+    import ssl
+
+    ctx = ssl.create_default_context(cafile=cafile)
+    ctx.check_hostname = False
+    return ctx
 
 
 def _still_valid(cert: pathlib.Path, days: int) -> bool:

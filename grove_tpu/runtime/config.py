@@ -39,6 +39,7 @@ class LeaderElectionConfig:
 class ServerConfig:
     """Bind addresses (types.go:120-151). Port 0 = auto-assign, -1 = disabled."""
 
+    bind_address: str = "127.0.0.1"  # 0.0.0.0 for in-cluster deployments
     health_port: int = 2751
     metrics_port: int = 2752
     profiling_enabled: bool = False  # pprof analog (manager.go:42-44)
@@ -167,6 +168,7 @@ _CAMEL_FIELDS = {
     "leaseDurationSeconds": "lease_duration_seconds",
     "renewDeadlineSeconds": "renew_deadline_seconds",
     "retryPeriodSeconds": "retry_period_seconds",
+    "bindAddress": "bind_address",
     "healthPort": "health_port",
     "metricsPort": "metrics_port",
     "profilingEnabled": "profiling_enabled",

@@ -436,7 +436,7 @@ class Manager:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(cert, key)
         handler = type("Handler", (_ProbeHandler,), {"manager": self})
-        server = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+        server = http.server.ThreadingHTTPServer((cfg.bind_address, port), handler)
         if ctx is not None:
             # Handshake lazily in the per-connection handler thread
             # (do_handshake_on_connect=False): a slow client must not park

@@ -251,3 +251,36 @@ def test_lease_without_deadline_keeps_renewing(tmp_path):
     lease = FileLease(path=str(tmp_path / "l.lease"), lease_duration_seconds=15.0)
     assert lease.try_acquire(now=0.0)
     assert lease.try_acquire(now=12.0)  # no deadline: still leader
+
+
+def test_two_managers_one_lease_ha_takeover(tmp_path):
+    """HA semantics (types.go:73-104): two managers share a lease file; only
+    one reconciles; when the leader releases, the standby takes over."""
+    def mgr():
+        m = _mgr(
+            tmp_path,
+            {
+                "leaderElection": {
+                    "enabled": True,
+                    "leaseFile": str(tmp_path / "ha.lease"),
+                    "leaseDurationSeconds": 15.0,
+                }
+            },
+        )
+        m.start()
+        return m
+
+    a = mgr()
+    b = mgr()
+    try:
+        assert a._is_leader != b._is_leader, "exactly one leader"
+        leader, standby = (a, b) if a._is_leader else (b, a)
+        assert leader._is_leader and not standby._is_leader
+        # Standby keeps failing to acquire while the leader renews.
+        assert not standby._lease.try_acquire()
+        # Leader stands down (release): the standby acquires.
+        leader._lease.release()
+        assert standby._lease.try_acquire()
+    finally:
+        a.stop()
+        b.stop()

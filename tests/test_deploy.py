@@ -13,6 +13,7 @@ from grove_tpu.runtime.config import parse_operator_config
 
 
 def _render(doc):
+    doc.setdefault("servers", {}).setdefault("bindAddress", "0.0.0.0")
     cfg, errors = parse_operator_config(doc)
     assert not errors
     return {d["kind"]: d for d in render_manifests(cfg, yaml.safe_dump(doc))}
