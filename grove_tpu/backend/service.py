@@ -51,7 +51,7 @@ from grove_tpu.api.types import (
 )
 from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
 from grove_tpu.solver.core import decode_assignments, solve
-from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.solver.encode import encode_gangs, pack_set_count
 from grove_tpu.state.cluster import Node, build_snapshot
 
 SERVICE_NAME = "grove_tpu.backend.v1.SchedulerBackend"
@@ -398,27 +398,10 @@ class TPUSchedulerBackend:
         mg = self._bucket(max(len(g.spec.pod_groups) for g in pending), cfg.max_groups)
         mp = self._bucket(max(g.total_pods() for g in pending), cfg.max_pods)
 
-        def set_count(g: PodGang) -> int:
-            tc = g.spec.topology_constraint
-            n = 1 if tc is not None and tc.pack_constraint is not None else 0
-            n += sum(
-                1
-                for gc in g.spec.topology_constraint_group_configs
-                if gc.topology_constraint is not None
-                and gc.topology_constraint.pack_constraint is not None
-            )
-            n += sum(
-                1
-                for grp in g.spec.pod_groups
-                if grp.topology_constraint is not None
-                and grp.topology_constraint.pack_constraint is not None
-            )
-            return n
-
         # Like mg/mp, the configured bound is a floor preference, never a cap
         # below the real demand — an undersized bucket would make encode raise
         # and wedge every subsequent Solve.
-        ms = self._bucket(max(max(set_count(g) for g in pending), 1), cfg.max_sets)
+        ms = self._bucket(max(max(pack_set_count(g) for g in pending), 1), cfg.max_sets)
         if cfg.pad_gangs_to:
             pad_to = cfg.pad_gangs_to * max(1, -(-len(pending) // cfg.pad_gangs_to))
         else:

@@ -48,7 +48,9 @@ def render_manifests(
         # double-reconcile (charts run a single replica by default too).
         replicas = 2 if cfg.leader_election.enabled else 1
 
-    if cfg.servers.bind_address.startswith("127."):
+    if cfg.servers.bind_address.startswith("127.") or cfg.servers.bind_address in (
+        "localhost", "::1",
+    ):
         # Probes and Services reach the POD IP; a loopback bind would render
         # manifests whose probes can never connect.
         raise ValueError(

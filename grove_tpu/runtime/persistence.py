@@ -64,7 +64,12 @@ def load_cluster(doc: dict, into: Optional[Cluster] = None) -> Cluster:
         raise ValueError(f"state schema {schema} not in (1, {SCHEMA_VERSION})")
     cluster = into if into is not None else Cluster()
     for f in _STATE_FIELDS:
-        setattr(cluster, f, serde.decode(doc.get(f) or type(getattr(cluster, f))()))
+        value = serde.decode(doc.get(f) or type(getattr(cluster, f))())
+        if f == "pods":
+            from grove_tpu.orchestrator.store import _PodDict
+
+            value = _PodDict(value)  # restore the clique/gang indexes
+        setattr(cluster, f, value)
     # v1 migration: aux-resource collections did not exist (loaded empty
     # above); the next sync_workload re-materializes them — including FRESH
     # SA tokens, so in-flight agents holding old credentials re-auth via
