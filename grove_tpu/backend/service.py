@@ -117,7 +117,7 @@ class TPUSchedulerBackend:
     never blocked behind a device execution (GREP-375 contract,
     docs/proposals/375-scheduler-backend-framework/README.md:158-202)."""
 
-    def __init__(self, solver_config=None) -> None:
+    def __init__(self, solver_config=None, priority_classes=None) -> None:
         from grove_tpu.runtime.config import SolverConfig
 
         self._lock = threading.Lock()
@@ -131,6 +131,8 @@ class TPUSchedulerBackend:
         self._bindings: dict[str, tuple[str, str, str]] = {}  # pod -> (node, gang, group)
         self._scheduled_gangs: set[str] = set()
         self._solver_config = solver_config or SolverConfig()
+        # Host-config defaults; an Init carrying priority_classes overrides.
+        self._priority_classes: dict[str, int] = dict(priority_classes or {})
 
     @staticmethod
     def _bucket(value: int, configured: Optional[int]) -> int:
@@ -182,6 +184,8 @@ class TPUSchedulerBackend:
                 )
         with self._lock:
             self._topology = ClusterTopology(name="backend", levels=levels)
+            if request.priority_classes:
+                self._priority_classes = dict(request.priority_classes)
         return pb.InitResponse(name=BACKEND_NAME)
 
     def SyncPodGang(self, request: pb.SyncPodGangRequest, context) -> pb.SyncPodGangResponse:
@@ -286,7 +290,13 @@ class TPUSchedulerBackend:
         bound_nodes_by_group: dict[str, dict[str, list[str]]] = {}
         for gang in sorted(
             self._gangs.values(),
-            key=lambda g: (g.base_podgang_name is not None, g.name),
+            # Batch order IS the solver's priority order (InitRequest proto):
+            # higher priority first, bases before their scaled gangs.
+            key=lambda g: (
+                -self._priority_classes.get(g.spec.priority_class_name, 0),
+                g.base_podgang_name is not None,
+                g.name,
+            ),
         ):
             reqs = self._group_requests.get(gang.name, {})
             sub = PodGang(name=gang.name, namespace=gang.namespace)
@@ -512,12 +522,18 @@ def _handlers(servicer: TPUSchedulerBackend) -> grpc.GenericRpcHandler:
 
 
 def create_server(
-    port: int = 0, max_workers: int = 8, solver_config=None
+    port: int = 0, max_workers: int = 8, solver_config=None, priority_classes=None
 ) -> tuple[grpc.Server, int]:
     """Build + start the sidecar server; returns (server, bound port)."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
-        (_handlers(TPUSchedulerBackend(solver_config=solver_config)),)
+        (
+            _handlers(
+                TPUSchedulerBackend(
+                    solver_config=solver_config, priority_classes=priority_classes
+                )
+            ),
+        )
     )
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()

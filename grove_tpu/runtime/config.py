@@ -91,6 +91,14 @@ class NetworkAccelerationConfig:
 
 
 @dataclass
+class SchedulingConfig:
+    """Priority classes (the chart's priorityclass.yaml analog): name ->
+    numeric priority consumed by the preemption pass and pending-sort."""
+
+    priority_classes: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
 class SolverConfig:
     """The placement engine (no reference analog — the KAI replacement)."""
 
@@ -132,6 +140,7 @@ class OperatorConfiguration:
     network_acceleration: NetworkAccelerationConfig = field(
         default_factory=NetworkAccelerationConfig
     )
+    scheduling: SchedulingConfig = field(default_factory=SchedulingConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
@@ -157,6 +166,7 @@ _SECTION_TYPES = {
     "authorizer": ("authorizer", AuthorizerConfig),
     "topologyAwareScheduling": ("topology_aware_scheduling", TopologyAwareSchedulingConfig),
     "networkAcceleration": ("network_acceleration", NetworkAccelerationConfig),
+    "scheduling": ("scheduling", SchedulingConfig),
     "solver": ("solver", SolverConfig),
     "backend": ("backend", BackendConfig),
     "persistence": ("persistence", PersistenceConfig),
@@ -181,6 +191,7 @@ _CAMEL_FIELDS = {
     "exemptActors": "exempt_actors",
     "autoSliceEnabled": "auto_slice_enabled",
     "sliceResourceName": "slice_resource_name",
+    "priorityClasses": "priority_classes",
     "maxGroups": "max_groups",
     "maxSets": "max_sets",
     "maxPods": "max_pods",
@@ -253,6 +264,16 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
                 "controllers.reconcileIntervalSeconds (renewal happens once "
                 "per reconcile cycle)"
             )
+    if not isinstance(cfg.scheduling.priority_classes, dict):
+        errors.append(
+            "scheduling.priorityClasses: must be a mapping of name -> integer"
+        )
+    else:
+        for pc_name, pc_value in cfg.scheduling.priority_classes.items():
+            if not isinstance(pc_value, int) or isinstance(pc_value, bool):
+                errors.append(
+                    f"scheduling.priorityClasses.{pc_name}: {pc_value!r} is not an integer"
+                )
     if cfg.servers.tls_mode not in ("disabled", "auto", "manual"):
         errors.append(
             f"servers.tlsMode: {cfg.servers.tls_mode!r} not in disabled|auto|manual"

@@ -256,6 +256,7 @@ class Manager:
             cluster=self.cluster,
             topology=self.topology,
             solver_params=SolverParams(),
+            priority_classes=dict(config.scheduling.priority_classes),
             tas_enabled=config.topology_aware_scheduling.enabled,
             max_groups=config.solver.max_groups,
             max_sets=config.solver.max_sets,
@@ -394,6 +395,7 @@ class Manager:
                 port=cfg.backend.port,
                 max_workers=cfg.backend.max_workers,
                 solver_config=cfg.solver,
+                priority_classes=cfg.scheduling.priority_classes,
             )
             self.log.info("backend sidecar listening", port=self.backend_port)
         if cfg.persistence.enabled:

@@ -255,6 +255,31 @@ def test_bucket_overflow_still_rounds():
     assert TPUSchedulerBackend._bucket(5, None) == 8  # pow2 fallback
 
 
+def test_priority_classes_order_backend_solve():
+    """InitRequest.priority_classes: higher priority solves first — under
+    contention the critical gang wins the capacity (proto contract, 'the
+    batch order IS the solver's priority order')."""
+    b = _backend(nodes=2)  # 2 nodes x 16 cpu: room for exactly one 2x16 gang
+    topo = __import__("grove_tpu.sim.workloads", fromlist=["bench_topology"]).bench_topology()
+    req = pb.InitRequest(
+        topology=[
+            pb.TopologyLevel(domain=lv.domain.value, node_label_key=lv.node_label_key)
+            for lv in topo.levels
+        ]
+    )
+    req.priority_classes["critical"] = 100
+    b.Init(req, _Ctx())
+    low = _gang_spec("a-low", n_pods=2, cpu=16.0)
+    high = _gang_spec("z-high", n_pods=2, cpu=16.0)
+    high.priority_class_name = "critical"
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=low), _Ctx())
+    b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=high), _Ctx())
+    resp = b.Solve(pb.SolveRequest(), _Ctx())
+    by_name = {g.name: g for g in resp.gangs}
+    assert by_name["z-high"].admitted, "critical gang must win despite name order"
+    assert not by_name["a-low"].admitted
+
+
 def test_config_speculative_default_applies():
     b = _backend(cfg=SolverConfig(speculative=True))
     b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("s", n_pods=2)), _Ctx())

@@ -253,6 +253,20 @@ def test_lease_without_deadline_keeps_renewing(tmp_path):
     assert lease.try_acquire(now=12.0)  # no deadline: still leader
 
 
+def test_priority_classes_reach_controller(tmp_path):
+    """scheduling.priorityClasses (chart priorityclass.yaml analog) feed the
+    preemption pass and pending sort."""
+    m = _mgr(tmp_path, {"scheduling": {"priorityClasses": {"critical": 100, "batch": 0}}})
+    assert m.controller.priority_classes == {"critical": 100, "batch": 0}
+    _, errors = parse_operator_config(
+        {"scheduling": {"priorityClasses": {"critical": "high"}}}
+    )
+    assert any("priorityClasses.critical" in e for e in errors)
+    # Non-mapping value is a field error, not an AttributeError crash.
+    _, errors = parse_operator_config({"scheduling": {"priorityClasses": "high"}})
+    assert any("must be a mapping" in e for e in errors)
+
+
 def test_two_managers_one_lease_ha_takeover(tmp_path):
     """HA semantics (types.go:73-104): two managers share a lease file; only
     one reconciles; when the leader releases, the standby takes over."""

@@ -1,0 +1,119 @@
+"""BASELINE.md's reference-config validation list: every shipped example
+loads, validates clean, expands, and reaches Running in the simulator.
+
+Configs (BASELINE.md "Reference configs to validate against"):
+  1. simple1.yaml — cliques + 1 scaling group
+  2. single-node-disaggregated.yaml — prefill+decode standalone cliques
+  3. multi-node-aggregated.yaml — leader/worker gang, InOrder startup,
+     rack-packed instances, minAvailable
+  4. multi-node-disaggregated.yaml — DeepSeek-R1-style router + prefill +
+     decode PCSGs with block/rack topology packing, explicit startup DAG
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+import yaml
+
+from grove_tpu.api import (
+    DEFAULT_CLUSTER_TOPOLOGY,
+    PodCliqueSet,
+    default_podcliqueset,
+    validate_podcliqueset,
+)
+from grove_tpu.api.types import TopologyDomain
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.sim.simulator import Simulator
+from grove_tpu.sim.workloads import bench_topology, synthetic_cluster
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+WORKLOADS = [
+    "simple1.yaml",
+    "single-node-disaggregated.yaml",
+    "multi-node-aggregated.yaml",
+    "multi-node-disaggregated.yaml",
+]
+
+
+def _load(name: str) -> PodCliqueSet:
+    with open(EXAMPLES / name) as f:
+        return default_podcliqueset(PodCliqueSet.from_dict(yaml.safe_load(f)))
+
+
+@pytest.mark.parametrize("name", WORKLOADS)
+def test_example_validates_clean(name):
+    pcs = _load(name)
+    errors = validate_podcliqueset(pcs, bench_topology())
+    assert errors == [], f"{name}: {errors}"
+
+
+@pytest.mark.parametrize("name", WORKLOADS)
+def test_example_schedules_to_running(name):
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=4, hosts_per_rack=7
+    ):
+        cluster.nodes[n.name] = n
+    ctrl = GroveController(cluster=cluster, topology=bench_topology())
+    pcs = _load(name)
+    cluster.podcliquesets[pcs.metadata.name] = pcs
+    sim = Simulator(cluster=cluster, controller=ctrl)
+    assert sim.run_until(
+        lambda: bool(cluster.pods)
+        and all(p.ready for p in cluster.pods.values() if p.is_active),
+        timeout=240,
+    ), f"{name}: {sum(p.ready for p in cluster.pods.values())}/{len(cluster.pods)} ready"
+
+
+def test_multi_node_disaggregated_topology_honored():
+    """Config #4's guarantees: replica packs one block; every prefill/decode
+    instance packs one rack; startup DAG router -> leaders -> workers."""
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=4, hosts_per_rack=7
+    ):
+        cluster.nodes[n.name] = n
+    topo = bench_topology()
+    ctrl = GroveController(cluster=cluster, topology=topo)
+    pcs = _load("multi-node-disaggregated.yaml")
+    cluster.podcliquesets[pcs.metadata.name] = pcs
+    sim = Simulator(cluster=cluster, controller=ctrl)
+    assert sim.run_until(
+        lambda: bool(cluster.pods)
+        and all(p.ready for p in cluster.pods.values() if p.is_active),
+        timeout=240,
+    )
+    from grove_tpu.state import build_snapshot
+
+    snap = build_snapshot(list(cluster.nodes.values()), topo)
+
+    def domains(prefix, level):
+        return {
+            snap.domain_of_node(p.node_name, level)
+            for p in cluster.pods.values()
+            if p.is_active and p.pclq_fqn.startswith(prefix)
+        }
+
+    assert len(domains("mn-disagg-0-", TopologyDomain.BLOCK)) == 1
+    for sg_prefix in ("mn-disagg-0-prefill-0-", "mn-disagg-0-prefill-1-",
+                      "mn-disagg-0-decode-0-"):
+        assert len(domains(sg_prefix, TopologyDomain.RACK)) == 1, sg_prefix
+    # Startup DAG: router first, then each instance's leader before workers.
+    router_start = min(
+        p.started_at for p in cluster.pods.values() if "router" in p.pclq_fqn
+    )
+    for inst in ("prefill-0", "prefill-1"):
+        ldr = min(
+            p.started_at
+            for p in cluster.pods.values()
+            if f"{inst}-pleader" in p.pclq_fqn
+        )
+        wrk = min(
+            p.started_at
+            for p in cluster.pods.values()
+            if f"{inst}-pworker" in p.pclq_fqn
+        )
+        assert router_start < ldr < wrk
