@@ -52,6 +52,7 @@ from grove_tpu.api.types import (
 from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
 from grove_tpu.solver.core import decode_assignments, solve
 from grove_tpu.solver.encode import encode_gangs, pack_set_count
+from grove_tpu.solver.planner import build_pending_subgang, sort_pending
 from grove_tpu.state.cluster import Node, build_snapshot
 
 SERVICE_NAME = "grove_tpu.backend.v1.SchedulerBackend"
@@ -288,38 +289,28 @@ class TPUSchedulerBackend:
         pending: list[PodGang] = []
         pods_by_name: dict[str, Pod] = {}
         bound_nodes_by_group: dict[str, dict[str, list[str]]] = {}
-        for gang in sorted(
-            self._gangs.values(),
-            # Batch order IS the solver's priority order (InitRequest proto):
-            # higher priority first, bases before their scaled gangs.
-            key=lambda g: (
-                -self._priority_classes.get(g.spec.priority_class_name, 0),
-                g.base_podgang_name is not None,
-                g.name,
-            ),
+        # Batch order IS the solver's priority order (InitRequest proto):
+        # family-max priority first, bases before their scaled gangs
+        # (sort_pending — the shared discipline with the in-process
+        # controller; an inline sort here once broke the base-before-scaled
+        # invariant for high-priority scaled gangs).
+        for gang in sort_pending(
+            list(self._gangs.values()),
+            lambda g: self._priority_classes.get(g.spec.priority_class_name, 0),
         ):
             reqs = self._group_requests.get(gang.name, {})
-            sub = PodGang(name=gang.name, namespace=gang.namespace)
-            sub.spec.topology_constraint = gang.spec.topology_constraint
-            sub.spec.priority_class_name = gang.spec.priority_class_name
-            sub.base_podgang_name = gang.base_podgang_name
-            groups_with_pending: set[str] = set()
+            unbound_refs: dict[str, list] = {}
+            bound_counts: dict[str, int] = {}
             per_group_bound: dict[str, list[str]] = {}
             for grp in gang.spec.pod_groups:
                 unbound = [r for r in grp.pod_references if r.name not in self._bindings]
                 bound = [r for r in grp.pod_references if r.name in self._bindings]
                 if bound:
                     per_group_bound[grp.name] = [self._bindings[r.name][0] for r in bound]
+                    bound_counts[grp.name] = len(bound)
                 if not unbound:
                     continue
-                sub_grp = PodGroup(
-                    name=grp.name,
-                    pod_references=unbound,
-                    min_replicas=max(0, grp.min_replicas - len(bound)),
-                    topology_constraint=grp.topology_constraint,
-                )
-                sub.spec.pod_groups.append(sub_grp)
-                groups_with_pending.add(grp.name)
+                unbound_refs[grp.name] = unbound
                 group_reqs = reqs.get(grp.name, {})
                 for ref in unbound:
                     pods_by_name[ref.name] = Pod(
@@ -327,13 +318,9 @@ class TPUSchedulerBackend:
                         namespace=ref.namespace,
                         spec=PodSpec(containers=[Container(name="c", requests=dict(group_reqs))]),
                     )
-            if not sub.spec.pod_groups:
+            sub = build_pending_subgang(gang, unbound_refs, bound_counts)
+            if sub is None:
                 continue
-            sub.spec.topology_constraint_group_configs = [
-                gc
-                for gc in gang.spec.topology_constraint_group_configs
-                if any(n in groups_with_pending for n in gc.pod_group_names)
-            ]
             if per_group_bound:
                 bound_nodes_by_group[gang.name] = per_group_bound
             pending.append(sub)

@@ -64,8 +64,32 @@ def sort_pending(
     gangs: list[PodGang], priority_of: Callable[[PodGang], int]
 ) -> list[PodGang]:
     """Priority order = solver batch order: higher priority first, base gangs
-    before their scaled gangs, then stable by scaled index and name."""
+    before their scaled gangs, then stable by scaled index and name.
+
+    The ranking key is the FAMILY priority — the max priority over a base
+    gang and every scaled gang that depends on it — not the gang's own.
+    Encoding gates a scaled gang out of the batch unless its base appears at
+    an earlier index (or is already scheduled), so sorting a high-priority
+    scaled gang ahead of its lower-priority base would silently reject it
+    for that solve; lifting the base to the family max preserves both the
+    dependency invariant and the intent that the critical member gets
+    scheduled early (scheduler/api/core/v1alpha1/podgang.go:51-72 priority +
+    base-gang semantics).
+
+    Only the BASE is lifted: a scaled sibling keeps its own priority (its
+    base's lifted rank plus the is_scaled tiebreak already guarantee the
+    base sorts earlier), so a low-priority scaled sibling cannot ride its
+    family's lift past higher-priority unrelated gangs."""
+    family_prio: dict[str, int] = {}
+    for g in gangs:
+        root = g.base_podgang_name or g.name
+        p = priority_of(g)
+        family_prio[root] = max(family_prio.get(root, p), p)
+
+    def rank(g: PodGang) -> int:
+        return priority_of(g) if g.is_scaled else family_prio[g.name]
+
     return sorted(
         gangs,
-        key=lambda g: (-priority_of(g), g.is_scaled, g.scaled_index, g.name),
+        key=lambda g: (-rank(g), g.is_scaled, g.scaled_index, g.name),
     )

@@ -298,3 +298,39 @@ def test_two_managers_one_lease_ha_takeover(tmp_path):
     finally:
         a.stop()
         b.stop()
+
+
+def test_sort_pending_family_priority_keeps_base_before_scaled():
+    """A high-priority scaled gang must not sort ahead of its lower-priority
+    base: encode gates a scaled gang out unless its base appears earlier in
+    the batch (solver/encode.py base-index check), so the family is ranked
+    by its max member priority with the base first."""
+    from grove_tpu.api.podgang import PodGang
+    from grove_tpu.solver.planner import sort_pending
+
+    base = PodGang(name="fam-0", namespace="d")
+    base.spec.priority_class_name = "batch"
+    scaled = PodGang(name="fam-0-scaled-1", namespace="d")
+    scaled.spec.priority_class_name = "critical"
+    scaled.base_podgang_name = "fam-0"
+    scaled.scaled_index = 1
+    other = PodGang(name="aaa-other", namespace="d")
+    other.spec.priority_class_name = "mid"
+
+    # A low-priority scaled SIBLING must not ride the family lift: only the
+    # base is lifted, so sibling sorts on its own (batch) priority.
+    sibling = PodGang(name="fam-0-scaled-2", namespace="d")
+    sibling.spec.priority_class_name = "batch"
+    sibling.base_podgang_name = "fam-0"
+    sibling.scaled_index = 2
+
+    prio = {"critical": 100, "mid": 50, "batch": 0}
+    order = sort_pending(
+        [scaled, sibling, other, base],
+        lambda g: prio.get(g.spec.priority_class_name, 0),
+    )
+    names = [g.name for g in order]
+    # Family fam-0's base is lifted to priority 100 by its critical scaled
+    # member, so it outranks 'mid' — the base still precedes the scaled gang,
+    # and the batch-priority sibling sorts after the unrelated mid gang.
+    assert names == ["fam-0", "fam-0-scaled-1", "aaa-other", "fam-0-scaled-2"]
