@@ -148,3 +148,55 @@ def kwok_fleet(nodes: list[Node], now: float = 0.0, **kwargs) -> KwokCluster:
     for node in nodes:
         cluster.add_node(node, now)
     return cluster
+
+
+def kwok_fleet_from_config(cluster_cfg, topology, now: float = 0.0) -> KwokCluster:
+    """Fabricate the fleet declared by `cluster.source: kwok` in the operator
+    config — the in-binary `make kind-up FAKE_NODES=N` analog
+    (operator/hack/kind-up.sh:31,252-265).
+
+    Every non-host topology level gets a node label so TAS pack constraints
+    resolve against this fleet: hosts group into racks of `kwokHostsPerRack`,
+    racks into blocks of `kwokRacksPerBlock`, and each broader level groups
+    4 of the next-narrower one (the e2e rig's zone/block/rack shape,
+    operator/hack/e2e-cluster/create-e2e-cluster.py:133-135).
+    """
+    from grove_tpu.api.types import TopologyDomain
+
+    levels = [
+        lvl
+        for lvl in topology.sorted_levels()
+        if lvl.domain != TopologyDomain.HOST
+    ]
+    # Group sizes, narrowest level first.
+    sizes: list[int] = []
+    for i in range(len(levels)):
+        if i == 0:
+            sizes.append(max(1, cluster_cfg.kwok_hosts_per_rack))
+        elif i == 1:
+            sizes.append(sizes[-1] * max(1, cluster_cfg.kwok_racks_per_block))
+        else:
+            sizes.append(sizes[-1] * 4)
+    nodes = []
+    for n in range(cluster_cfg.kwok_nodes):
+        labels: dict[str, str] = {}
+        for lvl, size in zip(reversed(levels), sizes):
+            labels[lvl.node_label_key] = f"{lvl.domain.value}-{n // size}"
+        nodes.append(
+            Node(
+                name=f"kwok-{n}",
+                capacity={
+                    "cpu": cluster_cfg.kwok_cpu_per_node,
+                    "memory": cluster_cfg.kwok_memory_per_node,
+                    "google.com/tpu": cluster_cfg.kwok_tpu_per_node,
+                },
+                labels=labels,
+            )
+        )
+    return kwok_fleet(
+        nodes,
+        now=now,
+        running_delay_s=cluster_cfg.running_delay_seconds,
+        ready_delay_s=cluster_cfg.ready_delay_seconds,
+        event_lag_s=cluster_cfg.event_lag_seconds,
+    )

@@ -30,6 +30,15 @@ def main(argv=None) -> int:
         print(f"config error: {e}", file=sys.stderr)
         return 2
 
+    # Same relay hardening as bench.py: the solver's first device use must
+    # not hang the control plane when the TPU tunnel is wedged — probe in a
+    # subprocess, fall back to CPU (grove_tpu/utils/platform.py).
+    from grove_tpu.utils.platform import ensure_usable_backend
+
+    _, plat_err = ensure_usable_backend()
+    if plat_err:
+        print(f"platform fallback: {plat_err}", file=sys.stderr)
+
     manager = Manager(config)
 
     def _stop(signum, frame):

@@ -128,6 +128,29 @@ class PersistenceConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """Node source for the manager. `none` (default): the store is fed
+    externally (attach_watch / backend RPCs / simulator). `kwok`: the manager
+    fabricates a KWOK-shaped fake fleet at boot and drives it through the
+    watch path — the in-binary analog of the reference's scale rig
+    (`make kind-up FAKE_NODES=N`, operator/hack/kind-up.sh:31,252-265), which
+    makes `python -m grove_tpu.runtime` a self-contained e2e environment."""
+
+    source: str = "none"  # none | kwok
+    kwok_nodes: int = 8
+    kwok_cpu_per_node: float = 32.0
+    kwok_memory_per_node: float = 128 * 2**30
+    kwok_tpu_per_node: float = 8.0
+    kwok_hosts_per_rack: int = 4
+    kwok_racks_per_block: int = 4
+    # KWOK stage latencies (kind-up.sh:264-265): bind -> Running -> Ready.
+    running_delay_seconds: float = 0.2
+    ready_delay_seconds: float = 0.2
+    # Informer-latency model: events become pollable only this much later.
+    event_lag_seconds: float = 0.0
+
+
+@dataclass
 class OperatorConfiguration:
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
     servers: ServerConfig = field(default_factory=ServerConfig)
@@ -144,6 +167,7 @@ class OperatorConfiguration:
     solver: SolverConfig = field(default_factory=SolverConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
 
     def cluster_topology(self) -> ClusterTopology:
         """TAS levels -> ClusterTopology (clustertopology sync analog)."""
@@ -170,6 +194,7 @@ _SECTION_TYPES = {
     "solver": ("solver", SolverConfig),
     "backend": ("backend", BackendConfig),
     "persistence": ("persistence", PersistenceConfig),
+    "cluster": ("cluster", ClusterConfig),
 }
 
 _CAMEL_FIELDS = {
@@ -198,6 +223,15 @@ _CAMEL_FIELDS = {
     "padGangsTo": "pad_gangs_to",
     "maxWorkers": "max_workers",
     "snapshotIntervalSeconds": "snapshot_interval_seconds",
+    "kwokNodes": "kwok_nodes",
+    "kwokCpuPerNode": "kwok_cpu_per_node",
+    "kwokMemoryPerNode": "kwok_memory_per_node",
+    "kwokTpuPerNode": "kwok_tpu_per_node",
+    "kwokHostsPerRack": "kwok_hosts_per_rack",
+    "kwokRacksPerBlock": "kwok_racks_per_block",
+    "runningDelaySeconds": "running_delay_seconds",
+    "readyDelaySeconds": "ready_delay_seconds",
+    "eventLagSeconds": "event_lag_seconds",
 }
 
 
@@ -309,6 +343,31 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             errors.append(f"topologyAwareScheduling.levels: {e}")
     if cfg.persistence.enabled and not cfg.persistence.path:
         errors.append("persistence.path: required when persistence is enabled")
+    cl = cfg.cluster
+    if cl.source not in ("none", "kwok"):
+        errors.append(f"cluster.source: {cl.source!r} not in none|kwok")
+    if cl.source == "kwok":
+        if cl.kwok_nodes < 1:
+            errors.append("cluster.kwokNodes: must be >= 1")
+        if cl.kwok_hosts_per_rack < 1 or cl.kwok_racks_per_block < 1:
+            errors.append(
+                "cluster.kwokHostsPerRack/kwokRacksPerBlock: must be >= 1"
+            )
+        if cl.running_delay_seconds < 0 or cl.ready_delay_seconds < 0:
+            errors.append(
+                "cluster.runningDelaySeconds/readyDelaySeconds: must be >= 0"
+            )
+        if cl.event_lag_seconds < 0:
+            errors.append("cluster.eventLagSeconds: must be >= 0")
+        if (
+            cl.kwok_cpu_per_node < 0
+            or cl.kwok_memory_per_node < 0
+            or cl.kwok_tpu_per_node < 0
+        ):
+            errors.append(
+                "cluster.kwokCpuPerNode/kwokMemoryPerNode/kwokTpuPerNode: "
+                "must be >= 0"
+            )
     return errors
 
 

@@ -408,6 +408,26 @@ class Manager:
             restored = self.persistence.restore(self.cluster)
             if restored:
                 self.log.info("restored control-plane state", path=cfg.persistence.path)
+        if cfg.cluster.source == "kwok":
+            # Config-fabricated KWOK fleet through the watch path — the
+            # binary is then a self-contained e2e rig (kind-up.sh KWOK
+            # analog). Nodes also forward to the backend sidecar when it is
+            # hosted here, so external Solve RPCs see the same fleet.
+            from grove_tpu.cluster.kwok import kwok_fleet_from_config
+
+            backend_client = None
+            if self.backend_port is not None:
+                from grove_tpu.backend.client import BackendClient
+
+                backend_client = BackendClient(f"127.0.0.1:{self.backend_port}")
+            # Fabricated at now=0.0 so the bootstrap node events are visible
+            # to the first pump under BOTH clocks: production's wall time and
+            # the tests' virtual time (reconcile_once(now=0.0)).
+            fleet = kwok_fleet_from_config(
+                cfg.cluster, cfg.cluster_topology(), now=0.0
+            )
+            self.attach_watch(fleet, backend=backend_client)
+            self.log.info("kwok fleet attached", nodes=cfg.cluster.kwok_nodes)
         self._started = True
         self.log.info(
             "manager started",
@@ -603,6 +623,10 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.watch is not None and self.watch.backend is not None:
+            close = getattr(self.watch.backend, "close", None)
+            if close is not None:
+                close()
         if self._backend_server is not None:
             self._backend_server.stop(grace=1.0)
         for server in self._http_servers:

@@ -334,3 +334,41 @@ def test_sort_pending_family_priority_keeps_base_before_scaled():
     # member, so it outranks 'mid' — the base still precedes the scaled gang,
     # and the batch-priority sibling sorts after the unrelated mid gang.
     assert names == ["fam-0", "fam-0-scaled-1", "aaa-other", "fam-0-scaled-2"]
+
+
+def test_cluster_kwok_section_fabricates_fleet():
+    """cluster.source=kwok: the manager boots with a config-shaped fake
+    fleet flowing in through the watch path (kind-up.sh KWOK rig analog),
+    labeled for every TAS level so pack constraints resolve."""
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "cluster": {
+                "source": "kwok",
+                "kwokNodes": 12,
+                "kwokHostsPerRack": 3,
+                "kwokTpuPerNode": 4,
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.reconcile_once(now=0.0)
+        assert len(m.cluster.nodes) == 12
+        node = m.cluster.nodes["kwok-5"]
+        assert node.capacity["google.com/tpu"] == 4
+        # Racks of 3: node 5 is in rack-1.
+        assert node.labels["topology.kubernetes.io/rack"] == "rack-1"
+    finally:
+        m.stop()
+
+    _, errors = parse_operator_config({"cluster": {"source": "k3d"}})
+    assert any("cluster.source" in e for e in errors)
+    _, errors = parse_operator_config(
+        {"cluster": {"source": "kwok", "kwokNodes": 0}}
+    )
+    assert any("kwokNodes" in e for e in errors)

@@ -1,0 +1,184 @@
+"""Process-level e2e: the operator binary as a black box.
+
+The reference's e2e tier boots a real cluster and drives the deployed
+operator purely through the API surface (`operator/e2e/`, k3d + KWOK rig,
+`operator/hack/kind-up.sh:252-265`). This is that tier for the TPU stack:
+`python -m grove_tpu.runtime --config <yaml>` is launched as a subprocess
+with a config-fabricated KWOK fleet (cluster.source=kwok), and everything
+else happens over HTTP — apply a PodCliqueSet, watch pods get placed and
+turn Ready through the staged KWOK lifecycle, delete, shut down with
+SIGTERM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# Control-plane e2e, not a solver-perf test: skip the TPU-relay probe so the
+# subprocess boots instantly even when the relay is wedged (the binary itself
+# would fall back after the probe timeout — too slow for a test).
+ENV = {**os.environ, "GROVE_FORCE_CPU": "1"}
+
+CONFIG = """
+log:
+  level: info
+  format: json
+servers:
+  healthPort: 0
+  metricsPort: -1
+controllers:
+  reconcileIntervalSeconds: 0.05
+cluster:
+  source: kwok
+  kwokNodes: 8
+  kwokHostsPerRack: 4
+  runningDelaySeconds: 0.05
+  readyDelaySeconds: 0.05
+"""
+
+
+def _get_raw(port: int, path: str) -> str:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def _get(port: int, path: str):
+    return json.loads(_get_raw(port, path))
+
+
+def _post(port: int, path: str, body: str, method: str = "POST") -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=body.encode(),
+        method=method,
+        headers={"Content-Type": "application/yaml"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+@pytest.fixture
+def operator_proc(tmp_path):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(CONFIG)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "grove_tpu.runtime", "--config", str(cfg)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+        env=ENV,
+    )
+    # The structured log line `manager started` carries the auto-assigned
+    # health port (log.format=json makes it machine-readable).
+    port = None
+    deadline = time.time() + 30
+    lines = []
+    while time.time() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            break
+        lines.append(line)
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if doc.get("msg") == "manager started":
+            port = doc["health_port"]
+            break
+    if port is None:
+        proc.kill()
+        pytest.fail(f"operator did not start: {''.join(lines)}")
+    yield proc, port
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_operator_binary_schedules_workload_end_to_end(operator_proc):
+    proc, port = operator_proc
+    assert _get_raw(port, "/healthz")
+
+    # Fleet fabricated from config, visible through the object API.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if len(_get(port, "/api/v1/nodes")) == 8:
+            break
+        time.sleep(0.1)
+    assert len(_get(port, "/api/v1/nodes")) == 8
+
+    # kubectl-apply analog over HTTP.
+    body = (REPO / "examples" / "simple1.yaml").read_text()
+    resp = _post(port, "/api/v1/podcliquesets", body)
+    assert resp["name"] == "simple1"
+
+    # The reconcile loop must expand, solve against the KWOK fleet, bind,
+    # and see the staged lifecycle take pods to Ready — all unattended.
+    deadline = time.time() + 30
+    pods_ready = {}
+    while time.time() < deadline:
+        names = _get(port, "/api/v1/pods")
+        if names:
+            pods_ready = {n: _get(port, f"/api/v1/pods/{n}") for n in names}
+            if pods_ready and all(
+                p.get("ready") and p.get("node_name") for p in pods_ready.values()
+            ):
+                break
+        time.sleep(0.2)
+    assert pods_ready, "no pods materialized"
+    not_ready = [n for n, p in pods_ready.items() if not p.get("ready")]
+    assert not not_ready, f"pods never became ready: {not_ready}"
+    unbound = [n for n, p in pods_ready.items() if not p.get("node_name")]
+    assert not unbound, f"pods never bound: {unbound}"
+    # Bindings must point at fabricated KWOK nodes.
+    assert all(
+        p["node_name"].startswith("kwok-") for p in pods_ready.values()
+    )
+
+    # Gangs reach a scheduled phase.
+    gang_names = _get(port, "/api/v1/podgangs")
+    assert gang_names
+    for g in gang_names:
+        gang = _get(port, f"/api/v1/podgangs/{g}")
+        assert gang.get("status", {}).get("phase") in ("Starting", "Running"), g
+
+    # Teardown cascades.
+    resp = _post(port, "/api/v1/podcliquesets/simple1", "", method="DELETE")
+    assert resp == {"deleted": "simple1"}
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if not _get(port, "/api/v1/pods"):
+            break
+        time.sleep(0.2)
+    assert _get(port, "/api/v1/pods") == []
+
+    # Clean shutdown on SIGTERM (the binary's signal contract).
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=15) == 0
+
+
+def test_operator_binary_rejects_invalid_config(tmp_path):
+    cfg = tmp_path / "bad.yaml"
+    cfg.write_text("cluster:\n  source: kwok\n  kwokNodes: 0\nnope: {}\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "grove_tpu.runtime", "--config", str(cfg)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=ENV,
+        timeout=60,
+    )
+    assert proc.returncode == 2
+    assert "kwokNodes" in proc.stderr
+    assert "unknown section" in proc.stderr
