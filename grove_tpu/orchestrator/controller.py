@@ -45,7 +45,9 @@ from grove_tpu.orchestrator.status import (
     compute_pcsg_status,
     compute_podclique_status,
     compute_podgang_status,
+    clique_rolling_state,
     pcsg_breached_since,
+    sync_pcsg_rolling_progress,
 )
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.solver.core import SolverParams, decode_assignments, solve
@@ -563,8 +565,34 @@ class GroveController:
         }
         for clique in c.podcliques.values():
             compute_podclique_status(c, clique, now, updating=clique.pcs_name in updating_pcs)
+        # Per-PCS template-hash cache: cliques sharing a template share a hash,
+        # and the sha only needs computing when a PCSG is mid-update.
+        hash_cache: dict[tuple[str, str], str] = {}
+
+        def _desired_hash(pcs, clique) -> str:
+            key = (pcs.metadata.name, clique.template_name)
+            if key not in hash_cache:
+                hash_cache[key] = exp.compute_pod_template_hash(
+                    pcs.clique_template(clique.template_name),
+                    pcs.spec.template.priority_class_name,
+                )
+            return hash_cache[key]
+
         for pcsg in c.scaling_groups.values():
             compute_pcsg_status(c, pcsg, now, updating=pcsg.pcs_name in updating_pcs)
+            pcs = c.podcliquesets.get(pcsg.pcs_name)
+            if pcs is not None:
+                pcs_prog = pcs.status.rolling_update_progress
+                sync_pcsg_rolling_progress(
+                    c,
+                    pcsg,
+                    lambda clique, _pcs=pcs: _desired_hash(_pcs, clique),
+                    now,
+                    updating=pcsg.pcs_name in updating_pcs,
+                    pcs_update_started_at=(
+                        pcs_prog.update_started_at if pcs_prog is not None else None
+                    ),
+                )
         for gang in c.podgangs.values():
             compute_podgang_status(c, gang, now)
         for pcs in c.podcliquesets.values():
@@ -660,16 +688,12 @@ class GroveController:
             minAvailable (isPCLQUpdateComplete, rollingupdate.go:286-295 gates
             on UpdatedReplicas and ReadyReplicas >= MinAvailable) — otherwise
             the update would advance while the replica is still down, losing
-            the one-replica-at-a-time availability guarantee."""
-            if stale_pods(i):
-                return False
+            the one-replica-at-a-time availability guarantee. The predicate
+            itself is shared with the PCSG-status bookkeeping
+            (status.clique_rolling_state) so the two granularities agree."""
             for clique in c.cliques_of_pcs_replica(pcs.metadata.name, i):
-                ready = sum(
-                    1
-                    for p in c.pods_of_clique(clique.metadata.name)
-                    if p.is_active and p.ready
-                )
-                if ready < clique.min_available:
+                stale, ready = clique_rolling_state(c, clique, desired_hash(clique))
+                if stale or ready < clique.min_available:
                     return False
             return True
 

@@ -16,6 +16,7 @@ Parity targets:
 from __future__ import annotations
 
 from collections import defaultdict
+from typing import Optional
 
 from grove_tpu.api import constants
 from grove_tpu.api.pod import Pod
@@ -141,6 +142,102 @@ def compute_pcsg_status(
         Condition(type=constants.CONDITION_MIN_AVAILABLE_BREACHED, status=status, reason=reason),
         now,
     )
+
+
+def clique_rolling_state(cluster: Cluster, clique, want_hash: str) -> tuple[bool, int]:
+    """(has stale active pod, ready active count) — the shared input to the
+    update-completion predicate (isPCLQUpdateComplete,
+    rollingupdate.go:286-295). Both the PCS-replica advance decision and the
+    PCSG-replica status bookkeeping MUST read it from here so the two
+    granularities cannot diverge on what 'updated' means."""
+    pods = [p for p in cluster.pods_of_clique(clique.metadata.name) if p.is_active]
+    stale = any(p.pod_template_hash != want_hash for p in pods)
+    ready = sum(1 for p in pods if p.ready)
+    return stale, ready
+
+
+def sync_pcsg_rolling_progress(
+    cluster: Cluster,
+    pcsg: PodCliqueScalingGroup,
+    desired_hash,
+    now: float,
+    updating: bool = False,
+    pcs_update_started_at: Optional[float] = None,
+) -> None:
+    """Maintain the PCSG-level rolling-update bookkeeping the reference keeps
+    in PCSG status (scalinggroup.go:106-129): `updated_replicas` plus a
+    `PCSGRollingUpdateProgress` with per-replica completion.
+
+    A PCSG replica counts as updated when none of its member-clique pods is
+    on a stale template hash AND every member clique is back to ready >=
+    minAvailable (clique_rolling_state), at PCSG-replica granularity.
+    `desired_hash` maps a PodClique -> its wanted hash; `updating` says the
+    owning PCS has an active rolling update, and `pcs_update_started_at` is
+    that update's start time (a PCS restart mid-roll restarts this progress
+    too, mirroring the PCS-level reset on generation-hash change)."""
+    from grove_tpu.api.types import PCSGRollingUpdateProgress
+
+    st = pcsg.status
+    prog = st.rolling_update_progress
+    prog_active = prog is not None and prog.update_ended_at is None
+    if not updating and not prog_active:
+        # Steady state: skip the per-pod hash scan entirely (this runs every
+        # reconcile for every PCSG). Any staleness would have started a PCS
+        # update via the generation hash, flipping `updating` next pass.
+        if prog is None:
+            # Never updated: every created replica is on the current template
+            # by construction.
+            created = {
+                c.pcsg_replica_index
+                for c in cluster.cliques_of_pcsg(pcsg.metadata.name)
+                if c.pcsg_replica_index is not None
+            }
+            st.updated_replicas = len(created)
+        return
+
+    members = cluster.cliques_of_pcsg(pcsg.metadata.name)
+    by_replica: dict[int, list] = defaultdict(list)
+    for c in members:
+        if c.pcsg_replica_index is not None:
+            by_replica[c.pcsg_replica_index].append(c)
+
+    any_stale = False
+    updated: list[int] = []
+    for idx in range(pcsg.spec.replicas):
+        cliques = by_replica.get(idx, [])
+        if not cliques:
+            continue
+        replica_stale = False
+        replica_ready = True
+        for clique in cliques:
+            stale, ready = clique_rolling_state(cluster, clique, desired_hash(clique))
+            if stale:
+                replica_stale = True
+            if ready < clique.min_available:
+                replica_ready = False
+        any_stale = any_stale or replica_stale
+        if not replica_stale and replica_ready:
+            updated.append(idx)
+
+    st.updated_replicas = len(updated)
+    restarted_mid_roll = (
+        prog_active
+        and pcs_update_started_at is not None
+        and pcs_update_started_at > prog.update_started_at
+    )
+    if (any_stale and not prog_active) or restarted_mid_roll:
+        prog = PCSGRollingUpdateProgress(update_started_at=now)
+        st.rolling_update_progress = prog
+    if prog is None or prog.update_ended_at is not None:
+        return
+    prog.updated_replica_indices = updated
+    remaining = [i for i in range(pcsg.spec.replicas) if i not in updated]
+    if remaining:
+        # Still rolling — or post-replacement replicas not back to ready yet.
+        prog.current_replica_index = min(remaining)
+    else:
+        prog.current_replica_index = None
+        prog.update_ended_at = now
 
 
 def pcsg_breached(pcsg: PodCliqueScalingGroup) -> bool:

@@ -284,6 +284,9 @@ class Manager:
         # Watch driver (cluster integration path): attached via attach_watch;
         # pumped before and pushed after every reconcile pass.
         self.watch = None
+        # gRPC client the manager itself created (kwok node-forwarding) and
+        # must close at stop(); caller-supplied clients stay caller-owned.
+        self._owned_backend_client = None
         # Admission chain (webhook analog): defaulting + validation +
         # authorizer-protected managed resources (config.authorizer).
         self.admission = AdmissionChain(
@@ -420,6 +423,9 @@ class Manager:
                 from grove_tpu.backend.client import BackendClient
 
                 backend_client = BackendClient(f"127.0.0.1:{self.backend_port}")
+                # Manager-created, so manager-closed at stop(); a client the
+                # CALLER passed to attach_watch stays the caller's to close.
+                self._owned_backend_client = backend_client
             # Fabricated at now=0.0 so the bootstrap node events are visible
             # to the first pump under BOTH clocks: production's wall time and
             # the tests' virtual time (reconcile_once(now=0.0)).
@@ -623,10 +629,9 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
-        if self.watch is not None and self.watch.backend is not None:
-            close = getattr(self.watch.backend, "close", None)
-            if close is not None:
-                close()
+        if self._owned_backend_client is not None:
+            self._owned_backend_client.close()
+            self._owned_backend_client = None
         if self._backend_server is not None:
             self._backend_server.stop(grace=1.0)
         for server in self._http_servers:

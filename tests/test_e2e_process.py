@@ -81,13 +81,26 @@ def operator_proc(tmp_path):
         env=ENV,
     )
     # The structured log line `manager started` carries the auto-assigned
-    # health port (log.format=json makes it machine-readable).
+    # health port (log.format=json makes it machine-readable). Read stderr on
+    # a thread: a wedged subprocess that emits nothing must fail the test at
+    # the deadline, not hang the session in readline().
+    import queue
+    import threading
+
+    lines_q: queue.Queue = queue.Queue()
+
+    def _reader():
+        for line in proc.stderr:
+            lines_q.put(line)
+
+    threading.Thread(target=_reader, daemon=True).start()
     port = None
     deadline = time.time() + 30
     lines = []
     while time.time() < deadline:
-        line = proc.stderr.readline()
-        if not line:
+        try:
+            line = lines_q.get(timeout=max(0.1, deadline - time.time()))
+        except queue.Empty:
             break
         lines.append(line)
         try:

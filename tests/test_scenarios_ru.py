@@ -296,3 +296,75 @@ def test_ru21_pclq_scale_in_before_update():
         timeout=400,
     )
     assert not _stale(s, pcs)
+
+
+def test_ru8b_pcsg_rolling_progress_status():
+    """PCSG status carries its own rolling-update bookkeeping
+    (scalinggroup.go:106-129): progress starts when member pods go stale,
+    updated replica indices accumulate, and it ends with updatedReplicas ==
+    replicas once every member clique is back to ready >= minAvailable."""
+    s = Scenario(10)
+    pcs = _deploy_ready(s, wl1(), 10)
+    pcsg = next(g for g in s.cluster.scaling_groups.values())
+    assert pcsg.status.rolling_update_progress is None
+
+    s.change_clique_spec(pcs, "pc-b")
+    saw_in_progress = False
+    for _ in range(300):
+        s.sim.step(1.0)
+        prog = pcsg.status.rolling_update_progress
+        if prog is not None and prog.update_ended_at is None:
+            saw_in_progress = True
+            assert prog.current_replica_index is not None
+        if (
+            pcs.status.rolling_update_progress is not None
+            and pcs.status.rolling_update_progress.update_ended_at is not None
+        ):
+            break
+    assert saw_in_progress, "PCSG progress never became active"
+    # Let the PCSG-side readiness gate settle after the PCS update ends.
+    assert s.until(
+        lambda: pcsg.status.rolling_update_progress.update_ended_at is not None,
+        timeout=120,
+    )
+    prog = pcsg.status.rolling_update_progress
+    assert sorted(prog.updated_replica_indices) == list(range(pcsg.spec.replicas))
+    assert pcsg.status.updated_replicas == pcsg.spec.replicas
+    assert prog.current_replica_index is None
+
+
+def test_ru8c_pcsg_progress_restarts_on_back_to_back_update():
+    """A second template change mid-roll restarts the PCS progress (new
+    generation hash) — the PCSG-level progress must restart with it, not
+    report one merged A+B window."""
+    s = Scenario(10)
+    pcs = _deploy_ready(s, wl1(), 10)
+    pcsg = next(g for g in s.cluster.scaling_groups.values())
+    s.change_clique_spec(pcs, "pc-b")
+    for _ in range(300):
+        s.sim.step(1.0)
+        prog = pcsg.status.rolling_update_progress
+        if prog is not None and prog.update_ended_at is None:
+            break
+    prog = pcsg.status.rolling_update_progress
+    assert prog is not None and prog.update_ended_at is None
+    first_started = prog.update_started_at
+
+    # Update B while A is mid-roll (change_clique_spec is idempotent at :v2 —
+    # bump the image again by hand for a fresh hash).
+    for tmpl in pcs.spec.template.cliques:
+        if tmpl.name == "pc-b":
+            for c in tmpl.spec.pod_spec.containers:
+                c.image = c.image.rsplit(":", 1)[0] + ":v3"
+    restarted = False
+    for _ in range(300):
+        s.sim.step(1.0)
+        prog = pcsg.status.rolling_update_progress
+        if prog is not None and prog.update_started_at > first_started:
+            restarted = True
+            break
+    assert restarted, "PCSG progress must restart when the PCS update restarts"
+    assert s.until(
+        lambda: pcsg.status.rolling_update_progress.update_ended_at is not None,
+        timeout=300,
+    )
