@@ -183,16 +183,17 @@ def sync_pcsg_rolling_progress(
     if not updating and not prog_active:
         # Steady state: skip the per-pod hash scan entirely (this runs every
         # reconcile for every PCSG). Any staleness would have started a PCS
-        # update via the generation hash, flipping `updating` next pass.
-        if prog is None:
-            # Never updated: every created replica is on the current template
-            # by construction.
-            created = {
+        # update via the generation hash, flipping `updating` next pass — so
+        # every CREATED replica is on the current template, and the count
+        # must keep tracking scale-out/in after an update completed (a frozen
+        # post-update value would over/under-report forever).
+        st.updated_replicas = len(
+            {
                 c.pcsg_replica_index
                 for c in cluster.cliques_of_pcsg(pcsg.metadata.name)
                 if c.pcsg_replica_index is not None
             }
-            st.updated_replicas = len(created)
+        )
         return
 
     members = cluster.cliques_of_pcsg(pcsg.metadata.name)

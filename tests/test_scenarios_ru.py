@@ -368,3 +368,22 @@ def test_ru8c_pcsg_progress_restarts_on_back_to_back_update():
         lambda: pcsg.status.rolling_update_progress.update_ended_at is not None,
         timeout=300,
     )
+
+
+def test_ru8d_pcsg_updated_replicas_tracks_scale_after_update():
+    """updated_replicas must keep tracking scale-out after a completed
+    rolling update, not freeze at the update-time count."""
+    s = Scenario(10)
+    pcs = _deploy_ready(s, wl1(), 10)
+    pcsg = next(g for g in s.cluster.scaling_groups.values())
+    s.change_clique_spec(pcs, "pc-b")
+    assert s.until(
+        lambda: pcsg.status.rolling_update_progress is not None
+        and pcsg.status.rolling_update_progress.update_ended_at is not None,
+        timeout=300,
+    )
+    before = pcsg.spec.replicas
+    s.scale_pcsg("pcs", "sg-x", before + 1)
+    assert s.until(
+        lambda: pcsg.status.updated_replicas == before + 1, timeout=120
+    ), f"updated_replicas stuck at {pcsg.status.updated_replicas}"
