@@ -1,0 +1,169 @@
+"""`python -m grove_tpu.cli` — the kubectl-plugin analog.
+
+Talks to a running manager (`python -m grove_tpu.runtime`) over its object
+API via the typed client. Commands:
+
+  get pcs|podgangs|pods|nodes|services|hpas     table listing
+  get <kind> <name>                             full object as JSON
+  apply -f <file.yaml>                          admit a PodCliqueSet
+  delete pcs <name>                             cascade-delete
+  events [--tail N]                             recent control-plane events
+
+Exit codes: 0 ok, 1 API/transport error, 2 usage error (cli.go:35-45 shape).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from grove_tpu.client.typed import GroveApiError, GroveClient
+from grove_tpu.utils import serde
+
+KIND_ALIASES = {
+    "pcs": "podcliquesets",
+    "podcliqueset": "podcliquesets",
+    "podcliquesets": "podcliquesets",
+    "pg": "podgangs",
+    "podgang": "podgangs",
+    "podgangs": "podgangs",
+    "pod": "pods",
+    "pods": "pods",
+    "node": "nodes",
+    "nodes": "nodes",
+    "svc": "services",
+    "service": "services",
+    "services": "services",
+    "hpa": "hpas",
+    "hpas": "hpas",
+}
+
+
+def _table(rows: list[list[str]], headers: list[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [headers, *rows]) for i in range(len(headers))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [fmt.format(*headers)]
+    out.extend(fmt.format(*(str(c) for c in row)) for row in rows)
+    return "\n".join(out)
+
+
+def _get_table(client: GroveClient, kind: str) -> str:
+    # Tables use the bulk listing (?full=1): one round trip and one
+    # consistent snapshot — per-name gets would be N+1 requests at cluster
+    # scale and racy against reconcile-loop churn.
+    if kind == "podcliquesets":
+        rows = [
+            [name, obj.spec.replicas, getattr(obj.status, "available_replicas", "?")]
+            for name, obj in client.list_podcliquesets_full().items()
+        ]
+        return _table(rows, ["NAME", "REPLICAS", "AVAILABLE"])
+    if kind == "podgangs":
+        rows = []
+        for name, obj in client.list_podgangs_full().items():
+            phase = getattr(obj.status.phase, "value", obj.status.phase)
+            score = obj.status.placement_score
+            rows.append([name, phase, "-" if score is None else f"{score:.3f}"])
+        return _table(rows, ["NAME", "PHASE", "SCORE"])
+    if kind == "pods":
+        rows = []
+        for name, obj in client.list_pods_full().items():
+            phase = getattr(obj.phase, "value", obj.phase)
+            rows.append(
+                [name, obj.node_name or "<none>", phase, "yes" if obj.ready else "no"]
+            )
+        return _table(rows, ["NAME", "NODE", "PHASE", "READY"])
+    if kind == "nodes":
+        rows = []
+        for name, obj in client.list_nodes_full().items():
+            cap = ",".join(f"{k}={v:g}" for k, v in sorted(obj.capacity.items()))
+            rows.append([name, "yes" if obj.schedulable else "no", cap])
+        return _table(rows, ["NAME", "SCHEDULABLE", "CAPACITY"])
+    if kind == "services":
+        return _table([[n] for n in client.list_services()], ["NAME"])
+    if kind == "hpas":
+        return _table([[n] for n in client.list_hpas()], ["NAME"])
+    raise AssertionError(kind)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="grove-tpu")
+    parser.add_argument("--server", default="http://127.0.0.1:2751")
+    parser.add_argument("--token-file", default=None, help="bearer token file")
+    parser.add_argument("--cafile", default=None, help="pinned serving cert (TLS)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_get = sub.add_parser("get", help="list a kind, or fetch one object")
+    p_get.add_argument("kind")
+    p_get.add_argument("name", nargs="?", default=None)
+
+    p_apply = sub.add_parser("apply", help="admit a PodCliqueSet")
+    p_apply.add_argument("-f", "--filename", required=True)
+
+    p_del = sub.add_parser("delete", help="cascade-delete a PodCliqueSet")
+    p_del.add_argument("kind")
+    p_del.add_argument("name")
+
+    p_ev = sub.add_parser("events", help="recent control-plane events")
+    p_ev.add_argument("--tail", type=int, default=20)
+
+    args = parser.parse_args(argv)
+
+    try:
+        token = None
+        if args.token_file:
+            with open(args.token_file) as f:
+                token = f.read().strip()
+        client = GroveClient(args.server, cafile=args.cafile, token=token)
+        if args.cmd == "get":
+            kind = KIND_ALIASES.get(args.kind)
+            if kind is None:
+                print(f"unknown kind {args.kind!r}", file=sys.stderr)
+                return 2
+            if args.name is None:
+                print(_get_table(client, kind))
+            else:
+                getter = {
+                    "podcliquesets": client.get_podcliqueset,
+                    "podgangs": client.get_podgang,
+                    "pods": client.get_pod,
+                    "nodes": client.get_node,
+                }.get(kind)
+                if getter is None:
+                    print(f"get-by-name unsupported for {kind}", file=sys.stderr)
+                    return 2
+                print(json.dumps(serde.encode(getter(args.name)), indent=2))
+        elif args.cmd == "apply":
+            with open(args.filename) as f:
+                name = client.apply_podcliqueset(f.read())
+            print(f"podcliqueset/{name} applied")
+        elif args.cmd == "delete":
+            if KIND_ALIASES.get(args.kind) != "podcliquesets":
+                print("delete supports: pcs", file=sys.stderr)
+                return 2
+            client.delete_podcliqueset(args.name)
+            print(f"podcliqueset/{args.name} deleted")
+        elif args.cmd == "events":
+            tail = client.events()[-args.tail:] if args.tail > 0 else []
+            for ts, obj, msg in tail:
+                print(f"{ts:10.1f}  {obj:<30}  {msg}")
+    except GroveApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # `grove-tpu get pods | head` closes stdout early — normal, not an
+        # error. Detach stdout so interpreter shutdown doesn't re-raise.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

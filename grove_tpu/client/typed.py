@@ -92,6 +92,12 @@ class GroveClient:
     def _list(self, kind: str) -> list[str]:
         return self._request("GET", f"/api/v1/{kind}")
 
+    def _list_full(self, kind: str) -> dict[str, Any]:
+        """One round trip for every object of a kind (?full=1) — the table
+        path; per-name gets would be N+1 requests at cluster scale."""
+        doc = self._request("GET", f"/api/v1/{kind}?full=1")
+        return {name: serde.decode(obj) for name, obj in doc.items()}
+
     def _get(self, kind: str, name: str):
         return serde.decode(self._request("GET", f"/api/v1/{kind}/{name}"))
 
@@ -99,6 +105,18 @@ class GroveClient:
 
     def list_podcliquesets(self) -> list[str]:
         return self._list("podcliquesets")
+
+    def list_podcliquesets_full(self) -> dict[str, Any]:
+        return self._list_full("podcliquesets")
+
+    def list_podgangs_full(self) -> dict[str, Any]:
+        return self._list_full("podgangs")
+
+    def list_pods_full(self) -> dict[str, Any]:
+        return self._list_full("pods")
+
+    def list_nodes_full(self) -> dict[str, Any]:
+        return self._list_full("nodes")
 
     def get_podcliqueset(self, name: str):
         return self._get("podcliquesets", name)
@@ -175,6 +193,14 @@ class FakeGroveClient:
     list_nodes = lambda self: self._list("nodes")  # noqa: E731
     list_services = lambda self: self._list("services")  # noqa: E731
     list_hpas = lambda self: self._list("hpas")  # noqa: E731
+
+    def _list_full(self, kind: str) -> dict:
+        return dict(sorted(self._coll(kind).items()))
+
+    list_podcliquesets_full = lambda self: self._list_full("podcliquesets")  # noqa: E731
+    list_podgangs_full = lambda self: self._list_full("podgangs")  # noqa: E731
+    list_pods_full = lambda self: self._list_full("pods")  # noqa: E731
+    list_nodes_full = lambda self: self._list_full("nodes")  # noqa: E731
 
     def get_podcliqueset(self, name: str):
         return self._get("podcliquesets", name)

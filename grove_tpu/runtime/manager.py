@@ -127,6 +127,7 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
     def _api_get(self, rest: str) -> None:
         from grove_tpu.utils import serde
 
+        rest, _, query = rest.partition("?")
         parts = [p for p in rest.split("/") if p]
         if not parts or parts[0] not in self._COLLECTIONS:
             self._respond(404, "not found")
@@ -142,6 +143,25 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
             return
         coll = getattr(c, self._COLLECTIONS[kind])
         if len(parts) == 1:
+            if query == "full=1":
+                # Bulk listing: one response with every object, so table
+                # clients (the CLI) don't do N+1 round trips at scale. Same
+                # mid-iteration-resize retry as the initc endpoint above —
+                # this thread races the reconcile thread's dict mutations.
+                for _ in range(8):
+                    try:
+                        doc = {
+                            name: serde.encode(obj)
+                            for name, obj in sorted(coll.items())
+                        }
+                        break
+                    except RuntimeError:
+                        continue
+                else:
+                    self._respond(503, "store busy")
+                    return
+                self._respond(200, json.dumps(doc), "application/json")
+                return
             self._respond(200, json.dumps(sorted(coll)), "application/json")
             return
         obj = coll.get("/".join(parts[1:]))

@@ -195,3 +195,60 @@ def test_operator_binary_rejects_invalid_config(tmp_path):
     assert proc.returncode == 2
     assert "kwokNodes" in proc.stderr
     assert "unknown section" in proc.stderr
+
+
+def test_cli_against_live_operator(operator_proc, tmp_path):
+    """`python -m grove_tpu.cli` (the cli-plugin analog) drives the same
+    manager: apply, get tables, get-by-name JSON, events, delete."""
+    proc, port = operator_proc
+    server = f"http://127.0.0.1:{port}"
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "grove_tpu.cli", "--server", server, *args],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            env=ENV,
+            timeout=60,
+        )
+
+    r = cli("apply", "-f", str(REPO / "examples" / "simple1.yaml"))
+    assert r.returncode == 0, r.stderr
+    assert "podcliqueset/simple1 applied" in r.stdout
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        r = cli("get", "pods")
+        if r.returncode == 0 and "kwok-" in r.stdout and "<none>" not in r.stdout:
+            break
+        time.sleep(0.5)
+    assert "NAME" in r.stdout and "NODE" in r.stdout, r.stdout
+    # The break condition itself must hold — a timed-out loop with only the
+    # header row would otherwise pass the asserts above.
+    assert "kwok-" in r.stdout and "<none>" not in r.stdout, r.stdout
+
+    r = cli("get", "pcs")
+    assert r.returncode == 0 and "simple1" in r.stdout
+
+    r = cli("get", "nodes")
+    assert r.returncode == 0 and "kwok-0" in r.stdout
+
+    gangs = cli("get", "podgangs")
+    assert gangs.returncode == 0 and "simple1-0" in gangs.stdout
+
+    r = cli("get", "pg", "simple1-0")
+    assert r.returncode == 0
+    doc = json.loads(r.stdout)
+    assert doc["name"] == "simple1-0"
+
+    r = cli("events", "--tail", "5")
+    assert r.returncode == 0 and r.stdout.strip()
+
+    r = cli("get", "frobs")
+    assert r.returncode == 2
+
+    r = cli("delete", "pcs", "simple1")
+    assert r.returncode == 0
+    r = cli("delete", "pcs", "simple1")
+    assert r.returncode == 1, "double delete must surface the 404"
