@@ -1,0 +1,27 @@
+# Dev entry points (root Makefile / operator/Makefile analog).
+
+PY ?= python
+
+.PHONY: test test-e2e bench bench-cpu dryrun check clean
+
+test:            ## unit + scenario suites (CPU-forced via tests/conftest.py)
+	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_process.py
+
+test-e2e:        ## process-level e2e tier only (binary + CLI over HTTP)
+	$(PY) -m pytest tests/test_e2e_process.py -q
+
+bench:           ## north-star benchmark (one JSON line; TPU if healthy)
+	$(PY) bench.py
+
+bench-cpu:       ## benchmark with the TPU-relay probe skipped
+	GROVE_FORCE_CPU=1 $(PY) bench.py
+
+dryrun:          ## multi-chip sharding compile+run on 8 virtual devices
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+check:           ## import + compile sanity across the package
+	$(PY) -m compileall -q grove_tpu tests bench.py __graft_entry__.py
+	$(PY) -c "import grove_tpu, grove_tpu.cli, grove_tpu.client, grove_tpu.deploy"
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -107,7 +107,16 @@ def main(argv=None) -> int:
     p_del.add_argument("name")
 
     p_ev = sub.add_parser("events", help="recent control-plane events")
-    p_ev.add_argument("--tail", type=int, default=20)
+    # The server returns at most the last 200 events; larger --tail values
+    # would silently truncate, so the parser enforces the cap visibly.
+    p_ev.add_argument(
+        "--tail",
+        type=int,
+        default=20,
+        help="lines to show (server keeps the last 200)",
+        choices=range(0, 201),
+        metavar="N",
+    )
 
     args = parser.parse_args(argv)
 
