@@ -77,3 +77,7 @@ ANNOTATION_ICI_DOMAIN = "grove.io/ici-domain"  # TPU-native: pin gang to ICI dom
 # (webhook/admission/pcs/validation/podcliqueset.go:37-39,564).
 MAX_PCS_NAME_LENGTH = 45
 MAX_K8S_NAME_LENGTH = 63
+
+# Control-plane event ring: the object API serves at most this many recent
+# events; clients (CLI --tail) validate against the same bound.
+EVENTS_BUFFER = 200

@@ -118,9 +118,31 @@ class TPUSchedulerBackend:
     never blocked behind a device execution (GREP-375 contract,
     docs/proposals/375-scheduler-backend-framework/README.md:158-202)."""
 
-    def __init__(self, solver_config=None, priority_classes=None) -> None:
+    def __init__(
+        self, solver_config=None, priority_classes=None, metrics=None
+    ) -> None:
         from grove_tpu.runtime.config import SolverConfig
+        from grove_tpu.utils.metrics import Registry
 
+        # Solver-side observability (GREP-244 placement-metrics direction):
+        # shared registry when hosted by the manager (surfaces on /metrics),
+        # private one standalone.
+        reg = metrics or Registry()
+        self._m_solves = reg.counter(
+            "grove_backend_solves_total", "Solve RPCs that ran a device solve"
+        )
+        self._m_solve_seconds = reg.histogram(
+            "grove_backend_solve_seconds", "end-to-end Solve RPC latency"
+        )
+        self._m_gangs_admitted = reg.counter(
+            "grove_backend_gangs_admitted_total", "gangs admitted by Solve"
+        )
+        self._m_gangs_rejected = reg.counter(
+            "grove_backend_gangs_rejected_total", "gangs left pending by Solve"
+        )
+        self._m_pods_bound = reg.counter(
+            "grove_backend_pods_bound_total", "pod bindings committed"
+        )
         self._lock = threading.Lock()
         # One solve at a time (capacity accounting is sequential); control
         # RPCs use _lock only.
@@ -277,6 +299,15 @@ class TPUSchedulerBackend:
                 with self._lock:
                     result = self._commit(work, *solved)
         result.solve_micros = int((time.perf_counter() - t0) * 1e6)
+        if work is not None:
+            self._m_solves.inc()
+            self._m_solve_seconds.observe(time.perf_counter() - t0)
+            admitted = sum(1 for g in result.gangs if g.admitted)
+            self._m_gangs_admitted.inc(admitted)
+            self._m_gangs_rejected.inc(len(result.gangs) - admitted)
+            self._m_pods_bound.inc(
+                sum(len(g.bindings) for g in result.gangs if g.admitted)
+            )
         return result
 
     def _collect_pending(self):
@@ -509,7 +540,11 @@ def _handlers(servicer: TPUSchedulerBackend) -> grpc.GenericRpcHandler:
 
 
 def create_server(
-    port: int = 0, max_workers: int = 8, solver_config=None, priority_classes=None
+    port: int = 0,
+    max_workers: int = 8,
+    solver_config=None,
+    priority_classes=None,
+    metrics=None,
 ) -> tuple[grpc.Server, int]:
     """Build + start the sidecar server; returns (server, bound port)."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -517,7 +552,9 @@ def create_server(
         (
             _handlers(
                 TPUSchedulerBackend(
-                    solver_config=solver_config, priority_classes=priority_classes
+                    solver_config=solver_config,
+                    priority_classes=priority_classes,
+                    metrics=metrics,
                 )
             ),
         )

@@ -106,15 +106,22 @@ def main(argv=None) -> int:
     p_del.add_argument("kind")
     p_del.add_argument("name")
 
+    from grove_tpu.api.constants import EVENTS_BUFFER
+
+    def _tail(value: str) -> int:
+        n = int(value)
+        if not 0 <= n <= EVENTS_BUFFER:
+            raise argparse.ArgumentTypeError(f"must be 0-{EVENTS_BUFFER}")
+        return n
+
     p_ev = sub.add_parser("events", help="recent control-plane events")
-    # The server returns at most the last 200 events; larger --tail values
-    # would silently truncate, so the parser enforces the cap visibly.
+    # The server returns at most the last EVENTS_BUFFER events; larger
+    # --tail values would silently truncate, so the parser rejects them.
     p_ev.add_argument(
         "--tail",
-        type=int,
+        type=_tail,
         default=20,
-        help="lines to show (server keeps the last 200)",
-        choices=range(0, 201),
+        help=f"lines to show (server keeps the last {EVENTS_BUFFER})",
         metavar="N",
     )
 

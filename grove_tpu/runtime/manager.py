@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Optional
 
+from grove_tpu.api import constants
 from grove_tpu.api.admission import AdmissionChain, Authorizer
 from grove_tpu.api.types import PodCliqueSet
 from grove_tpu.orchestrator.controller import GroveController
@@ -137,7 +138,7 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
         if kind == "events":
             self._respond(
                 200,
-                json.dumps([list(e) for e in c.events[-200:]]),
+                json.dumps([list(e) for e in c.events[-constants.EVENTS_BUFFER:]]),
                 "application/json",
             )
             return
@@ -419,6 +420,7 @@ class Manager:
                 max_workers=cfg.backend.max_workers,
                 solver_config=cfg.solver,
                 priority_classes=cfg.scheduling.priority_classes,
+                metrics=self.metrics,  # sidecar solves surface on /metrics
             )
             self.log.info("backend sidecar listening", port=self.backend_port)
         if cfg.persistence.enabled:

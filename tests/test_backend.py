@@ -153,3 +153,26 @@ def test_delete_gang_releases_capacity(backend):
     resp = backend.solve()
     gr = next(g for g in resp.gangs if g.name == "g4")
     assert gr.admitted and len(gr.bindings) == 16
+
+
+def test_solve_metrics_recorded():
+    """Sidecar Solve RPCs record counters/histogram in the injected registry
+    (manager /metrics surface; GREP-244 placement-metrics direction)."""
+    from grove_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    server, port = create_server(port=0, metrics=reg)
+    client = BackendClient(f"127.0.0.1:{port}")
+    try:
+        client.init([("zone", ZONE), ("rack", RACK)])
+        client.update_cluster(_nodes(8), full_replace=True)
+        client.sync_pod_gang(_gang("gm"))
+        resp = client.solve()
+        assert any(g.admitted for g in resp.gangs)
+    finally:
+        client.close()
+        server.stop(grace=None)
+    text = reg.render_text()
+    assert "grove_backend_solves_total 1" in text
+    assert "grove_backend_pods_bound_total 6" in text
+    assert "grove_backend_solve_seconds_count 1" in text
