@@ -72,8 +72,11 @@ def _pack_constraint(p: Optional[pb.PackConstraint]) -> Optional[IRTopologyConst
     )
 
 
-def _gang_from_proto(spec: pb.PodGangSpec) -> tuple[PodGang, dict[str, dict[str, float]]]:
-    """Proto -> PodGang IR + per-group per-pod request map."""
+def _gang_from_proto(
+    spec: pb.PodGangSpec,
+) -> tuple[PodGang, dict[str, dict[str, float]], dict[str, dict[str, str]]]:
+    """Proto -> PodGang IR + per-group per-pod request map + per-group
+    nodeSelector map."""
     gang = PodGang(name=spec.name, namespace=spec.namespace or "default")
     gang.spec.priority_class_name = spec.priority_class_name
     gang.spec.topology_constraint = _pack_constraint(
@@ -85,6 +88,7 @@ def _gang_from_proto(spec: pb.PodGangSpec) -> tuple[PodGang, dict[str, dict[str,
             spec.reuse_reservation_ref.namespace, spec.reuse_reservation_ref.name
         )
     requests: dict[str, dict[str, float]] = {}
+    selectors: dict[str, dict[str, str]] = {}
     for grp in spec.pod_groups:
         g = PodGroup(
             name=grp.name,
@@ -98,6 +102,8 @@ def _gang_from_proto(spec: pb.PodGangSpec) -> tuple[PodGang, dict[str, dict[str,
         )
         gang.spec.pod_groups.append(g)
         requests[grp.name] = {q.name: q.value for q in grp.per_pod_requests}
+        if grp.node_selector:
+            selectors[grp.name] = dict(grp.node_selector)
     for gc in spec.group_configs:
         gang.spec.topology_constraint_group_configs.append(
             TopologyConstraintGroupConfig(
@@ -108,7 +114,7 @@ def _gang_from_proto(spec: pb.PodGangSpec) -> tuple[PodGang, dict[str, dict[str,
                 ),
             )
         )
-    return gang, requests
+    return gang, requests, selectors
 
 
 class TPUSchedulerBackend:
@@ -151,6 +157,7 @@ class TPUSchedulerBackend:
         self._nodes: dict[str, Node] = {}
         self._gangs: dict[str, PodGang] = {}
         self._group_requests: dict[str, dict[str, dict[str, float]]] = {}  # gang -> group -> reqs
+        self._group_selectors: dict[str, dict[str, dict[str, str]]] = {}  # gang -> group -> nodeSelector
         self._bindings: dict[str, tuple[str, str, str]] = {}  # pod -> (node, gang, group)
         self._scheduled_gangs: set[str] = set()
         self._solver_config = solver_config or SolverConfig()
@@ -167,9 +174,10 @@ class TPUSchedulerBackend:
         return max(configured, pow2) if configured else pow2
 
     @staticmethod
-    def _gang_fingerprint(gang: PodGang, reqs: dict) -> tuple:
+    def _gang_fingerprint(gang: PodGang, reqs: dict, sels: dict) -> tuple:
         """Spec identity for mid-solve drift detection (see _commit): pods,
-        floors, per-group requests, and every pack-constraint key."""
+        floors, per-group requests, nodeSelectors, and every pack-constraint
+        key — a selector-only re-sync invalidates the placement too."""
 
         def pc(tc):
             if tc is None or tc.pack_constraint is None:
@@ -183,6 +191,7 @@ class TPUSchedulerBackend:
                     grp.min_replicas,
                     tuple(sorted(r.name for r in grp.pod_references)),
                     tuple(sorted((reqs.get(grp.name) or {}).items())),
+                    tuple(sorted((sels.get(grp.name) or {}).items())),
                     pc(grp.topology_constraint),
                 )
                 for grp in gang.spec.pod_groups
@@ -212,12 +221,13 @@ class TPUSchedulerBackend:
         return pb.InitResponse(name=BACKEND_NAME)
 
     def SyncPodGang(self, request: pb.SyncPodGangRequest, context) -> pb.SyncPodGangResponse:
-        gang, requests = _gang_from_proto(request.pod_gang)
+        gang, requests, selectors = _gang_from_proto(request.pod_gang)
         if not gang.name:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "pod_gang.name required")
         with self._lock:
             self._gangs[gang.name] = gang
             self._group_requests[gang.name] = requests
+            self._group_selectors[gang.name] = selectors
             # Drop bindings of pods no longer referenced (spec shrink).
             live = {r.name for g in gang.spec.pod_groups for r in g.pod_references}
             for pod in [p for p, (_, gname, _) in self._bindings.items()
@@ -229,6 +239,7 @@ class TPUSchedulerBackend:
         with self._lock:
             self._gangs.pop(request.name, None)
             self._group_requests.pop(request.name, None)
+            self._group_selectors.pop(request.name, None)
             self._scheduled_gangs.discard(request.name)
             for pod in [p for p, (_, gname, _) in self._bindings.items() if gname == request.name]:
                 del self._bindings[pod]
@@ -330,6 +341,7 @@ class TPUSchedulerBackend:
             lambda g: self._priority_classes.get(g.spec.priority_class_name, 0),
         ):
             reqs = self._group_requests.get(gang.name, {})
+            sels = self._group_selectors.get(gang.name, {})
             unbound_refs: dict[str, list] = {}
             bound_counts: dict[str, int] = {}
             per_group_bound: dict[str, list[str]] = {}
@@ -343,11 +355,15 @@ class TPUSchedulerBackend:
                     continue
                 unbound_refs[grp.name] = unbound
                 group_reqs = reqs.get(grp.name, {})
+                group_sel = sels.get(grp.name, {})
                 for ref in unbound:
                     pods_by_name[ref.name] = Pod(
                         name=ref.name,
                         namespace=ref.namespace,
-                        spec=PodSpec(containers=[Container(name="c", requests=dict(group_reqs))]),
+                        spec=PodSpec(
+                            containers=[Container(name="c", requests=dict(group_reqs))],
+                            node_selector=dict(group_sel),
+                        ),
                     )
             sub = build_pending_subgang(gang, unbound_refs, bound_counts)
             if sub is None:
@@ -393,7 +409,9 @@ class TPUSchedulerBackend:
             # Spec fingerprints for drift detection at commit time.
             "fingerprints": {
                 sub.name: self._gang_fingerprint(
-                    self._gangs[sub.name], self._group_requests.get(sub.name, {})
+                    self._gangs[sub.name],
+                    self._group_requests.get(sub.name, {}),
+                    self._group_selectors.get(sub.name, {}),
                 )
                 for sub in pending
             },
@@ -479,7 +497,9 @@ class TPUSchedulerBackend:
             # names are unchanged — comparing names alone would commit
             # bindings solved for the OLD spec.
             live_fp = self._gang_fingerprint(
-                live, self._group_requests.get(gang_name, {})
+                live,
+                self._group_requests.get(gang_name, {}),
+                self._group_selectors.get(gang_name, {}),
             )
             spec_drifted = live_fp != work["fingerprints"].get(gang_name)
             gr = pb.GangResult(

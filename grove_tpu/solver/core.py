@@ -192,6 +192,13 @@ def _place_gang(
     # committing domains, so per-node slots, per-node fused feature rows, and
     # per-level segment ids are computed once per gang, not once per set.
     slots_all = _group_slots(free, group_req)  # [MG, N]
+    # nodeSelector eligibility (encode.GangBatch.group_node_ok): ineligible
+    # nodes offer zero slots for the group, which flows into every
+    # feasibility aggregate below. Present only when a pod in the batch
+    # carries a selector — the common case compiles without this input.
+    eligible = gang.get("group_node_ok")  # bool [MG, N] or None
+    if eligible is not None:
+        slots_all = jnp.where(eligible, slots_all, 0)
     seg_all, dom_all = jax.vmap(lambda lv: seg_of(lv))(jnp.arange(levels))  # [L, N] x2
     # Fused per-node feature rows: [free (R) | slots (MG) | 1] — one
     # segment-sum yields domain free, domain slots, and domain node-count
@@ -420,6 +427,11 @@ def _place_gang(
 
         masks = jax.vmap(set_mask)(committed_req, set_req_level, set_member[:, g])  # [MS, N]
         node_ok = schedulable & masks.all(axis=0)
+        if eligible is not None:
+            # nodeSelector: allocation must honor it too — stage-2 recomputes
+            # slots from LIVE free, so the stage-1 slots_all mask alone would
+            # not constrain the take.
+            node_ok = node_ok & eligible[g]
 
         slots = _group_slots(free_g, req[None, :])[0]  # [N]
         slots = jnp.where(node_ok, jnp.minimum(slots, total), 0)
@@ -580,6 +592,8 @@ def solve_batch(
         "index": jnp.arange(g, dtype=jnp.int32),
         "reuse": _reuse_of(batch, n),
     }
+    if batch.group_node_ok is not None:
+        gang_dict["group_node_ok"] = batch.group_node_ok
     (free_final, _), (assigned, ok, score) = jax.lax.scan(
         step, (free0, jnp.zeros((g,), dtype=bool)), (gang_dict, jnp.arange(g))
     )
@@ -668,6 +682,8 @@ def solve_batch_speculative(
         "index": jnp.arange(g, dtype=jnp.int32),
         "reuse": _reuse_of(batch, n),
     }
+    if batch.group_node_ok is not None:
+        gang_dict["group_node_ok"] = batch.group_node_ok
 
     def place_one(free, gang_slices):
         used0 = gang_slices["reuse"]  # ReuseReservationRef seed (see solve_batch)

@@ -70,6 +70,11 @@ class GangBatch(NamedTuple):
     # incarnation occupied. Seeds the solver's per-gang locality (w_reuse), so
     # a rolling-updated gang prefers its old placement when capacity allows.
     reuse_nodes: np.ndarray = None  # bool [G, N]
+    # Per-group node eligibility from pod nodeSelector (we ARE the scheduler,
+    # so selector semantics are enforced here, not delegated): bool [G, MG, N]
+    # or None when no pod in the batch carries a selector — the common case
+    # pays nothing.
+    group_node_ok: np.ndarray = None
 
     @property
     def n_gangs(self) -> int:
@@ -236,6 +241,10 @@ def encode_gangs(
     decode = GangDecodeInfo(gang_names=[], pod_names=[], group_names=[])
     gang_index = {g.name: i for i, g in enumerate(gangs)}
     scheduled_gangs = scheduled_gangs or set()
+    selector_masks: np.ndarray | None = None  # bool [G, MG, N], lazy
+    # One O(N) label scan per UNIQUE selector, not per group — gang families
+    # share selectors, and this runs on the per-Solve encode hot path.
+    selector_rows: dict[tuple, np.ndarray] = {}
     # Normalize per resource before summing — raw units are incomparable
     # (cpu cores ~1 vs memory bytes ~1e10 vs TPU chips ~4).
     cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
@@ -283,6 +292,30 @@ def encode_gangs(
                         f"{grp.name!r} not found in pods_by_name"
                     )
                 batch.group_req[gi, k] = pod_request_vector(first, snapshot.resource_names)
+                selector = first.spec.node_selector
+                if selector:
+                    # nodeSelector semantics (we ARE the scheduler): a node is
+                    # eligible iff its labels are a superset of the selector.
+                    # Pods of one group share a template, so the first pod
+                    # speaks for the group. Lazily materialized — no selector
+                    # in the batch means no [G, MG, N] tensor at all.
+                    if selector_masks is None:
+                        selector_masks = np.ones(
+                            (g_count, mg, snapshot.capacity.shape[0]), dtype=bool
+                        )
+                    key = tuple(sorted(selector.items()))
+                    row = selector_rows.get(key)
+                    if row is None:
+                        row = np.fromiter(
+                            (
+                                all(lbl.get(sk) == sv for sk, sv in key)
+                                for lbl in snapshot.node_labels
+                            ),
+                            dtype=bool,
+                            count=len(snapshot.node_labels),
+                        )
+                        selector_rows[key] = row
+                    selector_masks[gi, k] = row
             for rank, ref in enumerate(refs):
                 batch.pod_group[gi, slot] = k
                 batch.pod_rank[gi, slot] = rank
@@ -327,4 +360,6 @@ def encode_gangs(
         decode.pod_names.append(pod_names)
         decode.group_names.append(group_names)
 
+    if selector_masks is not None:
+        batch = batch._replace(group_node_ok=selector_masks)
     return batch, decode

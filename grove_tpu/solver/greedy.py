@@ -191,6 +191,11 @@ def greedy_place_gang(
         k = int(b.pod_group[0, s])
         req = b.group_req[0, k]
         mask = schedulable & (trial + _EPS >= req).all(axis=1)
+        if b.group_node_ok is not None:
+            # nodeSelector: the baseline enforces the same constraint as the
+            # solver — waiving it would let greedy "admit" placements the
+            # solver correctly rejects and poison the quality comparison.
+            mask &= b.group_node_ok[0, k]
         pref_bonus = np.zeros(free.shape[0])
         for si in range(ms):
             if not b.set_valid[0, si] or not b.set_member[0, si, k]:

@@ -55,6 +55,9 @@ class ClusterSnapshot:
     domain_names: list[list[str]]  # per level: ordinal -> domain value
     num_domains: np.ndarray  # i32 [L] (actual domain count per level)
     node_index_map: dict[str, int] = field(default_factory=dict)
+    # Raw node labels (shared references, not copies), padded rows empty —
+    # nodeSelector matching happens against these at encode time.
+    node_labels: list[dict] = field(default_factory=list)
 
     @property
     def n_nodes(self) -> int:
@@ -164,6 +167,7 @@ def build_snapshot(
         domain_names=domain_names,
         num_domains=num_domains,
         node_index_map={x.name: i for i, x in enumerate(nodes)},
+        node_labels=[x.labels for x in nodes] + [{} for _ in range(n - n_real)],
     )
     for pod in bound_pods or []:
         # Skip stale bindings to nodes that no longer exist (routine race

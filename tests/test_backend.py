@@ -176,3 +176,28 @@ def test_solve_metrics_recorded():
     assert "grove_backend_solves_total 1" in text
     assert "grove_backend_pods_bound_total 6" in text
     assert "grove_backend_solve_seconds_count 1" in text
+
+
+def test_solve_honors_node_selector():
+    """A group's nodeSelector (PodGroup proto field) constrains its bindings
+    to matching nodes — backend parity with the in-process solver path."""
+    server, port = create_server(port=0)
+    client = BackendClient(f"127.0.0.1:{port}")
+    try:
+        client.init([("zone", ZONE), ("rack", RACK)])
+        nodes = _nodes(8)
+        for i, n in enumerate(nodes):
+            n.labels["pool"] = "tpu" if i >= 6 else "cpu"
+        client.update_cluster(nodes, full_replace=True)
+        spec = _gang("gsel", pods_per_group=2, min_replicas=2)
+        spec.pod_groups[0].node_selector["pool"] = "tpu"
+        client.sync_pod_gang(spec)
+        resp = client.solve()
+        admitted = {g.name: g for g in resp.gangs if g.admitted}
+        assert "gsel" in admitted
+        for b in admitted["gsel"].bindings:
+            if "alpha" in b.pod_name:  # the selector-pinned group
+                assert b.node_name in ("n6", "n7"), (b.pod_name, b.node_name)
+    finally:
+        client.close()
+        server.stop(grace=None)

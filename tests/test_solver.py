@@ -202,3 +202,51 @@ def test_snapshot_skips_stale_node_binding(simple_setup):
     topo = mk_topology()
     snap = build_snapshot(mk_nodes(2), topo, bound_pods=[stale])
     assert (snap.allocated == 0).all()
+
+
+def test_node_selector_constrains_placement(simple1: PodCliqueSet):
+    """nodeSelector semantics (we ARE the scheduler): a pod with a selector
+    only lands on nodes whose labels match; the rest of the gang is free."""
+    topo = mk_topology()
+    nodes = mk_nodes(8)
+    for i, node in enumerate(nodes):
+        node.labels["pool"] = "tpu" if i >= 6 else "cpu"
+    ds = expand_podcliqueset(simple1, topo)
+    pods_by_name = {p.name: p for p in ds.pods}
+    # Pin the frontend clique to the tpu pool (nodes 6,7 only).
+    for p in pods_by_name.values():
+        if "frontend" in p.pclq_fqn:
+            p.spec.node_selector = {"pool": "tpu"}
+    snap = build_snapshot(nodes, topo)
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    assert batch.group_node_ok is not None
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, decode, snap)
+    for pod_name, node_name in bindings["simple1-0"].items():
+        if "frontend" in pod_name:
+            assert node_name in ("n6", "n7"), f"{pod_name} on {node_name}"
+
+
+def test_node_selector_unsatisfiable_rejects_gang(simple1: PodCliqueSet):
+    """A selector no node matches makes the gang floor unmeetable — the gang
+    rejects whole (all-or-nothing), and nothing else is placed from it."""
+    topo = mk_topology()
+    ds = expand_podcliqueset(simple1, topo)
+    pods_by_name = {p.name: p for p in ds.pods}
+    for p in pods_by_name.values():
+        if "frontend" in p.pclq_fqn:
+            p.spec.node_selector = {"pool": "nonexistent"}
+    snap = build_snapshot(mk_nodes(8), topo)
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    bindings = decode_assignments(result, decode, snap)
+    assert "simple1-0" not in bindings, "base gang must reject whole"
+
+
+def test_no_selector_means_no_mask_tensor(simple_setup):
+    """The common case (no selectors anywhere) must not materialize the
+    [G, MG, N] eligibility tensor — bench-path cost control."""
+    ds, snap, pods_by_name = simple_setup
+    batch, _ = encode_gangs(ds.podgangs, pods_by_name, snap)
+    assert batch.group_node_ok is None
