@@ -19,6 +19,14 @@ def node_to_proto(node) -> pb.Node:
         capacity=[pb.ResourceQuantity(name=k, value=v) for k, v in node.capacity.items()],
         labels=dict(node.labels),
         schedulable=node.schedulable,
+        taints=[
+            pb.Taint(
+                key=t.get("key", ""),
+                value=t.get("value", ""),
+                effect=t.get("effect", ""),
+            )
+            for t in getattr(node, "taints", [])
+        ],
     )
 
 

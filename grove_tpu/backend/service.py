@@ -74,9 +74,14 @@ def _pack_constraint(p: Optional[pb.PackConstraint]) -> Optional[IRTopologyConst
 
 def _gang_from_proto(
     spec: pb.PodGangSpec,
-) -> tuple[PodGang, dict[str, dict[str, float]], dict[str, dict[str, str]]]:
-    """Proto -> PodGang IR + per-group per-pod request map + per-group
-    nodeSelector map."""
+) -> tuple[
+    PodGang,
+    dict[str, dict[str, float]],
+    dict[str, dict[str, str]],
+    dict[str, list[dict]],
+]:
+    """Proto -> PodGang IR + per-group maps: per-pod requests, nodeSelector,
+    tolerations."""
     gang = PodGang(name=spec.name, namespace=spec.namespace or "default")
     gang.spec.priority_class_name = spec.priority_class_name
     gang.spec.topology_constraint = _pack_constraint(
@@ -89,6 +94,7 @@ def _gang_from_proto(
         )
     requests: dict[str, dict[str, float]] = {}
     selectors: dict[str, dict[str, str]] = {}
+    tolerations: dict[str, list[dict]] = {}
     for grp in spec.pod_groups:
         g = PodGroup(
             name=grp.name,
@@ -104,6 +110,16 @@ def _gang_from_proto(
         requests[grp.name] = {q.name: q.value for q in grp.per_pod_requests}
         if grp.node_selector:
             selectors[grp.name] = dict(grp.node_selector)
+        if grp.tolerations:
+            tolerations[grp.name] = [
+                {
+                    "key": t.key,
+                    "operator": t.operator or "Equal",
+                    "value": t.value,
+                    "effect": t.effect,
+                }
+                for t in grp.tolerations
+            ]
     for gc in spec.group_configs:
         gang.spec.topology_constraint_group_configs.append(
             TopologyConstraintGroupConfig(
@@ -114,7 +130,7 @@ def _gang_from_proto(
                 ),
             )
         )
-    return gang, requests, selectors
+    return gang, requests, selectors, tolerations
 
 
 class TPUSchedulerBackend:
@@ -158,6 +174,7 @@ class TPUSchedulerBackend:
         self._gangs: dict[str, PodGang] = {}
         self._group_requests: dict[str, dict[str, dict[str, float]]] = {}  # gang -> group -> reqs
         self._group_selectors: dict[str, dict[str, dict[str, str]]] = {}  # gang -> group -> nodeSelector
+        self._group_tolerations: dict[str, dict[str, list]] = {}  # gang -> group -> tolerations
         self._bindings: dict[str, tuple[str, str, str]] = {}  # pod -> (node, gang, group)
         self._scheduled_gangs: set[str] = set()
         self._solver_config = solver_config or SolverConfig()
@@ -174,10 +191,13 @@ class TPUSchedulerBackend:
         return max(configured, pow2) if configured else pow2
 
     @staticmethod
-    def _gang_fingerprint(gang: PodGang, reqs: dict, sels: dict) -> tuple:
+    def _gang_fingerprint(
+        gang: PodGang, reqs: dict, sels: dict, tols: dict
+    ) -> tuple:
         """Spec identity for mid-solve drift detection (see _commit): pods,
-        floors, per-group requests, nodeSelectors, and every pack-constraint
-        key — a selector-only re-sync invalidates the placement too."""
+        floors, per-group requests, nodeSelectors, tolerations, and every
+        pack-constraint key — a selector/toleration-only re-sync invalidates
+        the placement too."""
 
         def pc(tc):
             if tc is None or tc.pack_constraint is None:
@@ -192,6 +212,10 @@ class TPUSchedulerBackend:
                     tuple(sorted(r.name for r in grp.pod_references)),
                     tuple(sorted((reqs.get(grp.name) or {}).items())),
                     tuple(sorted((sels.get(grp.name) or {}).items())),
+                    tuple(
+                        tuple(sorted(t.items()))
+                        for t in (tols.get(grp.name) or [])
+                    ),
                     pc(grp.topology_constraint),
                 )
                 for grp in gang.spec.pod_groups
@@ -221,13 +245,14 @@ class TPUSchedulerBackend:
         return pb.InitResponse(name=BACKEND_NAME)
 
     def SyncPodGang(self, request: pb.SyncPodGangRequest, context) -> pb.SyncPodGangResponse:
-        gang, requests, selectors = _gang_from_proto(request.pod_gang)
+        gang, requests, selectors, tolerations = _gang_from_proto(request.pod_gang)
         if not gang.name:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "pod_gang.name required")
         with self._lock:
             self._gangs[gang.name] = gang
             self._group_requests[gang.name] = requests
             self._group_selectors[gang.name] = selectors
+            self._group_tolerations[gang.name] = tolerations
             # Drop bindings of pods no longer referenced (spec shrink).
             live = {r.name for g in gang.spec.pod_groups for r in g.pod_references}
             for pod in [p for p, (_, gname, _) in self._bindings.items()
@@ -240,6 +265,7 @@ class TPUSchedulerBackend:
             self._gangs.pop(request.name, None)
             self._group_requests.pop(request.name, None)
             self._group_selectors.pop(request.name, None)
+            self._group_tolerations.pop(request.name, None)
             self._scheduled_gangs.discard(request.name)
             for pod in [p for p, (_, gname, _) in self._bindings.items() if gname == request.name]:
                 del self._bindings[pod]
@@ -284,6 +310,10 @@ class TPUSchedulerBackend:
                     capacity={q.name: q.value for q in n.capacity},
                     labels=dict(n.labels),
                     schedulable=n.schedulable,
+                    taints=[
+                        {"key": t.key, "value": t.value, "effect": t.effect}
+                        for t in n.taints
+                    ],
                 )
             return pb.UpdateClusterResponse(node_count=len(self._nodes))
 
@@ -342,6 +372,7 @@ class TPUSchedulerBackend:
         ):
             reqs = self._group_requests.get(gang.name, {})
             sels = self._group_selectors.get(gang.name, {})
+            tols = self._group_tolerations.get(gang.name, {})
             unbound_refs: dict[str, list] = {}
             bound_counts: dict[str, int] = {}
             per_group_bound: dict[str, list[str]] = {}
@@ -356,6 +387,7 @@ class TPUSchedulerBackend:
                 unbound_refs[grp.name] = unbound
                 group_reqs = reqs.get(grp.name, {})
                 group_sel = sels.get(grp.name, {})
+                group_tol = tols.get(grp.name, [])
                 for ref in unbound:
                     pods_by_name[ref.name] = Pod(
                         name=ref.name,
@@ -363,6 +395,7 @@ class TPUSchedulerBackend:
                         spec=PodSpec(
                             containers=[Container(name="c", requests=dict(group_reqs))],
                             node_selector=dict(group_sel),
+                            tolerations=list(group_tol),
                         ),
                     )
             sub = build_pending_subgang(gang, unbound_refs, bound_counts)
@@ -412,6 +445,7 @@ class TPUSchedulerBackend:
                     self._gangs[sub.name],
                     self._group_requests.get(sub.name, {}),
                     self._group_selectors.get(sub.name, {}),
+                    self._group_tolerations.get(sub.name, {}),
                 )
                 for sub in pending
             },
@@ -500,6 +534,7 @@ class TPUSchedulerBackend:
                 live,
                 self._group_requests.get(gang_name, {}),
                 self._group_selectors.get(gang_name, {}),
+                self._group_tolerations.get(gang_name, {}),
             )
             spec_drifted = live_fp != work["fingerprints"].get(gang_name)
             gr = pb.GangResult(

@@ -136,6 +136,7 @@ class KwokCluster:
             "capacity": dict(node.capacity),
             "labels": dict(node.labels),
             "schedulable": node.schedulable,
+            "taints": [dict(t) for t in node.taints],
         }
 
     def _emit(self, at: float, etype: EventType, kind: str, name: str, obj: dict) -> None:

@@ -97,6 +97,7 @@ class WatchDriver:
                 capacity=dict(ev.obj.get("capacity", {})),
                 labels=dict(ev.obj.get("labels", {})),
                 schedulable=bool(ev.obj.get("schedulable", True)),
+                taints=[dict(t) for t in ev.obj.get("taints", [])],
             )
         self._nodes_dirty = True
 

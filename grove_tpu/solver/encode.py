@@ -102,6 +102,38 @@ def _level_index(snapshot: ClusterSnapshot, label_key: str | None) -> int:
     return -1
 
 
+_BLOCKING_EFFECTS = ("NoSchedule", "NoExecute")
+
+
+def _tolerates(tolerations: list[dict], taint: dict) -> bool:
+    """k8s toleration-vs-taint match: key equal (or empty key + Exists),
+    operator Equal -> values equal, effect empty-or-equal."""
+    for tol in tolerations:
+        op = tol.get("operator", "Equal")
+        key = tol.get("key", "")
+        if key and key != taint.get("key"):
+            continue
+        if not key and op != "Exists":
+            continue
+        if op == "Equal" and tol.get("value", "") != taint.get("value", ""):
+            continue
+        eff = tol.get("effect", "")
+        if eff and eff != taint.get("effect", ""):
+            continue
+        return True
+    return False
+
+
+def node_tolerated(tolerations: list[dict], taints: list[dict]) -> bool:
+    """True iff every scheduling-blocking taint on the node is tolerated
+    (PreferNoSchedule is soft and never blocks)."""
+    return all(
+        _tolerates(tolerations, t)
+        for t in taints
+        if t.get("effect") in _BLOCKING_EFFECTS
+    )
+
+
 def pack_set_count(gang: PodGang) -> int:
     """Number of pack-sets this gang encodes to (shape-bucketing input)."""
     tc = gang.spec.topology_constraint
@@ -242,9 +274,18 @@ def encode_gangs(
     gang_index = {g.name: i for i, g in enumerate(gangs)}
     scheduled_gangs = scheduled_gangs or set()
     selector_masks: np.ndarray | None = None  # bool [G, MG, N], lazy
-    # One O(N) label scan per UNIQUE selector, not per group — gang families
-    # share selectors, and this runs on the per-Solve encode hot path.
+    # One O(N) label scan per UNIQUE selector / toleration set, not per
+    # group — gang families share templates, and this runs on the per-Solve
+    # encode hot path.
     selector_rows: dict[tuple, np.ndarray] = {}
+    toleration_rows: dict[tuple, np.ndarray] = {}
+    # Nodes carrying scheduling-blocking taints; empty on the common
+    # untainted cluster, keeping the mask tensor unmaterialized.
+    tainted_idx = [
+        i
+        for i, taints in enumerate(snapshot.node_taints)
+        if any(t.get("effect") in _BLOCKING_EFFECTS for t in taints)
+    ]
     # Normalize per resource before summing — raw units are incomparable
     # (cpu cores ~1 vs memory bytes ~1e10 vs TPU chips ~4).
     cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
@@ -293,28 +334,48 @@ def encode_gangs(
                     )
                 batch.group_req[gi, k] = pod_request_vector(first, snapshot.resource_names)
                 selector = first.spec.node_selector
-                if selector:
-                    # nodeSelector semantics (we ARE the scheduler): a node is
-                    # eligible iff its labels are a superset of the selector.
-                    # Pods of one group share a template, so the first pod
-                    # speaks for the group. Lazily materialized — no selector
-                    # in the batch means no [G, MG, N] tensor at all.
+                if selector or tainted_idx:
+                    # nodeSelector + taint semantics (we ARE the scheduler):
+                    # a node is eligible iff its labels are a superset of the
+                    # selector AND every blocking taint is tolerated. Pods of
+                    # one group share a template, so the first pod speaks for
+                    # the group. Lazily materialized — no selector and no
+                    # tainted node means no [G, MG, N] tensor at all.
                     if selector_masks is None:
                         selector_masks = np.ones(
                             (g_count, mg, snapshot.capacity.shape[0]), dtype=bool
                         )
-                    key = tuple(sorted(selector.items()))
-                    row = selector_rows.get(key)
-                    if row is None:
-                        row = np.fromiter(
-                            (
-                                all(lbl.get(sk) == sv for sk, sv in key)
-                                for lbl in snapshot.node_labels
-                            ),
-                            dtype=bool,
-                            count=len(snapshot.node_labels),
+                    row = np.ones((snapshot.capacity.shape[0],), dtype=bool)
+                    if selector:
+                        key = tuple(sorted(selector.items()))
+                        sel_row = selector_rows.get(key)
+                        if sel_row is None:
+                            sel_row = np.fromiter(
+                                (
+                                    all(lbl.get(sk) == sv for sk, sv in key)
+                                    for lbl in snapshot.node_labels
+                                ),
+                                dtype=bool,
+                                count=len(snapshot.node_labels),
+                            )
+                            selector_rows[key] = sel_row
+                        row = row & sel_row
+                    if tainted_idx:
+                        tols = first.spec.tolerations
+                        tkey = tuple(
+                            tuple(sorted(t.items())) for t in tols
                         )
-                        selector_rows[key] = row
+                        tol_row = toleration_rows.get(tkey)
+                        if tol_row is None:
+                            tol_row = np.ones(
+                                (snapshot.capacity.shape[0],), dtype=bool
+                            )
+                            for i in tainted_idx:
+                                tol_row[i] = node_tolerated(
+                                    tols, snapshot.node_taints[i]
+                                )
+                            toleration_rows[tkey] = tol_row
+                        row = row & tol_row
                     selector_masks[gi, k] = row
             for rank, ref in enumerate(refs):
                 batch.pod_group[gi, slot] = k

@@ -94,12 +94,20 @@ def _commit_domains(free, snapshot, b, schedulable):
                 continue
             feasible = True
             for k, floor in per_group_floor:
+                # Per-group eligibility (nodeSelector/tolerations) gates the
+                # floor check too: a domain whose eligible subset can't host
+                # the floor must not be committed, or the later per-pod mask
+                # empties and the gang is falsely rejected (the solver masks
+                # slots before domain selection; the baseline must match).
+                ksel = sel
+                if b.group_node_ok is not None:
+                    ksel = sel & b.group_node_ok[0, k]
                 req = b.group_req[0, k]
                 pos = req > 0
                 if pos.any():
-                    slots = np.floor((free[sel][:, pos] + _EPS) / req[pos]).min(axis=1)
+                    slots = np.floor((free[ksel][:, pos] + _EPS) / req[pos]).min(axis=1)
                 else:
-                    slots = np.full(sel.sum(), 1 << 20)
+                    slots = np.full(ksel.sum(), 1 << 20)
                 if slots.sum() < floor:
                     feasible = False
                     break

@@ -37,6 +37,9 @@ class Node:
     capacity: dict[str, float] = field(default_factory=dict)
     labels: dict[str, str] = field(default_factory=dict)
     schedulable: bool = True
+    # k8s taints ({"key", "value", "effect"}); NoSchedule/NoExecute block
+    # placement unless the pod tolerates them (we ARE the scheduler).
+    taints: list[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -58,6 +61,8 @@ class ClusterSnapshot:
     # Raw node labels (shared references, not copies), padded rows empty —
     # nodeSelector matching happens against these at encode time.
     node_labels: list[dict] = field(default_factory=list)
+    # Raw node taints, same layout; empty for untainted/padded rows.
+    node_taints: list[list] = field(default_factory=list)
 
     @property
     def n_nodes(self) -> int:
@@ -168,6 +173,7 @@ def build_snapshot(
         num_domains=num_domains,
         node_index_map={x.name: i for i, x in enumerate(nodes)},
         node_labels=[x.labels for x in nodes] + [{} for _ in range(n - n_real)],
+        node_taints=[x.taints for x in nodes] + [[] for _ in range(n - n_real)],
     )
     for pod in bound_pods or []:
         # Skip stale bindings to nodes that no longer exist (routine race

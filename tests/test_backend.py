@@ -201,3 +201,47 @@ def test_solve_honors_node_selector():
     finally:
         client.close()
         server.stop(grace=None)
+
+
+def test_solve_honors_taints_and_tolerations():
+    """Node taints flow through UpdateCluster and group tolerations through
+    SyncPodGang; the solve places only on tolerated nodes."""
+    server, port = create_server(port=0)
+    client = BackendClient(f"127.0.0.1:{port}")
+    try:
+        client.init([("zone", ZONE), ("rack", RACK)])
+        nodes = _nodes(8)
+        for n in nodes[:6]:
+            n.taints.append(
+                pb.Taint(key="dedicated", value="infer", effect="NoSchedule")
+            )
+        client.update_cluster(nodes, full_replace=True)
+        spec = _gang("gtaint", pods_per_group=2, min_replicas=2)
+        client.sync_pod_gang(spec)
+        resp = client.solve()
+        admitted = {g.name: g for g in resp.gangs if g.admitted}
+        assert "gtaint" in admitted
+        for b in admitted["gtaint"].bindings:
+            assert b.node_name in ("n6", "n7"), (b.pod_name, b.node_name)
+
+        # A tolerating gang may use the tainted pool.
+        spec2 = _gang("gtol", pods_per_group=3, min_replicas=3)
+        for grp in spec2.pod_groups:
+            grp.tolerations.append(
+                pb.Toleration(
+                    key="dedicated", operator="Equal", value="infer", effect="NoSchedule"
+                )
+            )
+        client.sync_pod_gang(spec2)
+        resp = client.solve()
+        admitted = {g.name: g for g in resp.gangs if g.admitted}
+        assert "gtol" in admitted
+        tainted_used = [
+            b.node_name
+            for b in admitted["gtol"].bindings
+            if b.node_name not in ("n6", "n7")
+        ]
+        assert tainted_used, "tolerating gang should reach the tainted pool"
+    finally:
+        client.close()
+        server.stop(grace=None)

@@ -250,3 +250,51 @@ def test_no_selector_means_no_mask_tensor(simple_setup):
     ds, snap, pods_by_name = simple_setup
     batch, _ = encode_gangs(ds.podgangs, pods_by_name, snap)
     assert batch.group_node_ok is None
+
+
+def test_taints_block_unless_tolerated(simple1: PodCliqueSet):
+    """NoSchedule taints keep pods off nodes unless the pod template
+    tolerates them (k8s semantics, enforced by the solver)."""
+    topo = mk_topology()
+    nodes = mk_nodes(8)
+    for node in nodes[:6]:
+        node.taints = [{"key": "dedicated", "value": "infer", "effect": "NoSchedule"}]
+    ds = expand_podcliqueset(simple1, topo)
+    pods_by_name = {p.name: p for p in ds.pods}
+    snap = build_snapshot(nodes, topo)
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    bindings = decode_assignments(result, decode, snap)
+    # Without tolerations everything must squeeze onto the 2 untainted nodes.
+    for gang_bindings in bindings.values():
+        for node_name in gang_bindings.values():
+            assert node_name in ("n6", "n7")
+
+    # Now tolerate the taint: the full fleet is usable again.
+    for p in pods_by_name.values():
+        p.spec.tolerations = [
+            {"key": "dedicated", "operator": "Equal", "value": "infer", "effect": "NoSchedule"}
+        ]
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    bindings = decode_assignments(result, decode, snap)
+    used = {n for gb in bindings.values() for n in gb.values()}
+    assert len(used & {"n0", "n1", "n2", "n3", "n4", "n5"}) > 0, (
+        "tolerating pods should spread back onto tainted nodes"
+    )
+
+
+def test_prefer_no_schedule_is_soft(simple1: PodCliqueSet):
+    """PreferNoSchedule never blocks placement (soft taint)."""
+    topo = mk_topology()
+    nodes = mk_nodes(2)
+    for node in nodes:
+        node.taints = [{"key": "x", "value": "y", "effect": "PreferNoSchedule"}]
+    ds = expand_podcliqueset(simple1, topo)
+    pods_by_name = {p.name: p for p in ds.pods}
+    snap = build_snapshot(nodes, topo)
+    batch, decode = encode_gangs(ds.podgangs, pods_by_name, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    # Soft taints alone must not materialize the eligibility tensor.
+    assert batch.group_node_ok is None
