@@ -89,6 +89,10 @@ class PodGangSpec:
     topology_constraint_group_configs: list[TopologyConstraintGroupConfig] = field(default_factory=list)
     priority_class_name: str = ""
     reuse_reservation_ref: Optional[NamespacedName] = None
+    # Replica spread (PCS topologySpreadDomain translated to a node-label
+    # key, like pack constraints): base gangs of sibling PCS replicas prefer
+    # domains at this level that no sibling occupies (soft; w_spread).
+    spread_key: Optional[str] = None
 
 
 @dataclass

@@ -256,6 +256,19 @@ def _validate_topology_constraints(
     errs: list[ValidationError] = []
     tmpl = pcs.spec.template
 
+    if (
+        pcs.spec.topology_spread_domain is not None
+        and topology is not None
+        and topology.label_key_for(pcs.spec.topology_spread_domain) is None
+    ):
+        errs.append(
+            ValidationError(
+                "spec.topologySpreadDomain",
+                f"topology domain {pcs.spec.topology_spread_domain.value!r} "
+                "is not defined in the cluster topology",
+            )
+        )
+
     def check_domain_exists(tc: TopologyConstraint | None, fld: str) -> None:
         if tc is None or topology is None:
             return

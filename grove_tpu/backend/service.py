@@ -88,6 +88,9 @@ def _gang_from_proto(
         spec.pack_constraint if spec.HasField("pack_constraint") else None
     )
     gang.base_podgang_name = spec.base_podgang_name or None
+    gang.pcs_name = spec.pcs_name or ""
+    gang.pcs_replica_index = spec.pcs_replica_index
+    gang.spec.spread_key = spec.spread_key or None
     if spec.HasField("reuse_reservation_ref"):
         gang.spec.reuse_reservation_ref = NamespacedName(
             spec.reuse_reservation_ref.namespace, spec.reuse_reservation_ref.name
@@ -430,6 +433,31 @@ class TPUSchedulerBackend:
             ref = self._gangs[sub.name].spec.reuse_reservation_ref
             if ref is not None and ref.name in nodes_by_gang:
                 reuse_names_by_gang[sub.name] = nodes_by_gang[ref.name]
+        # Replica-spread seed: nodes bound to SIBLING replicas of a spreading
+        # base gang (same pcs_name, different replica index). One grouping
+        # pass over _gangs (like nodes_by_gang above), not one scan per
+        # pending gang — this runs under the control-RPC lock.
+        spread_names_by_gang: dict[str, set[str]] = {}
+        spreading = [
+            self._gangs[sub.name]
+            for sub in pending
+            if self._gangs[sub.name].spec.spread_key is not None
+            and self._gangs[sub.name].base_podgang_name is None
+        ]
+        if spreading:
+            nodes_by_pcs_replica: dict[tuple[str, int], set[str]] = {}
+            for other in self._gangs.values():
+                if other.pcs_name:
+                    nodes_by_pcs_replica.setdefault(
+                        (other.pcs_name, other.pcs_replica_index), set()
+                    ).update(nodes_by_gang.get(other.name, ()))
+            for live in spreading:
+                sib_nodes: set[str] = set()
+                for (pcs, replica), nodes in nodes_by_pcs_replica.items():
+                    if pcs == live.pcs_name and replica != live.pcs_replica_index:
+                        sib_nodes |= nodes
+                if sib_nodes:
+                    spread_names_by_gang[live.name] = sib_nodes
         return {
             "pending": pending,
             "pods_by_name": pods_by_name,
@@ -439,6 +467,7 @@ class TPUSchedulerBackend:
             "topology": self._topology,
             "scheduled_gangs": set(self._scheduled_gangs),
             "reuse_names_by_gang": reuse_names_by_gang,
+            "spread_names_by_gang": spread_names_by_gang,
             # Spec fingerprints for drift detection at commit time.
             "fingerprints": {
                 sub.name: self._gang_fingerprint(
@@ -472,6 +501,14 @@ class TPUSchedulerBackend:
             )
             for gname, names in work["reuse_names_by_gang"].items()
         }
+        spread_by_gang = {
+            gname: sorted(
+                snapshot.node_index(n)
+                for n in names
+                if n in snapshot.node_index_map
+            )
+            for gname, names in work["spread_names_by_gang"].items()
+        }
         # Bucketed shapes (SolverConfig or next-pow2): repeated Solve calls
         # with drifting pending-set sizes hit the warm compiled program.
         cfg = self._solver_config
@@ -497,6 +534,7 @@ class TPUSchedulerBackend:
             scheduled_gangs=work["scheduled_gangs"],
             bound_nodes_by_group=bound_idx,
             reuse_nodes_by_gang=reuse_by_gang,
+            spread_avoid_by_gang=spread_by_gang,
         )
         result = solve(snapshot, batch, speculative=speculative)
         bindings = decode_assignments(result, decode, snapshot)

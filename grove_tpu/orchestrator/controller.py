@@ -382,6 +382,37 @@ class GroveController:
             }
             if idxs:
                 reuse_nodes[gang.name] = sorted(idxs)
+        # Replica spread (topologySpreadDomain): seed each pending base gang
+        # with the nodes its SIBLING replicas' pods occupy right now, so a
+        # recreated/scaled-out replica prefers a domain no live sibling uses.
+        # One grouping pass over bound pods, not a store scan per gang.
+        spread_avoid: dict[str, list[int]] = {}
+        spreading = [
+            gang
+            for gang in pending
+            if gang.spec.spread_key is not None and gang.base_podgang_name is None
+        ]
+        if spreading:
+            spread_pcs = {gang.pcs_name for gang in spreading}
+            idxs_by_pcs_replica: dict[tuple[str, int], set[int]] = {}
+            for other in c.podgangs.values():
+                if other.pcs_name not in spread_pcs:
+                    continue
+                key = (other.pcs_name, other.pcs_replica_index)
+                bucket = idxs_by_pcs_replica.setdefault(key, set())
+                bucket.update(
+                    snapshot.node_index(p.node_name)
+                    for p in c.pods_of_gang(other.name)
+                    if p.node_name is not None
+                    and p.node_name in snapshot.node_index_map
+                )
+            for gang in spreading:
+                sibling_idxs: set[int] = set()
+                for (pcs, replica), idxs in idxs_by_pcs_replica.items():
+                    if pcs == gang.pcs_name and replica != gang.pcs_replica_index:
+                        sibling_idxs |= idxs
+                if sibling_idxs:
+                    spread_avoid[gang.name] = sorted(sibling_idxs)
         # Convert the bound-pod node names collected above to snapshot indices.
         bound_nodes: dict[str, dict[str, list[int]]] = {}
         for gname, groups in bound_node_names.items():
@@ -415,6 +446,7 @@ class GroveController:
             scheduled_gangs=scheduled_names,
             bound_nodes_by_group=bound_nodes,
             reuse_nodes_by_gang=reuse_nodes,
+            spread_avoid_by_gang=spread_avoid,
         )
         result = solve(snapshot, batch, self.solver_params, speculative=self.speculative)
         bindings = decode_assignments(result, decode, snapshot)

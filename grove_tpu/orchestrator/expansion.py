@@ -187,6 +187,16 @@ def expand_podcliqueset(
                 topology_constraint=translate_pack_constraint(
                     tmpl.topology_constraint, topology, tas_enabled
                 ),
+                # Replica spread: base gangs only (base_name None); translated
+                # to the node-label key like pack constraints so the solver
+                # stays label-keyed, not enum-keyed.
+                spread_key=(
+                    topology.label_key_for(pcs.spec.topology_spread_domain)
+                    if base_name is None
+                    and tas_enabled
+                    and pcs.spec.topology_spread_domain is not None
+                    else None
+                ),
             ),
         )
 

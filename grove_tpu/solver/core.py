@@ -50,6 +50,10 @@ class SolverParams(NamedTuple):
     w_pref: jnp.float32 = 4.0  # preferred-domain bonus per matching pack-set
     w_reuse: jnp.float32 = 2.0  # gang locality: prefer nodes this gang already uses
     w_reserve: jnp.float32 = 8.0  # keep non-members out of committed pack domains
+    # Replica-spread repulsion (PCS topologySpreadDomain): penalty for nodes
+    # whose spread-level domain already hosts a sibling replica's base gang.
+    # Soft by design — spread yields to Required packs and to feasibility.
+    w_spread: jnp.float32 = 1.5
     # Deterministic per-gang score jitter that decorrelates speculative
     # parallel placements: without it every gang in a wave picks the same
     # best-fit nodes/domains and the conflict chain degenerates to sequential
@@ -167,6 +171,7 @@ def _place_gang(
     cap_scale,
     params,
     coarse_onehot=None,  # [Lc, Dm, N] f32; None = segment-sum fallback
+    spread_avoid=None,  # bool [N]: nodes sibling replicas occupy (see w_spread)
 ):
     """Place one gang against `free`; pure function of its inputs."""
     n, r = free.shape
@@ -205,6 +210,7 @@ def _place_gang(
     # together instead of three reductions.
     ones_col = jnp.ones((free.shape[0], 1), dtype=jnp.float32)
     feat = jnp.concatenate([free, slots_all.T.astype(jnp.float32), ones_col], axis=1)
+
 
     def _joint_slots_ok(dom_slots, members):
         """Joint slot feasibility for a set's member groups [N_dom].
@@ -263,6 +269,23 @@ def _place_gang(
     tables_L = jax.vmap(
         lambda lv: agg_by_domain(jnp.where(schedulable[:, None], feat, 0.0), lv)
     )(jnp.arange(levels))  # [L, N, C]
+    # Replica-spread penalty, hoisted (the avoid set is fixed during this
+    # gang): 1.0 on nodes whose spread-level domain contains ANY avoided
+    # node. Domain granularity, not node granularity — an availability
+    # spread means "a different rack/zone", not "a different host".
+    spread_pen = None
+    if spread_avoid is not None:
+        s_lvl = gang["spread_level"]
+        lvl_c = jnp.clip(s_lvl, 0, levels - 1)
+        used_cnt = agg_by_domain(
+            spread_avoid[:, None].astype(jnp.float32), lvl_c
+        )[:, 0]  # [N] domain-ordinal rows
+        s_dom = dom_all[lvl_c]  # [N] node -> ordinal at the spread level
+        spread_pen = jnp.where(
+            (s_lvl >= 0) & (s_dom >= 0),
+            jnp.take(used_cnt, jnp.clip(s_dom, 0, n - 1)) > 0.5,
+            False,
+        ).astype(jnp.float32)
 
     def _set_dom_feasible(s2):
         lvl2c = jnp.clip(set_req_level[s2], 0, levels - 1)
@@ -377,6 +400,18 @@ def _place_gang(
                 -norm_free * (1.0 + params.w_jitter * dj) - params.w_reserve * taken_frac,
                 -jnp.inf,
             )
+            if spread_pen is not None:
+                # Replica spread must steer the DOMAIN choice, not just the
+                # stage-2 node scoring: best-fit actively prefers the tighter
+                # domain, which is exactly the one the sibling already
+                # occupies. Any feasible domain with no avoided nodes beats
+                # any with them (BIG > max possible norm_free = n*r), while
+                # infeasible domains stay -inf — spread remains soft.
+                touched = agg_by_domain(
+                    jnp.where(ok_nodes, spread_pen, 0.0)[:, None], level
+                )[:, 0] > 0.5
+                big = jnp.float32(n * r + 2)
+                score = score - jnp.minimum(params.w_spread, 1.0) * big * touched
             return jnp.argmax(score), feasible.any()
 
         # Incremental re-solve pin: bound pods of this set already sit in a
@@ -456,6 +491,8 @@ def _place_gang(
             - params.w_reserve * reserved
             + params.w_jitter * _weyl_jitter(gang["index"] * 31 + g, n)
         )
+        if spread_pen is not None:
+            score = score - params.w_spread * spread_pen
         # Top-k instead of a full argsort over N nodes: a group places at most
         # MP pods and every usable node contributes >= 1 slot, so the best MP
         # nodes always suffice. O(N log k) vs O(N log N) — the full sort was
@@ -549,8 +586,10 @@ def solve_batch(
         None if coarse_dmax is None else _coarse_onehot_stack(node_domain_id, coarse_dmax)
     )
 
+    has_spread = batch.spread_level is not None
+
     def step(carry, xs):
-        free, ok_vec = carry
+        free, ok_vec, family_used = carry
         gang_slices, gi = xs
         # Scaled gangs wait for their base gang (syncflow.go:347-387): the base
         # gang sits earlier in the batch, so its verdict is already in ok_vec.
@@ -561,6 +600,14 @@ def solve_batch(
         # Per-gang locality seed: the previous incarnation's nodes
         # (ReuseReservationRef, podgang.go:65-71) attract via w_reuse.
         used0 = gang_slices["reuse"]
+        avoid = None
+        if has_spread:
+            # Read-before-write: a base gang sees domains occupied by sibling
+            # replicas placed EARLIER (in-batch, via the family row) or
+            # already live in the store (spread_avoid seed) — never its own.
+            fam = gang_slices["spread_family"]
+            ridx = jnp.clip(fam, 0, g - 1)
+            avoid = gang_slices["spread_avoid"] | (family_used[ridx] & (fam >= 0))
         free_out, _, assigned, ok, score = _place_gang(
             free,
             used0,
@@ -570,9 +617,19 @@ def solve_batch(
             cap_scale=cap_scale,
             params=params,
             coarse_onehot=coarse_onehot,
+            spread_avoid=avoid,
         )
         ok_vec = ok_vec.at[gi].set(ok)
-        return (free_out, ok_vec), (assigned, ok, score)
+        if has_spread:
+            placed_mask = (
+                jnp.zeros((n,), dtype=bool)
+                .at[jnp.clip(assigned, 0, n - 1)]
+                .max((assigned >= 0) & ok)
+            )
+            family_used = family_used.at[ridx].set(
+                jnp.where(fam >= 0, family_used[ridx] | placed_mask, family_used[ridx])
+            )
+        return (free_out, ok_vec, family_used), (assigned, ok, score)
 
     gang_dict = {
         "group_req": batch.group_req,
@@ -594,8 +651,13 @@ def solve_batch(
     }
     if batch.group_node_ok is not None:
         gang_dict["group_node_ok"] = batch.group_node_ok
-    (free_final, _), (assigned, ok, score) = jax.lax.scan(
-        step, (free0, jnp.zeros((g,), dtype=bool)), (gang_dict, jnp.arange(g))
+    if has_spread:
+        gang_dict["spread_level"] = batch.spread_level
+        gang_dict["spread_family"] = batch.spread_family
+        gang_dict["spread_avoid"] = batch.spread_avoid
+    fam_init = jnp.zeros((g, n) if has_spread else (1, 1), dtype=bool)
+    (free_final, _, _), (assigned, ok, score) = jax.lax.scan(
+        step, (free0, jnp.zeros((g,), dtype=bool), fam_init), (gang_dict, jnp.arange(g))
     )
     return SolveResult(
         assigned=assigned,
@@ -685,6 +747,16 @@ def solve_batch_speculative(
     if batch.group_node_ok is not None:
         gang_dict["group_node_ok"] = batch.group_node_ok
 
+    # Replica spread in speculative mode is SEED-ONLY: gangs place in
+    # parallel, so the in-batch family carry of the sequential scan has no
+    # analog here — sibling repulsion applies to nodes already live in the
+    # store (spread_avoid), not to siblings placed in this same batch.
+    has_spread = batch.spread_level is not None
+    if has_spread:
+        gang_dict["spread_level"] = batch.spread_level
+        gang_dict["spread_family"] = batch.spread_family
+        gang_dict["spread_avoid"] = batch.spread_avoid
+
     def place_one(free, gang_slices):
         used0 = gang_slices["reuse"]  # ReuseReservationRef seed (see solve_batch)
         free_out, _, assigned, ok, score = _place_gang(
@@ -696,6 +768,7 @@ def solve_batch_speculative(
             cap_scale=cap_scale,
             params=params,
             coarse_onehot=coarse_onehot,
+            spread_avoid=gang_slices["spread_avoid"] if has_spread else None,
         )
         usage = jnp.where(ok, free - free_out, 0.0)  # [N, R]
         return usage, assigned, ok, score

@@ -75,6 +75,12 @@ class GangBatch(NamedTuple):
     # or None when no pod in the batch carries a selector — the common case
     # pays nothing.
     group_node_ok: np.ndarray = None
+    # Replica spread (PCS topologySpreadDomain): base gangs of one PCS repel
+    # the spread-level domains sibling replicas occupy (w_spread). All three
+    # are None unless some gang in the batch carries a spread constraint.
+    spread_level: np.ndarray = None  # i32 [G] topology level index, -1 = none
+    spread_family: np.ndarray = None  # i32 [G] batch slot of family root, -1
+    spread_avoid: np.ndarray = None  # bool [G, N] sibling nodes live in store
 
     @property
     def n_gangs(self) -> int:
@@ -174,6 +180,7 @@ def encode_gangs(
     bound_nodes_by_group: dict[str, dict[str, list[int]]] | None = None,
     global_index_of: dict[str, int] | None = None,
     reuse_nodes_by_gang: dict[str, list[int]] | None = None,
+    spread_avoid_by_gang: dict[str, list[int]] | None = None,
 ) -> tuple[GangBatch, GangDecodeInfo]:
     """Flatten gang CRs into the padded batch + decode info.
 
@@ -423,4 +430,36 @@ def encode_gangs(
 
     if selector_masks is not None:
         batch = batch._replace(group_node_ok=selector_masks)
+
+    # Replica spread: base gangs whose spec carries a resolvable spread_key
+    # get a level, a family root (first base sibling of the same PCS in this
+    # batch), and an avoid seed (nodes sibling replicas already occupy in the
+    # store, from the caller). Scaled gangs never spread — they follow their
+    # base. No spread in the batch → all three stay None (no cost).
+    spread_active = [
+        gi
+        for gi, gang in enumerate(gangs)
+        if gang.spec.spread_key is not None
+        and gang.base_podgang_name is None
+        and _level_index(snapshot, gang.spec.spread_key) >= 0
+    ]
+    if spread_active:
+        n_nodes = snapshot.capacity.shape[0]
+        spread_level = np.full((g_count,), -1, dtype=np.int32)
+        spread_family = np.full((g_count,), -1, dtype=np.int32)
+        spread_avoid = np.zeros((g_count, n_nodes), dtype=bool)
+        family_root: dict[str, int] = {}
+        for gi in spread_active:
+            gang = gangs[gi]
+            spread_level[gi] = _level_index(snapshot, gang.spec.spread_key)
+            fam_key = gang.pcs_name or gang.name
+            spread_family[gi] = family_root.setdefault(fam_key, gi)
+            for node_idx in (spread_avoid_by_gang or {}).get(gang.name, []):
+                if 0 <= node_idx < n_nodes:
+                    spread_avoid[gi, node_idx] = True
+        batch = batch._replace(
+            spread_level=spread_level,
+            spread_family=spread_family,
+            spread_avoid=spread_avoid,
+        )
     return batch, decode

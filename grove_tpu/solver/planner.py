@@ -37,6 +37,7 @@ def build_pending_subgang(
     )
     sub.spec.topology_constraint = gang.spec.topology_constraint
     sub.spec.priority_class_name = gang.spec.priority_class_name
+    sub.spec.spread_key = gang.spec.spread_key
     for grp in gang.spec.pod_groups:
         refs = unbound_refs.get(grp.name) or []
         if not refs:

@@ -245,3 +245,54 @@ def test_solve_honors_taints_and_tolerations():
     finally:
         client.close()
         server.stop(grace=None)
+
+
+def test_solve_spreads_sibling_replicas():
+    """PodGangSpec.spread_key + pcs identity: a sibling base gang solved
+    later avoids the zone the first replica landed in."""
+    server, port = create_server(port=0)
+    client = BackendClient(f"127.0.0.1:{port}")
+    try:
+        client.init([("zone", ZONE), ("rack", RACK)])
+        # 2 zones x 3 nodes, ample capacity in either.
+        nodes = []
+        for z in range(2):
+            for h in range(3):
+                n = pb.Node(name=f"z{z}h{h}", schedulable=True)
+                n.capacity.append(pb.ResourceQuantity(name="cpu", value=16))
+                n.capacity.append(pb.ResourceQuantity(name="memory", value=8 * 2**30))
+                n.labels[ZONE] = f"z{z}"
+                n.labels[RACK] = f"r{z}"
+                nodes.append(n)
+        client.update_cluster(nodes, full_replace=True)
+
+        def gang(name, replica):
+            spec = pb.PodGangSpec(
+                name=name, namespace="default",
+                spread_key=ZONE, pcs_name="spr", pcs_replica_index=replica,
+            )
+            grp = pb.PodGroup(name=f"{name}-w", min_replicas=2)
+            for i in range(2):
+                grp.pod_references.append(
+                    pb.NamespacedName(namespace="default", name=f"{name}-w-{i}")
+                )
+            grp.per_pod_requests.append(pb.ResourceQuantity(name="cpu", value=1))
+            spec.pod_groups.append(grp)
+            return spec
+
+        client.sync_pod_gang(gang("spr-0", 0))
+        first = client.solve()
+        z0 = {b.node_name[:2] for g in first.gangs if g.admitted for b in g.bindings}
+        assert len(z0) == 1
+        client.sync_pod_gang(gang("spr-1", 1))
+        second = client.solve()
+        z1 = {
+            b.node_name[:2]
+            for g in second.gangs
+            if g.admitted and g.name == "spr-1"
+            for b in g.bindings
+        }
+        assert z1 and z1.isdisjoint(z0), f"sibling shares zone: {z0} vs {z1}"
+    finally:
+        client.close()
+        server.stop(grace=None)

@@ -1,0 +1,229 @@
+"""Replica spread (spec.topologySpreadDomain): base gangs of one
+PodCliqueSet prefer distinct domains at the spread level — the availability
+analog of the reference's replica spreading (README.md:9 "spread", PCS-level
+topology semantics).
+
+Soft semantics: spread yields to feasibility (a cluster with one zone still
+schedules everything) and to Required pack constraints.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grove_tpu.api import (
+    ClusterTopology,
+    PodCliqueSet,
+    TopologyDomain,
+    TopologyLevel,
+    default_podcliqueset,
+    validate_podcliqueset,
+)
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.solver import decode_assignments, encode_gangs, solve
+from grove_tpu.state import Node, build_snapshot
+
+ZONE = "topology.kubernetes.io/zone"
+RACK = "topology.kubernetes.io/rack"
+
+
+def _topo():
+    return ClusterTopology(
+        name="t",
+        levels=[
+            TopologyLevel(TopologyDomain.ZONE, ZONE),
+            TopologyLevel(TopologyDomain.RACK, RACK),
+        ],
+    )
+
+
+def _nodes(zones=2, per_zone=3, cpu=16.0):
+    out = []
+    for z in range(zones):
+        for h in range(per_zone):
+            out.append(
+                Node(
+                    name=f"z{z}h{h}",
+                    capacity={"cpu": cpu, "memory": 64 * 2**30},
+                    labels={ZONE: f"z{z}", RACK: f"r{z}"},
+                )
+            )
+    return out
+
+
+def _pcs(replicas=2, spread="zone"):
+    doc = {
+        "apiVersion": "grove.io/v1alpha1",
+        "kind": "PodCliqueSet",
+        "metadata": {"name": "spr"},
+        "spec": {
+            "replicas": replicas,
+            "topologySpreadDomain": spread,
+            "template": {
+                "cliques": [
+                    {
+                        "name": "w",
+                        "spec": {
+                            "roleName": "w",
+                            "replicas": 2,
+                            "podSpec": {
+                                "containers": [
+                                    {
+                                        "name": "w",
+                                        "image": "r.local/w:latest",
+                                        "resources": {"requests": {"cpu": "1"}},
+                                    }
+                                ]
+                            },
+                        },
+                    }
+                ]
+            },
+        },
+    }
+    return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+
+def _zone_of(snapshot, node_name):
+    idx = snapshot.node_index(node_name)
+    return snapshot.node_labels[idx][ZONE]
+
+
+def test_expansion_sets_spread_key_on_base_gangs_only():
+    pcs = _pcs()
+    ds = expand_podcliqueset(pcs, _topo())
+    for gang in ds.podgangs:
+        if gang.base_podgang_name is None:
+            assert gang.spec.spread_key == ZONE
+        else:
+            assert gang.spec.spread_key is None
+
+
+def test_replicas_spread_across_zones_in_one_batch():
+    """Without spread both tiny replicas bin-pack into one zone; with it the
+    in-batch family carry pushes replica 1 to the other zone."""
+    topo = _topo()
+    nodes = _nodes()
+    snap = build_snapshot(nodes, topo)
+
+    def zones_used(pcs):
+        ds = expand_podcliqueset(pcs, topo)
+        batch, dec = encode_gangs(ds.podgangs, {p.name: p for p in ds.pods}, snap)
+        result = solve(snap, batch)
+        assert bool(np.asarray(result.ok).all())
+        bindings = decode_assignments(result, dec, snap)
+        return [
+            {_zone_of(snap, n) for n in gb.values()} for gb in bindings.values()
+        ]
+
+    spread_zones = zones_used(_pcs(spread="zone"))
+    assert len(spread_zones) == 2
+    assert spread_zones[0].isdisjoint(spread_zones[1]), (
+        f"replicas share a zone despite spread: {spread_zones}"
+    )
+
+    no_spread = _pcs(spread="zone")
+    no_spread.spec.topology_spread_domain = None
+    packed_zones = zones_used(no_spread)
+    assert not packed_zones[0].isdisjoint(packed_zones[1]), (
+        "control: without spread the tight bin-pack shares a zone"
+    )
+
+
+def test_spread_yields_to_feasibility():
+    """One zone only: spread is soft — everything still schedules."""
+    topo = _topo()
+    snap = build_snapshot(_nodes(zones=1, per_zone=4), topo)
+    ds = expand_podcliqueset(_pcs(spread="zone"), topo)
+    batch, dec = encode_gangs(ds.podgangs, {p.name: p for p in ds.pods}, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+
+
+def test_recreated_replica_avoids_live_sibling_zone():
+    """Re-solve seeding: a recreated base gang avoids the zone its live
+    sibling occupies (spread_avoid_by_gang, the controller's store feed)."""
+    topo = _topo()
+    snap = build_snapshot(_nodes(), topo)
+    ds = expand_podcliqueset(_pcs(), topo)
+    pods = {p.name: p for p in ds.pods}
+    # Only replica 1's gang pending; replica 0 lives in z0 (nodes 0..2).
+    gang1 = next(
+        g for g in ds.podgangs if g.base_podgang_name is None and g.pcs_replica_index == 1
+    )
+    avoid = {gang1.name: [0, 1, 2]}
+    batch, dec = encode_gangs([gang1], pods, snap, spread_avoid_by_gang=avoid)
+    result = solve(snap, batch)
+    bindings = decode_assignments(result, dec, snap)
+    zones = {_zone_of(snap, n) for n in bindings[gang1.name].values()}
+    assert zones == {"z1"}, f"recreated replica should avoid z0: {zones}"
+
+
+def test_validation_rejects_unknown_spread_domain():
+    pcs = _pcs(spread="datacenter")  # not in this topology
+    errs = validate_podcliqueset(pcs, _topo())
+    assert any("topologySpreadDomain" in e.field for e in errs)
+
+
+def test_spread_steers_domain_choice_under_pack_constraint():
+    """The regression the stage-1 penalty exists for: with a rack pack
+    constraint, best-fit would commit a rack inside the sibling's (tighter)
+    zone and stage-2 could not escape the committed domain. Spread must steer
+    the DOMAIN pick to the unoccupied zone."""
+    import yaml as _yaml  # noqa: F401 (parity with sibling tests' imports)
+
+    doc = {
+        "apiVersion": "grove.io/v1alpha1",
+        "kind": "PodCliqueSet",
+        "metadata": {"name": "sprk"},
+        "spec": {
+            "replicas": 2,
+            "topologySpreadDomain": "zone",
+            "template": {
+                "cliques": [
+                    {
+                        "name": "w",
+                        "topologyConstraint": {"packDomain": "rack"},
+                        "spec": {
+                            "roleName": "w",
+                            "replicas": 2,
+                            "podSpec": {
+                                "containers": [
+                                    {
+                                        "name": "w",
+                                        "image": "r.local/w:latest",
+                                        "resources": {"requests": {"cpu": "1"}},
+                                    }
+                                ]
+                            },
+                        },
+                    }
+                ]
+            },
+        },
+    }
+    pcs = default_podcliqueset(PodCliqueSet.from_dict(doc))
+    topo = _topo()
+    # Two zones, one rack each; z0 pre-loaded (tighter => best-fit favorite).
+    nodes = _nodes(zones=2, per_zone=3)
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.api.types import Container, PodSpec
+
+    squat = Pod(
+        name="squat",
+        spec=PodSpec(containers=[Container(name="c", requests={"cpu": 10.0})]),
+        node_name="z0h0",
+    )
+    snap = build_snapshot(nodes, topo, bound_pods=[squat])
+    ds = expand_podcliqueset(pcs, topo)
+    batch, dec = encode_gangs(ds.podgangs, {p.name: p for p in ds.pods}, snap)
+    result = solve(snap, batch)
+    assert bool(np.asarray(result.ok).all())
+    bindings = decode_assignments(result, dec, snap)
+    per_gang_zones = [
+        {_zone_of(snap, n) for n in gb.values()} for gb in bindings.values()
+    ]
+    assert all(len(z) == 1 for z in per_gang_zones), "rack pack must hold"
+    assert per_gang_zones[0].isdisjoint(per_gang_zones[1]), (
+        f"spread failed to steer the domain pick: {per_gang_zones}"
+    )
