@@ -52,7 +52,11 @@ from grove_tpu.api.types import (
 from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
 from grove_tpu.solver.core import decode_assignments, solve
 from grove_tpu.solver.encode import encode_gangs, pack_set_count
-from grove_tpu.solver.planner import build_pending_subgang, sort_pending
+from grove_tpu.solver.planner import (
+    build_pending_subgang,
+    build_spread_avoid,
+    sort_pending,
+)
 from grove_tpu.state.cluster import Node, build_snapshot
 
 SERVICE_NAME = "grove_tpu.backend.v1.SchedulerBackend"
@@ -451,13 +455,9 @@ class TPUSchedulerBackend:
                     nodes_by_pcs_replica.setdefault(
                         (other.pcs_name, other.pcs_replica_index), set()
                     ).update(nodes_by_gang.get(other.name, ()))
-            for live in spreading:
-                sib_nodes: set[str] = set()
-                for (pcs, replica), nodes in nodes_by_pcs_replica.items():
-                    if pcs == live.pcs_name and replica != live.pcs_replica_index:
-                        sib_nodes |= nodes
-                if sib_nodes:
-                    spread_names_by_gang[live.name] = sib_nodes
+            spread_names_by_gang = build_spread_avoid(
+                spreading, nodes_by_pcs_replica
+            )
         return {
             "pending": pending,
             "pods_by_name": pods_by_name,

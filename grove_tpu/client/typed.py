@@ -157,6 +157,14 @@ class GroveClient:
     def events(self) -> list[tuple[float, str, str]]:
         return [tuple(e) for e in self._request("GET", "/api/v1/events")]
 
+    def push_metrics(self, metrics: dict[str, float]) -> int:
+        """HPA utilization feed (metrics-server analog): target FQN ->
+        utilization normalized to the target (1.0 == at target)."""
+        resp = self._request(
+            "POST", "/api/v1/metrics", json.dumps(metrics).encode()
+        )
+        return resp["targets"]
+
 
 class FakeGroveClient:
     """In-process fake with the same typed surface (fake-clientset analog).
@@ -213,6 +221,10 @@ class FakeGroveClient:
 
     def get_node(self, name: str):
         return self._get("nodes", name)
+
+    def push_metrics(self, metrics: dict[str, float]) -> int:
+        self.manager.hpa_metrics.update({str(k): float(v) for k, v in metrics.items()})
+        return len(metrics)
 
     def apply_podcliqueset(self, doc_or_yaml: dict | str) -> str:
         import yaml as _yaml

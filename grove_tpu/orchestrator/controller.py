@@ -52,7 +52,11 @@ from grove_tpu.orchestrator.status import (
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.solver.core import SolverParams, decode_assignments, solve
 from grove_tpu.solver.encode import encode_gangs
-from grove_tpu.solver.planner import build_pending_subgang, sort_pending
+from grove_tpu.solver.planner import (
+    build_pending_subgang,
+    build_spread_avoid,
+    sort_pending,
+)
 from grove_tpu.state.cluster import build_snapshot
 
 
@@ -406,13 +410,12 @@ class GroveController:
                     if p.node_name is not None
                     and p.node_name in snapshot.node_index_map
                 )
-            for gang in spreading:
-                sibling_idxs: set[int] = set()
-                for (pcs, replica), idxs in idxs_by_pcs_replica.items():
-                    if pcs == gang.pcs_name and replica != gang.pcs_replica_index:
-                        sibling_idxs |= idxs
-                if sibling_idxs:
-                    spread_avoid[gang.name] = sorted(sibling_idxs)
+            spread_avoid = {
+                name: sorted(idxs)
+                for name, idxs in build_spread_avoid(
+                    spreading, idxs_by_pcs_replica
+                ).items()
+            }
         # Convert the bound-pod node names collected above to snapshot indices.
         bound_nodes: dict[str, dict[str, list[int]]] = {}
         for gname, groups in bound_node_names.items():

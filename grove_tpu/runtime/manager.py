@@ -17,6 +17,7 @@ surface.
 
 from __future__ import annotations
 
+import math
 import http.server
 import json
 import threading
@@ -173,7 +174,29 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 (stdlib API)
         """Apply a PodCliqueSet through the admission chain (kubectl-apply
-        analog). Body: YAML or JSON PCS document."""
+        analog). Body: YAML or JSON PCS document. Also accepts HPA metrics
+        pushes on /api/v1/metrics (the metrics-server feed)."""
+        if self.path == "/api/v1/metrics":
+            if not self._authorized(None):
+                self._respond(401, "unauthorized")
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                doc = json.loads(self.rfile.read(length).decode())
+                if not isinstance(doc, dict):
+                    raise ValueError("metrics body must be a JSON object")
+                update = {str(k): float(v) for k, v in doc.items()}
+                # json.loads admits the NaN/Infinity literals; a non-finite
+                # ratio would make autoscale's ceil() raise on every tick.
+                bad = [k for k, v in update.items() if not math.isfinite(v)]
+                if bad:
+                    raise ValueError(f"non-finite utilization for {bad}")
+            except (ValueError, TypeError) as e:
+                self._respond(400, json.dumps({"errors": [str(e)]}), "application/json")
+                return
+            self.manager.hpa_metrics.update(update)
+            self._respond(200, json.dumps({"targets": len(update)}), "application/json")
+            return
         if self.path != "/api/v1/podcliquesets":
             self._respond(404, "not found")
             return
@@ -308,6 +331,10 @@ class Manager:
         # gRPC client the manager itself created (kwok node-forwarding) and
         # must close at stop(); caller-supplied clients stay caller-owned.
         self._owned_backend_client = None
+        # HPA utilization feed (metrics-server analog): target FQN -> current
+        # average utilization normalized to the target (1.0 == at target).
+        # Pushed via POST /api/v1/metrics; consumed by the autoscale step.
+        self.hpa_metrics: dict[str, float] = {}
         # Admission chain (webhook analog): defaulting + validation +
         # authorizer-protected managed resources (config.authorizer).
         self.admission = AdmissionChain(
@@ -595,8 +622,23 @@ class Manager:
                 pcs.status.last_errors = list(msgs)
 
         t0 = time.perf_counter()
+        def _autoscale(now=now):
+            # metrics-server analog: utilization pushed to /api/v1/metrics
+            # feeds the HPA objects; scale_overrides land in the NEXT
+            # sync_workloads expansion (HPA -> scale subresource flow).
+            # Consume-once: the ratio scales the CURRENT replica count, so
+            # re-applying one stale push every tick would compound
+            # geometrically to max/min replicas — each push is one
+            # evaluation, like HPA refusing to act on stale metrics.
+            if self.hpa_metrics:
+                metrics = dict(self.hpa_metrics)
+                self.hpa_metrics.clear()
+                ctrl.autoscale(metrics, now)
+            return continue_reconcile()
+
         outcome = run_reconcile_flow(
             [
+                ("autoscale", _timed("autoscale", _autoscale)),
                 ("sync_workloads", _timed("sync_workloads", _sync_workloads)),
                 ("rolling_updates", _step("rolling_updates", ctrl.rolling_updates)),
                 ("solve_pending", _timed("solve_pending", _solve)),

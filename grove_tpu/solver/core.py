@@ -404,14 +404,16 @@ def _place_gang(
                 # Replica spread must steer the DOMAIN choice, not just the
                 # stage-2 node scoring: best-fit actively prefers the tighter
                 # domain, which is exactly the one the sibling already
-                # occupies. Any feasible domain with no avoided nodes beats
-                # any with them (BIG > max possible norm_free = n*r), while
-                # infeasible domains stay -inf — spread remains soft.
+                # occupies. The margin must dominate every other score term —
+                # norm_free (<= n*r) INCLUDING its jitter multiplier, plus
+                # w_reserve * taken_frac (<= w_reserve) — so any feasible
+                # domain with no avoided nodes beats any with them, while
+                # infeasible domains stay -inf (spread remains soft).
                 touched = agg_by_domain(
                     jnp.where(ok_nodes, spread_pen, 0.0)[:, None], level
                 )[:, 0] > 0.5
-                big = jnp.float32(n * r + 2)
-                score = score - jnp.minimum(params.w_spread, 1.0) * big * touched
+                big = n * r * (1.0 + params.w_jitter) + params.w_reserve + 2.0
+                score = score - jnp.where(params.w_spread > 0, big, 0.0) * touched
             return jnp.argmax(score), feasible.any()
 
         # Incremental re-solve pin: bound pods of this set already sit in a

@@ -94,3 +94,26 @@ def sort_pending(
         gangs,
         key=lambda g: (-rank(g), g.is_scaled, g.scaled_index, g.name),
     )
+
+
+def build_spread_avoid(
+    spreading: list[PodGang],
+    nodes_by_pcs_replica: dict[tuple[str, int], set],
+) -> dict[str, set]:
+    """Sibling avoid-sets for replica spread, shared by both drivers.
+
+    `spreading`: pending BASE gangs whose spec carries a spread_key.
+    `nodes_by_pcs_replica`: (pcs_name, replica_index) -> nodes that replica's
+    pods occupy right now (names or indices — the caller's currency).
+    Returns gang name -> union of nodes every SIBLING replica occupies.
+    Living here keeps the controller and the sidecar from drifting on what
+    counts as a sibling (same PCS, different replica index)."""
+    out: dict[str, set] = {}
+    for gang in spreading:
+        sib: set = set()
+        for (pcs, replica), nodes in nodes_by_pcs_replica.items():
+            if pcs == gang.pcs_name and replica != gang.pcs_replica_index:
+                sib |= nodes
+        if sib:
+            out[gang.name] = sib
+    return out

@@ -372,3 +372,46 @@ def test_cluster_kwok_section_fabricates_fleet():
         {"cluster": {"source": "kwok", "kwokNodes": 0}}
     )
     assert any("kwokNodes" in e for e in errors)
+
+
+def test_hpa_metrics_feed_drives_autoscale(tmp_path, simple1):
+    """The metrics-server analog: utilization pushed to /api/v1/metrics makes
+    the reconcile loop's autoscale step scale the HPA target, and the next
+    expansion materializes the extra pods."""
+    import urllib.request as _rq
+
+    m = _mgr(tmp_path, {"cluster": {"source": "kwok", "kwokNodes": 10}})
+    m.start()
+    try:
+        m.cluster.podcliquesets[simple1.metadata.name] = simple1
+        m.reconcile_once(now=1.0)
+        hpa = next(h for h in m.cluster.hpas.values() if "frontend" in h.target_name)
+        before = sum(1 for p in m.cluster.pods.values() if "frontend" in p.pclq_fqn)
+
+        body = json.dumps({hpa.target_name: 1.6}).encode()
+        req = _rq.Request(
+            f"http://127.0.0.1:{m.health_port}/api/v1/metrics",
+            data=body,
+            method="POST",
+        )
+        with _rq.urlopen(req) as r:
+            assert json.loads(r.read())["targets"] == 1
+        m.reconcile_once(now=2.0)
+        m.reconcile_once(now=3.0)
+        after = sum(1 for p in m.cluster.pods.values() if "frontend" in p.pclq_fqn)
+        assert after > before, f"frontend did not scale out: {before} -> {after}"
+        assert m.cluster.scale_overrides[hpa.target_name] <= hpa.max_replicas
+
+        # Bad body is a client error, not a crash.
+        req = _rq.Request(
+            f"http://127.0.0.1:{m.health_port}/api/v1/metrics",
+            data=b"[1,2]",
+            method="POST",
+        )
+        try:
+            _rq.urlopen(req)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        m.stop()
