@@ -223,8 +223,15 @@ class FakeGroveClient:
         return self._get("nodes", name)
 
     def push_metrics(self, metrics: dict[str, float]) -> int:
-        self.manager.hpa_metrics.update({str(k): float(v) for k, v in metrics.items()})
-        return len(metrics)
+        import math as _math
+
+        update = {str(k): float(v) for k, v in metrics.items()}
+        bad = [k for k, v in update.items() if not _math.isfinite(v)]
+        if bad:
+            # Same contract as the HTTP path's 400 on non-finite values.
+            raise GroveApiError(400, [f"non-finite utilization for {bad}"])
+        self.manager.hpa_metrics.update(update)
+        return len(update)
 
     def apply_podcliqueset(self, doc_or_yaml: dict | str) -> str:
         import yaml as _yaml

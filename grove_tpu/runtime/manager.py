@@ -631,8 +631,9 @@ class Manager:
             # geometrically to max/min replicas — each push is one
             # evaluation, like HPA refusing to act on stale metrics.
             if self.hpa_metrics:
-                metrics = dict(self.hpa_metrics)
-                self.hpa_metrics.clear()
+                # Atomic swap, not copy-then-clear: an HTTP push landing
+                # between the two would be 200-acknowledged yet discarded.
+                self.hpa_metrics, metrics = {}, self.hpa_metrics
                 ctrl.autoscale(metrics, now)
             return continue_reconcile()
 
