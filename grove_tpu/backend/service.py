@@ -185,6 +185,8 @@ class TPUSchedulerBackend:
         self._bindings: dict[str, tuple[str, str, str]] = {}  # pod -> (node, gang, group)
         self._scheduled_gangs: set[str] = set()
         self._solver_config = solver_config or SolverConfig()
+        # Frozen config -> build once; Solve is the p99-tuned path.
+        self._solver_params = self._solver_config.solver_params()
         # Host-config defaults; an Init carrying priority_classes overrides.
         self._priority_classes: dict[str, int] = dict(priority_classes or {})
 
@@ -536,7 +538,9 @@ class TPUSchedulerBackend:
             reuse_nodes_by_gang=reuse_by_gang,
             spread_avoid_by_gang=spread_by_gang,
         )
-        result = solve(snapshot, batch, speculative=speculative)
+        result = solve(
+            snapshot, batch, params=self._solver_params, speculative=speculative
+        )
         bindings = decode_assignments(result, decode, snapshot)
 
         import numpy as np

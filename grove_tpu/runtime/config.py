@@ -107,6 +107,16 @@ class SolverConfig:
     max_sets: Optional[int] = None
     max_pods: Optional[int] = None
     pad_gangs_to: Optional[int] = None
+    # Score-weight overrides (SolverParams fields, camelCase: wTight, wPref,
+    # wReuse, wReserve, wJitter, wSpread). Unset fields keep their defaults.
+    weights: dict = field(default_factory=dict)
+
+    def solver_params(self):
+        """SolverConfig.weights -> SolverParams (validated at config load)."""
+        from grove_tpu.solver.core import SolverParams
+
+        snake = {_CAMEL_FIELDS.get(k, k): float(v) for k, v in self.weights.items()}
+        return SolverParams(**snake)
 
 
 @dataclass
@@ -223,6 +233,12 @@ _CAMEL_FIELDS = {
     "padGangsTo": "pad_gangs_to",
     "maxWorkers": "max_workers",
     "snapshotIntervalSeconds": "snapshot_interval_seconds",
+    "wTight": "w_tight",
+    "wPref": "w_pref",
+    "wReuse": "w_reuse",
+    "wReserve": "w_reserve",
+    "wJitter": "w_jitter",
+    "wSpread": "w_spread",
     "kwokNodes": "kwok_nodes",
     "kwokCpuPerNode": "kwok_cpu_per_node",
     "kwokMemoryPerNode": "kwok_memory_per_node",
@@ -343,6 +359,23 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             errors.append(f"topologyAwareScheduling.levels: {e}")
     if cfg.persistence.enabled and not cfg.persistence.path:
         errors.append("persistence.path: required when persistence is enabled")
+    if not isinstance(cfg.solver.weights, dict):
+        errors.append("solver.weights: must be a mapping of weight -> number")
+    elif cfg.solver.weights:
+        # Imports deferred: config loading must stay light for the CLI and
+        # deploy renderer; the jax-backed module only loads when weight
+        # overrides are actually present.
+        import math as _math
+
+        from grove_tpu.solver.core import SolverParams as _SP
+
+        valid_weights = set(_SP._fields)
+        for wk, wv in cfg.solver.weights.items():
+            field_name = _CAMEL_FIELDS.get(wk, wk)
+            if field_name not in valid_weights:
+                errors.append(f"solver.weights.{wk}: unknown weight")
+            elif not isinstance(wv, (int, float)) or isinstance(wv, bool) or not _math.isfinite(float(wv)):
+                errors.append(f"solver.weights.{wk}: {wv!r} is not a finite number")
     cl = cfg.cluster
     if cl.source not in ("none", "kwok"):
         errors.append(f"cluster.source: {cl.source!r} not in none|kwok")

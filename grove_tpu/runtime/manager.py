@@ -38,7 +38,6 @@ from grove_tpu.runtime.flow import (
 )
 from grove_tpu.utils.errors import GroveError
 from grove_tpu.runtime.lease import FileLease
-from grove_tpu.solver.core import SolverParams
 from grove_tpu.utils.logging import Logger, new_logger
 from grove_tpu.utils.metrics import Registry
 
@@ -299,7 +298,7 @@ class Manager:
         self.controller = GroveController(
             cluster=self.cluster,
             topology=self.topology,
-            solver_params=SolverParams(),
+            solver_params=config.solver.solver_params(),
             priority_classes=dict(config.scheduling.priority_classes),
             tas_enabled=config.topology_aware_scheduling.enabled,
             max_groups=config.solver.max_groups,

@@ -415,3 +415,30 @@ def test_hpa_metrics_feed_drives_autoscale(tmp_path, simple1):
             assert e.code == 400
     finally:
         m.stop()
+
+
+def test_solver_weights_reach_both_drivers(tmp_path):
+    """solver.weights overrides SolverParams for the controller AND the
+    sidecar; unknown weights and non-finite values fail validation."""
+    m = _mgr(tmp_path, {"solver": {"weights": {"wPref": 9.0, "wSpread": 0.0}}})
+    assert float(m.controller.solver_params.w_pref) == 9.0
+    assert float(m.controller.solver_params.w_spread) == 0.0
+    assert float(m.controller.solver_params.w_tight) == 1.0  # default kept
+
+    from grove_tpu.backend.service import TPUSchedulerBackend
+
+    cfg, errors = parse_operator_config(
+        {"solver": {"weights": {"wReuse": 5.5}}}
+    )
+    assert not errors
+    svc = TPUSchedulerBackend(solver_config=cfg.solver)
+    assert float(svc._solver_config.solver_params().w_reuse) == 5.5
+
+    _, errors = parse_operator_config({"solver": {"weights": {"wBogus": 1}}})
+    assert any("wBogus" in e for e in errors)
+    _, errors = parse_operator_config(
+        {"solver": {"weights": {"wPref": float("inf")}}}
+    )
+    assert any("finite" in e for e in errors)
+    _, errors = parse_operator_config({"solver": {"weights": "heavy"}})
+    assert any("solver.weights" in e for e in errors)
