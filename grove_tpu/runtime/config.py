@@ -192,6 +192,13 @@ class OperatorConfiguration:
         return topo.with_host_level()
 
 
+# Valid score-weight field names, kept jax-free (config validation must not
+# import the solver). tests/test_config_wiring.py pins this against
+# SolverParams._fields so the two cannot drift.
+_WEIGHT_FIELDS = frozenset(
+    {"w_tight", "w_pref", "w_reuse", "w_reserve", "w_jitter", "w_spread"}
+)
+
 _SECTION_TYPES = {
     "leaderElection": ("leader_election", LeaderElectionConfig),
     "servers": ("servers", ServerConfig),
@@ -362,20 +369,28 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
     if not isinstance(cfg.solver.weights, dict):
         errors.append("solver.weights: must be a mapping of weight -> number")
     elif cfg.solver.weights:
-        # Imports deferred: config loading must stay light for the CLI and
-        # deploy renderer; the jax-backed module only loads when weight
-        # overrides are actually present.
         import math as _math
 
-        from grove_tpu.solver.core import SolverParams as _SP
-
-        valid_weights = set(_SP._fields)
+        seen_weights: dict[str, str] = {}
         for wk, wv in cfg.solver.weights.items():
             field_name = _CAMEL_FIELDS.get(wk, wk)
-            if field_name not in valid_weights:
+            if field_name not in _WEIGHT_FIELDS:
                 errors.append(f"solver.weights.{wk}: unknown weight")
-            elif not isinstance(wv, (int, float)) or isinstance(wv, bool) or not _math.isfinite(float(wv)):
+                continue
+            if field_name in seen_weights:
+                errors.append(
+                    f"solver.weights.{wk}: duplicate of "
+                    f"{seen_weights[field_name]!r} after case normalization"
+                )
+                continue
+            seen_weights[field_name] = wk
+            if not isinstance(wv, (int, float)) or isinstance(wv, bool) or not _math.isfinite(float(wv)):
                 errors.append(f"solver.weights.{wk}: {wv!r} is not a finite number")
+            elif field_name == "w_jitter" and wv < 0:
+                errors.append(
+                    f"solver.weights.{wk}: must be >= 0 (negative is the "
+                    "internal AUTO sentinel)"
+                )
     cl = cfg.cluster
     if cl.source not in ("none", "kwok"):
         errors.append(f"cluster.source: {cl.source!r} not in none|kwok")

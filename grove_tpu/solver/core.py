@@ -57,13 +57,14 @@ class SolverParams(NamedTuple):
     # Deterministic per-gang score jitter that decorrelates speculative
     # parallel placements: without it every gang in a wave picks the same
     # best-fit nodes/domains and the conflict chain degenerates to sequential
-    # commits. Zero by default — the sequential path gains nothing from it
-    # and would only pay bin-packing quality; solve_batch_speculative
-    # substitutes SPECULATIVE_JITTER when the caller leaves it at 0.
-    w_jitter: jnp.float32 = 0.0
+    # commits. The default -1.0 means AUTO: 0 on the sequential path (which
+    # gains nothing and would pay bin-packing quality), SPECULATIVE_JITTER on
+    # the speculative path. An explicit value — including 0.0 — is honored on
+    # both paths, so jitter can actually be turned off.
+    w_jitter: jnp.float32 = -1.0
 
 
-# Jitter used by the speculative path when params.w_jitter is 0 (measured
+# Jitter used by the speculative path when params.w_jitter is AUTO (measured
 # sweet spot: strong enough to spread colliding gangs across near-equal
 # domains, weak enough to keep packing tight).
 SPECULATIVE_JITTER = 0.15
@@ -582,6 +583,11 @@ def solve_batch(
     solve() wrapper does. None falls back to segment-sum (fine on CPU)."""
     n = free0.shape[0]
     g = batch.gang_valid.shape[0]
+    # AUTO jitter (w_jitter < 0) resolves to 0 on this path — the sequential
+    # scan gains nothing from decorrelation and would pay packing quality.
+    params = params._replace(
+        w_jitter=jnp.maximum(jnp.asarray(params.w_jitter, jnp.float32), 0.0)
+    )
     cap_scale = jnp.maximum(capacity.max(axis=0), 1e-9)  # [R]
     gang_valid0 = _apply_global_deps(batch, ok_global)
     coarse_onehot = (
@@ -724,7 +730,7 @@ def solve_batch_speculative(
     # Speculation needs score decorrelation; honor an explicit caller value.
     params = params._replace(
         w_jitter=jnp.where(
-            jnp.asarray(params.w_jitter) > 0, params.w_jitter, SPECULATIVE_JITTER
+            jnp.asarray(params.w_jitter) >= 0, params.w_jitter, SPECULATIVE_JITTER
         )
     )
 

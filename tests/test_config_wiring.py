@@ -442,3 +442,30 @@ def test_solver_weights_reach_both_drivers(tmp_path):
     assert any("finite" in e for e in errors)
     _, errors = parse_operator_config({"solver": {"weights": "heavy"}})
     assert any("solver.weights" in e for e in errors)
+
+
+def test_weight_fields_match_solver_params():
+    """_WEIGHT_FIELDS is the jax-free copy of SolverParams._fields — pinned
+    here so adding a weight to one without the other fails loudly."""
+    from grove_tpu.runtime.config import _WEIGHT_FIELDS
+    from grove_tpu.solver.core import SolverParams
+
+    assert _WEIGHT_FIELDS == frozenset(SolverParams._fields)
+
+
+def test_weight_duplicate_and_negative_jitter_rejected():
+    _, errors = parse_operator_config(
+        {"solver": {"weights": {"wPref": 9.0, "w_pref": 2.0}}}
+    )
+    assert any("duplicate" in e for e in errors)
+    _, errors = parse_operator_config(
+        {"solver": {"weights": {"wJitter": -0.5}}}
+    )
+    assert any("AUTO" in e for e in errors)
+    # Explicit zero jitter is legal and must be honored even in speculative
+    # mode (AUTO substitution keys on the NEGATIVE sentinel, not on zero).
+    cfg, errors = parse_operator_config(
+        {"solver": {"weights": {"wJitter": 0.0}, "speculative": True}}
+    )
+    assert not errors
+    assert float(cfg.solver.solver_params().w_jitter) == 0.0

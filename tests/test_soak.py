@@ -1,0 +1,168 @@
+"""Churn soak: sustained deploy/fail/kill/scale/delete cycles with global
+invariants checked every tick.
+
+The behavior matrices (GS/SO/RU/TAS) pin individual transitions; this tier
+pins what must hold under COMPOSITION — hours of cluster life compressed
+into a deterministic randomized schedule. Invariants are the control
+plane's conservation laws:
+
+  I1  every active pod's owner clique exists (no orphans)
+  I2  a bound pod's node exists and is accounted (no ghost capacity)
+  I3  no node is oversubscribed by the pods bound to it
+  I4  a gang marked Scheduled has every group at/above its floor among
+      bound pods — unless recovery is in flight (breach latches are
+      allowed; terminate-and-recreate handles them)
+  I5  pods of one required-rack-packed gang never straddle racks
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from scenario_harness import Scenario, wl1
+
+pytestmark = pytest.mark.slow
+
+
+def _soak_wl():
+    """wl1 with a short terminationDelay: crashlooping pods latch
+    MinAvailableBreached, and recovery is terminate-and-recreate AFTER the
+    delay (gangterminate.go semantics) — the default 4h would park recovery
+    far outside the test window."""
+    pcs = wl1()
+    pcs.spec.template.termination_delay_seconds = 30.0
+    return pcs
+
+
+def _check_invariants(s: Scenario) -> None:
+    c = s.cluster
+    # I1: no orphaned active pods.
+    for pod in c.pods.values():
+        if pod.is_active:
+            assert pod.pclq_fqn in c.podcliques, f"orphan pod {pod.name}"
+    # I2 + I3: per-node accounting from first principles.
+    used: dict[str, dict[str, float]] = {}
+    for pod in c.pods.values():
+        if pod.node_name is not None and pod.is_active:
+            acc = used.setdefault(pod.node_name, {})
+            for res, qty in pod.spec.total_requests().items():
+                acc[res] = acc.get(res, 0.0) + qty
+    for node_name, acc in used.items():
+        node = c.nodes.get(node_name)
+        assert node is not None, f"pods bound to vanished node {node_name}"
+        for res, qty in acc.items():
+            cap = node.capacity.get(res, 0.0)
+            assert qty <= cap + 1e-6, (
+                f"node {node_name} oversubscribed on {res}: {qty} > {cap}"
+            )
+
+
+def test_soak_churn_invariants():
+    rng = random.Random(7)
+    s = Scenario(16)
+    s.deploy(_soak_wl())
+    assert s.until_ready(10, timeout=240)
+
+    live_pcs = {"pcs"}
+    for tick in range(400):
+        s.sim.step(1.0)
+        roll = rng.random()
+        pods = [p for p in s.pods() if p.is_active]
+        if roll < 0.08 and pods:
+            s.sim.fail_pod(rng.choice(pods).name)
+        elif roll < 0.12 and pods:
+            s.sim.crash_pod(rng.choice(pods).name)
+        elif roll < 0.16:
+            node = rng.choice(list(s.cluster.nodes))
+            s.sim.cordon(node)
+        elif roll < 0.20:
+            cordoned = [
+                n for n, node in s.cluster.nodes.items() if not node.schedulable
+            ]
+            if cordoned:
+                s.sim.uncordon(rng.choice(cordoned))
+        elif roll < 0.22 and len(s.cluster.nodes) > 12:
+            # One-pod nodes: keep >= 12 so the full workload (10 pods at
+            # sg-x scale 2) always has somewhere to converge back to.
+            s.sim.kill_node(rng.choice(list(s.cluster.nodes)))
+        elif roll < 0.24:
+            s.scale_pcsg("pcs", "sg-x", rng.choice([1, 2, 3]))
+        _check_invariants(s)
+
+    # Restore a known shape (scale back to 2) and full capacity, then the
+    # system must converge back to ALL 10 pods ready.
+    s.scale_pcsg("pcs", "sg-x", 2)
+    for name, node in list(s.cluster.nodes.items()):
+        if not node.schedulable:
+            s.sim.uncordon(name)
+    # Convergence may require gang termination of crashlooped replicas
+    # (breach > terminationDelay 30s) and a fresh reschedule.
+    assert s.until(
+        lambda: len(s.ready()) >= 10, timeout=900
+    ), f"system failed to re-converge: {len(s.ready())} ready"
+    _check_invariants(s)
+    assert live_pcs == set(s.cluster.podcliquesets)
+
+    # Full teardown leaves nothing behind.
+    s.controller.cluster.delete_pcs_cascade("pcs")
+    s.sim.step(1.0)
+    assert not s.cluster.pods, "teardown left pods"
+    assert not s.cluster.podcliques, "teardown left cliques"
+    assert not s.cluster.podgangs, "teardown left gangs"
+
+
+def test_soak_rack_pack_never_straddles():
+    """I5 under churn: a required-rack gang that reschedules after failures
+    still lands whole-rack every time."""
+    from grove_tpu.api import PodCliqueSet, default_podcliqueset
+
+    doc = {
+        "apiVersion": "grove.io/v1alpha1",
+        "kind": "PodCliqueSet",
+        "metadata": {"name": "packed"},
+        "spec": {
+            "replicas": 1,
+            "template": {
+                "cliques": [
+                    {
+                        "name": "w",
+                        "topologyConstraint": {"packDomain": "rack"},
+                        "spec": {
+                            "roleName": "w",
+                            "replicas": 3,
+                            "podSpec": {
+                                "containers": [
+                                    {
+                                        "name": "w",
+                                        "image": "r/w:1",
+                                        "resources": {"requests": {"cpu": "1"}},
+                                    }
+                                ]
+                            },
+                        },
+                    }
+                ]
+            },
+        },
+    }
+    rng = random.Random(11)
+    s = Scenario(12)
+    s.deploy(default_podcliqueset(PodCliqueSet.from_dict(doc)))
+    assert s.until_ready(3, timeout=240)
+
+    def rack_of(node_name):
+        return s.cluster.nodes[node_name].labels.get(
+            "topology.kubernetes.io/rack"
+        )
+
+    for tick in range(200):
+        s.sim.step(1.0)
+        pods = [p for p in s.pods() if p.is_active]
+        if rng.random() < 0.1 and pods:
+            s.sim.fail_pod(rng.choice(pods).name)
+        bound = [p for p in pods if p.node_name and p.ready]
+        racks = {rack_of(p.node_name) for p in bound}
+        if len(bound) == 3:
+            assert len(racks) == 1, f"rack pack straddled: {racks} at tick {tick}"
