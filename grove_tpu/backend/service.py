@@ -196,7 +196,9 @@ class TPUSchedulerBackend:
         with overflow still rounded to the next power of two — recurring
         solve shapes reuse the compiled program instead of recompiling per
         pending-set size."""
-        pow2 = max(1, 1 << (max(value, 1) - 1).bit_length())
+        from grove_tpu.solver.encode import next_pow2
+
+        pow2 = next_pow2(value)
         return max(configured, pow2) if configured else pow2
 
     @staticmethod

@@ -893,8 +893,15 @@ def solve(
 
 def decode_assignments(result: SolveResult, decode_info, snapshot) -> dict[str, dict[str, str]]:
     """SolveResult -> {gang name: {pod name: node name}} for admitted gangs."""
-    assigned = np.asarray(result.assigned)
-    ok = np.asarray(result.ok)
+    return decode_bindings(result.ok, result.assigned, decode_info, snapshot)
+
+
+def decode_bindings(ok, assigned, decode_info, snapshot) -> dict[str, dict[str, str]]:
+    """(ok [G], assigned [G, MP]) -> {gang: {pod: node}} — the array-level
+    decode; callers that retained only these two arrays (the drain keeps
+    results' chaining buffers off-device) use this directly."""
+    assigned = np.asarray(assigned)
+    ok = np.asarray(ok)
     out: dict[str, dict[str, str]] = {}
     for gi, gang_name in enumerate(decode_info.gang_names):
         if not ok[gi]:

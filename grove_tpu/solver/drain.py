@@ -1,0 +1,195 @@
+"""Mass admission: drain a whole gang backlog through the solver, pipelined.
+
+The per-tick drivers (orchestrator controller, backend sidecar) solve the
+CURRENT pending set as one batch — right for steady state. When a backlog
+arrives at once (cluster bring-up, failover, the north-star bench), the
+throughput-optimal shape is different, and it lives here as a public API:
+
+  1. Shape-bucketed waves: gangs batch with others of their own padded
+     encode shape (groups, pack-sets, pods-next-pow2) instead of padding
+     everything to global maxima; each wave additionally pads its gang axis
+     to its own next power of two (the scan pays per padded slot).
+  2. Two dependency ranks: all base gangs dispatch before all scaled gangs —
+     a scaled gang's verdict is only trustworthy if its base's wave was
+     dispatched earlier, and class-major order alone cannot guarantee that
+     across mixed shapes.
+  3. Fully async dispatch: waves chain device-side through free_after and
+     the ok_global bitmap (cross-wave base-gang gating costs zero host round
+     trips), so the host enqueues every wave back to back.
+  4. ONE batched device_get harvests every wave's verdicts. Measured on the
+     TPU relay (round 3): each separate device->host fetch pays a fixed
+     ~70-150ms, and per-wave polling blew a 10k-pod drain from <1s to 39s.
+
+bench.py is a thin consumer of this module; tests/test_drain.py pins the
+semantics platform-independently.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from grove_tpu.solver.core import (
+    SolverParams,
+    coarse_dmax_of,
+    decode_bindings,
+    solve_batch,
+    solve_batch_speculative,
+)
+from grove_tpu.solver.encode import encode_gangs, gang_shape, next_pow2
+
+
+@dataclass
+class DrainStats:
+    """Phase breakdown of one drain (wall seconds unless noted)."""
+
+    compile_s: float = 0.0  # warm-up of each (shape, pad) program
+    encode_s: float = 0.0  # host dense encode, all waves
+    dispatch_s: float = 0.0  # async enqueue of all solves
+    harvest_s: float = 0.0  # the single blocking batched device_get
+    decode_s: float = 0.0  # host decode of all bindings
+    total_s: float = 0.0  # timed section: encode+dispatch+harvest+decode
+    waves: int = 0
+    gangs: int = 0
+    admitted: int = 0
+    pods_bound: int = 0
+    scores: list = field(default_factory=list)  # per admitted gang
+
+
+def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int]]:
+    """Shape-bucketed, rank-ordered waves: (members, (mg, ms, mp), pad)."""
+
+    def _padded_shape(g):
+        mg_g, ms_g, mp_g = gang_shape(g)
+        return (mg_g, max(ms_g, 1), next_pow2(mp_g))
+
+    waves: list[tuple[list, tuple, int]] = []
+    for rank in (0, 1):
+        classes: dict[tuple, list] = {}
+        for g in gangs:
+            if (g.base_podgang_name is not None) == bool(rank):
+                classes.setdefault(_padded_shape(g), []).append(g)
+        for shape, members in classes.items():
+            for i in range(0, len(members), wave_size):
+                wave = members[i : i + wave_size]
+                waves.append((wave, shape, max(32, next_pow2(len(wave)))))
+    return waves
+
+
+def drain_backlog(
+    gangs: list,
+    pods_by_name: dict,
+    snapshot,
+    *,
+    wave_size: int = 256,
+    params: SolverParams | None = None,
+    speculative: bool = False,
+    warm: bool = True,
+) -> tuple[dict[str, dict[str, str]], DrainStats]:
+    """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
+
+    Admission order is preserved WITHIN each shape class only: waves
+    dispatch class-major (then base rank before scaled rank), so a
+    high-priority gang in a later-dispatched class can lose capacity to
+    earlier classes. Use the per-tick drivers (controller / sidecar), which
+    batch the whole pending set in priority order, when strict cross-class
+    priority matters; the drain trades that for pipelined throughput.
+    All-or-nothing per gang; scaled gangs wait for their base's verdict
+    on-device.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    params = params or SolverParams()
+    solver = solve_batch_speculative if speculative else solve_batch
+    stats = DrainStats(gangs=len(gangs))
+    if not gangs:
+        return {}, stats
+
+    waves = plan_waves(gangs, wave_size)
+    stats.waves = len(waves)
+    gidx = {g.name: i for i, g in enumerate(gangs)}
+
+    capacity = jnp.asarray(snapshot.capacity)
+    schedulable = jnp.asarray(snapshot.schedulable)
+    node_domain_id = jnp.asarray(snapshot.node_domain_id)
+    dmax = coarse_dmax_of(snapshot)
+
+    def encode_wave(ws):
+        wave, (mg_c, ms_c, mp_c), pad = ws
+        return encode_gangs(
+            wave,
+            pods_by_name,
+            snapshot,
+            max_groups=mg_c,
+            max_sets=ms_c,
+            max_pods=mp_c,
+            pad_gangs_to=pad,
+            global_index_of=gidx,
+        )
+
+    if warm:
+        t0 = time.perf_counter()
+        warmed: set[tuple] = set()
+        last = None
+        for ws in waves:
+            if ws[1:] in warmed:
+                continue
+            warmed.add(ws[1:])
+            warm_batch, _ = encode_wave(ws)
+            last = solver(
+                jnp.asarray(snapshot.free),
+                capacity,
+                schedulable,
+                node_domain_id,
+                warm_batch,
+                params,
+                jnp.zeros((len(gangs),), dtype=bool),
+                coarse_dmax=dmax,
+            )
+            jax.block_until_ready(last.ok)
+        stats.compile_s = time.perf_counter() - t0
+        # Prime the device->host path OUTSIDE both the compile and the timed
+        # drain regions (first d2h in a process pays a ~0.5s relay setup that
+        # has nothing to do with either).
+        np.asarray(last.ok)
+
+    t0 = time.perf_counter()
+    free_arr = jnp.asarray(snapshot.free)
+    ok_g = jnp.zeros((len(gangs),), dtype=bool)
+    # Keep only what decode needs per wave — retaining full SolveResults
+    # would pin every wave's chaining buffers in device memory.
+    inflight = []  # (ok, placement_score, assigned, decode_info)
+    for ws in waves:
+        te = time.perf_counter()
+        batch, decode = encode_wave(ws)
+        stats.encode_s += time.perf_counter() - te
+        ts = time.perf_counter()
+        result = solver(
+            free_arr, capacity, schedulable, node_domain_id, batch, params, ok_g,
+            coarse_dmax=dmax,
+        )
+        stats.dispatch_s += time.perf_counter() - ts
+        free_arr = result.free_after
+        ok_g = result.ok_global
+        inflight.append((result.ok, result.placement_score, result.assigned, decode))
+
+    th = time.perf_counter()
+    jax.device_get([(ok, sc, asg) for ok, sc, asg, _ in inflight])
+    stats.harvest_s = time.perf_counter() - th
+
+    bindings: dict[str, dict[str, str]] = {}
+    for ok, sc, asg, decode in inflight:
+        td = time.perf_counter()
+        wave_bindings = decode_bindings(ok, asg, decode, snapshot)
+        stats.decode_s += time.perf_counter() - td
+        scores = np.asarray(sc)
+        ok_mask = np.asarray(ok)
+        stats.scores.extend(scores[ok_mask].tolist())
+        for gang_name, pod_bindings in wave_bindings.items():
+            bindings[gang_name] = pod_bindings
+            stats.admitted += 1
+            stats.pods_bound += len(pod_bindings)
+    stats.total_s = time.perf_counter() - t0
+    return bindings, stats

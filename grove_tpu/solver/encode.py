@@ -108,6 +108,12 @@ def _level_index(snapshot: ClusterSnapshot, label_key: str | None) -> int:
     return -1
 
 
+def next_pow2(v: int) -> int:
+    """Next power of two >= v (min 1) — THE bucketing rounding, shared by the
+    sidecar's shape buckets and the drain planner so policy cannot diverge."""
+    return max(1, 1 << (max(v, 1) - 1).bit_length())
+
+
 _BLOCKING_EFFECTS = ("NoSchedule", "NoExecute")
 
 
