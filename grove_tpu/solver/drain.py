@@ -57,7 +57,14 @@ class DrainStats:
 
 
 def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int]]:
-    """Shape-bucketed, rank-ordered waves: (members, (mg, ms, mp), pad)."""
+    """Shape-bucketed, rank-ordered waves: (members, (mg, ms, mp), pad).
+
+    Within each rank, shape classes dispatch in order of their FIRST member's
+    position in `gangs` (dict insertion order) — a caller that pre-sorted by
+    priority gets the class containing the top-priority gang solved first,
+    shrinking the cross-class inversion window the drain trades for
+    throughput (strict global priority still needs the per-tick drivers);
+    test_plan_waves_class_order_follows_input_order pins this."""
 
     def _padded_shape(g):
         mg_g, ms_g, mp_g = gang_shape(g)
@@ -88,12 +95,13 @@ def drain_backlog(
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
 
-    Admission order is preserved WITHIN each shape class only: waves
-    dispatch class-major (then base rank before scaled rank), so a
-    high-priority gang in a later-dispatched class can lose capacity to
-    earlier classes. Use the per-tick drivers (controller / sidecar), which
-    batch the whole pending set in priority order, when strict cross-class
-    priority matters; the drain trades that for pipelined throughput.
+    Admission order is preserved WITHIN each shape class; across classes,
+    a pre-sorted input (planner.sort_pending) dispatches the class holding
+    the top-priority gang first, but a high-priority gang whose class sits
+    later can still lose capacity to earlier classes. Use the per-tick
+    drivers (controller / sidecar), which batch the whole pending set in
+    strict priority order, when that matters; the drain trades it for
+    pipelined throughput.
     All-or-nothing per gang; scaled gangs wait for their base's verdict
     on-device.
     """

@@ -1,12 +1,18 @@
 #!/bin/bash
-# Poll the TPU relay; when it answers, run the full bench on it and save.
+# Poll the TPU relay; when it answers, run the full bench and save. A failed
+# or timed-out bench (the relay can wedge mid-run) keeps polling — the watch
+# only succeeds with a non-empty JSON line in hand.
+cd "$(dirname "$0")/.." || exit 1
 for i in $(seq 1 200); do
   if timeout 120 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
     echo "relay up at attempt $i ($(date))"
-    timeout 580 python bench.py > /tmp/bench_tpu_final.json 2>/tmp/bench_tpu_final.err
-    echo "bench rc=$?"
-    cat /tmp/bench_tpu_final.json
-    exit 0
+    if timeout 580 python bench.py > /tmp/bench_tpu_final.json 2>/tmp/bench_tpu_final.err \
+        && [ -s /tmp/bench_tpu_final.json ]; then
+      echo "bench ok"
+      cat /tmp/bench_tpu_final.json
+      exit 0
+    fi
+    echo "bench failed (rc=$?); continuing to poll"
   fi
   sleep 60
 done

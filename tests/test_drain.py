@@ -100,3 +100,16 @@ def test_plan_waves_rank_ordering():
             saw_scaled = True
         else:
             assert not saw_scaled, "base wave after a scaled wave"
+
+
+def test_plan_waves_class_order_follows_input_order():
+    """The class containing the FIRST gang of the (priority-sorted) input
+    dispatches first within its rank."""
+    gangs, _, _ = _setup(n_disagg=3, n_agg=3, n_frontend=3)
+    bases = [g for g in gangs if g.base_podgang_name is None]
+    # Put a frontend-class gang first, then reverse: the leading class flips.
+    frontend_first = sorted(bases, key=lambda g: "frontend" not in g.name)
+    waves_a = plan_waves(frontend_first, wave_size=64)
+    waves_b = plan_waves(list(reversed(frontend_first)), wave_size=64)
+    assert waves_a[0][0][0].name == frontend_first[0].name
+    assert waves_b[0][0][0].name != frontend_first[0].name
