@@ -7,6 +7,7 @@ API via the typed client. Commands:
   get <kind> <name>                             full object as JSON
   apply -f <file.yaml>                          admit a PodCliqueSet
   delete pcs <name>                             cascade-delete
+  top                                           per-node requested/capacity
   events [--tail N]                             recent control-plane events
 
 Exit codes: 0 ok, 1 API/transport error, 2 usage error (cli.go:35-45 shape).
@@ -114,6 +115,8 @@ def main(argv=None) -> int:
             raise argparse.ArgumentTypeError(f"must be 0-{EVENTS_BUFFER}")
         return n
 
+    sub.add_parser("top", help="per-node utilization from live bindings")
+
     p_ev = sub.add_parser("events", help="recent control-plane events")
     # The server returns at most the last EVENTS_BUFFER events; larger
     # --tail values would silently truncate, so the parser rejects them.
@@ -161,6 +164,27 @@ def main(argv=None) -> int:
                 return 2
             client.delete_podcliqueset(args.name)
             print(f"podcliqueset/{args.name} deleted")
+        elif args.cmd == "top":
+            # kubectl-top analog, computed client-side from two bulk
+            # listings: requested = sum of active bound pods' requests.
+            nodes = client.list_nodes_full()
+            pods = client.list_pods_full()
+            used: dict[str, dict[str, float]] = {}
+            for pod in pods.values():
+                if pod.node_name and pod.is_active:
+                    acc = used.setdefault(pod.node_name, {})
+                    for res, qty in pod.spec.total_requests().items():
+                        acc[res] = acc.get(res, 0.0) + qty
+            rows = []
+            for name, node in nodes.items():
+                cells = []
+                for res in sorted(node.capacity):
+                    cap = node.capacity[res]
+                    req = used.get(name, {}).get(res, 0.0)
+                    pct = f"{100.0 * req / cap:.0f}%" if cap else "-"
+                    cells.append(f"{res}={req:g}/{cap:g}({pct})")
+                rows.append([name, " ".join(cells)])
+            print(_table(rows, ["NAME", "REQUESTED/CAPACITY"]))
         elif args.cmd == "events":
             tail = client.events()[-args.tail:] if args.tail > 0 else []
             for ts, obj, msg in tail:

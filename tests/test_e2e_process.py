@@ -252,3 +252,30 @@ def test_cli_against_live_operator(operator_proc, tmp_path):
     assert r.returncode == 0
     r = cli("delete", "pcs", "simple1")
     assert r.returncode == 1, "double delete must surface the 404"
+
+
+def test_cli_top_against_live_operator(operator_proc):
+    proc, port = operator_proc
+    server = f"http://127.0.0.1:{port}"
+    body = (REPO / "examples" / "simple1.yaml").read_text()
+    _post(port, "/api/v1/podcliquesets", body)
+    deadline = time.time() + 30
+    out = ""
+    while time.time() < deadline:
+        r = subprocess.run(
+            [sys.executable, "-m", "grove_tpu.cli", "--server", server, "top"],
+            capture_output=True, text=True, cwd=REPO, env=ENV, timeout=60,
+        )
+        out = r.stdout
+        # Any fractional nonzero cpu request means pods have bound (0.01
+        # per pod; co-located pods show 0.02/0.03... on one node).
+        if r.returncode == 0 and "cpu=0.0" in out.replace(" ", ""):
+            break
+        time.sleep(0.5)
+    assert "REQUESTED/CAPACITY" in out
+    assert "kwok-0" in out
+    # At least one node shows non-zero requested cpu once pods bind.
+    assert any(
+        "cpu=0" not in line.replace(" ", "") or "cpu=0." in line.replace(" ", "")
+        for line in out.splitlines()[1:]
+    ), out
