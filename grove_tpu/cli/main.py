@@ -8,6 +8,7 @@ API via the typed client. Commands:
   apply -f <file.yaml>                          admit a PodCliqueSet
   delete pcs <name>                             cascade-delete
   top                                           per-node requested/capacity
+  validate -f <file.yaml>                       dry-run admission check
   events [--tail N]                             recent control-plane events
 
 Exit codes: 0 ok, 1 API/transport error, 2 usage error (cli.go:35-45 shape).
@@ -117,6 +118,11 @@ def main(argv=None) -> int:
 
     sub.add_parser("top", help="per-node utilization from live bindings")
 
+    p_val = sub.add_parser(
+        "validate", help="dry-run admission check (defaulting + validation)"
+    )
+    p_val.add_argument("-f", "--filename", required=True)
+
     p_ev = sub.add_parser("events", help="recent control-plane events")
     # The server returns at most the last EVENTS_BUFFER events; larger
     # --tail values would silently truncate, so the parser rejects them.
@@ -185,6 +191,31 @@ def main(argv=None) -> int:
                     cells.append(f"{res}={req:g}/{cap:g}({pct})")
                 rows.append([name, " ".join(cells)])
             print(_table(rows, ["NAME", "REQUESTED/CAPACITY"]))
+        elif args.cmd == "validate":
+            # kubectl --dry-run analog: run the SAME defaulting + validation
+            # the apply path runs, locally — no server needed.
+            import yaml as _yaml
+
+            from grove_tpu.api import (
+                DEFAULT_CLUSTER_TOPOLOGY,
+                PodCliqueSet,
+                default_podcliqueset,
+                validate_podcliqueset,
+            )
+
+            with open(args.filename) as f:
+                doc = _yaml.safe_load(f)
+            try:
+                pcs = default_podcliqueset(PodCliqueSet.from_dict(doc))
+            except (KeyError, TypeError, ValueError) as e:
+                print(f"invalid: {e}", file=sys.stderr)
+                return 1
+            errs = validate_podcliqueset(pcs, DEFAULT_CLUSTER_TOPOLOGY)
+            if errs:
+                for e in errs:
+                    print(f"invalid: {e.field}: {e.message}", file=sys.stderr)
+                return 1
+            print(f"podcliqueset/{pcs.metadata.name} valid")
         elif args.cmd == "events":
             tail = client.events()[-args.tail:] if args.tail > 0 else []
             for ts, obj, msg in tail:

@@ -124,3 +124,33 @@ def test_http_and_fake_agree(served):
     a = http_client.get_podcliqueset("cl")
     b = fake.get_podcliqueset("cl")
     assert a.spec.replicas == b.spec.replicas
+
+
+def test_cli_validate_dry_run(tmp_path):
+    """`grove-tpu validate -f` runs the admission pipeline locally: exit 0
+    on a valid spec, exit 1 listing every problem on an invalid one."""
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    ok = subprocess.run(
+        [sys.executable, "-m", "grove_tpu.cli", "validate", "-f",
+         str(repo / "examples" / "simple1.yaml")],
+        capture_output=True, text=True, cwd=repo, timeout=60,
+    )
+    assert ok.returncode == 0 and "valid" in ok.stdout
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        "apiVersion: grove.io/v1alpha1\nkind: PodCliqueSet\n"
+        "metadata: {name: x}\nspec:\n  replicas: 1\n  template:\n    cliques:\n"
+        "      - name: a\n        spec: {roleName: a, replicas: 2, minAvailable: 5,\n"
+        "          podSpec: {containers: [{name: c, image: i}]}}\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "grove_tpu.cli", "validate", "-f", str(bad)],
+        capture_output=True, text=True, cwd=repo, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "minAvailable" in r.stderr
