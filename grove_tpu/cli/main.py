@@ -122,6 +122,12 @@ def main(argv=None) -> int:
         "validate", help="dry-run admission check (defaulting + validation)"
     )
     p_val.add_argument("-f", "--filename", required=True)
+    p_val.add_argument(
+        "--config",
+        default=None,
+        help="operator config YAML; validates against ITS topology levels "
+        "(omit for the default topology)",
+    )
 
     p_ev = sub.add_parser("events", help="recent control-plane events")
     # The server returns at most the last EVENTS_BUFFER events; larger
@@ -192,28 +198,37 @@ def main(argv=None) -> int:
                 rows.append([name, " ".join(cells)])
             print(_table(rows, ["NAME", "REQUESTED/CAPACITY"]))
         elif args.cmd == "validate":
-            # kubectl --dry-run analog: run the SAME defaulting + validation
-            # the apply path runs, locally — no server needed.
+            # kubectl --dry-run analog: the SAME AdmissionChain the server's
+            # apply path runs (no hand-rolled pipeline copy that could
+            # drift), against the operator config's topology when given.
             import yaml as _yaml
 
-            from grove_tpu.api import (
-                DEFAULT_CLUSTER_TOPOLOGY,
-                PodCliqueSet,
-                default_podcliqueset,
-                validate_podcliqueset,
-            )
+            from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY, PodCliqueSet
+            from grove_tpu.api.admission import AdmissionChain, AdmissionError
 
-            with open(args.filename) as f:
-                doc = _yaml.safe_load(f)
+            topology = DEFAULT_CLUSTER_TOPOLOGY
+            if args.config:
+                from grove_tpu.runtime.config import load_operator_config
+
+                topology = load_operator_config(args.config).cluster_topology()
             try:
-                pcs = default_podcliqueset(PodCliqueSet.from_dict(doc))
-            except (KeyError, TypeError, ValueError) as e:
-                print(f"invalid: {e}", file=sys.stderr)
+                with open(args.filename) as f:
+                    doc = _yaml.safe_load(f)
+                pcs = AdmissionChain(topology=topology).admit_podcliqueset(
+                    PodCliqueSet.from_dict(doc)
+                )
+            except AdmissionError as e:
+                for err in e.errors:
+                    print(f"invalid: {err}", file=sys.stderr)
                 return 1
-            errs = validate_podcliqueset(pcs, DEFAULT_CLUSTER_TOPOLOGY)
-            if errs:
-                for e in errs:
-                    print(f"invalid: {e.field}: {e.message}", file=sys.stderr)
+            except (
+                _yaml.YAMLError,
+                AttributeError,  # non-mapping top level (empty/scalar/list)
+                KeyError,
+                TypeError,
+                ValueError,
+            ) as e:
+                print(f"invalid: {e}", file=sys.stderr)
                 return 1
             print(f"podcliqueset/{pcs.metadata.name} valid")
         elif args.cmd == "events":
