@@ -73,3 +73,24 @@ def test_solver_never_loses_to_greedy_uncontended():
     result = solve(snapshot, batch)
     bindings = decode_assignments(result, decode, snapshot)
     assert len(bindings) >= gstats.admitted
+
+
+def test_speculative_matches_sequential_admission_under_contention():
+    """Round-2 open question, now measured: on the trap-block cluster the
+    speculative parallel commit admits the SAME count as the sequential scan
+    (both reach the 32-gang capacity ceiling at 48 offered). Pinned as a
+    floor so a regression in the conflict-resolution rounds fails loudly."""
+    topo = bench_topology()
+    nodes, squatters = contended_cluster()
+    backlog = contended_backlog(n_gangs=48)
+    gangs, pods = _expand_all(backlog, topo)
+    snapshot = build_snapshot(nodes, topo, bound_pods=squatters)
+    batch, decode = encode_gangs(gangs, pods, snapshot)
+    seq = len(decode_assignments(solve(snapshot, batch), decode, snapshot))
+    spec = len(
+        decode_assignments(
+            solve(snapshot, batch, speculative=True), decode, snapshot
+        )
+    )
+    assert seq == 32, f"sequential ceiling moved: {seq}"
+    assert spec >= seq, f"speculative under-admits: {spec} < {seq}"
