@@ -2,10 +2,13 @@
 
 PY ?= python
 
-.PHONY: test test-e2e bench bench-cpu dryrun check clean
+.PHONY: test test-all test-e2e bench bench-cpu dryrun check clean
 
 test:            ## unit + scenario suites (CPU-forced via tests/conftest.py)
 	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_process.py
+
+test-all:        ## everything incl. soak/churn tiers and process e2e
+	$(PY) -m pytest tests/ -q -m ""
 
 test-e2e:        ## process-level e2e tier only (binary + CLI over HTTP)
 	$(PY) -m pytest tests/test_e2e_process.py -q

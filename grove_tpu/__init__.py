@@ -16,4 +16,4 @@ Layout (mirrors SURVEY.md §7):
   sim/          synthetic cluster generator + event-driven simulator
 """
 
-__version__ = "0.1.0"
+from grove_tpu.version import VERSION as __version__  # noqa: E402

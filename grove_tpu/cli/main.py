@@ -91,7 +91,12 @@ def _get_table(client: GroveClient, kind: str) -> str:
 
 
 def main(argv=None) -> int:
+    from grove_tpu.version import version_string
+
     parser = argparse.ArgumentParser(prog="grove-tpu")
+    parser.add_argument(
+        "--version", action="version", version=version_string("grove-tpu")
+    )
     parser.add_argument("--server", default="http://127.0.0.1:2751")
     parser.add_argument("--token-file", default=None, help="bearer token file")
     parser.add_argument("--cafile", default=None, help="pinned serving cert (TLS)")
@@ -128,6 +133,12 @@ def main(argv=None) -> int:
         help="operator config YAML; validates against ITS topology levels "
         "(omit for the default topology)",
     )
+
+    p_scale = sub.add_parser(
+        "scale", help="set a PodClique/PCSG scale subresource (kubectl scale)"
+    )
+    p_scale.add_argument("target", help="PodClique or PCSG FQN")
+    p_scale.add_argument("--replicas", type=int, required=True)
 
     p_ev = sub.add_parser("events", help="recent control-plane events")
     # The server returns at most the last EVENTS_BUFFER events; larger
@@ -231,6 +242,9 @@ def main(argv=None) -> int:
                 print(f"invalid: {e}", file=sys.stderr)
                 return 1
             print(f"podcliqueset/{pcs.metadata.name} valid")
+        elif args.cmd == "scale":
+            previous = client.scale(args.target, args.replicas)
+            print(f"{args.target} scaled {previous} -> {args.replicas}")
         elif args.cmd == "events":
             tail = client.events()[-args.tail:] if args.tail > 0 else []
             for ts, obj, msg in tail:

@@ -165,6 +165,16 @@ class GroveClient:
         )
         return resp["targets"]
 
+    def scale(self, target: str, replicas: int) -> int:
+        """kubectl-scale analog: set a PodClique/PCSG scale subresource.
+        Returns the previous effective replica count."""
+        resp = self._request(
+            "POST",
+            "/api/v1/scale",
+            json.dumps({"target": target, "replicas": replicas}).encode(),
+        )
+        return resp["previous"]
+
 
 class FakeGroveClient:
     """In-process fake with the same typed surface (fake-clientset analog).
@@ -256,6 +266,16 @@ class FakeGroveClient:
         if name not in self.manager.cluster.podcliquesets:
             raise GroveApiError(404, ["not found"])
         self.manager.delete_podcliqueset(name, actor=self.actor)
+
+    def scale(self, target: str, replicas: int) -> int:
+        if not isinstance(replicas, int) or isinstance(replicas, bool):
+            raise GroveApiError(400, ["replicas must be an integer"])
+        try:
+            return self.manager.scale_target(target, replicas, actor=self.actor)
+        except KeyError:
+            raise GroveApiError(404, [f"unknown scale target {target!r}"]) from None
+        except ValueError as e:
+            raise GroveApiError(400, [str(e)]) from None
 
     def events(self) -> list[tuple[float, str, str]]:
         return list(self.manager.cluster.events[-200:])

@@ -159,8 +159,11 @@ def kwok_fleet_from_config(cluster_cfg, topology, now: float = 0.0) -> KwokClust
     Every non-host topology level gets a node label so TAS pack constraints
     resolve against this fleet: hosts group into racks of `kwokHostsPerRack`,
     racks into blocks of `kwokRacksPerBlock`, and each broader level groups
-    4 of the next-narrower one (the e2e rig's zone/block/rack shape,
-    operator/hack/e2e-cluster/create-e2e-cluster.py:133-135).
+    by the matching `kwokLevelGroupFactors` entry (narrowest first). The
+    default zone-over-block shape keeps an implicit factor of 4 (the e2e
+    rig's shape, operator/hack/e2e-cluster/create-e2e-cluster.py:133-135);
+    config validation demands explicit factors only for hierarchies deeper
+    than zone.
     """
     from grove_tpu.api.types import TopologyDomain
 
@@ -170,13 +173,19 @@ def kwok_fleet_from_config(cluster_cfg, topology, now: float = 0.0) -> KwokClust
         if lvl.domain != TopologyDomain.HOST
     ]
     # Group sizes, narrowest level first.
+    factors = list(getattr(cluster_cfg, "kwok_level_group_factors", []) or [])
     sizes: list[int] = []
     for i in range(len(levels)):
         if i == 0:
             sizes.append(max(1, cluster_cfg.kwok_hosts_per_rack))
         elif i == 1:
             sizes.append(sizes[-1] * max(1, cluster_cfg.kwok_racks_per_block))
+        elif i - 2 < len(factors):
+            sizes.append(sizes[-1] * max(1, factors[i - 2]))
         else:
+            # Implicit zone factor for the default <=3-level shape; configs
+            # deeper than zone never get here (validation requires explicit
+            # factors for them).
             sizes.append(sizes[-1] * 4)
     nodes = []
     for n in range(cluster_cfg.kwok_nodes):

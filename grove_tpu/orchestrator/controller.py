@@ -84,6 +84,9 @@ class GroveController:
     # victims every pass — one preemption attempt per contender per window.
     preemption_cooldown_seconds: float = 30.0
     _preempted_for_at: dict = field(default_factory=dict)
+    # set by the floors wave when some gang has gated pods beyond its floor;
+    # gates the extras wave (see solve_pending)
+    _extras_candidates: bool = False
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -293,9 +296,16 @@ class GroveController:
         One combined wave would let an earlier gang's extras strand the
         capacity a later gang's floor needs — GS-7/GS-8 pin the reference
         behavior (gang_scheduling_test.go:537-786): every gang floor binds
-        before ANY best-effort pod. Returns newly admitted gangs."""
+        before ANY best-effort pod. Returns newly admitted gangs.
+
+        The extras wave only runs when the floors pass saw at least one gang
+        with gated pods beyond its floor (replicas > minAvailable is the
+        exception, not the rule) — otherwise the second scan over every gang
+        and pod is pure overhead at fleet scale."""
+        self._extras_candidates = False
         admitted = self._solve_wave(now, floors_only=True)
-        self._solve_wave(now, floors_only=False)
+        if self._extras_candidates:
+            self._solve_wave(now, floors_only=False)
         return admitted
 
     def _solve_wave(self, now: float, floors_only: bool) -> int:
@@ -346,6 +356,8 @@ class GroveController:
                         # Encode ONLY up to the unmet floor; extras wait for
                         # the second wave.
                         needed = max(0, grp.min_replicas - len(scheduled_pods))
+                        if len(refs) > needed:
+                            self._extras_candidates = True
                         refs = refs[:needed]
                     if refs:
                         unbound_refs[grp.name] = refs

@@ -18,7 +18,11 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--run-for", type=float, default=None, help="exit after N seconds (testing)"
     )
-    parser.add_argument("--version", action="version", version="grove-tpu 0.2")
+    from grove_tpu.version import version_string
+
+    parser.add_argument(
+        "--version", action="version", version=version_string("grove-tpu")
+    )
     args = parser.parse_args(argv)
 
     from grove_tpu.runtime.config import load_operator_config

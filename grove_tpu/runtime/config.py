@@ -153,6 +153,11 @@ class ClusterConfig:
     kwok_tpu_per_node: float = 8.0
     kwok_hosts_per_rack: int = 4
     kwok_racks_per_block: int = 4
+    # Group factors for topology levels BEYOND rack/block, narrowest first
+    # (e.g. [2, 3] = 2 blocks per zone, 3 zones per super-zone). Required
+    # when the TAS config declares more than rack/block/host — a deeper
+    # hierarchy must not silently get a fleet shape nobody asked for.
+    kwok_level_group_factors: list = field(default_factory=list)
     # KWOK stage latencies (kind-up.sh:264-265): bind -> Running -> Ready.
     running_delay_seconds: float = 0.2
     ready_delay_seconds: float = 0.2
@@ -252,6 +257,7 @@ _CAMEL_FIELDS = {
     "kwokTpuPerNode": "kwok_tpu_per_node",
     "kwokHostsPerRack": "kwok_hosts_per_rack",
     "kwokRacksPerBlock": "kwok_racks_per_block",
+    "kwokLevelGroupFactors": "kwok_level_group_factors",
     "runningDelaySeconds": "running_delay_seconds",
     "readyDelaySeconds": "ready_delay_seconds",
     "eventLagSeconds": "event_lag_seconds",
@@ -416,6 +422,37 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
                 "cluster.kwokCpuPerNode/kwokMemoryPerNode/kwokTpuPerNode: "
                 "must be >= 0"
             )
+        factors = cl.kwok_level_group_factors
+        if not isinstance(factors, list) or any(
+            not isinstance(fct, int) or isinstance(fct, bool) or fct < 1
+            for fct in factors
+        ):
+            errors.append(
+                "cluster.kwokLevelGroupFactors: must be a list of ints >= 1"
+            )
+        else:
+            from grove_tpu.api.types import TopologyDomain
+
+            try:
+                non_host = [
+                    lvl
+                    for lvl in cfg.cluster_topology().sorted_levels()
+                    if lvl.domain != TopologyDomain.HOST
+                ]
+            except Exception:
+                non_host = []  # reported above via topologyAwareScheduling
+            # The default rack/block/zone shape keeps its implicit factors
+            # (zone groups 4 blocks, the e2e rig's shape); anything DEEPER
+            # must spell out every factor beyond block — a 5-level hierarchy
+            # silently shaped by a hardcoded 4 is a fleet nobody asked for.
+            extra = len(non_host) - 2
+            if len(non_host) > 3 and extra > len(factors):
+                errors.append(
+                    f"cluster.kwokLevelGroupFactors: topology declares {extra} "
+                    "level(s) beyond rack/block; list a group factor for each "
+                    "(narrowest first) — hierarchies deeper than zone get no "
+                    "implicit shape"
+                )
     return errors
 
 

@@ -196,6 +196,38 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
             self.manager.hpa_metrics.update(update)
             self._respond(200, json.dumps({"targets": len(update)}), "application/json")
             return
+        if self.path == "/api/v1/scale":
+            # kubectl-scale analog: {"target": <pclq|pcsg FQN>, "replicas": N}
+            # writes the scale subresource (same path the HPA drives).
+            if not self._authorized(None):
+                self._respond(401, "unauthorized")
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            actor = self.headers.get("X-Grove-Actor", "user")
+            try:
+                doc = json.loads(self.rfile.read(length).decode())
+                if not isinstance(doc, dict) or "target" not in doc or "replicas" not in doc:
+                    raise ValueError('body must be {"target": ..., "replicas": N}')
+                target = str(doc["target"])
+                replicas = doc["replicas"]
+                if not isinstance(replicas, int) or isinstance(replicas, bool):
+                    raise ValueError("replicas must be an integer")
+                previous = self.manager.scale_target(target, replicas, actor=actor)
+            except KeyError as e:
+                self._respond(
+                    404, json.dumps({"errors": [f"unknown scale target {e}"]}),
+                    "application/json",
+                )
+                return
+            except (ValueError, TypeError) as e:
+                self._respond(400, json.dumps({"errors": [str(e)]}), "application/json")
+                return
+            self._respond(
+                200,
+                json.dumps({"target": target, "replicas": replicas, "previous": previous}),
+                "application/json",
+            )
+            return
         if self.path != "/api/v1/podcliquesets":
             self._respond(404, "not found")
             return
@@ -373,6 +405,39 @@ class Manager:
     def delete_podcliqueset(self, name: str, actor: str = "user") -> None:
         self.cluster.delete_pcs_cascade(name)
 
+    def scale_target(
+        self,
+        target: str,
+        replicas: int,
+        actor: str = "user",
+        now: float | None = None,
+    ) -> int:
+        """kubectl-scale analog: write the scale subresource of a PodClique
+        or PodCliqueScalingGroup — the SAME surface the HPA component writes
+        (reference: `scale` subresource on the CRs, podcliqueset.go:27;
+        HPA ScaleTargetRef, components/hpa/hpa.go:249-259). Returns the
+        previous effective value. Raises KeyError for an unknown target,
+        ValueError for a bad count."""
+        c = self.cluster
+        if target in c.podcliques:
+            spec_replicas = c.podcliques[target].spec.replicas
+        elif target in c.scaling_groups:
+            spec_replicas = c.scaling_groups[target].spec.replicas
+        else:
+            raise KeyError(target)
+        if replicas < 0:
+            raise ValueError("replicas must be >= 0")
+        previous = c.scale_overrides.get(target, spec_replicas)
+        c.scale_overrides[target] = int(replicas)
+        # `now` keeps virtual-time callers (tests, simulator) on one event
+        # timeline; the HTTP path has no virtual clock and takes wall time.
+        c.record_event(
+            time.time() if now is None else now,
+            target,
+            f"scaled {previous} -> {replicas} (by {actor})",
+        )
+        return previous
+
     def mutate_managed(self, actor: str, kind: str, name: str, fn) -> None:
         """Apply `fn(cluster)` as `actor` touching managed resource kind/name.
         The authorizer (when enabled) blocks everyone but the operator and
@@ -404,7 +469,10 @@ class Manager:
         }
 
     def statusz(self) -> dict:
+        from grove_tpu.version import build_info
+
         return {
+            "build": build_info(),
             "leader": self._is_leader,
             "backend_port": self.backend_port,
             "objects": {

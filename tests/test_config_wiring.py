@@ -469,3 +469,54 @@ def test_weight_duplicate_and_negative_jitter_rejected():
     )
     assert not errors
     assert float(cfg.solver.solver_params().w_jitter) == 0.0
+
+
+def test_cluster_kwok_deep_topology_requires_explicit_factors():
+    """A TAS hierarchy deeper than zone must declare kwokLevelGroupFactors —
+    the fleet shape for extra levels is never implicit (round-3 finding:
+    hardcoded factor-4 silently shaped 5+-level fleets)."""
+    deep_levels = [
+        {"domain": "datacenter", "nodeLabelKey": "topology.kubernetes.io/dc"},
+        {"domain": "zone", "nodeLabelKey": "topology.kubernetes.io/zone"},
+        {"domain": "block", "nodeLabelKey": "topology.kubernetes.io/block"},
+        {"domain": "rack", "nodeLabelKey": "topology.kubernetes.io/rack"},
+    ]
+    base = {
+        "topologyAwareScheduling": {"enabled": True, "levels": deep_levels},
+        "cluster": {"source": "kwok", "kwokNodes": 48},
+    }
+    _, errors = parse_operator_config(base)
+    assert any("kwokLevelGroupFactors" in e for e in errors)
+
+    # Bad factor values are rejected.
+    bad = {**base, "cluster": {**base["cluster"], "kwokLevelGroupFactors": [0, 2]}}
+    _, errors = parse_operator_config(bad)
+    assert any("kwokLevelGroupFactors" in e for e in errors)
+
+    # Explicit factors shape the fleet (hierarchy broad->narrow is
+    # zone > datacenter > block > rack, TopologyDomain ordering): racks of
+    # 2 hosts, blocks of 2 racks, datacenters of 3 blocks, zones of 2 DCs.
+    good = {
+        **base,
+        "cluster": {
+            **base["cluster"],
+            "kwokHostsPerRack": 2,
+            "kwokRacksPerBlock": 2,
+            "kwokLevelGroupFactors": [3, 2],
+        },
+    }
+    cfg, errors = parse_operator_config(good)
+    assert not errors, errors
+    from grove_tpu.cluster.kwok import kwok_fleet_from_config
+
+    fleet = kwok_fleet_from_config(cfg.cluster, cfg.cluster_topology())
+    events = fleet.poll(0.0)
+    nodes = {e.name: e.obj for e in events if e.kind == "Node"}
+    assert len(nodes) == 48
+    # Node 12: rack 6, block 3, dc 1 (12 hosts/dc), zone 0 (24 hosts/zone).
+    labels = nodes["kwok-12"]["labels"]
+    assert labels["topology.kubernetes.io/rack"] == "rack-6"
+    assert labels["topology.kubernetes.io/block"] == "block-3"
+    assert labels["topology.kubernetes.io/dc"] == "datacenter-1"
+    assert labels["topology.kubernetes.io/zone"] == "zone-0"
+    assert nodes["kwok-24"]["labels"]["topology.kubernetes.io/zone"] == "zone-1"

@@ -338,3 +338,129 @@ def test_manager_non_leader_does_not_reconcile(tmp_path, simple1):
     finally:
         m.stop()
         holder.release()
+
+
+# --- version / build info (internal/version analog) ----------------------------
+
+
+def test_version_single_source():
+    """Every version surface comes from grove_tpu.version (the reference's
+    ldflags build-info discipline, internal/version/): __version__, the
+    --version flags, and /statusz must agree by construction."""
+    import grove_tpu
+    from grove_tpu.version import VERSION, build_info, version_string
+
+    assert grove_tpu.__version__ == VERSION
+    assert VERSION in version_string("grove-tpu")
+    assert build_info()["version"] == VERSION
+
+
+def test_operator_version_flag_matches(capsys):
+    from grove_tpu.runtime.__main__ import main as operator_main
+    from grove_tpu.version import VERSION
+
+    with pytest.raises(SystemExit) as ei:
+        operator_main(["--version"])
+    assert ei.value.code == 0
+    assert VERSION in capsys.readouterr().out
+
+
+def test_cli_version_flag_matches(capsys):
+    from grove_tpu.cli.main import main as cli_main
+    from grove_tpu.version import VERSION
+
+    with pytest.raises(SystemExit) as ei:
+        cli_main(["--version"])
+    assert ei.value.code == 0
+    assert VERSION in capsys.readouterr().out
+
+
+def test_statusz_reports_build_info(booted_manager):
+    from grove_tpu.version import VERSION
+
+    base = f"http://127.0.0.1:{booted_manager.health_port}"
+    statusz = json.loads(urllib.request.urlopen(f"{base}/statusz").read())
+    assert statusz["build"]["version"] == VERSION
+
+
+# --- scale subresource (kubectl-scale analog) ----------------------------------
+
+
+def _post_json(url: str, doc: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), method="POST"
+    )
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+def test_scale_endpoint_drives_expansion(booted_manager, simple1):
+    """POST /api/v1/scale writes the same scale subresource the HPA writes;
+    the next reconcile expands the target to the new count."""
+    m = booted_manager
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+    m.reconcile_once(now=1.0)
+    target = next(iter(m.cluster.podcliques))
+    spec_replicas = m.cluster.podcliques[target].spec.replicas
+    base = f"http://127.0.0.1:{m.health_port}"
+    resp = _post_json(
+        f"{base}/api/v1/scale", {"target": target, "replicas": spec_replicas + 2}
+    )
+    assert resp["previous"] == spec_replicas
+    assert m.cluster.scale_overrides[target] == spec_replicas + 2
+    m.reconcile_once(now=2.0)
+    pods = [p for p in m.cluster.pods.values() if p.pclq_fqn == target]
+    assert len(pods) == spec_replicas + 2
+
+
+def test_scale_endpoint_rejects_bad_input(booted_manager, simple1):
+    m = booted_manager
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+    m.reconcile_once(now=1.0)
+    target = next(iter(m.cluster.podcliques))
+    base = f"http://127.0.0.1:{m.health_port}"
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_json(f"{base}/api/v1/scale", {"target": "nope", "replicas": 3})
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_json(f"{base}/api/v1/scale", {"target": target, "replicas": -1})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_json(f"{base}/api/v1/scale", {"target": target})
+    assert ei.value.code == 400
+
+
+def test_scale_via_clients_and_cli(booted_manager, simple1, capsys):
+    """GroveClient.scale, FakeGroveClient.scale and the CLI verb share one
+    server-side surface."""
+    from grove_tpu.client.typed import FakeGroveClient, GroveApiError, GroveClient
+
+    m = booted_manager
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+    m.reconcile_once(now=1.0)
+    target = next(iter(m.cluster.podcliques))
+    spec_replicas = m.cluster.podcliques[target].spec.replicas
+
+    http_client = GroveClient(f"http://127.0.0.1:{m.health_port}")
+    assert http_client.scale(target, spec_replicas + 1) == spec_replicas
+    fake = FakeGroveClient(m)
+    assert fake.scale(target, spec_replicas + 2) == spec_replicas + 1
+    with pytest.raises(GroveApiError):
+        fake.scale("nope", 3)
+
+    from grove_tpu.cli.main import main as cli_main
+
+    rc = cli_main(
+        [
+            "--server",
+            f"http://127.0.0.1:{m.health_port}",
+            "scale",
+            target,
+            "--replicas",
+            str(spec_replicas + 3),
+        ]
+    )
+    assert rc == 0
+    assert f"-> {spec_replicas + 3}" in capsys.readouterr().out
+    assert m.cluster.scale_overrides[target] == spec_replicas + 3

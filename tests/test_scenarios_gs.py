@@ -259,3 +259,34 @@ def test_gs12_complex_pcsg_scaling():
     # scale back out: freed capacity is reusable
     s.scale_pcsg("pcs", "sg-x", 3)
     assert s.until_scheduled(14)
+
+
+def test_extras_wave_only_runs_with_best_effort_pods(monkeypatch):
+    """solve_pending's second (extras) wave is gated on the floors pass
+    having seen gated pods beyond a floor: WL1 (minAvailable == replicas
+    everywhere) solves in ONE wave; WL2 (minAvailable=1 floors) runs both.
+    GS-5..GS-8 pin that the ordering semantics survive the gating."""
+    for wl, has_extras in ((wl1, False), (wl2, True)):
+        s = Scenario(12)
+        s.deploy(wl())
+        ctrl = s.controller
+        calls: list[bool] = []
+        orig = ctrl._solve_wave
+        monkeypatch.setattr(
+            ctrl,
+            "_solve_wave",
+            lambda now, floors_only, _o=orig, _c=calls: (
+                _c.append(floors_only),
+                _o(now, floors_only),
+            )[1],
+        )
+        s.settle(3)
+        assert calls, "solve_pending never ran"
+        if has_extras:
+            # First pass sees gated best-effort pods: floors then extras.
+            assert calls[:2] == [True, False], f"wl2 first pass: {calls}"
+        else:
+            # No best-effort pods ever exist: the extras wave must NEVER
+            # run, on any pass — the full call log is all floors.
+            assert all(calls), f"wl1 ran an extras wave: {calls}"
+        monkeypatch.undo()
