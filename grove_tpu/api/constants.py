@@ -81,3 +81,9 @@ MAX_K8S_NAME_LENGTH = 63
 # Control-plane event ring: the object API serves at most this many recent
 # events; clients (CLI --tail) validate against the same bound.
 EVENTS_BUFFER = 200
+
+# Ceiling for the scale subresource (kubectl-scale analog): the operator
+# materializes one in-memory Pod per replica, so an unbounded scale request
+# could OOM the control plane in one reconcile. HPA maxReplicas (when an HPA
+# targets the object) is the tighter, user-declared bound.
+MAX_SCALE_REPLICAS = 10_000

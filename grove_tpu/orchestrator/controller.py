@@ -105,14 +105,27 @@ class GroveController:
         worker thread (the manager parallelizes this across PCSes with the
         slow-start runner when controllers.concurrentSyncs > 1)."""
         c = self.cluster
-        pcsg_overrides = {
-            k: v
-            for k, v in c.scale_overrides.items()
-            if k in {naming.scaling_group_name(pcs.metadata.name, i, cfg.name)
-                     for i in range(pcs.spec.replicas)
-                     for cfg in pcs.spec.template.pod_clique_scaling_group_configs}
+        # The scale endpoint (POST /api/v1/scale) inserts into scale_overrides
+        # from an HTTP handler thread; retry the snapshot on the rare
+        # mid-iteration resize — same discipline as the manager's object-API
+        # reads (dict writes are GIL-atomic, iteration is the racy part).
+        for _ in range(8):
+            try:
+                overrides_snapshot = dict(c.scale_overrides)
+                break
+            except RuntimeError:
+                continue
+        else:
+            overrides_snapshot = {}
+        pcsg_names = {
+            naming.scaling_group_name(pcs.metadata.name, i, cfg.name)
+            for i in range(pcs.spec.replicas)
+            for cfg in pcs.spec.template.pod_clique_scaling_group_configs
         }
-        pclq_overrides = dict(c.scale_overrides)
+        pcsg_overrides = {
+            k: v for k, v in overrides_snapshot.items() if k in pcsg_names
+        }
+        pclq_overrides = overrides_snapshot
         return exp.expand_podcliqueset(
             pcs,
             self.topology,

@@ -427,6 +427,15 @@ class Manager:
             raise KeyError(target)
         if replicas < 0:
             raise ValueError("replicas must be >= 0")
+        # Ceiling: the HPA's user-declared max when one targets this object,
+        # else the control-plane sanity bound — one reconcile materializes a
+        # Pod object per replica, so an unbounded request is an OOM lever.
+        ceiling = constants.MAX_SCALE_REPLICAS
+        hpa = c.hpas.get(f"{target}-hpa")
+        if hpa is not None:
+            ceiling = min(ceiling, hpa.max_replicas)
+        if replicas > ceiling:
+            raise ValueError(f"replicas must be <= {ceiling} for {target}")
         previous = c.scale_overrides.get(target, spec_replicas)
         c.scale_overrides[target] = int(replicas)
         # `now` keeps virtual-time callers (tests, simulator) on one event

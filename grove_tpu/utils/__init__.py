@@ -8,6 +8,7 @@ from grove_tpu.utils.platform import (
     force_virtual_cpu_devices,
     probe_default_platform,
     scrubbed_cpu_env,
+    wait_for_accelerator,
 )
 
 __all__ = [
@@ -16,4 +17,5 @@ __all__ = [
     "force_virtual_cpu_devices",
     "probe_default_platform",
     "scrubbed_cpu_env",
+    "wait_for_accelerator",
 ]

@@ -439,7 +439,9 @@ def test_scale_via_clients_and_cli(booted_manager, simple1, capsys):
     m = booted_manager
     m.cluster.podcliquesets[simple1.metadata.name] = simple1
     m.reconcile_once(now=1.0)
-    target = next(iter(m.cluster.podcliques))
+    # The router clique has no HPA, so only the control-plane ceiling
+    # applies (the HPA-target case is pinned separately below).
+    target = next(n for n in m.cluster.podcliques if n.endswith("router"))
     spec_replicas = m.cluster.podcliques[target].spec.replicas
 
     http_client = GroveClient(f"http://127.0.0.1:{m.health_port}")
@@ -464,3 +466,23 @@ def test_scale_via_clients_and_cli(booted_manager, simple1, capsys):
     assert rc == 0
     assert f"-> {spec_replicas + 3}" in capsys.readouterr().out
     assert m.cluster.scale_overrides[target] == spec_replicas + 3
+
+
+def test_scale_ceiling_hpa_and_sanity_bound(booted_manager, simple1):
+    """Scale requests are capped: by the target's HPA maxReplicas when one
+    exists (the user-declared bound), else by MAX_SCALE_REPLICAS — one
+    reconcile materializes a Pod object per replica, so an unbounded scale
+    request would be an OOM lever on the control plane."""
+    from grove_tpu.api.constants import MAX_SCALE_REPLICAS
+
+    m = booted_manager
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+    m.reconcile_once(now=1.0)
+    frontend = next(n for n in m.cluster.podcliques if n.endswith("frontend"))
+    hpa = m.cluster.hpas[f"{frontend}-hpa"]
+    with pytest.raises(ValueError, match=f"<= {hpa.max_replicas}"):
+        m.scale_target(frontend, hpa.max_replicas + 1, now=1.5)
+    assert m.scale_target(frontend, hpa.max_replicas, now=1.6) >= 0
+    router = next(n for n in m.cluster.podcliques if n.endswith("router"))
+    with pytest.raises(ValueError, match="<="):
+        m.scale_target(router, MAX_SCALE_REPLICAS + 1, now=1.7)
