@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e bench bench-cpu dryrun check clean
+.PHONY: test test-all test-e2e test-conformance test-go-shim bench bench-cpu dryrun check clean
 
 test:            ## unit + scenario suites (CPU-forced via tests/conftest.py)
 	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_process.py
@@ -12,6 +12,16 @@ test-all:        ## everything incl. soak/churn tiers and process e2e
 
 test-e2e:        ## process-level e2e tier only (binary + CLI over HTTP)
 	$(PY) -m pytest tests/test_e2e_process.py -q
+
+test-conformance: ## GREP-375 wire conformance vs the live sidecar (protoc-built client)
+	$(PY) -m pytest tests/test_backend_conformance.py -q
+
+test-go-shim:    ## `go test` the GREP-375 shim (needs a Go toolchain; absent in this image)
+	@if command -v go >/dev/null 2>&1; then \
+		cd shim/go && ./gen.sh && go mod tidy && go test ./...; \
+	else \
+		echo "go toolchain not found; wire contract covered by 'make test-conformance'"; \
+	fi
 
 bench:           ## north-star benchmark (one JSON line; TPU if healthy)
 	$(PY) bench.py

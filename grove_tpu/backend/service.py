@@ -669,6 +669,17 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="grove-tpu scheduler backend sidecar")
     parser.add_argument("--port", type=int, default=50055)
     args = parser.parse_args()
+    # Same relay hardening as the operator binary and bench: a wedged TPU
+    # tunnel must degrade the standalone sidecar to CPU, not hang its first
+    # Solve (the relay plugin overrides JAX_PLATFORMS at interpreter start,
+    # so env alone cannot opt out — grove_tpu/utils/platform.py).
+    from grove_tpu.utils.platform import ensure_usable_backend
+
+    _, plat_err = ensure_usable_backend()
+    if plat_err:
+        import sys as _sys
+
+        print(f"platform fallback: {plat_err}", file=_sys.stderr, flush=True)
     server, bound = create_server(port=args.port)
     print(f"{BACKEND_NAME} backend listening on 127.0.0.1:{bound}", flush=True)
     server.wait_for_termination()

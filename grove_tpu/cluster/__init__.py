@@ -1,2 +1,7 @@
+from grove_tpu.cluster.kubernetes import (  # noqa: F401
+    KubeContext,
+    KubernetesWatchSource,
+    load_kube_context,
+)
 from grove_tpu.cluster.kwok import KwokCluster  # noqa: F401
 from grove_tpu.cluster.watch import EventType, WatchDriver, WatchEvent  # noqa: F401

@@ -3,9 +3,10 @@
 The reference's controllers see the world exclusively through kube-apiserver
 watch streams (informers, SURVEY.md §5.8); the in-pod agent watches too
 (`operator/initc/internal/wait.go:111-164`). This module is that integration
-path for the TPU stack: a WatchSource (KwokCluster, or a real-cluster adapter)
-produces `WatchEvent`s, the WatchDriver applies them to the Manager's store,
-and control-plane decisions (bindings, deletions) flow back out.
+path for the TPU stack: a WatchSource — KwokCluster (cluster/kwok.py) or the
+live-apiserver KubernetesWatchSource (cluster/kubernetes.py) — produces
+`WatchEvent`s, the WatchDriver applies them to the Manager's store, and
+control-plane decisions (bindings, deletions) flow back out.
 
 Apply discipline (the ExpectationsStore lesson,
 `operator/internal/expect/expectations.go:33-71`): watch events are DELAYED
@@ -48,9 +49,11 @@ class WatchEvent:
 class WatchSource(Protocol):
     def poll(self, now: float) -> list[WatchEvent]: ...
 
-    def observe_binding(self, pod_name: str, node_name: str, now: float) -> None: ...
+    # observe_* return None/True when the push landed durably; an explicit
+    # False means "failed, retry me next tick" (a live apiserver can 500).
+    def observe_binding(self, pod_name: str, node_name: str, now: float): ...
 
-    def observe_deletion(self, pod_name: str, now: float) -> None: ...
+    def observe_deletion(self, pod_name: str, now: float): ...
 
 
 @dataclass
@@ -62,6 +65,11 @@ class WatchDriver:
     backend: Optional["object"] = None  # backend.client.BackendClient
     # pods we've told the source about (bind pushed), and known-deleted pods
     _pushed_bindings: set[str] = field(default_factory=set)
+    # pods whose bind FAILED after the source may have already materialized
+    # the object (create-succeeded/bind-500): if the store drops such a pod
+    # before a retry lands, it still needs an outbound deletion or the real
+    # cluster keeps an unschedulable Pending pod forever.
+    _attempted_bindings: set[str] = field(default_factory=set)
     _nodes_dirty: bool = field(default=True)
 
     # ---- inbound: events -> store --------------------------------------------------
@@ -109,7 +117,16 @@ class WatchDriver:
         if pod is None:
             return  # controller already deleted it; lagged event is stale
         if ev.type == EventType.DELETED:
-            return  # outbound deletions originate from the controller, not here
+            # Controller-initiated deletions leave the store first, so a
+            # DELETED for a pod still in the store is an OUT-OF-BAND removal
+            # (kubectl delete, eviction): the pod died with the external
+            # world — fail it so status rollup + gang termination recover,
+            # and drop the binding record so a recreated namesake re-pushes.
+            if pod.is_scheduled:
+                pod.phase = PodPhase.FAILED
+                pod.ready = False
+                self._pushed_bindings.discard(ev.name)
+            return
         node = ev.obj.get("node")
         if node is not None and pod.node_name != node:
             return  # stale: the pod has been re-placed since this event
@@ -127,21 +144,32 @@ class WatchDriver:
     # ---- outbound: store decisions -> source/backend -------------------------------
 
     def push(self, now: float) -> int:
-        """Tell the source about new bindings and deletions; returns pushes."""
+        """Tell the source about new bindings and deletions; returns pushes.
+
+        A push is recorded as done only when the source does NOT report
+        failure (an explicit False return): a transient apiserver error on
+        bind/delete must leave the pod in the retry set, or the store
+        believes a placement the cluster never saw (orphaned forever)."""
         c = self.cluster
         pushed = 0
         live = set()
         for pod in c.pods.values():
             live.add(pod.name)
             if pod.is_scheduled and pod.name not in self._pushed_bindings:
-                self.source.observe_binding(pod.name, pod.node_name, now)
-                self._pushed_bindings.add(pod.name)
-                pushed += 1
-        for name in list(self._pushed_bindings):
+                ok = self.source.observe_binding(pod.name, pod.node_name, now)
+                if ok is not False:
+                    self._pushed_bindings.add(pod.name)
+                    self._attempted_bindings.discard(pod.name)
+                    pushed += 1
+                else:
+                    self._attempted_bindings.add(pod.name)
+        for name in list(self._pushed_bindings | self._attempted_bindings):
             if name not in live:
-                self.source.observe_deletion(name, now)
-                self._pushed_bindings.discard(name)
-                pushed += 1
+                ok = self.source.observe_deletion(name, now)
+                if ok is not False:
+                    self._pushed_bindings.discard(name)
+                    self._attempted_bindings.discard(name)
+                    pushed += 1
         return pushed
 
     def step(self, now: float) -> None:

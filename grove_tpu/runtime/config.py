@@ -144,9 +144,22 @@ class ClusterConfig:
     fabricates a KWOK-shaped fake fleet at boot and drives it through the
     watch path — the in-binary analog of the reference's scale rig
     (`make kind-up FAKE_NODES=N`, operator/hack/kind-up.sh:31,252-265), which
-    makes `python -m grove_tpu.runtime` a self-contained e2e environment."""
+    makes `python -m grove_tpu.runtime` a self-contained e2e environment.
+    `kubernetes`: a live apiserver via the list/watch wire protocol
+    (cluster/kubernetes.py; the informer pattern of manager.go:53-121) —
+    node/pod events stream in, solver placements POST back as pod creates +
+    binding subresource calls."""
 
-    source: str = "none"  # none | kwok
+    source: str = "none"  # none | kwok | kubernetes
+    # kubernetes source: kubeconfig path ("" = $KUBECONFIG, ~/.kube/config,
+    # then the in-cluster service-account mount), context ("" = current),
+    # namespace ("" = the context's), and the pod watch label selector.
+    kubeconfig: str = ""
+    kube_context: str = ""
+    kube_namespace: str = ""
+    # "" = the managed-by selector derived from api/constants
+    # (cluster/kubernetes.py DEFAULT_POD_LABEL_SELECTOR).
+    pod_label_selector: str = ""
     kwok_nodes: int = 8
     kwok_cpu_per_node: float = 32.0
     kwok_memory_per_node: float = 128 * 2**30
@@ -251,6 +264,10 @@ _CAMEL_FIELDS = {
     "wReserve": "w_reserve",
     "wJitter": "w_jitter",
     "wSpread": "w_spread",
+    "kubeconfig": "kubeconfig",
+    "kubeContext": "kube_context",
+    "kubeNamespace": "kube_namespace",
+    "podLabelSelector": "pod_label_selector",
     "kwokNodes": "kwok_nodes",
     "kwokCpuPerNode": "kwok_cpu_per_node",
     "kwokMemoryPerNode": "kwok_memory_per_node",
@@ -398,8 +415,15 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
                     "internal AUTO sentinel)"
                 )
     cl = cfg.cluster
-    if cl.source not in ("none", "kwok"):
-        errors.append(f"cluster.source: {cl.source!r} not in none|kwok")
+    if cl.source not in ("none", "kwok", "kubernetes"):
+        errors.append(
+            f"cluster.source: {cl.source!r} not in none|kwok|kubernetes"
+        )
+    if cl.source == "kubernetes" and cl.kubeconfig:
+        import os as _os
+
+        if not _os.path.exists(cl.kubeconfig):
+            errors.append(f"cluster.kubeconfig: {cl.kubeconfig!r} does not exist")
     if cl.source == "kwok":
         if cl.kwok_nodes < 1:
             errors.append("cluster.kwokNodes: must be >= 1")

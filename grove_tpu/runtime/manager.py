@@ -362,6 +362,9 @@ class Manager:
         # gRPC client the manager itself created (kwok node-forwarding) and
         # must close at stop(); caller-supplied clients stay caller-owned.
         self._owned_backend_client = None
+        # Live-apiserver watch source (cluster.source: kubernetes); its
+        # reader threads are stopped at manager stop().
+        self._kube_source = None
         # HPA utilization feed (metrics-server analog): target FQN -> current
         # average utilization normalized to the target (1.0 == at target).
         # Pushed via POST /api/v1/metrics; consumed by the autoscale step.
@@ -536,13 +539,10 @@ class Manager:
             restored = self.persistence.restore(self.cluster)
             if restored:
                 self.log.info("restored control-plane state", path=cfg.persistence.path)
-        if cfg.cluster.source == "kwok":
-            # Config-fabricated KWOK fleet through the watch path — the
-            # binary is then a self-contained e2e rig (kind-up.sh KWOK
-            # analog). Nodes also forward to the backend sidecar when it is
-            # hosted here, so external Solve RPCs see the same fleet.
-            from grove_tpu.cluster.kwok import kwok_fleet_from_config
-
+        if cfg.cluster.source in ("kwok", "kubernetes"):
+            # External fleets flow in through the watch path. Nodes also
+            # forward to the backend sidecar when it is hosted here, so
+            # external Solve RPCs see the same fleet.
             backend_client = None
             if self.backend_port is not None:
                 from grove_tpu.backend.client import BackendClient
@@ -551,6 +551,11 @@ class Manager:
                 # Manager-created, so manager-closed at stop(); a client the
                 # CALLER passed to attach_watch stays the caller's to close.
                 self._owned_backend_client = backend_client
+        if cfg.cluster.source == "kwok":
+            # Config-fabricated KWOK fleet — the binary is then a
+            # self-contained e2e rig (kind-up.sh KWOK analog).
+            from grove_tpu.cluster.kwok import kwok_fleet_from_config
+
             # Fabricated at now=0.0 so the bootstrap node events are visible
             # to the first pump under BOTH clocks: production's wall time and
             # the tests' virtual time (reconcile_once(now=0.0)).
@@ -559,6 +564,39 @@ class Manager:
             )
             self.attach_watch(fleet, backend=backend_client)
             self.log.info("kwok fleet attached", nodes=cfg.cluster.kwok_nodes)
+        elif cfg.cluster.source == "kubernetes":
+            # Live apiserver via the list/watch wire protocol; solver
+            # placements go back as pod creates + binding subresource POSTs
+            # (cluster/kubernetes.py).
+            from grove_tpu.cluster.kubernetes import (
+                KubernetesWatchSource,
+                load_kube_context,
+                render_pod_manifest,
+            )
+
+            ctx = load_kube_context(
+                cfg.cluster.kubeconfig or None,
+                cfg.cluster.kube_context or None,
+                cfg.cluster.kube_namespace or None,
+            )
+
+            def _manifest(name: str):
+                pod = self.cluster.pods.get(name)
+                return render_pod_manifest(pod) if pod is not None else None
+
+            source = KubernetesWatchSource(
+                ctx,
+                pod_label_selector=cfg.cluster.pod_label_selector or None,
+                pod_manifest_for=_manifest,
+            )
+            source.start()
+            self._kube_source = source
+            self.attach_watch(source, backend=backend_client)
+            self.log.info(
+                "kubernetes cluster attached",
+                server=ctx.server,
+                namespace=ctx.namespace,
+            )
         self._started = True
         self.log.info(
             "manager started",
@@ -770,6 +808,9 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._kube_source is not None:
+            self._kube_source.stop()
+            self._kube_source = None
         if self._owned_backend_client is not None:
             self._owned_backend_client.close()
             self._owned_backend_client = None

@@ -1,0 +1,13 @@
+#!/bin/sh
+# Generate the Go protobuf/grpc stubs for the shim.
+# Requires: protoc, protoc-gen-go, protoc-gen-go-grpc on PATH
+#   go install google.golang.org/protobuf/cmd/protoc-gen-go@latest
+#   go install google.golang.org/grpc/cmd/protoc-gen-go-grpc@latest
+set -e
+cd "$(dirname "$0")"
+protoc \
+  --proto_path=proto \
+  --go_out=proto --go_opt=paths=source_relative \
+  --go-grpc_out=proto --go-grpc_opt=paths=source_relative \
+  proto/scheduler_backend.proto
+echo "generated proto/scheduler_backend{,_grpc}.pb.go"

@@ -1,0 +1,24 @@
+// GREP-375 scheduler-backend shim: implements the Go SchedulerBackend
+// interface (docs/proposals/375-scheduler-backend-framework/README.md:
+// 158-202) by delegating to the grove-tpu gRPC sidecar.
+//
+// NOTE: this build image ships no Go toolchain (see shim/go/README.md);
+// the module is compiled and `go test`-ed where Go is available. The wire
+// contract itself is conformance-tested in-repo against the live sidecar
+// by tests/test_backend_conformance.py.
+module grove-tpu.dev/scheduler-backend-shim
+
+go 1.25.0
+
+require (
+	github.com/ai-dynamo/grove/scheduler/api v0.0.0
+	google.golang.org/grpc v1.76.0
+	google.golang.org/protobuf v1.36.0
+	k8s.io/api v0.34.3
+	k8s.io/apimachinery v0.34.3
+	sigs.k8s.io/yaml v1.6.0
+)
+
+// The scheduler IR API lives in the grove repo as its own module
+// (scheduler/api/go.mod); point the replace at your checkout.
+replace github.com/ai-dynamo/grove/scheduler/api => ../../../reference/scheduler/api

@@ -1,0 +1,247 @@
+// Package shim implements the GREP-375 SchedulerBackend interface
+// (docs/proposals/375-scheduler-backend-framework/README.md:158-202) by
+// delegating every operation to the grove-tpu gRPC sidecar
+// (grove_tpu/backend/service.py). An unmodified Go operator registers this
+// backend with its Backend Manager and gains the JAX batched placement
+// engine without linking any Python.
+//
+// Division of labor (mirrors the reference's KAI split): the operator-side
+// shim translates PodGang CRs into the sidecar's IR and applies pod
+// mutations; placement itself (UpdateCluster/Solve) runs out-of-band in the
+// sidecar against the node snapshot the operator forwards.
+package shim
+
+import (
+	"context"
+	"fmt"
+	"sync"
+	"time"
+
+	groveschedulerv1alpha1 "github.com/ai-dynamo/grove/scheduler/api/core/v1alpha1"
+	"google.golang.org/grpc"
+	"google.golang.org/grpc/credentials/insecure"
+	corev1 "k8s.io/api/core/v1"
+	"sigs.k8s.io/yaml"
+
+	backendpb "grove-tpu.dev/scheduler-backend-shim/proto"
+)
+
+// PodResolver fetches the live Pod for a PodGang pod reference — the shim
+// uses it to fill per-pod resource requests the PodGang IR does not carry
+// (the operator passes a controller-runtime-client-backed closure).
+type PodResolver func(ctx context.Context, namespace, name string) (*corev1.Pod, error)
+
+// TPUSchedulerBackend is the SchedulerBackend implementation.
+type TPUSchedulerBackend struct {
+	target   string // sidecar address, e.g. "127.0.0.1:50055"
+	topology []*backendpb.TopologyLevel
+	resolve  PodResolver
+
+	mu     sync.Mutex
+	conn   *grpc.ClientConn
+	client backendpb.SchedulerBackendClient
+
+	// PreparePod mutations cached from the sidecar at Init so the per-pod
+	// hook (sync, no ctx, no error in the interface) costs zero RPCs.
+	schedulerName   string
+	schedulingGates []string
+}
+
+// New builds a backend delegating to the sidecar at target.
+// topology carries the operator's ClusterTopology levels broad->narrow
+// (the Init handshake, mirroring clustertopology sync).
+func New(target string, topology []*backendpb.TopologyLevel, resolve PodResolver) *TPUSchedulerBackend {
+	return &TPUSchedulerBackend{target: target, topology: topology, resolve: resolve}
+}
+
+// Name implements SchedulerBackend.
+func (b *TPUSchedulerBackend) Name() string { return "grove-tpu" }
+
+// Init implements SchedulerBackend: dials the sidecar, performs the
+// topology handshake, and caches the PreparePod mutations.
+func (b *TPUSchedulerBackend) Init() error {
+	b.mu.Lock()
+	defer b.mu.Unlock()
+	conn, err := grpc.NewClient(
+		b.target, grpc.WithTransportCredentials(insecure.NewCredentials()),
+	)
+	if err != nil {
+		return fmt.Errorf("dial sidecar %s: %w", b.target, err)
+	}
+	b.conn = conn
+	b.client = backendpb.NewSchedulerBackendClient(conn)
+	ctx, cancel := context.WithTimeout(context.Background(), 10*time.Second)
+	defer cancel()
+	if _, err := b.client.Init(ctx, &backendpb.InitRequest{Topology: b.topology}); err != nil {
+		return fmt.Errorf("sidecar Init: %w", err)
+	}
+	prep, err := b.client.PreparePod(ctx, &backendpb.PreparePodRequest{})
+	if err != nil {
+		return fmt.Errorf("sidecar PreparePod probe: %w", err)
+	}
+	b.schedulerName = prep.GetSchedulerName()
+	b.schedulingGates = prep.GetSchedulingGates()
+	return nil
+}
+
+// Close releases the sidecar connection (not part of the interface; the
+// operator calls it at shutdown).
+func (b *TPUSchedulerBackend) Close() error {
+	b.mu.Lock()
+	defer b.mu.Unlock()
+	if b.conn != nil {
+		return b.conn.Close()
+	}
+	return nil
+}
+
+// SyncPodGang implements SchedulerBackend: PodGang CR -> sidecar IR.
+func (b *TPUSchedulerBackend) SyncPodGang(ctx context.Context, podGang *groveschedulerv1alpha1.PodGang) error {
+	spec, err := b.translate(ctx, podGang)
+	if err != nil {
+		return err
+	}
+	_, err = b.client.SyncPodGang(ctx, &backendpb.SyncPodGangRequest{PodGang: spec})
+	return err
+}
+
+// OnPodGangDelete implements SchedulerBackend.
+func (b *TPUSchedulerBackend) OnPodGangDelete(ctx context.Context, podGang *groveschedulerv1alpha1.PodGang) error {
+	_, err := b.client.OnPodGangDelete(ctx, &backendpb.OnPodGangDeleteRequest{
+		Namespace: podGang.Namespace,
+		Name:      podGang.Name,
+	})
+	return err
+}
+
+// PreparePod implements SchedulerBackend: schedulerName + scheduling-gate
+// injection (the reference gates pods the same way, podclique/components/
+// pod/pod.go:68,162). Values come from the Init-time sidecar handshake.
+func (b *TPUSchedulerBackend) PreparePod(pod *corev1.Pod) {
+	pod.Spec.SchedulerName = b.schedulerName
+	for _, gate := range b.schedulingGates {
+		pod.Spec.SchedulingGates = append(
+			pod.Spec.SchedulingGates, corev1.PodSchedulingGate{Name: gate},
+		)
+	}
+}
+
+// ValidatePodCliqueSet implements SchedulerBackend: the PCS document goes
+// to the sidecar as YAML; a non-empty error list rejects admission.
+//
+// The proposal types this parameter as *groveschedulerv1alpha1.PodCliqueSet
+// (README.md:196-201), but no published module defines that type yet — the
+// PCS CRD lives in the operator's API group. Until GREP-375 lands the type,
+// the shim accepts any marshalable PCS document; swap the signature when
+// the interface freezes.
+func (b *TPUSchedulerBackend) ValidatePodCliqueSet(ctx context.Context, pcs interface{}) error {
+	raw, err := yaml.Marshal(pcs)
+	if err != nil {
+		return fmt.Errorf("marshal PodCliqueSet: %w", err)
+	}
+	resp, err := b.client.ValidatePodCliqueSet(ctx, &backendpb.ValidatePodCliqueSetRequest{
+		PcsYaml: string(raw),
+	})
+	if err != nil {
+		return err
+	}
+	if errs := resp.GetErrors(); len(errs) > 0 {
+		return fmt.Errorf("backend rejected PodCliqueSet: %v", errs)
+	}
+	return nil
+}
+
+// translate renders a PodGang CR into the sidecar's PodGangSpec IR,
+// resolving per-pod resource requests through the PodResolver (the IR
+// carries them; the CR does not).
+func (b *TPUSchedulerBackend) translate(ctx context.Context, pg *groveschedulerv1alpha1.PodGang) (*backendpb.PodGangSpec, error) {
+	spec := &backendpb.PodGangSpec{
+		Name:              pg.Name,
+		Namespace:         pg.Namespace,
+		PriorityClassName: pg.Spec.PriorityClassName,
+		PackConstraint:    packOf(pg.Spec.TopologyConstraint),
+	}
+	if ref := pg.Spec.ReuseReservationRef; ref != nil {
+		spec.ReuseReservationRef = &backendpb.NamespacedName{
+			Namespace: ref.Namespace, Name: ref.Name,
+		}
+	}
+	for _, gc := range pg.Spec.TopologyConstraintGroupConfigs {
+		spec.GroupConfigs = append(spec.GroupConfigs, &backendpb.GroupConstraintConfig{
+			Name:           gc.Name,
+			PodGroupNames:  gc.PodGroupNames,
+			PackConstraint: packOf(gc.TopologyConstraint),
+		})
+	}
+	for _, grp := range pg.Spec.PodGroups {
+		g := &backendpb.PodGroup{
+			Name:           grp.Name,
+			MinReplicas:    grp.MinReplicas,
+			PackConstraint: packOf(grp.TopologyConstraint),
+		}
+		for _, ref := range grp.PodReferences {
+			g.PodReferences = append(g.PodReferences, &backendpb.NamespacedName{
+				Namespace: ref.Namespace, Name: ref.Name,
+			})
+		}
+		if b.resolve != nil && len(grp.PodReferences) > 0 {
+			// One resolve per group: every pod of a group shares a template
+			// (podgang.go:75), so the first reference's requests stand in
+			// for all of them.
+			ref := grp.PodReferences[0]
+			pod, err := b.resolve(ctx, ref.Namespace, ref.Name)
+			if err != nil {
+				return nil, fmt.Errorf("resolve pod %s/%s: %w", ref.Namespace, ref.Name, err)
+			}
+			for name, qty := range podRequests(pod) {
+				g.PerPodRequests = append(g.PerPodRequests, &backendpb.ResourceQuantity{
+					Name: name, Value: qty,
+				})
+			}
+			g.NodeSelector = pod.Spec.NodeSelector
+			for _, tol := range pod.Spec.Tolerations {
+				g.Tolerations = append(g.Tolerations, &backendpb.Toleration{
+					Key:      tol.Key,
+					Operator: string(tol.Operator),
+					Value:    tol.Value,
+					Effect:   string(tol.Effect),
+				})
+			}
+		}
+		spec.PodGroups = append(spec.PodGroups, g)
+	}
+	return spec, nil
+}
+
+func packOf(tc *groveschedulerv1alpha1.TopologyConstraint) *backendpb.PackConstraint {
+	if tc == nil || tc.PackConstraint == nil {
+		return nil
+	}
+	out := &backendpb.PackConstraint{}
+	if tc.PackConstraint.Required != nil {
+		out.RequiredKey = *tc.PackConstraint.Required
+	}
+	if tc.PackConstraint.Preferred != nil {
+		out.PreferredKey = *tc.PackConstraint.Preferred
+	}
+	return out
+}
+
+// podRequests sums container requests (max against init containers — the
+// kubelet's effective-request rule) into base-unit floats.
+func podRequests(pod *corev1.Pod) map[string]float64 {
+	total := map[string]float64{}
+	for _, c := range pod.Spec.Containers {
+		for name, qty := range c.Resources.Requests {
+			total[string(name)] += qty.AsApproximateFloat64()
+		}
+	}
+	for _, c := range pod.Spec.InitContainers {
+		for name, qty := range c.Resources.Requests {
+			if v := qty.AsApproximateFloat64(); v > total[string(name)] {
+				total[string(name)] = v
+			}
+		}
+	}
+	return total
+}

@@ -1,0 +1,196 @@
+// Conformance test: the full GREP-375 backend cycle driven from Go against
+// the LIVE Python sidecar (spawned as a subprocess) — the round-trip an
+// unmodified Go operator would perform:
+//
+//	Init -> UpdateCluster -> SyncPodGang -> PreparePod -> Solve ->
+//	OnPodGangDelete
+//
+// Run where a Go toolchain exists (the build image has none — see README):
+//
+//	./gen.sh && go test ./...
+//
+// The same RPC sequence is pinned in-repo by
+// tests/test_backend_conformance.py, which runs in CI here.
+package shim
+
+import (
+	"bufio"
+	"context"
+	"fmt"
+	"os"
+	"os/exec"
+	"path/filepath"
+	"strings"
+	"testing"
+	"time"
+
+	groveschedulerv1alpha1 "github.com/ai-dynamo/grove/scheduler/api/core/v1alpha1"
+	corev1 "k8s.io/api/core/v1"
+	"k8s.io/apimachinery/pkg/api/resource"
+	metav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
+
+	backendpb "grove-tpu.dev/scheduler-backend-shim/proto"
+)
+
+// startSidecar launches `python -m grove_tpu.backend.service` from the repo
+// root and returns its address once it reports listening.
+func startSidecar(t *testing.T) string {
+	t.Helper()
+	repoRoot, err := filepath.Abs("../..")
+	if err != nil {
+		t.Fatal(err)
+	}
+	cmd := exec.Command("python", "-m", "grove_tpu.backend.service", "--port", "0")
+	cmd.Dir = repoRoot
+	cmd.Env = append(os.Environ(), "JAX_PLATFORMS=cpu", "GROVE_FORCE_CPU=1")
+	stdout, err := cmd.StdoutPipe()
+	if err != nil {
+		t.Fatal(err)
+	}
+	if err := cmd.Start(); err != nil {
+		t.Fatalf("spawn sidecar: %v", err)
+	}
+	t.Cleanup(func() { _ = cmd.Process.Kill(); _, _ = cmd.Process.Wait() })
+	scanner := bufio.NewScanner(stdout)
+	deadline := time.After(60 * time.Second)
+	addrCh := make(chan string, 1)
+	go func() {
+		for scanner.Scan() {
+			line := scanner.Text()
+			// "grove-tpu backend listening on 127.0.0.1:PORT"
+			if i := strings.LastIndex(line, "listening on "); i >= 0 {
+				addrCh <- strings.TrimSpace(line[i+len("listening on "):])
+				return
+			}
+		}
+	}()
+	select {
+	case addr := <-addrCh:
+		return addr
+	case <-deadline:
+		t.Fatal("sidecar never reported listening")
+		return ""
+	}
+}
+
+func strptr(s string) *string { return &s }
+
+func testPodGang(ns string) *groveschedulerv1alpha1.PodGang {
+	return &groveschedulerv1alpha1.PodGang{
+		ObjectMeta: metav1.ObjectMeta{Name: "wl-0", Namespace: ns},
+		Spec: groveschedulerv1alpha1.PodGangSpec{
+			PodGroups: []groveschedulerv1alpha1.PodGroup{
+				{
+					Name:        "wl-0-workers",
+					MinReplicas: 2,
+					PodReferences: []groveschedulerv1alpha1.NamespacedName{
+						{Namespace: ns, Name: "wl-0-workers-0"},
+						{Namespace: ns, Name: "wl-0-workers-1"},
+					},
+					TopologyConstraint: &groveschedulerv1alpha1.TopologyConstraint{
+						PackConstraint: &groveschedulerv1alpha1.TopologyPackConstraint{
+							Preferred: strptr("topology.kubernetes.io/rack"),
+						},
+					},
+				},
+			},
+		},
+	}
+}
+
+func TestConformanceFullCycle(t *testing.T) {
+	addr := startSidecar(t)
+	backend := New(addr, []*backendpb.TopologyLevel{
+		{Domain: "zone", NodeLabelKey: "topology.kubernetes.io/zone"},
+		{Domain: "rack", NodeLabelKey: "topology.kubernetes.io/rack"},
+		{Domain: "host", NodeLabelKey: "kubernetes.io/hostname"},
+	}, func(ctx context.Context, namespace, name string) (*corev1.Pod, error) {
+		return &corev1.Pod{
+			ObjectMeta: metav1.ObjectMeta{Name: name, Namespace: namespace},
+			Spec: corev1.PodSpec{
+				Containers: []corev1.Container{{
+					Name:  "w",
+					Image: "worker:latest",
+					Resources: corev1.ResourceRequirements{
+						Requests: corev1.ResourceList{
+							corev1.ResourceCPU: resource.MustParse("1"),
+						},
+					},
+				}},
+			},
+		}, nil
+	})
+	if err := backend.Init(); err != nil {
+		t.Fatalf("Init: %v", err)
+	}
+	defer backend.Close()
+	if got := backend.Name(); got != "grove-tpu" {
+		t.Fatalf("Name() = %q", got)
+	}
+
+	// PreparePod applies the Init-cached mutations.
+	pod := &corev1.Pod{}
+	backend.PreparePod(pod)
+	if pod.Spec.SchedulerName == "" || len(pod.Spec.SchedulingGates) == 0 {
+		t.Fatalf("PreparePod left pod unprepared: %+v", pod.Spec)
+	}
+
+	ctx, cancel := context.WithTimeout(context.Background(), 60*time.Second)
+	defer cancel()
+
+	// Feed a 4-node fleet, sync the gang, and solve.
+	var nodes []*backendpb.Node
+	for i := 0; i < 4; i++ {
+		nodes = append(nodes, &backendpb.Node{
+			Name:        fmt.Sprintf("n%d", i),
+			Schedulable: true,
+			Capacity: []*backendpb.ResourceQuantity{
+				{Name: "cpu", Value: 8},
+			},
+			Labels: map[string]string{
+				"topology.kubernetes.io/zone": "z0",
+				"topology.kubernetes.io/rack": fmt.Sprintf("r%d", i/2),
+				"kubernetes.io/hostname":      fmt.Sprintf("n%d", i),
+			},
+		})
+	}
+	if _, err := backend.client.UpdateCluster(ctx, &backendpb.UpdateClusterRequest{
+		Nodes: nodes, FullReplace: true,
+	}); err != nil {
+		t.Fatalf("UpdateCluster: %v", err)
+	}
+	pg := testPodGang("default")
+	if err := backend.SyncPodGang(ctx, pg); err != nil {
+		t.Fatalf("SyncPodGang: %v", err)
+	}
+	resp, err := backend.client.Solve(ctx, &backendpb.SolveRequest{})
+	if err != nil {
+		t.Fatalf("Solve: %v", err)
+	}
+	if len(resp.Gangs) != 1 || !resp.Gangs[0].Admitted {
+		t.Fatalf("gang not admitted: %+v", resp.Gangs)
+	}
+	if got := len(resp.Gangs[0].Bindings); got != 2 {
+		t.Fatalf("bindings = %d, want 2", got)
+	}
+	// Rack packing preferred: both pods land in one rack.
+	rackOf := map[string]string{"n0": "r0", "n1": "r0", "n2": "r1", "n3": "r1"}
+	racks := map[string]bool{}
+	for _, b := range resp.Gangs[0].Bindings {
+		racks[rackOf[b.NodeName]] = true
+	}
+	if len(racks) != 1 {
+		t.Fatalf("preferred rack packing violated: %+v", resp.Gangs[0].Bindings)
+	}
+
+	if err := backend.OnPodGangDelete(ctx, pg); err != nil {
+		t.Fatalf("OnPodGangDelete: %v", err)
+	}
+	resp, err = backend.client.Solve(ctx, &backendpb.SolveRequest{})
+	if err != nil {
+		t.Fatalf("Solve after delete: %v", err)
+	}
+	if len(resp.Gangs) != 0 {
+		t.Fatalf("deleted gang still solving: %+v", resp.Gangs)
+	}
+}

@@ -1,0 +1,262 @@
+"""Recorded-protocol kube-apiserver fixture for wire-format tests.
+
+The environment has no live Kubernetes cluster, so the kubernetes
+WatchSource (grove_tpu/cluster/kubernetes.py) is proven against this
+in-process server speaking the actual apiserver wire protocol:
+
+  GET  /api/v1/nodes                          -> NodeList JSON
+  GET  /api/v1/nodes?watch=1&resourceVersion= -> newline-delimited watch
+  GET  /api/v1/namespaces/{ns}/pods[?watch=1&labelSelector=...]
+  POST /api/v1/namespaces/{ns}/pods           -> create (409 on duplicate)
+  POST /api/v1/namespaces/{ns}/pods/{n}/binding -> set spec.nodeName (404/409)
+  DELETE /api/v1/namespaces/{ns}/pods/{n}     -> delete
+
+The fixture also plays kubelet: `advance_pod(name)` walks a bound pod
+through Running then Ready (the KWOK stage analog), emitting MODIFIED
+events on every change. `fail_watch_once(code)` arms a one-shot watch
+failure (e.g. 410 Gone) to pin the relist path.
+
+Modeled on the reference's e2e philosophy (SURVEY.md §4): the wire is
+real, the machines are not.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import queue
+import threading
+import urllib.parse
+
+
+def k8s_node(name: str, cpu="32", memory="128Gi", labels=None, unschedulable=False,
+             taints=None, tpu=None) -> dict:
+    alloc = {"cpu": cpu, "memory": memory}
+    if tpu is not None:
+        alloc["google.com/tpu"] = tpu
+    spec: dict = {}
+    if unschedulable:
+        spec["unschedulable"] = True
+    if taints:
+        spec["taints"] = taints
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": dict(labels or {})},
+        "spec": spec,
+        "status": {"allocatable": alloc, "capacity": alloc},
+    }
+
+
+class FixtureApiServer:
+    """In-process apiserver: state + watch fan-out + an HTTP front end."""
+
+    def __init__(self, namespace: str = "default"):
+        self.namespace = namespace
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[str, dict] = {}
+        self._rv = 0
+        self._lock = threading.Lock()
+        self._watchers: dict[str, list[queue.Queue]] = {"nodes": [], "pods": []}
+        self._fail_watch_code: int | None = None
+        self.binding_log: list[tuple[str, str]] = []  # (pod, node) in order
+        self.created_pods: list[str] = []
+
+        fixture = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, doc: dict):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                qs = dict(urllib.parse.parse_qsl(parsed.query))
+                resource = fixture._resource_for(parsed.path)
+                if resource is None:
+                    self._json(404, {"kind": "Status", "code": 404})
+                    return
+                if qs.get("watch") == "1":
+                    fixture._serve_watch(self, resource, qs)
+                else:
+                    self._json(200, fixture._list_doc(resource, qs))
+
+            def do_POST(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                code, doc = fixture._post(parsed.path, body)
+                self._json(code, doc)
+
+            def do_DELETE(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                code, doc = fixture._delete(parsed.path)
+                self._json(code, doc)
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    # ---- test-facing controls -------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def close(self):
+        for qlist in self._watchers.values():
+            for q in qlist:
+                q.put(None)  # unblock streams
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def add_node(self, obj: dict):
+        with self._lock:
+            self.nodes[obj["metadata"]["name"]] = obj
+            self._emit("nodes", "ADDED", obj)
+
+    def update_node(self, name: str, mutate):
+        with self._lock:
+            mutate(self.nodes[name])
+            self._emit("nodes", "MODIFIED", self.nodes[name])
+
+    def delete_node(self, name: str):
+        with self._lock:
+            obj = self.nodes.pop(name)
+            self._emit("nodes", "DELETED", obj)
+
+    def advance_pod(self, name: str):
+        """Kubelet stand-in: bound pod -> Running -> Ready, one hop per call."""
+        with self._lock:
+            pod = self.pods[name]
+            status = pod.setdefault("status", {})
+            if status.get("phase") != "Running":
+                status["phase"] = "Running"
+                status["conditions"] = [{"type": "Ready", "status": "False"}]
+            else:
+                status["conditions"] = [{"type": "Ready", "status": "True"}]
+            self._emit("pods", "MODIFIED", pod)
+
+    def fail_watch_once(self, code: int = 410):
+        self._fail_watch_code = code
+
+    # ---- protocol internals ---------------------------------------------------------
+
+    def _resource_for(self, path: str):
+        if path == "/api/v1/nodes":
+            return "nodes"
+        if path == f"/api/v1/namespaces/{self.namespace}/pods":
+            return "pods"
+        return None
+
+    def _coll(self, resource: str) -> dict:
+        return self.nodes if resource == "nodes" else self.pods
+
+    def _matches(self, obj: dict, selector: str) -> bool:
+        if not selector:
+            return True
+        labels = obj.get("metadata", {}).get("labels", {}) or {}
+        for clause in selector.split(","):
+            k, _, v = clause.partition("=")
+            if labels.get(k.strip()) != v.strip():
+                return False
+        return True
+
+    def _list_doc(self, resource: str, qs: dict) -> dict:
+        selector = qs.get("labelSelector", "")
+        with self._lock:
+            items = [
+                obj for obj in self._coll(resource).values()
+                if self._matches(obj, selector)
+            ]
+            rv = str(self._rv)
+        kind = "NodeList" if resource == "nodes" else "PodList"
+        return {
+            "apiVersion": "v1",
+            "kind": kind,
+            "metadata": {"resourceVersion": rv},
+            "items": items,
+        }
+
+    def _emit(self, resource: str, etype: str, obj: dict):
+        self._rv += 1
+        for q in self._watchers[resource]:
+            q.put({"type": etype, "object": json.loads(json.dumps(obj))})
+
+    def _serve_watch(self, handler, resource: str, qs: dict):
+        if self._fail_watch_code is not None:
+            code, self._fail_watch_code = self._fail_watch_code, None
+            handler._json(code, {"kind": "Status", "code": code})
+            return
+        selector = qs.get("labelSelector", "")
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._watchers[resource].append(q)
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            # Close-delimited stream (no Content-Length): the client reads
+            # lines until the server ends the stream — the apiserver's
+            # chunked behavior, minus the framing the fixture doesn't need.
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            while True:
+                ev = q.get()
+                if ev is None:  # server closing
+                    return
+                if not self._matches(ev["object"], selector):
+                    continue
+                handler.wfile.write(json.dumps(ev).encode() + b"\n")
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; reader relists on its next loop
+        finally:
+            with self._lock:
+                self._watchers[resource].remove(q)
+
+    def _post(self, path: str, body: dict):
+        pods_prefix = f"/api/v1/namespaces/{self.namespace}/pods"
+        if path == pods_prefix:
+            name = body["metadata"]["name"]
+            with self._lock:
+                if name in self.pods:
+                    return 409, {"kind": "Status", "code": 409, "reason": "AlreadyExists"}
+                body.setdefault("status", {})["phase"] = "Pending"
+                self.pods[name] = body
+                self.created_pods.append(name)
+                self._emit("pods", "ADDED", body)
+            return 201, body
+        if path.startswith(pods_prefix + "/") and path.endswith("/binding"):
+            name = path[len(pods_prefix) + 1 : -len("/binding")]
+            with self._lock:
+                pod = self.pods.get(name)
+                if pod is None:
+                    return 404, {"kind": "Status", "code": 404}
+                if pod.get("spec", {}).get("nodeName"):
+                    return 409, {"kind": "Status", "code": 409, "reason": "AlreadyBound"}
+                pod.setdefault("spec", {})["nodeName"] = body["target"]["name"]
+                self.binding_log.append((name, body["target"]["name"]))
+                self._emit("pods", "MODIFIED", pod)
+            return 201, {"kind": "Status", "code": 201}
+        return 404, {"kind": "Status", "code": 404}
+
+    def _delete(self, path: str):
+        pods_prefix = f"/api/v1/namespaces/{self.namespace}/pods/"
+        if not path.startswith(pods_prefix):
+            return 404, {"kind": "Status", "code": 404}
+        name = path[len(pods_prefix):]
+        with self._lock:
+            pod = self.pods.pop(name, None)
+            if pod is None:
+                return 404, {"kind": "Status", "code": 404}
+            self._emit("pods", "DELETED", pod)
+        return 200, {"kind": "Status", "code": 200}

@@ -1,0 +1,479 @@
+"""Real-cluster integration path: the kubernetes WatchSource against a
+wire-protocol fixture apiserver (no live cluster in this environment —
+the FIXTURE speaks the actual apiserver protocol; see
+tests/fixture_apiserver.py).
+
+Reference contracts mirrored: informer list+watch (manager.go:53-121,
+initc/internal/wait.go:111-164), the scheduler bind subresource, pod
+creation by the pod component (podclique/components/pod/pod.go:68), and
+the GS-1 gang-scheduling behavior (gang_scheduling_test.go:34) driven over
+the wire end to end.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+
+import pytest
+
+from fixture_apiserver import FixtureApiServer, k8s_node
+from grove_tpu.cluster.kubernetes import (
+    KubeContext,
+    KubernetesWatchSource,
+    load_kube_context,
+    node_payload,
+    pod_payload,
+    render_pod_manifest,
+)
+from grove_tpu.cluster.watch import EventType
+
+
+@pytest.fixture
+def api():
+    server = FixtureApiServer()
+    yield server
+    server.close()
+
+
+def _source(api, **kw):
+    src = KubernetesWatchSource(
+        KubeContext(server=api.url, namespace="default"),
+        watch_read_timeout_s=5.0,
+        **kw,
+    )
+    return src
+
+
+def _poll_until(src, pred, timeout=30.0):
+    """Drain poll() until pred(all_events) or timeout; returns all events."""
+    events = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        events.extend(src.poll(0.0))
+        if pred(events):
+            return events
+        time.sleep(0.02)
+    raise AssertionError(f"timeout; saw {[(e.type, e.kind, e.name) for e in events]}")
+
+
+# --- pure translation ------------------------------------------------------------
+
+
+def test_node_payload_translation():
+    obj = k8s_node(
+        "n0", cpu="7500m", memory="64Gi", labels={"topology.kubernetes.io/rack": "r1"},
+        unschedulable=True, taints=[{"key": "k", "effect": "NoSchedule"}], tpu="4",
+    )
+    p = node_payload(obj)
+    assert p["capacity"]["cpu"] == 7.5
+    assert p["capacity"]["memory"] == 64 * 2**30
+    assert p["capacity"]["google.com/tpu"] == 4
+    assert p["labels"]["topology.kubernetes.io/rack"] == "r1"
+    assert p["schedulable"] is False
+    assert p["taints"] == [{"key": "k", "effect": "NoSchedule"}]
+
+
+def test_pod_payload_translation():
+    obj = {
+        "metadata": {"name": "p0"},
+        "spec": {"nodeName": "n3"},
+        "status": {
+            "phase": "Running",
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+    assert pod_payload(obj) == {"ready": True, "phase": "Running", "node": "n3"}
+    assert pod_payload({"metadata": {"name": "p"}}) == {"ready": False}
+
+
+# --- kubeconfig resolution -------------------------------------------------------
+
+
+def test_load_kube_context_from_kubeconfig(tmp_path):
+    ca_pem = "-----BEGIN CERTIFICATE-----\nZZZZ\n-----END CERTIFICATE-----\n"
+    doc = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": "dev",
+        "clusters": [
+            {
+                "name": "c1",
+                "cluster": {
+                    "server": "https://10.1.2.3:6443/",
+                    "certificate-authority-data": base64.b64encode(
+                        ca_pem.encode()
+                    ).decode(),
+                },
+            }
+        ],
+        "users": [{"name": "u1", "user": {"token": "sekret"}}],
+        "contexts": [
+            {
+                "name": "dev",
+                "context": {"cluster": "c1", "user": "u1", "namespace": "infer"},
+            }
+        ],
+    }
+    import yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(doc))
+    ctx = load_kube_context(str(path))
+    assert ctx.server == "https://10.1.2.3:6443"  # trailing slash stripped
+    assert ctx.token == "sekret"
+    assert ctx.ca_pem == ca_pem
+    assert ctx.namespace == "infer"
+
+    with pytest.raises(ValueError, match="context 'nope' not found"):
+        load_kube_context(str(path), context_name="nope")
+
+
+# --- list+watch over the wire ----------------------------------------------------
+
+
+def test_list_then_watch_streams_node_events(api):
+    api.add_node(k8s_node("n0"))
+    api.add_node(k8s_node("n1", unschedulable=True))
+    src = _source(api)
+    src.start()
+    try:
+        events = _poll_until(
+            src, lambda evs: {e.name for e in evs if e.kind == "Node"} >= {"n0", "n1"}
+        )
+        by_name = {e.name: e for e in events if e.kind == "Node"}
+        assert by_name["n0"].obj["schedulable"] is True
+        assert by_name["n1"].obj["schedulable"] is False
+        # Live watch: cordon n0, add n2, delete n1 — all stream through.
+        api.update_node("n0", lambda n: n["spec"].update(unschedulable=True))
+        api.add_node(k8s_node("n2"))
+        api.delete_node("n1")
+        events = _poll_until(
+            src,
+            lambda evs: any(e.type == EventType.DELETED and e.name == "n1" for e in evs)
+            and any(e.name == "n2" for e in evs)
+            and any(
+                e.type == EventType.MODIFIED
+                and e.name == "n0"
+                and e.obj["schedulable"] is False
+                for e in evs
+            ),
+        )
+    finally:
+        src.stop()
+
+
+def test_watch_410_gone_relists(api):
+    api.add_node(k8s_node("n0"))
+    src = _source(api)
+    api.fail_watch_once(410)
+    src.start()
+    try:
+        _poll_until(src, lambda evs: any(e.name == "n0" for e in evs))
+        # After the forced 410 the loop relisted; later events still arrive.
+        api.add_node(k8s_node("n9"))
+        _poll_until(src, lambda evs: any(e.name == "n9" for e in evs))
+    finally:
+        src.stop()
+
+
+def test_binding_creates_and_binds_pod(api, simple1):
+    """observe_binding materializes the pod (reference pod component analog)
+    then POSTs the binding subresource; deletion round-trips too."""
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.api.types import PodSpec
+
+    store_pod = Pod(
+        name="simple1-0-frontend-abc12",
+        labels={"app.kubernetes.io/managed-by": "grove-tpu-operator"},
+        spec=PodSpec.from_dict(
+            {
+                "containers": [
+                    {
+                        "name": "frontend",
+                        "image": "registry.local/frontend:latest",
+                        "resources": {"requests": {"cpu": "500m"}},
+                    }
+                ]
+            }
+        ),
+        pclq_fqn="simple1-0-frontend",
+        pod_index=0,
+    )
+    src = _source(
+        api,
+        pod_manifest_for=lambda name: render_pod_manifest(store_pod)
+        if name == store_pod.name
+        else None,
+    )
+    src.start()
+    try:
+        src.observe_binding(store_pod.name, "n7", now=0.0)
+        assert api.binding_log == [(store_pod.name, "n7")]
+        created = api.pods[store_pod.name]
+        assert created["spec"]["nodeName"] == "n7"
+        assert created["spec"]["schedulerName"] == "grove-tpu"
+        assert created["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "500m"
+        assert (
+            created["metadata"]["labels"]["app.kubernetes.io/managed-by"]
+            == "grove-tpu-operator"
+        )
+        # Re-binding is idempotent at the source level (409 swallowed).
+        src.observe_binding(store_pod.name, "n7", now=1.0)
+        assert len(api.binding_log) == 1
+        src.observe_deletion(store_pod.name, now=2.0)
+        assert store_pod.name not in api.pods
+        src.observe_deletion(store_pod.name, now=3.0)  # already gone: no error
+        assert not src.errors
+    finally:
+        src.stop()
+
+
+# --- the full loop: manager <-> fixture apiserver (GS-1 analog) ------------------
+
+
+def _write_kubeconfig(tmp_path, server_url) -> str:
+    import yaml
+
+    doc = {
+        "current-context": "fixture",
+        "clusters": [{"name": "c", "cluster": {"server": server_url}}],
+        "users": [{"name": "u", "user": {"token": "fixture-token"}}],
+        "contexts": [
+            {"name": "fixture", "context": {"cluster": "c", "user": "u"}}
+        ],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(doc))
+    return str(path)
+
+
+def test_manager_runs_gang_against_fixture_cluster(api, tmp_path, simple1):
+    """GS-1 over the wire: cluster.source=kubernetes boots the watch source
+    from a kubeconfig, nodes stream in, the solver binds the gang via the
+    binding subresource, the fixture's kubelet stand-in reports Ready, and
+    the store's gang reaches RUNNING — the full reference loop
+    (apiserver -> informer -> reconcile -> bind -> kubelet -> status)."""
+    from grove_tpu.api.podgang import PodGangPhase
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    for i in range(10):
+        api.add_node(
+            k8s_node(
+                f"n{i}",
+                cpu="4",
+                memory="16Gi",
+                labels={
+                    "topology.kubernetes.io/zone": "z0",
+                    "topology.kubernetes.io/block": "b0",
+                    "topology.kubernetes.io/rack": f"r{i % 2}",
+                },
+            )
+        )
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)
+        deadline = time.monotonic() + 30.0
+        t = 0.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            # Kubelet stand-in: advance every bound-but-not-ready pod a hop.
+            for name, pod in list(api.pods.items()):
+                if pod.get("spec", {}).get("nodeName"):
+                    conds = pod.get("status", {}).get("conditions", [])
+                    if not any(
+                        c["type"] == "Ready" and c["status"] == "True" for c in conds
+                    ):
+                        api.advance_pod(name)
+            gangs = list(m.cluster.podgangs.values())
+            if gangs and all(
+                g.status.phase == PodGangPhase.RUNNING for g in gangs
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"gangs never RUNNING; fixture pods={list(api.pods)} "
+                f"bindings={api.binding_log} errors={m.watch.source.errors}"
+            )
+        # Every store pod is bound, created on the fixture, and placed where
+        # the binding said.
+        assert len(api.binding_log) == len(m.cluster.pods) == 13
+        for pod in m.cluster.pods.values():
+            assert api.pods[pod.name]["spec"]["nodeName"] == pod.node_name
+    finally:
+        m.stop()
+
+
+def test_failed_bind_stays_in_retry_set(api):
+    """A transient apiserver failure on bind must NOT mark the push done:
+    the WatchDriver retries it next tick (review finding: a swallowed 500
+    orphaned the placement forever)."""
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.api.types import PodSpec
+    from grove_tpu.cluster.watch import WatchDriver
+    from grove_tpu.orchestrator.store import Cluster
+
+    c = Cluster()
+    pod = Pod(
+        name="p0",
+        spec=PodSpec.from_dict(
+            {"containers": [{"name": "x", "image": "img"}]}
+        ),
+    )
+    pod.node_name = "n1"  # store says placed
+    c.pods[pod.name] = pod
+    src = _source(api, pod_manifest_for=lambda name: None)
+    # No manifest AND no pre-existing fixture pod: the binding POST 404s.
+    driver = WatchDriver(cluster=c, source=src)
+    assert driver.push(now=0.0) == 0
+    assert pod.name not in driver._pushed_bindings
+    assert src.errors  # the failure is visible
+    # The pod object appears (e.g. operator restarts mid-create) -> retry wins.
+    api.pods["p0"] = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p0", "labels": {}}, "spec": {}, "status": {},
+    }
+    assert driver.push(now=1.0) == 1
+    assert pod.name in driver._pushed_bindings
+    assert api.binding_log == [("p0", "n1")]
+
+
+def test_render_manifest_includes_init_containers_and_pins_namespace(api):
+    """startsAfter ordering rides on the injected initc init container —
+    the manifest must carry it; creates are pinned to the watch namespace."""
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.api.types import PodSpec
+
+    pod = Pod(
+        name="w0",
+        namespace="somewhere-else",
+        labels={"app.kubernetes.io/managed-by": "grove-tpu-operator"},
+        spec=PodSpec.from_dict(
+            {
+                "containers": [{"name": "main", "image": "img"}],
+                "initContainers": [
+                    {"name": "grove-initc", "image": "initc:latest"}
+                ],
+            }
+        ),
+    )
+    manifest = render_pod_manifest(pod)
+    assert manifest["spec"]["initContainers"][0]["name"] == "grove-initc"
+    src = _source(api, pod_manifest_for=lambda name: render_pod_manifest(pod))
+    assert src.observe_binding("w0", "n1", now=0.0) is True
+    # The create landed in the source's (watch) namespace regardless of the
+    # store pod's namespace — single-namespace operation, documented.
+    assert api.pods["w0"]["metadata"]["namespace"] == "default"
+    assert api.pods["w0"]["spec"]["initContainers"][0]["image"] == "initc:latest"
+
+
+def test_created_but_unbound_pod_cleaned_after_store_drop(api):
+    """The create-succeeded/bind-failed window: if the store drops the pod
+    before a bind retry lands, the driver still deletes the materialized
+    cluster object (else an unschedulable Pending pod leaks forever)."""
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.api.types import PodSpec
+    from grove_tpu.cluster.watch import WatchDriver
+    from grove_tpu.orchestrator.store import Cluster
+
+    c = Cluster()
+    pod = Pod(
+        name="p1",
+        spec=PodSpec.from_dict({"containers": [{"name": "x", "image": "img"}]}),
+    )
+    pod.node_name = "n1"
+    c.pods[pod.name] = pod
+    src = _source(
+        api, pod_manifest_for=lambda name: render_pod_manifest(c.pods[name])
+        if name in c.pods else None,
+    )
+    # Sabotage the BIND only: the create lands, the binding 404s... simplest
+    # wire-level sabotage is deleting the fixture pod between create and
+    # bind — instead, make bind fail by pre-binding the pod to another node
+    # is a 409 (success path). So: drop the pod object right after create
+    # via a fixture hook on the binding log. Here we emulate the window
+    # directly: create the object, then fail the bind with a server 500.
+    api.pods["p1"] = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p1", "labels": {}},
+        "spec": {"nodeName": "other"}, "status": {},
+    }
+    # Binding an already-bound pod returns 409 (treated as landed) — so to
+    # get a genuine failure, point the store pod at a name the fixture 404s
+    # the BINDING for while the create 409s (object exists).
+    api.pods["p1"]["spec"].pop("nodeName")
+    orig_post = api._post
+
+    def failing_post(path, body):
+        if path.endswith("/binding"):
+            return 500, {"kind": "Status", "code": 500}
+        return orig_post(path, body)
+
+    api._post = failing_post
+    driver = WatchDriver(cluster=c, source=src)
+    assert driver.push(now=0.0) == 0
+    assert "p1" in driver._attempted_bindings
+    # Store drops the pod (gang terminated) while the bind never landed.
+    del c.pods["p1"]
+    api._post = orig_post
+    driver.push(now=1.0)
+    assert "p1" not in api.pods, "materialized pod must be deleted"
+    assert "p1" not in driver._attempted_bindings
+
+
+def test_out_of_band_pod_deletion_fails_store_pod(api):
+    """kubectl-delete of a managed pod must surface in the store as a
+    failed pod (recovery via gang termination), not a ghost that stays
+    Running forever."""
+    from grove_tpu.api.pod import Pod, PodPhase
+    from grove_tpu.api.types import PodSpec
+    from grove_tpu.cluster.watch import WatchDriver
+    from grove_tpu.orchestrator.store import Cluster
+
+    c = Cluster()
+    pod = Pod(
+        name="p2",
+        labels={"app.kubernetes.io/managed-by": "grove-tpu-operator"},
+        spec=PodSpec.from_dict({"containers": [{"name": "x", "image": "img"}]}),
+    )
+    pod.node_name = "n1"
+    pod.phase = PodPhase.RUNNING
+    pod.ready = True
+    c.pods[pod.name] = pod
+    src = _source(
+        api, pod_manifest_for=lambda name: render_pod_manifest(c.pods[name])
+        if name in c.pods else None,
+    )
+    src.start()
+    try:
+        driver = WatchDriver(cluster=c, source=src)
+        driver.push(now=0.0)
+        assert "p2" in api.pods
+        # Out-of-band removal (kubectl delete).
+        api._delete(f"/api/v1/namespaces/default/pods/p2")
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            driver.pump(now=1.0)
+            if c.pods["p2"].phase == PodPhase.FAILED:
+                break
+            time.sleep(0.05)
+        assert c.pods["p2"].phase == PodPhase.FAILED
+        assert c.pods["p2"].ready is False
+        assert "p2" not in driver._pushed_bindings  # namesake re-push allowed
+    finally:
+        src.stop()
