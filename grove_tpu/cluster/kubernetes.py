@@ -39,6 +39,7 @@ import queue
 import ssl
 import tempfile
 import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -215,6 +216,27 @@ def _in_cluster_context(namespace: Optional[str]) -> KubeContext:
         ca_pem=ca_pem,
         namespace=ns or "default",
     )
+
+
+# ---------------------------------------------------------------------------------
+# Shared transport helpers
+# ---------------------------------------------------------------------------------
+
+
+def _open_connection(ctx: KubeContext, timeout: float) -> http.client.HTTPConnection:
+    u = urllib.parse.urlsplit(ctx.server)
+    if u.scheme == "https":
+        return http.client.HTTPSConnection(
+            u.hostname, u.port or 443, timeout=timeout, context=ctx.ssl_context()
+        )
+    return http.client.HTTPConnection(u.hostname, u.port or 80, timeout=timeout)
+
+
+def _auth_headers(ctx: KubeContext) -> dict:
+    h = {"Accept": "application/json"}
+    if ctx.token:
+        h["Authorization"] = f"Bearer {ctx.token}"
+    return h
 
 
 # ---------------------------------------------------------------------------------
@@ -478,19 +500,10 @@ class KubernetesWatchSource:
     # ---- HTTP plumbing --------------------------------------------------------------
 
     def _connect(self, timeout: float) -> http.client.HTTPConnection:
-        u = urllib.parse.urlsplit(self.ctx.server)
-        if u.scheme == "https":
-            return http.client.HTTPSConnection(
-                u.hostname, u.port or 443, timeout=timeout,
-                context=self.ctx.ssl_context(),
-            )
-        return http.client.HTTPConnection(u.hostname, u.port or 80, timeout=timeout)
+        return _open_connection(self.ctx, timeout)
 
     def _headers(self) -> dict:
-        h = {"Accept": "application/json"}
-        if self.ctx.token:
-            h["Authorization"] = f"Bearer {self.ctx.token}"
-        return h
+        return _auth_headers(self.ctx)
 
     def _request(
         self, method: str, path: str, body: Optional[dict] = None,
@@ -529,6 +542,183 @@ class KubernetesWatchSource:
     def _record_error(self, msg: str) -> None:
         self.errors.append(msg)
         del self.errors[:-20]
+
+
+# ---------------------------------------------------------------------------------
+# Apiserver-backed leader election (coordination.k8s.io/v1 Lease)
+# ---------------------------------------------------------------------------------
+
+
+class KubeLease:
+    """Leader election over a k8s Lease object — the reference's actual
+    mechanism (`operator/api/config/v1alpha1/types.go:73-104` rides
+    controller-runtime's Lease-based election). Same try_acquire/release
+    interface as runtime.lease.FileLease, so the Manager swaps them by
+    cluster source: with a live apiserver the lease lives where every
+    replica can see it, making multi-replica Deployments honest (a file
+    lease only coordinates processes sharing a filesystem).
+
+    Concurrency control is the apiserver's optimistic resourceVersion: the
+    renewing PUT carries the GET's resourceVersion; a 409 means another
+    replica won the race and this one stands down.
+    """
+
+    def __init__(
+        self,
+        ctx: KubeContext,
+        name: str = "grove-tpu-operator-leader",
+        lease_duration_seconds: float = 15.0,
+        renew_deadline_seconds: Optional[float] = None,
+        identity: Optional[str] = None,
+        request_timeout_s: float = 5.0,
+    ):
+        import uuid
+
+        self.ctx = ctx
+        self.name = name
+        self.lease_duration_seconds = lease_duration_seconds
+        self.renew_deadline_seconds = renew_deadline_seconds
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self._request_timeout_s = request_timeout_s
+        self._last_renew: Optional[float] = None
+        ns = urllib.parse.quote(ctx.namespace)
+        self._path = f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+    # -- wire helpers ---------------------------------------------------------------
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        # Fresh connection per call: one call per reconcile tick, so
+        # handshake cost is irrelevant here (unlike the binding path).
+        conn = _open_connection(self.ctx, timeout=self._request_timeout_s)
+        try:
+            data = json.dumps(body).encode() if body is not None else None
+            headers = _auth_headers(self.ctx)
+            if data is not None:
+                headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=data, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status >= 300:
+                raise KubeApiError(resp.status, raw[:1024].decode("utf-8", "replace"))
+            return json.loads(raw) if raw else None
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _micro_time(now: float) -> str:
+        import datetime
+
+        dt = datetime.datetime.fromtimestamp(now, tz=datetime.timezone.utc)
+        return dt.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+    @staticmethod
+    def _parse_micro_time(s: str) -> float:
+        import datetime
+
+        return (
+            datetime.datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%fZ")
+            .replace(tzinfo=datetime.timezone.utc)
+            .timestamp()
+        )
+
+    # -- FileLease-compatible surface -----------------------------------------------
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        # Renew-deadline stand-down first (types.go semantics): an overslept
+        # holder must stop leading BEFORE the lease could be stolen.
+        if (
+            self.renew_deadline_seconds is not None
+            and self._last_renew is not None
+            and now - self._last_renew > self.renew_deadline_seconds
+        ):
+            self._last_renew = None
+            self.release()
+            return False
+        try:
+            return self._acquire_or_renew(now)
+        except (KubeApiError, OSError, ValueError):
+            # Apiserver unreachable: WITHOUT a renewed lease we cannot lead.
+            self._last_renew = None
+            return False
+
+    def _acquire_or_renew(self, now: float) -> bool:
+        try:
+            cur = self._req("GET", f"{self._path}/{self.name}")
+        except KubeApiError as e:
+            if e.status != 404:
+                raise
+            body = {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": self.name, "namespace": self.ctx.namespace},
+                "spec": {
+                    "holderIdentity": self.identity,
+                    "leaseDurationSeconds": int(self.lease_duration_seconds),
+                    "acquireTime": self._micro_time(now),
+                    "renewTime": self._micro_time(now),
+                    "leaseTransitions": 0,
+                },
+            }
+            try:
+                self._req("POST", self._path, body)
+            except KubeApiError as e2:
+                if e2.status == 409:  # another replica created it first
+                    return False
+                raise
+            self._last_renew = now
+            return True
+        spec = cur.get("spec", {}) or {}
+        holder = spec.get("holderIdentity")
+        renew_raw = spec.get("renewTime")
+        expired = True
+        if renew_raw:
+            try:
+                renewed = self._parse_micro_time(renew_raw)
+                expired = now - renewed >= self.lease_duration_seconds
+            except ValueError:
+                expired = True
+        if holder != self.identity and not expired:
+            self._last_renew = None
+            return False
+        transitions = int(spec.get("leaseTransitions", 0) or 0)
+        if holder != self.identity:
+            transitions += 1
+            spec["acquireTime"] = self._micro_time(now)
+        spec.update(
+            holderIdentity=self.identity,
+            leaseDurationSeconds=int(self.lease_duration_seconds),
+            renewTime=self._micro_time(now),
+            leaseTransitions=transitions,
+        )
+        cur["spec"] = spec
+        try:
+            self._req("PUT", f"{self._path}/{self.name}", cur)
+        except KubeApiError as e:
+            if e.status == 409:  # lost the optimistic-concurrency race
+                self._last_renew = None
+                return False
+            raise
+        self._last_renew = now
+        return True
+
+    def release(self) -> None:
+        try:
+            cur = self._req("GET", f"{self._path}/{self.name}")
+            if (cur.get("spec", {}) or {}).get("holderIdentity") == self.identity:
+                # Preconditioned delete: between the GET and the DELETE a
+                # successor may have stolen an expired lease — deleting
+                # unconditionally would evict THEIR active lease and open a
+                # two-leader window. The resourceVersion precondition makes
+                # the apiserver reject (409) the stale delete.
+                rv = (cur.get("metadata", {}) or {}).get("resourceVersion")
+                self._req(
+                    "DELETE",
+                    f"{self._path}/{self.name}",
+                    {"preconditions": {"resourceVersion": rv}} if rv else None,
+                )
+        except (KubeApiError, OSError, ValueError):
+            pass  # releasing best-effort; expiry reclaims it anyway
 
 
 # ---------------------------------------------------------------------------------

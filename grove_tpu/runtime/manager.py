@@ -344,6 +344,8 @@ class Manager:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._http_servers: list[http.server.ThreadingHTTPServer] = []
+        # FileLease, or cluster.kubernetes.KubeLease when source=kubernetes
+        # (same try_acquire/release surface).
         self._lease: Optional[FileLease] = None
         self._is_leader = not config.leader_election.enabled
         self._backend_server = None
@@ -496,17 +498,44 @@ class Manager:
             },
         }
 
+    def _kube_ctx(self):
+        """Memoized kube connection material (shared by the lease and the
+        watch source so both target the same cluster/namespace)."""
+        if getattr(self, "_kube_ctx_cache", None) is None:
+            from grove_tpu.cluster.kubernetes import load_kube_context
+
+            cfg = self.config.cluster
+            self._kube_ctx_cache = load_kube_context(
+                cfg.kubeconfig or None,
+                cfg.kube_context or None,
+                cfg.kube_namespace or None,
+            )
+        return self._kube_ctx_cache
+
     def start(self) -> None:
         """Start servers + background loops (mgr.Start analog); idempotent."""
         if self._started:
             return
         cfg = self.config
         if cfg.leader_election.enabled:
-            self._lease = FileLease(
-                path=cfg.leader_election.lease_file,
-                lease_duration_seconds=cfg.leader_election.lease_duration_seconds,
-                renew_deadline_seconds=cfg.leader_election.renew_deadline_seconds,
-            )
+            if cfg.cluster.source == "kubernetes":
+                # Apiserver-backed Lease: the only store EVERY replica of a
+                # k8s Deployment can see — a file lease would leave two
+                # active managers on separate filesystems (the reference's
+                # election is apiserver-backed too, types.go:73-104).
+                from grove_tpu.cluster.kubernetes import KubeLease
+
+                self._lease = KubeLease(
+                    self._kube_ctx(),
+                    lease_duration_seconds=cfg.leader_election.lease_duration_seconds,
+                    renew_deadline_seconds=cfg.leader_election.renew_deadline_seconds,
+                )
+            else:
+                self._lease = FileLease(
+                    path=cfg.leader_election.lease_file,
+                    lease_duration_seconds=cfg.leader_election.lease_duration_seconds,
+                    renew_deadline_seconds=cfg.leader_election.renew_deadline_seconds,
+                )
             self._is_leader = self._lease.try_acquire()
         self._m_leader.set(1.0 if self._is_leader else 0.0)
 
@@ -570,15 +599,10 @@ class Manager:
             # (cluster/kubernetes.py).
             from grove_tpu.cluster.kubernetes import (
                 KubernetesWatchSource,
-                load_kube_context,
                 render_pod_manifest,
             )
 
-            ctx = load_kube_context(
-                cfg.cluster.kubeconfig or None,
-                cfg.cluster.kube_context or None,
-                cfg.cluster.kube_namespace or None,
-            )
+            ctx = self._kube_ctx()
 
             def _manifest(name: str):
                 pod = self.cluster.pods.get(name)

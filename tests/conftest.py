@@ -45,3 +45,13 @@ def simple1_variant() -> PodCliqueSet:
         doc = yaml.safe_load(f)
     doc["metadata"]["name"] = "variant1"
     return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+
+@pytest.hookimpl(tryfirst=True, hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Expose each phase's report on the item so fixtures can see whether
+    the test body failed (drives the e2e diagnostics dump, tests/e2e_diag.py
+    — the reference's GROVE_E2E_DIAG_MODE analog)."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)

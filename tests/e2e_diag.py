@@ -1,0 +1,72 @@
+"""e2e failure diagnostics — the reference's debug_utils.go analog.
+
+When a process-level e2e test fails, the live operator's whole object state
+(every /api/v1 collection, recent events, /statusz) is dumped to one JSON
+artifact so the failure is debuggable after the subprocess is gone
+(reference: `operator/e2e/tests/debug_utils.go`, `GROVE_E2E_DIAG_MODE`,
+`operator/Makefile:97-101`).
+
+Modes via GROVE_E2E_DIAG_MODE: "on-failure" (default), "always", "off".
+Artifacts land in GROVE_E2E_DIAG_DIR (default /tmp/grove-e2e-diag).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+import time
+import urllib.request
+
+COLLECTIONS = ("podcliquesets", "podgangs", "pods", "nodes", "services", "hpas")
+
+
+def dump_diagnostics(port: int, test_name: str) -> pathlib.Path:
+    """Snapshot the operator's API surface into one artifact; every endpoint
+    is best-effort (a half-dead operator should still yield a partial dump)."""
+    dest_dir = pathlib.Path(
+        os.environ.get("GROVE_E2E_DIAG_DIR", "/tmp/grove-e2e-diag")
+    )
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    doc: dict = {"test": test_name, "captured_at": time.time(), "port": port}
+
+    def fetch(path: str):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as r:
+            return json.loads(r.read())
+
+    for coll in COLLECTIONS:
+        try:
+            doc[coll] = fetch(f"/api/v1/{coll}?full=1")
+        except Exception as e:  # noqa: BLE001 — partial dumps beat none
+            doc[coll] = {"_diag_error": str(e)}
+    for path, key in (("/api/v1/events", "events"), ("/statusz", "statusz")):
+        try:
+            doc[key] = fetch(path)
+        except Exception as e:  # noqa: BLE001
+            doc[key] = {"_diag_error": str(e)}
+
+    safe = re.sub(r"[^A-Za-z0-9._-]+", "_", test_name)
+    dest = dest_dir / f"{safe}-{int(time.time())}.json"
+    dest.write_text(json.dumps(doc, indent=2, default=str))
+    return dest
+
+
+def maybe_dump(request, port: int) -> pathlib.Path | None:
+    """Fixture-teardown hook: dump when the test failed (or mode=always)."""
+    mode = os.environ.get("GROVE_E2E_DIAG_MODE", "on-failure")
+    if mode == "off":
+        return None
+    rep = getattr(request.node, "rep_call", None)
+    failed = rep is not None and rep.failed
+    if not failed and mode != "always":
+        return None
+    try:
+        dest = dump_diagnostics(port, request.node.nodeid)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not mask the failure
+        print(f"[e2e-diag] dump failed: {e}")
+        return None
+    print(f"[e2e-diag] operator state dumped to {dest}")
+    return dest

@@ -10,6 +10,9 @@ in-process server speaking the actual apiserver wire protocol:
   POST /api/v1/namespaces/{ns}/pods           -> create (409 on duplicate)
   POST /api/v1/namespaces/{ns}/pods/{n}/binding -> set spec.nodeName (404/409)
   DELETE /api/v1/namespaces/{ns}/pods/{n}     -> delete
+  GET/POST/PUT/DELETE /apis/coordination.k8s.io/v1/namespaces/{ns}/leases[/n]
+    — Lease objects with optimistic resourceVersion concurrency (leader
+    election; a PUT with a stale resourceVersion gets 409)
 
 The fixture also plays kubelet: `advance_pod(name)` walks a bound pod
 through Running then Ready (the KWOK stage analog), emitting MODIFIED
@@ -61,6 +64,7 @@ class FixtureApiServer:
         self._fail_watch_code: int | None = None
         self.binding_log: list[tuple[str, str]] = []  # (pod, node) in order
         self.created_pods: list[str] = []
+        self.leases: dict[str, dict] = {}
 
         fixture = self
 
@@ -81,6 +85,10 @@ class FixtureApiServer:
             def do_GET(self):
                 parsed = urllib.parse.urlsplit(self.path)
                 qs = dict(urllib.parse.parse_qsl(parsed.query))
+                if parsed.path.startswith(fixture._leases_prefix):
+                    code, doc = fixture._lease_get(parsed.path)
+                    self._json(code, doc)
+                    return
                 resource = fixture._resource_for(parsed.path)
                 if resource is None:
                     self._json(404, {"kind": "Status", "code": 404})
@@ -94,11 +102,30 @@ class FixtureApiServer:
                 parsed = urllib.parse.urlsplit(self.path)
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                code, doc = fixture._post(parsed.path, body)
+                if parsed.path.startswith(fixture._leases_prefix):
+                    code, doc = fixture._lease_post(parsed.path, body)
+                else:
+                    code, doc = fixture._post(parsed.path, body)
                 self._json(code, doc)
+
+            def do_PUT(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if parsed.path.startswith(fixture._leases_prefix):
+                    code, doc = fixture._lease_put(parsed.path, body)
+                    self._json(code, doc)
+                else:
+                    self._json(404, {"kind": "Status", "code": 404})
 
             def do_DELETE(self):
                 parsed = urllib.parse.urlsplit(self.path)
+                length = int(self.headers.get("Content-Length", "0") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}") if length else {}
+                if parsed.path.startswith(fixture._leases_prefix):
+                    code, doc = fixture._lease_delete(parsed.path, body)
+                    self._json(code, doc)
+                    return
                 code, doc = fixture._delete(parsed.path)
                 self._json(code, doc)
 
@@ -150,6 +177,62 @@ class FixtureApiServer:
         self._fail_watch_code = code
 
     # ---- protocol internals ---------------------------------------------------------
+
+    @property
+    def _leases_prefix(self) -> str:
+        return f"/apis/coordination.k8s.io/v1/namespaces/{self.namespace}/leases"
+
+    def _lease_name(self, path: str) -> str | None:
+        rest = path[len(self._leases_prefix):]
+        return rest.lstrip("/") or None
+
+    def _lease_get(self, path: str):
+        name = self._lease_name(path)
+        with self._lock:
+            lease = self.leases.get(name or "")
+            if lease is None:
+                return 404, {"kind": "Status", "code": 404}
+            return 200, json.loads(json.dumps(lease))
+
+    def _lease_post(self, path: str, body: dict):
+        name = body.get("metadata", {}).get("name")
+        with self._lock:
+            if name in self.leases:
+                return 409, {"kind": "Status", "code": 409, "reason": "AlreadyExists"}
+            self._rv += 1
+            body.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+            self.leases[name] = body
+            return 201, json.loads(json.dumps(body))
+
+    def _lease_put(self, path: str, body: dict):
+        name = self._lease_name(path)
+        with self._lock:
+            cur = self.leases.get(name or "")
+            if cur is None:
+                return 404, {"kind": "Status", "code": 404}
+            sent_rv = body.get("metadata", {}).get("resourceVersion")
+            if sent_rv != cur["metadata"]["resourceVersion"]:
+                # Optimistic concurrency: stale update loses (the race the
+                # KubeLease relies on for single-leader semantics).
+                return 409, {"kind": "Status", "code": 409, "reason": "Conflict"}
+            self._rv += 1
+            body["metadata"]["resourceVersion"] = str(self._rv)
+            self.leases[name] = body
+            return 200, json.loads(json.dumps(body))
+
+    def _lease_delete(self, path: str, body: dict | None = None):
+        name = self._lease_name(path)
+        with self._lock:
+            cur = self.leases.get(name or "")
+            if cur is None:
+                return 404, {"kind": "Status", "code": 404}
+            want_rv = ((body or {}).get("preconditions") or {}).get("resourceVersion")
+            if want_rv is not None and want_rv != cur["metadata"]["resourceVersion"]:
+                # Preconditioned delete lost a race (the successor's lease
+                # is live) — refuse, as the real apiserver does.
+                return 409, {"kind": "Status", "code": 409, "reason": "Conflict"}
+            del self.leases[name]
+            return 200, {"kind": "Status", "code": 200}
 
     def _resource_for(self, path: str):
         if path == "/api/v1/nodes":

@@ -44,13 +44,25 @@ def test_render_covers_chart_surface():
 
 
 def test_leader_election_enables_ha_replicas():
+    """HA replicas need an election every pod can SEE: the apiserver lease
+    (cluster.source: kubernetes). A file lease renders one replica —
+    election or not (round-3 finding: two pods, two filesystems, two
+    leaders)."""
+    by_kind = _render(
+        {
+            "leaderElection": {"enabled": True, "leaseFile": "/var/lock/g"},
+            "servers": {"healthPort": 2751, "metricsPort": -1},
+            "cluster": {"source": "kubernetes"},
+        }
+    )
+    assert by_kind["Deployment"]["spec"]["replicas"] == 2
     by_kind = _render(
         {
             "leaderElection": {"enabled": True, "leaseFile": "/var/lock/g"},
             "servers": {"healthPort": 2751, "metricsPort": -1},
         }
     )
-    assert by_kind["Deployment"]["spec"]["replicas"] == 2
+    assert by_kind["Deployment"]["spec"]["replicas"] == 1
 
 
 def test_disabled_ports_render_no_service_entries():
@@ -87,3 +99,34 @@ def test_cli_rejects_invalid_config(tmp_path):
     )
     assert proc.returncode == 2
     assert "log.level" in proc.stderr
+
+
+def test_multi_replica_requires_apiserver_lease(tmp_path):
+    """replicas>1 is only honest with an apiserver-backed lease: the file
+    lease cannot coordinate pods on separate filesystems (round-3 finding)."""
+    import pytest
+
+    from grove_tpu.deploy import render_manifests
+    from grove_tpu.runtime.config import parse_operator_config
+
+    base = {
+        "servers": {"bindAddress": "0.0.0.0"},
+        "leaderElection": {"enabled": True, "leaseFile": "/var/lock/l"},
+    }
+    cfg, errors = parse_operator_config(base)
+    assert not errors
+    # File-lease default renders ONE replica even with election on...
+    docs = render_manifests(cfg, "cfg: {}")
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    assert dep["spec"]["replicas"] == 1
+    # ...and explicitly asking for more is an error, not a silent hazard.
+    with pytest.raises(ValueError, match="replicas > 1"):
+        render_manifests(cfg, "cfg: {}", replicas=2)
+
+    kube = dict(base)
+    kube["cluster"] = {"source": "kubernetes"}
+    cfg2, errors = parse_operator_config(kube)
+    assert not errors
+    docs = render_manifests(cfg2, "cfg: {}")
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    assert dep["spec"]["replicas"] == 2  # HA-capable: apiserver lease

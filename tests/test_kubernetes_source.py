@@ -477,3 +477,128 @@ def test_out_of_band_pod_deletion_fails_store_pod(api):
         assert "p2" not in driver._pushed_bindings  # namesake re-push allowed
     finally:
         src.stop()
+
+
+# --- apiserver-backed leader election (KubeLease) --------------------------------
+
+
+def test_kube_lease_acquire_renew_steal(api):
+    from grove_tpu.cluster.kubernetes import KubeLease
+
+    ctx = KubeContext(server=api.url, namespace="default")
+    a = KubeLease(ctx, lease_duration_seconds=10.0, identity="a")
+    b = KubeLease(ctx, lease_duration_seconds=10.0, identity="b")
+    assert a.try_acquire(now=100.0) is True
+    assert b.try_acquire(now=101.0) is False  # held and fresh
+    assert a.try_acquire(now=105.0) is True  # renewal
+    # Holder dies silently; past leaseDuration the lease is stolen.
+    assert b.try_acquire(now=115.1) is True
+    assert a.try_acquire(now=116.0) is False  # original holder stands down
+    assert api.leases["grove-tpu-operator-leader"]["spec"]["leaseTransitions"] >= 1
+
+
+def test_kube_lease_release_hands_over(api):
+    from grove_tpu.cluster.kubernetes import KubeLease
+
+    ctx = KubeContext(server=api.url, namespace="default")
+    a = KubeLease(ctx, lease_duration_seconds=60.0, identity="a")
+    b = KubeLease(ctx, lease_duration_seconds=60.0, identity="b")
+    assert a.try_acquire(now=0.0)
+    assert not b.try_acquire(now=1.0)
+    a.release()
+    assert b.try_acquire(now=2.0) is True
+
+
+def test_kube_lease_renew_deadline_stand_down(api):
+    from grove_tpu.cluster.kubernetes import KubeLease
+
+    ctx = KubeContext(server=api.url, namespace="default")
+    a = KubeLease(
+        ctx, lease_duration_seconds=30.0, renew_deadline_seconds=5.0, identity="a"
+    )
+    assert a.try_acquire(now=0.0)
+    # Overslept the renew deadline: stand down BEFORE the lease could be
+    # stolen, releasing so a successor takes over immediately.
+    assert a.try_acquire(now=6.0) is False
+    b = KubeLease(ctx, lease_duration_seconds=30.0, identity="b")
+    assert b.try_acquire(now=7.0) is True
+
+
+def test_two_managers_failover_via_apiserver_lease(api, tmp_path, simple1):
+    """The deployed-shape honesty test (round-3 finding): two manager
+    replicas coordinating through the APISERVER lease — no shared
+    filesystem. Second stands by; leader stop hands over."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    api.add_node(k8s_node("n0", cpu="8", memory="32Gi"))
+    kubeconfig = _write_kubeconfig(tmp_path, api.url)
+
+    def mk():
+        cfg, errors = parse_operator_config(
+            {
+                "servers": {"healthPort": -1, "metricsPort": -1},
+                "backend": {"enabled": False},
+                "leaderElection": {
+                    "enabled": True,
+                    "leaseDurationSeconds": 2.0,
+                    "renewDeadlineSeconds": 1.5,
+                },
+                "cluster": {"source": "kubernetes", "kubeconfig": kubeconfig},
+            }
+        )
+        assert not errors, errors
+        return Manager(cfg)
+
+    m1 = mk()
+    m2 = mk()
+    m1.start()
+    m2.start()
+    try:
+        assert m1._is_leader is True
+        assert m2._is_leader is False
+        m2.cluster.podcliquesets[simple1.metadata.name] = simple1
+        m2.run(stop_after_seconds=0.3)
+        assert not m2.cluster.podgangs, "standby must not reconcile"
+        # Leader stops (releases the lease) -> standby takes over.
+        m1.stop()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not m2._is_leader:
+            m2.run(stop_after_seconds=0.3)
+        assert m2._is_leader is True
+        m2.run(stop_after_seconds=0.5)
+        assert m2.cluster.podgangs, "new leader reconciles"
+    finally:
+        m1.stop()
+        m2.stop()
+
+
+def test_kube_lease_release_never_clobbers_successor(api):
+    """The stand-down race: A's release must NOT delete a lease B already
+    stole (preconditioned delete; an unconditioned one would open a
+    two-leader window for C)."""
+    from grove_tpu.cluster.kubernetes import KubeLease
+
+    ctx = KubeContext(server=api.url, namespace="default")
+    a = KubeLease(ctx, lease_duration_seconds=5.0, identity="a")
+    b = KubeLease(ctx, lease_duration_seconds=5.0, identity="b")
+    assert a.try_acquire(now=0.0)
+    # A's lease expires; B steals it between A's GET and DELETE. Emulate by
+    # stealing first, then restoring the doc A would have read: the fixture
+    # enforces resourceVersion preconditions, so A's stale release loses.
+    assert b.try_acquire(now=6.0)  # stolen: rv bumped
+    a.release()  # holder is now b -> A's GET sees b, skips the delete
+    assert api.leases["grove-tpu-operator-leader"]["spec"]["holderIdentity"] == "b"
+    # Direct precondition check: a stale-rv delete is refused with 409.
+    import pytest as _pytest
+
+    from grove_tpu.cluster.kubernetes import KubeApiError
+
+    with _pytest.raises(KubeApiError) as ei:
+        b._req(
+            "DELETE",
+            f"{b._path}/{b.name}",
+            {"preconditions": {"resourceVersion": "stale"}},
+        )
+    assert ei.value.status == 409
+    assert "grove-tpu-operator-leader" in api.leases  # survived the stale delete
