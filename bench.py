@@ -96,6 +96,10 @@ def run_bench() -> dict:
     # re-placement multiplier costs more than the sequential scan's depth).
     spec_env = os.environ.get("GROVE_BENCH_SPECULATIVE", "auto")
     speculative = spec_env == "1"
+    # Portfolio width for the drain (solver.portfolio analog): P weight
+    # variants per wave, winner kept. 1 = off (the latency-headline default;
+    # the quality delta shows on the contended scenario, scripts/profile_ablate).
+    portfolio = int(os.environ.get("GROVE_BENCH_PORTFOLIO", "1"))
     run_baseline = os.environ.get("GROVE_BENCH_BASELINE", "1") == "1"
 
     topo = bench_topology()
@@ -130,6 +134,7 @@ def run_bench() -> dict:
         wave_size=wave_size,
         params=SolverParams(),
         speculative=speculative,
+        portfolio=portfolio,
     )
     total_s = stats.total_s
     admitted = stats.admitted
@@ -169,6 +174,7 @@ def run_bench() -> dict:
         "nodes": len(nodes),
         "wave_size": wave_size,
         "speculative": speculative,
+        "portfolio": portfolio,
         "compile_s": round(stats.compile_s, 2),
         "setup_s": round(setup_s, 2),
         # Phase breakdown: host encode, dispatch, decode; device_wait_s is

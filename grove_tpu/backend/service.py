@@ -540,8 +540,18 @@ class TPUSchedulerBackend:
             reuse_nodes_by_gang=reuse_by_gang,
             spread_avoid_by_gang=spread_by_gang,
         )
+        # solver.portfolio > 1: the sidecar's Solve explores P weight
+        # variants and keeps the winner (multi-chip quality path; the
+        # variants shard over the device mesh when one exists). A
+        # speculative Solve request takes precedence for that call since
+        # the two paths are mutually exclusive.
+        portfolio = 1 if speculative else self._solver_config.portfolio
         result = solve(
-            snapshot, batch, params=self._solver_params, speculative=speculative
+            snapshot,
+            batch,
+            params=self._solver_params,
+            speculative=speculative,
+            portfolio=portfolio,
         )
         bindings = decode_assignments(result, decode, snapshot)
 

@@ -76,6 +76,9 @@ class GroveController:
     pad_gangs_to: int | None = None
     # speculative parallel commit (solve_batch_speculative) vs sequential scan
     speculative: bool = False
+    # portfolio width: >1 solves each wave under P weight variants, winner
+    # kept (solver.portfolio; parallel/portfolio.py)
+    portfolio: int = 1
     # MNNVL-analog TPU-slice injection (networkAcceleration config section)
     auto_slice_enabled: bool = False
     slice_resource_name: str = "google.com/tpu"
@@ -476,7 +479,13 @@ class GroveController:
             reuse_nodes_by_gang=reuse_nodes,
             spread_avoid_by_gang=spread_avoid,
         )
-        result = solve(snapshot, batch, self.solver_params, speculative=self.speculative)
+        result = solve(
+            snapshot,
+            batch,
+            self.solver_params,
+            speculative=self.speculative,
+            portfolio=self.portfolio,
+        )
         bindings = decode_assignments(result, decode, snapshot)
 
         admitted = 0

@@ -47,6 +47,33 @@ def solver_mesh(devices: list | None = None) -> Mesh:
     return Mesh(np.asarray(devices).reshape(p, k), (PORTFOLIO_AXIS, NODE_AXIS))
 
 
+def solver_mesh_for(
+    portfolio: int, n_nodes: int, devices: list | None = None
+) -> Mesh | None:
+    """Largest valid (portfolio, node) mesh for the PROBLEM shape, or None.
+
+    device_put with a NamedSharding needs each sharded dimension divisible
+    by its axis size; an arbitrary (P, N) pair (P=2 variants, 6 nodes, 8
+    devices) often can't use the most-square split. Prefer the largest
+    portfolio axis that divides P with a node axis that divides N; None
+    means no valid layout — the caller solves unsharded (vmap on the
+    default device), which is always correct, just not distributed.
+    """
+    devices = devices if devices is not None else jax.devices()
+    nd = len(devices)
+    if nd <= 1:
+        return None
+    for k in range(1, nd + 1):
+        if nd % k:
+            continue
+        pa = nd // k
+        if portfolio % pa == 0 and n_nodes % k == 0:
+            return Mesh(
+                np.asarray(devices).reshape(pa, k), (PORTFOLIO_AXIS, NODE_AXIS)
+            )
+    return None
+
+
 def portfolio_sharding(mesh: Mesh) -> NamedSharding:
     """Leading axis split across the portfolio axis, rest replicated."""
     return NamedSharding(mesh, PartitionSpec(PORTFOLIO_AXIS))

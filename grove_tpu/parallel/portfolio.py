@@ -39,7 +39,17 @@ _N_WEIGHTS = len(SolverParams._fields)
 
 def params_population(p: int, base: SolverParams = SolverParams(), spread: float = 0.6,
                       seed: int = 0) -> SolverParams:
-    """Stack P weight vectors: the base plus log-normal perturbations.
+    """Stack P weight vectors: the base plus log-normal perturbations, with
+    PACKING-POLARITY diversity — odd slots flip w_tight's sign (worst-fit).
+
+    Magnitude noise alone cannot change which node wins an argmax whose
+    ordering every positive scaling preserves; the measured failure it
+    misses is the bin-packing trap where best-fit doubles small gangs onto
+    one node and strands a later gang's floor, while worst-fit (negative
+    tightness = spread-first) admits everything. Half the portfolio
+    explores each polarity and the winner-select keeps whichever fits the
+    batch; slot 0 is always the exact base, so the portfolio's admitted
+    count can never fall below the base solver's.
 
     Deterministic for a given seed so portfolio solves are reproducible.
     """
@@ -48,6 +58,8 @@ def params_population(p: int, base: SolverParams = SolverParams(), spread: float
     factors[0, :] = 1.0  # slot 0 is always the unperturbed base
     base_vec = np.asarray([float(x) for x in base], dtype=np.float32)
     stack = factors * base_vec[None, :]
+    tight_i = SolverParams._fields.index("w_tight")
+    stack[1::2, tight_i] *= -1.0  # odd slots: worst-fit members
     return SolverParams(*(jnp.asarray(stack[:, i]) for i in range(_N_WEIGHTS)))
 
 
@@ -73,6 +85,7 @@ def portfolio_solve_batch(
     node_domain_id: jax.Array,
     batch: GangBatch,
     params_stack: SolverParams,
+    ok_global: jax.Array | None = None,  # cross-wave verdict bitmap [T]
     coarse_dmax: int | None = None,  # see solver/core.py coarse_dmax_of
 ) -> tuple[SolveResult, jax.Array, jax.Array]:
     """Solve the same batch under every weight vector; return the winner.
@@ -81,9 +94,16 @@ def portfolio_solve_batch(
     The winner is chosen by exact lexicographic (admitted count, quality) —
     a two-stage argmax, NOT a packed float (which would quantize the quality
     tie-break away in f32 once admitted*1e6 dominates the mantissa).
+
+    `ok_global` (the drain's cross-wave scaled-gang verdict bitmap) is
+    shared by every member: wave chaining keeps only the WINNER's outcome,
+    so each member must judge base-gang dependencies against that one
+    committed history, not its own hypothetical.
     """
     vsolve = jax.vmap(
-        lambda f, c, s, nd, b, p: solve_batch(f, c, s, nd, b, p, coarse_dmax=coarse_dmax),
+        lambda f, c, s, nd, b, p: solve_batch(
+            f, c, s, nd, b, p, ok_global, coarse_dmax=coarse_dmax
+        ),
         in_axes=(None, None, None, None, None, 0),
     )
     results = vsolve(free0, capacity, schedulable, node_domain_id, batch, params_stack)
@@ -123,18 +143,21 @@ def tune_solve_step(
     return best, next_stack, objectives
 
 
-def shard_inputs(mesh, snapshot, batch: GangBatch, params_stack: SolverParams):
-    """Lay solver inputs out on the mesh: node tensors sharded along NODE_AXIS,
-    the weight stack along PORTFOLIO_AXIS, the gang batch replicated. The one
-    place the sharding layout is defined — production solve and the driver
-    dryrun both go through it.
+def shard_solver_inputs(
+    mesh, free0, capacity, schedulable, node_domain_id, batch: GangBatch,
+    params_stack: SolverParams,
+):
+    """Array-level mesh layout: node tensors sharded along NODE_AXIS, the
+    weight stack along PORTFOLIO_AXIS, the gang batch replicated. The one
+    place the sharding layout is defined — production solve (solver.core
+    portfolio path), shard_inputs, and the driver dryrun all go through it.
     """
     rep = replicated(mesh)
-    free0 = jax.device_put(jnp.asarray(snapshot.free), node_sharding(mesh, 0, 2))
-    capacity = jax.device_put(jnp.asarray(snapshot.capacity), node_sharding(mesh, 0, 2))
-    schedulable = jax.device_put(jnp.asarray(snapshot.schedulable), node_sharding(mesh, 0, 1))
+    free0 = jax.device_put(jnp.asarray(free0), node_sharding(mesh, 0, 2))
+    capacity = jax.device_put(jnp.asarray(capacity), node_sharding(mesh, 0, 2))
+    schedulable = jax.device_put(jnp.asarray(schedulable), node_sharding(mesh, 0, 1))
     node_domain_id = jax.device_put(
-        jnp.asarray(snapshot.node_domain_id), node_sharding(mesh, 1, 2)
+        jnp.asarray(node_domain_id), node_sharding(mesh, 1, 2)
     )
     jbatch = GangBatch(
         *(None if x is None else jax.device_put(jnp.asarray(x), rep) for x in batch)
@@ -143,6 +166,62 @@ def shard_inputs(mesh, snapshot, batch: GangBatch, params_stack: SolverParams):
         *(jax.device_put(jnp.asarray(x), portfolio_sharding(mesh)) for x in params_stack)
     )
     return free0, capacity, schedulable, node_domain_id, jbatch, pstack
+
+
+def shard_inputs(mesh, snapshot, batch: GangBatch, params_stack: SolverParams):
+    """Snapshot-level wrapper over shard_solver_inputs."""
+    return shard_solver_inputs(
+        mesh,
+        snapshot.free,
+        snapshot.capacity,
+        snapshot.schedulable,
+        snapshot.node_domain_id,
+        batch,
+        params_stack,
+    )
+
+
+def portfolio_solve(
+    free0,
+    capacity,
+    schedulable,
+    node_domain_id,
+    batch: GangBatch,
+    base_params: SolverParams,
+    portfolio: int,
+    ok_global=None,
+    coarse_dmax: int | None = None,
+) -> SolveResult:
+    """One-stop portfolio solve: population -> mesh layout (when the device
+    count admits a valid (P, N)-divisible split, solver_mesh_for) -> winner.
+
+    The single entry both serving paths use (solver.core.solve's portfolio
+    branch and solver.drain's per-wave closure), so population seeding,
+    sharding, and winner selection can never diverge between them.
+    """
+    from grove_tpu.parallel.mesh import solver_mesh_for
+
+    pstack = params_population(portfolio, base=base_params)
+    mesh = solver_mesh_for(portfolio, int(free0.shape[0]))
+    if mesh is not None:
+        (free0, capacity, schedulable, node_domain_id, batch, pstack) = (
+            shard_solver_inputs(
+                mesh, free0, capacity, schedulable, node_domain_id, batch, pstack
+            )
+        )
+        if ok_global is not None:
+            ok_global = jax.device_put(jnp.asarray(ok_global), replicated(mesh))
+    best, _winner, _objectives = portfolio_solve_batch(
+        free0,
+        capacity,
+        schedulable,
+        node_domain_id,
+        batch,
+        pstack,
+        ok_global,
+        coarse_dmax=coarse_dmax,
+    )
+    return best
 
 
 def sharded_portfolio_solve(snapshot, batch: GangBatch, params_stack: SolverParams,

@@ -103,6 +103,10 @@ class SolverConfig:
     """The placement engine (no reference analog — the KAI replacement)."""
 
     speculative: bool = False
+    # Portfolio width: >1 solves every batch under P score-weight variants
+    # and keeps the winner (parallel/portfolio.py) — the multi-chip quality
+    # knob; the variants shard across the device mesh when one is available.
+    portfolio: int = 1
     max_groups: Optional[int] = None
     max_sets: Optional[int] = None
     max_pods: Optional[int] = None
@@ -389,6 +393,14 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             errors.append(f"topologyAwareScheduling.levels: {e}")
     if cfg.persistence.enabled and not cfg.persistence.path:
         errors.append("persistence.path: required when persistence is enabled")
+    pf = cfg.solver.portfolio
+    if not isinstance(pf, int) or isinstance(pf, bool) or pf < 1:
+        errors.append("solver.portfolio: must be an int >= 1")
+    elif pf > 1 and cfg.solver.speculative:
+        errors.append(
+            "solver.portfolio: mutually exclusive with solver.speculative "
+            "(the portfolio already explores commit variants)"
+        )
     if not isinstance(cfg.solver.weights, dict):
         errors.append("solver.weights: must be a mapping of weight -> number")
     elif cfg.solver.weights:

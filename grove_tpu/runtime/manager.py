@@ -338,6 +338,7 @@ class Manager:
             max_pods=config.solver.max_pods,
             pad_gangs_to=config.solver.pad_gangs_to,
             speculative=config.solver.speculative,
+            portfolio=config.solver.portfolio,
             auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
             slice_resource_name=config.network_acceleration.slice_resource_name,
         )

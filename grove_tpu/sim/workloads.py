@@ -278,3 +278,69 @@ def contended_backlog(n_gangs: int = 24) -> list[PodCliqueSet]:
         }
         out.append(default_podcliqueset(PodCliqueSet.from_dict(doc)))
     return out
+
+
+def binpack_trap_cluster(n_nodes: int = 6, node_cpu: float = 7.0) -> list[Node]:
+    """Identical nodes sized so only one packing admits the whole trap
+    backlog (see binpack_trap_backlog)."""
+    return [
+        Node(
+            name=f"bp-{i}",
+            capacity={"cpu": node_cpu, "memory": 64.0 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/block": "b0",
+                "topology.kubernetes.io/rack": f"r{i}",
+            },
+        )
+        for i in range(n_nodes)
+    ]
+
+
+def binpack_trap_backlog(n_pairs: int = 6) -> list[PodCliqueSet]:
+    """The packing-polarity trap (portfolio quality scenario).
+
+    n_pairs small gangs (3 cpu) arrive BEFORE n_pairs big gangs (4 cpu) on
+    n_pairs 7-cpu nodes — demand exactly equals capacity, so only the
+    4+3-per-node pairing admits everything. Best-fit doubles the smalls up
+    (3+3 on one node leaves 1 cpu: dead) and strands bigs; worst-fit
+    (spread-first, negative w_tight) spreads the smalls one-per-node and
+    every big fits. No single score polarity wins both this and the tight-
+    consolidation workloads — which is exactly the regime the solver
+    portfolio (parallel/portfolio.py params_population) exists for.
+    """
+
+    def one(name: str, cpu: str) -> PodCliqueSet:
+        doc = {
+            "apiVersion": "grove.io/v1alpha1",
+            "kind": "PodCliqueSet",
+            "metadata": {"name": name},
+            "spec": {
+                "replicas": 1,
+                "template": {
+                    "cliques": [
+                        {
+                            "name": "w",
+                            "spec": {
+                                "roleName": "w",
+                                "replicas": 1,
+                                "podSpec": {
+                                    "containers": [
+                                        {
+                                            "name": "w",
+                                            "image": "registry.local/w:latest",
+                                            "resources": {"requests": {"cpu": cpu}},
+                                        }
+                                    ]
+                                },
+                            },
+                        }
+                    ],
+                },
+            },
+        }
+        return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+    smalls = [one(f"bp-small-{i}", "3") for i in range(n_pairs)]
+    bigs = [one(f"bp-big-{i}", "4") for i in range(n_pairs)]
+    return smalls + bigs

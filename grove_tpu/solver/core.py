@@ -866,18 +866,44 @@ def solve(
     free: jax.Array | None = None,
     schedulable: jax.Array | None = None,
     ok_global: jax.Array | None = None,
+    portfolio: int = 1,
 ) -> SolveResult:
     """Convenience wrapper: snapshot (numpy) -> device -> solve_batch.
 
     `free`/`schedulable` override the snapshot's (wave chaining: pass the
     previous result's free_after); `ok_global` threads the cross-wave verdict
     bitmap (see solve_batch).
+
+    `portfolio` > 1 solves the batch under P score-weight variants (base +
+    log-normal perturbations, parallel/portfolio.py) and keeps the winner by
+    (admitted count, quality) — the multi-chip quality path (solver.portfolio
+    config knob): on a multi-device mesh the variants ride the portfolio
+    axis; on one device they vmap into a single batched program.
     """
     free0 = jnp.asarray(snapshot.free if free is None else free)
     capacity = jnp.asarray(snapshot.capacity)
     sched = jnp.asarray(snapshot.schedulable if schedulable is None else schedulable)
     node_domain_id = jnp.asarray(snapshot.node_domain_id)
     jbatch = GangBatch(*(None if x is None else jnp.asarray(x) for x in batch))
+    if portfolio > 1:
+        if speculative:
+            raise ValueError(
+                "solver.portfolio and solver.speculative are mutually "
+                "exclusive (config validation enforces this)"
+            )
+        from grove_tpu.parallel.portfolio import portfolio_solve
+
+        return portfolio_solve(
+            free0,
+            capacity,
+            sched,
+            node_domain_id,
+            jbatch,
+            params,
+            portfolio,
+            ok_global,
+            coarse_dmax=coarse_dmax_of(snapshot),
+        )
     fn = solve_batch_speculative if speculative else solve_batch
     return fn(
         free0,

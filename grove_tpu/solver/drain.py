@@ -91,6 +91,7 @@ def drain_backlog(
     wave_size: int = 256,
     params: SolverParams | None = None,
     speculative: bool = False,
+    portfolio: int = 1,
     warm: bool = True,
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
@@ -110,7 +111,22 @@ def drain_backlog(
     import numpy as np
 
     params = params or SolverParams()
-    solver = solve_batch_speculative if speculative else solve_batch
+    if portfolio > 1:
+        if speculative:
+            raise ValueError("portfolio and speculative are mutually exclusive")
+        # Per-wave portfolio: every wave solved under P weight variants, the
+        # winner's free_after/ok chained forward (solver.portfolio knob;
+        # the shared portfolio_solve handles population + mesh layout, so
+        # the drain distributes exactly like the operator path).
+        from grove_tpu.parallel.portfolio import portfolio_solve
+
+        def solver(f, c, s, nd, b, p, okg=None, coarse_dmax=None):
+            return portfolio_solve(
+                f, c, s, nd, b, p, portfolio, okg, coarse_dmax=coarse_dmax
+            )
+
+    else:
+        solver = solve_batch_speculative if speculative else solve_batch
     stats = DrainStats(gangs=len(gangs))
     if not gangs:
         return {}, stats

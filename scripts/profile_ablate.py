@@ -317,5 +317,57 @@ def main():
         print(f"{label:28s}: min={min(ts)*1e3:7.1f}ms  compile={compile_s:5.1f}s")
 
 
+def portfolio_quality():
+    """Quality ablation for solver.portfolio (round-4 mandate): the
+    contended trap-block scenario solved at P in {1,2,4,8}; prints admitted
+    gangs + mean PlacementScore per width. The portfolio's value is quality
+    under contention, not latency — the headline drain stays P=1."""
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import (
+        bench_topology,
+        contended_backlog,
+        contended_cluster,
+    )
+    from grove_tpu.solver.core import SolverParams, decode_assignments, solve
+    from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.state import build_snapshot
+
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+    from grove_tpu.sim.workloads import binpack_trap_backlog, binpack_trap_cluster
+
+    scenarios = []
+    topo = bench_topology()
+    nodes, squatters = contended_cluster()
+    scenarios.append(("contended", topo, nodes, squatters, contended_backlog(n_gangs=48)))
+    scenarios.append(
+        ("binpack-trap", DEFAULT_CLUSTER_TOPOLOGY, binpack_trap_cluster(), [],
+         binpack_trap_backlog())
+    )
+    for label, stopo, snodes, sbound, backlog in scenarios:
+        gangs, pods = [], {}
+        for pcs in backlog:
+            ds = expand_podcliqueset(pcs, stopo)
+            gangs.extend(ds.podgangs)
+            pods.update({p.name: p for p in ds.pods})
+        snapshot = build_snapshot(snodes, stopo, bound_pods=sbound)
+        batch, decode = encode_gangs(gangs, pods, snapshot)
+        print(f"backend={jax.default_backend()} {label}: {len(gangs)} gangs")
+        for p_width in (1, 2, 4, 8):
+            t0 = time.perf_counter()
+            result = solve(snapshot, batch, SolverParams(), portfolio=p_width)
+            admitted = len(decode_assignments(result, decode, snapshot))
+            ok = np.asarray(result.ok)
+            scores = np.asarray(result.placement_score)[ok]
+            mean_score = float(scores.mean()) if scores.size else 0.0
+            dt = time.perf_counter() - t0
+            print(
+                f"  portfolio={p_width}: admitted={admitted}/{len(gangs)} "
+                f"mean_score={mean_score:.4f} wall={dt:.2f}s (incl. compile)"
+            )
+
+
 if __name__ == "__main__":
-    main()
+    if "--portfolio" in sys.argv:
+        portfolio_quality()
+    else:
+        main()

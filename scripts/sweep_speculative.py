@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""The round-4 speculative-vs-sequential verdict sweep.
+
+Round-3 measured speculative LOSING on-chip at the bench shape (0.86s vs
+0.19s per 64-gang wave); the round-4 mandate: sweep G x contention, and
+either find the regime where the speculative parallel-commit path wins or
+delete it. Warm timings only (compile excluded); prints one row per cell.
+
+Usage: python scripts/sweep_speculative.py  (GROVE_FORCE_CPU=1 honored)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from grove_tpu.utils.platform import ensure_usable_backend
+
+ensure_usable_backend()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.sim.workloads import (
+    bench_topology,
+    synthetic_backlog,
+    synthetic_cluster,
+)
+from grove_tpu.solver.core import (
+    SolverParams,
+    coarse_dmax_of,
+    solve_batch,
+    solve_batch_speculative,
+)
+from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.state import build_snapshot
+
+
+def build(g: int, contention: str):
+    """Problem of G gangs; `contention` scales the fleet so admission is
+    either easy (fleet sized to the backlog) or scarce (half capacity)."""
+    topo = bench_topology()
+    scale = g / 1250.0
+    racks = max(1, round(16 * scale * (0.5 if contention == "scarce" else 1.0)))
+    nodes = synthetic_cluster(racks_per_block=racks)
+    backlog = synthetic_backlog(
+        n_disagg=max(1, round(350 * scale)),
+        n_agg=max(1, round(250 * scale)),
+        n_frontend=max(1, round(300 * scale)),
+    )
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    gangs = gangs[:g]
+    snapshot = build_snapshot(nodes, topo)
+    batch, _ = encode_gangs(
+        gangs, pods, snapshot, max_groups=3, max_sets=5, max_pods=16,
+        pad_gangs_to=g,
+    )
+    return snapshot, batch, len(nodes)
+
+
+def time_solver(fn, snapshot, batch, reps: int = 3) -> tuple[float, int]:
+    free0 = jnp.asarray(snapshot.free)
+    args = (
+        free0,
+        jnp.asarray(snapshot.capacity),
+        jnp.asarray(snapshot.schedulable),
+        jnp.asarray(snapshot.node_domain_id),
+        batch,
+        SolverParams(),
+        None,
+    )
+    dmax = coarse_dmax_of(snapshot)
+    result = fn(*args, coarse_dmax=dmax)
+    jax.block_until_ready(result.ok)  # compile + first run
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        result = fn(*args, coarse_dmax=dmax)
+        jax.block_until_ready(result.ok)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), int(np.asarray(result.ok).sum())
+
+
+def main() -> None:
+    print(f"backend={jax.default_backend()}")
+    for g in (256, 1024, 4096):
+        for contention in ("ample", "scarce"):
+            snapshot, batch, n_nodes = build(g, contention)
+            seq_s, seq_adm = time_solver(solve_batch, snapshot, batch)
+            spec_s, spec_adm = time_solver(solve_batch_speculative, snapshot, batch)
+            verdict = "SPEC WINS" if spec_s < seq_s else "seq wins"
+            row = (
+                f"G={g:5d} {contention:6s} N={n_nodes:5d}  "
+                f"seq={seq_s * 1e3:8.1f}ms ({seq_adm:4d} adm)  "
+                f"spec={spec_s * 1e3:8.1f}ms ({spec_adm:4d} adm)  {verdict}"
+            )
+            print(row, flush=True)
+    print("\nsweep complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -520,3 +520,61 @@ def test_cluster_kwok_deep_topology_requires_explicit_factors():
     assert labels["topology.kubernetes.io/dc"] == "datacenter-1"
     assert labels["topology.kubernetes.io/zone"] == "zone-0"
     assert nodes["kwok-24"]["labels"]["topology.kubernetes.io/zone"] == "zone-1"
+
+
+def test_solver_portfolio_knob_wiring(tmp_path):
+    """solver.portfolio flows to the controller and the backend sidecar;
+    validation rejects bad widths and the speculative conflict."""
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "solver": {"portfolio": 4},
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    assert m.controller.portfolio == 4
+
+    _, errors = parse_operator_config({"solver": {"portfolio": 0}})
+    assert any("solver.portfolio" in e for e in errors)
+    _, errors = parse_operator_config(
+        {"solver": {"portfolio": 4, "speculative": True}}
+    )
+    assert any("mutually exclusive" in e for e in errors)
+
+
+def test_portfolio_controller_schedules_workload(simple1):
+    """A portfolio-configured controller still runs the full reconcile
+    cascade (the serving path exercises parallel/portfolio.py, not just the
+    dryrun)."""
+    from grove_tpu.orchestrator.controller import GroveController
+    from grove_tpu.orchestrator.store import Cluster
+    from grove_tpu.sim import SimConfig, Simulator
+    from grove_tpu.state import Node
+
+    cluster = Cluster()
+    for i in range(8):
+        cluster.nodes[f"n{i}"] = Node(
+            name=f"n{i}",
+            capacity={"cpu": 4.0, "memory": 8 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/block": "b0",
+                "topology.kubernetes.io/rack": f"r{i % 2}",
+            },
+        )
+    cluster.podcliquesets[simple1.metadata.name] = simple1
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+
+    controller = GroveController(
+        cluster=cluster, topology=DEFAULT_CLUSTER_TOPOLOGY, portfolio=2
+    )
+    sim = Simulator(cluster=cluster, controller=controller, config=SimConfig())
+    assert sim.run_until(
+        lambda: bool(cluster.pods)
+        and all(p.is_scheduled for p in cluster.pods.values()),
+        timeout=60,
+    )

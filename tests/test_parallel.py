@@ -122,3 +122,67 @@ def test_tune_solve_step_elitism(problem):
     # a second step from the new generation still solves
     best2, _, _ = tune_solve_step(*args, nxt)
     assert np.asarray(best2.ok).sum() >= np.asarray(best.ok).sum()
+
+
+def test_portfolio_polarity_beats_binpack_trap():
+    """The portfolio's pinned quality delta (round-4 mandate): on the
+    packing-polarity trap the base best-fit solver strands gangs, the
+    P>=2 portfolio (odd slots run worst-fit, params_population) admits
+    everything, and slot-0 elitism guarantees the portfolio never admits
+    FEWER than the base."""
+    import numpy as np
+
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import binpack_trap_backlog, binpack_trap_cluster
+    from grove_tpu.solver.core import SolverParams, solve
+    from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.state import build_snapshot
+
+    topo = DEFAULT_CLUSTER_TOPOLOGY
+    gangs, pods = [], {}
+    for pcs in binpack_trap_backlog():
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    snapshot = build_snapshot(binpack_trap_cluster(), topo)
+    batch, _ = encode_gangs(gangs, pods, snapshot)
+
+    base_admitted = int(np.asarray(solve(snapshot, batch, SolverParams()).ok).sum())
+    assert base_admitted < len(gangs), "trap must bite the base solver"
+    for p_width in (2, 8):
+        r = solve(snapshot, batch, SolverParams(), portfolio=p_width)
+        admitted = int(np.asarray(r.ok).sum())
+        assert admitted == len(gangs), f"P={p_width} admitted {admitted}"
+        assert admitted >= base_admitted  # elitism floor
+
+
+def test_portfolio_solve_matches_contended_ceiling():
+    """On the ceiling-locked contended scenario the portfolio must hold the
+    base solver's admitted count (elitism: slot 0 IS the base)."""
+    import numpy as np
+
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import (
+        bench_topology,
+        contended_backlog,
+        contended_cluster,
+    )
+    from grove_tpu.solver.core import SolverParams, solve
+    from grove_tpu.solver.encode import encode_gangs
+    from grove_tpu.state import build_snapshot
+
+    topo = bench_topology()
+    nodes, squatters = contended_cluster()
+    gangs, pods = [], {}
+    for pcs in contended_backlog(n_gangs=24):
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    snapshot = build_snapshot(nodes, topo, bound_pods=squatters)
+    batch, _ = encode_gangs(gangs, pods, snapshot)
+    base = int(np.asarray(solve(snapshot, batch, SolverParams()).ok).sum())
+    port = int(
+        np.asarray(solve(snapshot, batch, SolverParams(), portfolio=4).ok).sum()
+    )
+    assert port >= base
