@@ -89,16 +89,11 @@ def run_bench() -> dict:
     # Wave 256 measured best on TPU (round 3, batched-harvest loop: total
     # 0.63-0.96s vs 0.93-0.95s at 512); CPU is flat across 64-256.
     wave_size = int(os.environ.get("GROVE_BENCH_WAVE", "256"))
-    # auto: sequential scan EVERYWHERE. Round-2 assumed accelerators want the
-    # speculative parallel commit; round-3 measurement on the chip refuted it
-    # (speculative 0.86s vs sequential 0.19s per 64-gang wave after the
-    # scatter-free aggregation landed — the speculative path's per-round
-    # re-placement multiplier costs more than the sequential scan's depth).
-    spec_env = os.environ.get("GROVE_BENCH_SPECULATIVE", "auto")
-    speculative = spec_env == "1"
     # Portfolio width for the drain (solver.portfolio analog): P weight
     # variants per wave, winner kept. 1 = off (the latency-headline default;
     # the quality delta shows on the contended scenario, scripts/profile_ablate).
+    # (The speculative parallel-commit path was deleted in round 4: refuted
+    # on-chip in round 3 and again by the round-4 G x contention sweep.)
     portfolio = int(os.environ.get("GROVE_BENCH_PORTFOLIO", "1"))
     run_baseline = os.environ.get("GROVE_BENCH_BASELINE", "1") == "1"
 
@@ -133,7 +128,6 @@ def run_bench() -> dict:
         snapshot,
         wave_size=wave_size,
         params=SolverParams(),
-        speculative=speculative,
         portfolio=portfolio,
     )
     total_s = stats.total_s
@@ -173,7 +167,6 @@ def run_bench() -> dict:
         "pods_per_sec": round(pods_per_sec, 1),
         "nodes": len(nodes),
         "wave_size": wave_size,
-        "speculative": speculative,
         "portfolio": portfolio,
         "compile_s": round(stats.compile_s, 2),
         "setup_s": round(setup_s, 2),

@@ -97,5 +97,5 @@ class BackendClient:
     def release_pods(self, pod_names: list[str]) -> pb.ReleasePodsResponse:
         return self._stubs["ReleasePods"](pb.ReleasePodsRequest(pod_names=pod_names))
 
-    def solve(self, speculative: bool = False) -> pb.SolveResponse:
-        return self._stubs["Solve"](pb.SolveRequest(speculative=speculative))
+    def solve(self) -> pb.SolveResponse:
+        return self._stubs["Solve"](pb.SolveRequest())

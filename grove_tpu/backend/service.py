@@ -335,8 +335,10 @@ class TPUSchedulerBackend:
         return pb.ReleasePodsResponse()
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        # request.speculative is accepted and ignored (wire-compat): the
+        # speculative path was deleted in round 4 after losing to the
+        # sequential scan in every measured regime.
         t0 = time.perf_counter()
-        speculative = request.speculative or self._solver_config.speculative
         with self._solve_lock:  # one device solve at a time
             with self._lock:
                 work = self._collect_pending()
@@ -347,7 +349,7 @@ class TPUSchedulerBackend:
                 # without blocking control RPCs. The state may drift
                 # meanwhile; _commit re-validates every binding against the
                 # live state before applying it.
-                solved = self._solve_unlocked(work, speculative)
+                solved = self._solve_unlocked(work)
                 with self._lock:
                     result = self._commit(work, *solved)
         result.solve_micros = int((time.perf_counter() - t0) * 1e6)
@@ -484,7 +486,7 @@ class TPUSchedulerBackend:
             },
         }
 
-    def _solve_unlocked(self, work: dict, speculative: bool):
+    def _solve_unlocked(self, work: dict):
         """No lock held: snapshot build, bucketed encode, device solve, decode."""
         pending = work["pending"]
         snapshot = build_snapshot(
@@ -542,16 +544,12 @@ class TPUSchedulerBackend:
         )
         # solver.portfolio > 1: the sidecar's Solve explores P weight
         # variants and keeps the winner (multi-chip quality path; the
-        # variants shard over the device mesh when one exists). A
-        # speculative Solve request takes precedence for that call since
-        # the two paths are mutually exclusive.
-        portfolio = 1 if speculative else self._solver_config.portfolio
+        # variants shard over the device mesh when one exists).
         result = solve(
             snapshot,
             batch,
             params=self._solver_params,
-            speculative=speculative,
-            portfolio=portfolio,
+            portfolio=self._solver_config.portfolio,
         )
         bindings = decode_assignments(result, decode, snapshot)
 

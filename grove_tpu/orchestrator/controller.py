@@ -74,8 +74,6 @@ class GroveController:
     max_sets: int | None = None
     max_pods: int | None = None
     pad_gangs_to: int | None = None
-    # speculative parallel commit (solve_batch_speculative) vs sequential scan
-    speculative: bool = False
     # portfolio width: >1 solves each wave under P weight variants, winner
     # kept (solver.portfolio; parallel/portfolio.py)
     portfolio: int = 1
@@ -480,11 +478,7 @@ class GroveController:
             spread_avoid_by_gang=spread_avoid,
         )
         result = solve(
-            snapshot,
-            batch,
-            self.solver_params,
-            speculative=self.speculative,
-            portfolio=self.portfolio,
+            snapshot, batch, self.solver_params, portfolio=self.portfolio
         )
         bindings = decode_assignments(result, decode, snapshot)
 

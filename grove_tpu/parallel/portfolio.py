@@ -30,6 +30,7 @@ from grove_tpu.parallel.mesh import (
     portfolio_sharding,
     replicated,
     solver_mesh,
+    solver_mesh_for,
 )
 from grove_tpu.solver.core import SolveResult, SolverParams, solve_batch
 from grove_tpu.solver.encode import GangBatch
@@ -181,6 +182,9 @@ def shard_inputs(mesh, snapshot, batch: GangBatch, params_stack: SolverParams):
     )
 
 
+_AUTO_MESH = object()  # sentinel: "compute the mesh here" (None = unsharded)
+
+
 def portfolio_solve(
     free0,
     capacity,
@@ -191,6 +195,9 @@ def portfolio_solve(
     portfolio: int,
     ok_global=None,
     coarse_dmax: int | None = None,
+    *,
+    pstack: SolverParams | None = None,
+    mesh=_AUTO_MESH,
 ) -> SolveResult:
     """One-stop portfolio solve: population -> mesh layout (when the device
     count admits a valid (P, N)-divisible split, solver_mesh_for) -> winner.
@@ -198,11 +205,17 @@ def portfolio_solve(
     The single entry both serving paths use (solver.core.solve's portfolio
     branch and solver.drain's per-wave closure), so population seeding,
     sharding, and winner selection can never diverge between them.
-    """
-    from grove_tpu.parallel.mesh import solver_mesh_for
 
-    pstack = params_population(portfolio, base=base_params)
-    mesh = solver_mesh_for(portfolio, int(free0.shape[0]))
+    A wave-loop caller (the drain) hoists the invariants by passing
+    `pstack` (the population) and `mesh` (None = stay unsharded) computed
+    ONCE — re-running the RNG and the mesh search per wave would put host
+    work back in the dispatch loop the drain exists to keep clean; the
+    per-wave device_puts of unchanged statics are no-ops.
+    """
+    if pstack is None:
+        pstack = params_population(portfolio, base=base_params)
+    if mesh is _AUTO_MESH:
+        mesh = solver_mesh_for(portfolio, int(free0.shape[0]))
     if mesh is not None:
         (free0, capacity, schedulable, node_domain_id, batch, pstack) = (
             shard_solver_inputs(

@@ -102,17 +102,18 @@ class SchedulingConfig:
 class SolverConfig:
     """The placement engine (no reference analog — the KAI replacement)."""
 
-    speculative: bool = False
     # Portfolio width: >1 solves every batch under P score-weight variants
     # and keeps the winner (parallel/portfolio.py) — the multi-chip quality
     # knob; the variants shard across the device mesh when one is available.
+    # (A `speculative` knob existed through round 3; the path was deleted
+    # after losing to the sequential scan in every measured regime.)
     portfolio: int = 1
     max_groups: Optional[int] = None
     max_sets: Optional[int] = None
     max_pods: Optional[int] = None
     pad_gangs_to: Optional[int] = None
     # Score-weight overrides (SolverParams fields, camelCase: wTight, wPref,
-    # wReuse, wReserve, wJitter, wSpread). Unset fields keep their defaults.
+    # wReuse, wReserve, wSpread). Unset fields keep their defaults.
     weights: dict = field(default_factory=dict)
 
     def solver_params(self):
@@ -218,7 +219,7 @@ class OperatorConfiguration:
 # import the solver). tests/test_config_wiring.py pins this against
 # SolverParams._fields so the two cannot drift.
 _WEIGHT_FIELDS = frozenset(
-    {"w_tight", "w_pref", "w_reuse", "w_reserve", "w_jitter", "w_spread"}
+    {"w_tight", "w_pref", "w_reuse", "w_reserve", "w_spread"}
 )
 
 _SECTION_TYPES = {
@@ -266,7 +267,6 @@ _CAMEL_FIELDS = {
     "wPref": "w_pref",
     "wReuse": "w_reuse",
     "wReserve": "w_reserve",
-    "wJitter": "w_jitter",
     "wSpread": "w_spread",
     "kubeconfig": "kubeconfig",
     "kubeContext": "kube_context",
@@ -396,11 +396,6 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
     pf = cfg.solver.portfolio
     if not isinstance(pf, int) or isinstance(pf, bool) or pf < 1:
         errors.append("solver.portfolio: must be an int >= 1")
-    elif pf > 1 and cfg.solver.speculative:
-        errors.append(
-            "solver.portfolio: mutually exclusive with solver.speculative "
-            "(the portfolio already explores commit variants)"
-        )
     if not isinstance(cfg.solver.weights, dict):
         errors.append("solver.weights: must be a mapping of weight -> number")
     elif cfg.solver.weights:
@@ -421,11 +416,6 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             seen_weights[field_name] = wk
             if not isinstance(wv, (int, float)) or isinstance(wv, bool) or not _math.isfinite(float(wv)):
                 errors.append(f"solver.weights.{wk}: {wv!r} is not a finite number")
-            elif field_name == "w_jitter" and wv < 0:
-                errors.append(
-                    f"solver.weights.{wk}: must be >= 0 (negative is the "
-                    "internal AUTO sentinel)"
-                )
     cl = cfg.cluster
     if cl.source not in ("none", "kwok", "kubernetes"):
         errors.append(

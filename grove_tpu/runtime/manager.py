@@ -337,7 +337,6 @@ class Manager:
             max_sets=config.solver.max_sets,
             max_pods=config.solver.max_pods,
             pad_gangs_to=config.solver.pad_gangs_to,
-            speculative=config.solver.speculative,
             portfolio=config.solver.portfolio,
             auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
             slice_resource_name=config.network_acceleration.slice_resource_name,
@@ -550,7 +549,7 @@ class Manager:
             from grove_tpu.backend.service import create_server
 
             # create_server builds AND starts the gRPC server; the solver
-            # section configures its bucketing + speculative defaults.
+            # section configures its bucketing + portfolio defaults.
             self._backend_server, self.backend_port = create_server(
                 port=cfg.backend.port,
                 max_workers=cfg.backend.max_workers,

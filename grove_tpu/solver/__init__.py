@@ -6,7 +6,6 @@ from grove_tpu.solver.core import (  # noqa: F401
     decode_assignments,
     solve,
     solve_batch,
-    solve_batch_speculative,
 )
 from grove_tpu.solver.encode import GangBatch, GangDecodeInfo, encode_gangs  # noqa: F401
 from grove_tpu.solver.drain import DrainStats, drain_backlog, plan_waves  # noqa: F401

@@ -54,35 +54,6 @@ class SolverParams(NamedTuple):
     # whose spread-level domain already hosts a sibling replica's base gang.
     # Soft by design — spread yields to Required packs and to feasibility.
     w_spread: jnp.float32 = 1.5
-    # Deterministic per-gang score jitter that decorrelates speculative
-    # parallel placements: without it every gang in a wave picks the same
-    # best-fit nodes/domains and the conflict chain degenerates to sequential
-    # commits. The default -1.0 means AUTO: 0 on the sequential path (which
-    # gains nothing and would pay bin-packing quality), SPECULATIVE_JITTER on
-    # the speculative path. An explicit value — including 0.0 — is honored on
-    # both paths, so jitter can actually be turned off.
-    w_jitter: jnp.float32 = -1.0
-
-
-# Jitter used by the speculative path when params.w_jitter is AUTO (measured
-# sweet spot: strong enough to spread colliding gangs across near-equal
-# domains, weak enough to keep packing tight).
-SPECULATIVE_JITTER = 0.15
-
-
-def _weyl_jitter(seed: jax.Array, count: int) -> jax.Array:
-    """Deterministic pseudo-jitter in [0, 1), shaped [count].
-
-    Hashed in uint32 integer space — a float32 Weyl sequence loses all
-    fractional resolution once seed*phi exceeds ~2^20 (exactly the
-    index + round*G seeds the speculative re-roll uses), silently turning
-    the decorrelation into a constant."""
-    idx = jnp.arange(count, dtype=jnp.uint32)
-    h = seed.astype(jnp.uint32) * jnp.uint32(2654435761) + idx * jnp.uint32(0x9E3779B9)
-    h = h ^ (h >> 16)
-    h = h * jnp.uint32(0x7FEB352D)
-    h = h ^ (h >> 15)
-    return h.astype(jnp.float32) * jnp.float32(1.0 / 4294967296.0)
 
 
 class SolveResult(NamedTuple):
@@ -392,13 +363,11 @@ def _place_gang(
                 jnp.where(ok_nodes & taken_node, 1.0, 0.0)[:, None], level
             )[:, 0] / jnp.maximum(dom_count, 1.0)
             # Best fit on normalized free (raw sums would let memory bytes
-            # drown cpu/chip counts), perturbed by per-gang jitter so
-            # concurrent speculative gangs spread across near-equal domains.
+            # drown cpu/chip counts).
             norm_free = (dom_free / cap_scale[None, :]).sum(axis=-1)
-            dj = _weyl_jitter(gang["index"] * 7919 + level, n)
             score = jnp.where(
                 feasible,
-                -norm_free * (1.0 + params.w_jitter * dj) - params.w_reserve * taken_frac,
+                -norm_free - params.w_reserve * taken_frac,
                 -jnp.inf,
             )
             if spread_pen is not None:
@@ -406,14 +375,14 @@ def _place_gang(
                 # stage-2 node scoring: best-fit actively prefers the tighter
                 # domain, which is exactly the one the sibling already
                 # occupies. The margin must dominate every other score term —
-                # norm_free (<= n*r) INCLUDING its jitter multiplier, plus
-                # w_reserve * taken_frac (<= w_reserve) — so any feasible
-                # domain with no avoided nodes beats any with them, while
-                # infeasible domains stay -inf (spread remains soft).
+                # norm_free (<= n*r) plus w_reserve * taken_frac
+                # (<= w_reserve) — so any feasible domain with no avoided
+                # nodes beats any with them, while infeasible domains stay
+                # -inf (spread remains soft).
                 touched = agg_by_domain(
                     jnp.where(ok_nodes, spread_pen, 0.0)[:, None], level
                 )[:, 0] > 0.5
-                big = n * r * (1.0 + params.w_jitter) + params.w_reserve + 2.0
+                big = n * r + params.w_reserve + 2.0
                 score = score - jnp.where(params.w_spread > 0, big, 0.0) * touched
             return jnp.argmax(score), feasible.any()
 
@@ -492,7 +461,6 @@ def _place_gang(
             + params.w_reuse * used.astype(jnp.float32)
             - params.w_tight * norm_free
             - params.w_reserve * reserved
-            + params.w_jitter * _weyl_jitter(gang["index"] * 31 + g, n)
         )
         if spread_pen is not None:
             score = score - params.w_spread * spread_pen
@@ -583,11 +551,6 @@ def solve_batch(
     solve() wrapper does. None falls back to segment-sum (fine on CPU)."""
     n = free0.shape[0]
     g = batch.gang_valid.shape[0]
-    # AUTO jitter (w_jitter < 0) resolves to 0 on this path — the sequential
-    # scan gains nothing from decorrelation and would pay packing quality.
-    params = params._replace(
-        w_jitter=jnp.maximum(jnp.asarray(params.w_jitter, jnp.float32), 0.0)
-    )
     cap_scale = jnp.maximum(capacity.max(axis=0), 1e-9)  # [R]
     gang_valid0 = _apply_global_deps(batch, ok_global)
     coarse_onehot = (
@@ -676,168 +639,6 @@ def solve_batch(
     )
 
 
-@partial(jax.jit, static_argnames=("coarse_dmax",))
-def solve_batch_speculative(
-    free0: jax.Array,  # f32 [N, R]
-    capacity: jax.Array,  # f32 [N, R]
-    schedulable: jax.Array,  # bool [N]
-    node_domain_id: jax.Array,  # i32 [L, N]
-    batch: GangBatch,
-    params: SolverParams = SolverParams(),
-    ok_global: jax.Array | None = None,  # bool [T] cross-wave verdict bitmap
-    coarse_dmax: int | None = None,  # static max domains over non-host levels
-) -> SolveResult:
-    """Speculative parallel commit: place the whole batch at once, keep the
-    conflict-free subset, loop on the rest.
-
-    The sequential scan in `solve_batch` pays O(G) per-gang latency because
-    each gang must see the previous gang's capacity updates. But placements
-    rarely collide on a large cluster — so place ALL undecided gangs in
-    parallel (vmap) against the current free capacity, then:
-
-      - prefix-feasible commit: with gangs in batch (priority) order, gang g
-        commits when, on every (node, resource) IT uses, the cumulative
-        speculative usage of gangs <= g fits within free capacity. The
-        committed set is jointly feasible: for any node, the last committed
-        gang using it has a cumulative that upper-bounds the committed total
-        there. The first admitted gang always commits (its cumulative is its
-        own feasible placement), so every round makes progress — and
-        independent sub-batches (different racks) commit concurrently
-      - the per-gang score jitter is re-rolled each round (seed folds in the
-        round number), so gangs that collided re-spread across near-equal
-        nodes/domains instead of re-picking the same ones — randomized
-        backoff for placement
-      - a placeable gang whose own placement failed is rejected finally
-        (free only shrinks; all-or-nothing is preserved exactly)
-      - a scaled gang waits (stays undecided, consumes nothing) until its
-        base gang is decided, then follows the same path (syncflow.go:347-387)
-
-    Worst case (every gang fighting for one node) degenerates toward the
-    sequential scan's behavior over `lax.while_loop` rounds; the common case
-    converges in a handful of rounds, each costing ~one parallel placement.
-    Admission can differ from `solve_batch` only in contended corners (commit
-    order differs); the gang invariants — all-or-nothing, capacity never
-    oversubscribed, dependency gating — hold identically.
-    """
-    n = free0.shape[0]
-    g = batch.gang_valid.shape[0]
-    mp = batch.pod_group.shape[1]
-    cap_scale = jnp.maximum(capacity.max(axis=0), 1e-9)
-    gang_valid0 = _apply_global_deps(batch, ok_global)
-    coarse_onehot = (
-        None if coarse_dmax is None else _coarse_onehot_stack(node_domain_id, coarse_dmax)
-    )
-    # Speculation needs score decorrelation; honor an explicit caller value.
-    params = params._replace(
-        w_jitter=jnp.where(
-            jnp.asarray(params.w_jitter) >= 0, params.w_jitter, SPECULATIVE_JITTER
-        )
-    )
-
-    gang_dict = {
-        "group_req": batch.group_req,
-        "group_total": batch.group_total,
-        "group_required": batch.group_required,
-        "group_valid": batch.group_valid,
-        "set_member": batch.set_member,
-        "set_req_level": batch.set_req_level,
-        "set_pref_level": batch.set_pref_level,
-        "set_valid": batch.set_valid,
-        "set_pinned": batch.set_pinned,
-        "pod_group": batch.pod_group,
-        "pod_rank": batch.pod_rank,
-        "gang_valid": gang_valid0,
-        "group_order": batch.group_order,
-        "depends_on": batch.depends_on,
-        "index": jnp.arange(g, dtype=jnp.int32),
-        "reuse": _reuse_of(batch, n),
-    }
-    if batch.group_node_ok is not None:
-        gang_dict["group_node_ok"] = batch.group_node_ok
-
-    # Replica spread in speculative mode is SEED-ONLY: gangs place in
-    # parallel, so the in-batch family carry of the sequential scan has no
-    # analog here — sibling repulsion applies to nodes already live in the
-    # store (spread_avoid), not to siblings placed in this same batch.
-    has_spread = batch.spread_level is not None
-    if has_spread:
-        gang_dict["spread_level"] = batch.spread_level
-        gang_dict["spread_family"] = batch.spread_family
-        gang_dict["spread_avoid"] = batch.spread_avoid
-
-    def place_one(free, gang_slices):
-        used0 = gang_slices["reuse"]  # ReuseReservationRef seed (see solve_batch)
-        free_out, _, assigned, ok, score = _place_gang(
-            free,
-            used0,
-            gang_slices,
-            schedulable=schedulable,
-            node_domain_id=node_domain_id,
-            cap_scale=cap_scale,
-            params=params,
-            coarse_onehot=coarse_onehot,
-            spread_avoid=gang_slices["spread_avoid"] if has_spread else None,
-        )
-        usage = jnp.where(ok, free - free_out, 0.0)  # [N, R]
-        return usage, assigned, ok, score
-
-    place_all = jax.vmap(place_one, in_axes=(None, 0))
-
-    dep = batch.depends_on  # [G]
-    dep_idx = jnp.clip(dep, 0, g - 1)
-
-    def cond(state):
-        free, decided, ok_final, assigned, scores, rounds = state
-        return (~decided).any() & (rounds < g + 1)
-
-    def body(state):
-        free, decided, ok_final, assigned, scores, rounds = state
-        # Dependency gate: no dep, or dep decided (then its verdict applies).
-        dep_decided = jnp.where(dep >= 0, decided[dep_idx], True)
-        dep_ok = jnp.where(dep >= 0, ok_final[dep_idx], True)
-        placeable = ~decided & dep_decided
-        gd = dict(gang_dict)
-        gd["gang_valid"] = gd["gang_valid"] & placeable & dep_ok
-        gd["index"] = gang_dict["index"] + rounds * g  # re-roll jitter per round
-        usage, assigned_r, ok_r, scores_r = place_all(free, gd)
-
-        # Prefix-feasible commit (see docstring): cumulative usage in batch
-        # order; a gang commits iff its own footprint stays within free.
-        cum = jnp.cumsum(usage, axis=0)  # [G, N, R]
-        violates = ((usage > 0) & (cum > free[None, :, :] + _EPS)).any(axis=(1, 2))
-        commit = ok_r & ~violates
-
-        free = free - jnp.where(commit[:, None, None], usage, 0.0).sum(axis=0)
-        # Finalize: committed gangs, and placeable gangs that failed outright
-        # (incl. dep-rejected). Conflicted non-head gangs stay undecided.
-        rejected_now = placeable & ~ok_r
-        newly = commit | rejected_now
-        assigned = jnp.where((newly & ok_r)[:, None], assigned_r, assigned)
-        scores = jnp.where(newly & ok_r, scores_r, scores)
-        ok_final = ok_final | (newly & ok_r & commit)
-        decided = decided | newly
-        return (free, decided, ok_final, assigned, scores, rounds + 1)
-
-    init = (
-        free0,
-        ~gang_valid0,  # invalid/padding gangs are pre-decided as rejected
-        jnp.zeros((g,), dtype=bool),
-        jnp.full((g, mp), -1, dtype=jnp.int32),
-        jnp.zeros((g,), dtype=jnp.float32),
-        jnp.asarray(0, dtype=jnp.int32),
-    )
-    free_f, decided, ok_final, assigned, scores, _ = jax.lax.while_loop(cond, body, init)
-    assigned = jnp.where(ok_final[:, None], assigned, -1)
-    scores = jnp.where(ok_final, scores, 0.0)
-    return SolveResult(
-        assigned=assigned,
-        ok=ok_final,
-        placement_score=scores,
-        free_after=free_f,
-        ok_global=_scatter_global_ok(batch, ok_final, ok_global),
-    )
-
-
 def coarse_dmax_of(snapshot) -> int | None:
     """Static bound on domains per non-host level, selecting the aggregation
     strategy for the backend the solve will run on:
@@ -862,7 +663,6 @@ def solve(
     snapshot,
     batch: GangBatch,
     params: SolverParams = SolverParams(),
-    speculative: bool = False,
     free: jax.Array | None = None,
     schedulable: jax.Array | None = None,
     ok_global: jax.Array | None = None,
@@ -875,10 +675,17 @@ def solve(
     bitmap (see solve_batch).
 
     `portfolio` > 1 solves the batch under P score-weight variants (base +
-    log-normal perturbations, parallel/portfolio.py) and keeps the winner by
-    (admitted count, quality) — the multi-chip quality path (solver.portfolio
-    config knob): on a multi-device mesh the variants ride the portfolio
-    axis; on one device they vmap into a single batched program.
+    polarity-diverse perturbations, parallel/portfolio.py) and keeps the
+    winner by (admitted count, quality) — the multi-chip quality path
+    (solver.portfolio config knob): on a multi-device mesh the variants ride
+    the portfolio axis; on one device they vmap into a single batched
+    program.
+
+    (A speculative parallel-commit path existed through round 3; it was
+    deleted after losing to the sequential scan in every measured regime —
+    on-chip at the bench shape and a CPU G x contention sweep where its
+    per-round re-placement multiplier grew the gap with G. See git history
+    for scripts/sweep_speculative.py.)
     """
     free0 = jnp.asarray(snapshot.free if free is None else free)
     capacity = jnp.asarray(snapshot.capacity)
@@ -886,11 +693,6 @@ def solve(
     node_domain_id = jnp.asarray(snapshot.node_domain_id)
     jbatch = GangBatch(*(None if x is None else jnp.asarray(x) for x in batch))
     if portfolio > 1:
-        if speculative:
-            raise ValueError(
-                "solver.portfolio and solver.speculative are mutually "
-                "exclusive (config validation enforces this)"
-            )
         from grove_tpu.parallel.portfolio import portfolio_solve
 
         return portfolio_solve(
@@ -904,8 +706,7 @@ def solve(
             ok_global,
             coarse_dmax=coarse_dmax_of(snapshot),
         )
-    fn = solve_batch_speculative if speculative else solve_batch
-    return fn(
+    return solve_batch(
         free0,
         capacity,
         sched,

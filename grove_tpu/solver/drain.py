@@ -34,7 +34,6 @@ from grove_tpu.solver.core import (
     coarse_dmax_of,
     decode_bindings,
     solve_batch,
-    solve_batch_speculative,
 )
 from grove_tpu.solver.encode import encode_gangs, gang_shape, next_pow2
 
@@ -90,7 +89,6 @@ def drain_backlog(
     *,
     wave_size: int = 256,
     params: SolverParams | None = None,
-    speculative: bool = False,
     portfolio: int = 1,
     warm: bool = True,
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
@@ -112,21 +110,28 @@ def drain_backlog(
 
     params = params or SolverParams()
     if portfolio > 1:
-        if speculative:
-            raise ValueError("portfolio and speculative are mutually exclusive")
         # Per-wave portfolio: every wave solved under P weight variants, the
-        # winner's free_after/ok chained forward (solver.portfolio knob;
-        # the shared portfolio_solve handles population + mesh layout, so
-        # the drain distributes exactly like the operator path).
-        from grove_tpu.parallel.portfolio import portfolio_solve
+        # winner's free_after/ok chained forward (solver.portfolio knob; the
+        # shared portfolio_solve handles layout, so the drain distributes
+        # exactly like the operator path). Population + mesh are hoisted —
+        # computed once here, not per wave inside the dispatch loop.
+        from grove_tpu.parallel.mesh import solver_mesh_for
+        from grove_tpu.parallel.portfolio import (
+            params_population,
+            portfolio_solve,
+        )
+
+        pstack = params_population(portfolio, base=params)
+        mesh = solver_mesh_for(portfolio, int(snapshot.free.shape[0]))
 
         def solver(f, c, s, nd, b, p, okg=None, coarse_dmax=None):
             return portfolio_solve(
-                f, c, s, nd, b, p, portfolio, okg, coarse_dmax=coarse_dmax
+                f, c, s, nd, b, p, portfolio, okg, coarse_dmax=coarse_dmax,
+                pstack=pstack, mesh=mesh,
             )
 
     else:
-        solver = solve_batch_speculative if speculative else solve_batch
+        solver = solve_batch
     stats = DrainStats(gangs=len(gangs))
     if not gangs:
         return {}, stats

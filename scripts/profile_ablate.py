@@ -136,8 +136,7 @@ def place_gang_ablated(free, gang, *, schedulable, node_domain_id, cap_scale,
                 if check_nested and "nested" not in ablate:
                     feasible = feasible & nested_feasible(level, ok_nodes)
                 norm_free = (dom_free / cap_scale[None, :]).sum(axis=-1)
-                dj = C._weyl_jitter(gang["index"] * 7919 + level, n)
-                score = jnp.where(feasible, -norm_free * (1.0 + params.w_jitter * dj), -jnp.inf)
+                score = jnp.where(feasible, -norm_free, -jnp.inf)
                 return jnp.argmax(score), feasible.any()
 
             req_dom = node_domain_id[jnp.clip(req_level, 0, levels - 1)]
@@ -205,7 +204,6 @@ def place_gang_ablated(free, gang, *, schedulable, node_domain_id, cap_scale,
                 params.w_pref * pref_bonus
                 - params.w_tight * norm_free
                 - params.w_reserve * reserved
-                + params.w_jitter * C._weyl_jitter(gang["index"] * 31 + g_, n)
             )
             k = min(n, mp_bound)
             masked_score = jnp.where(slots > 0, score, -jnp.inf)

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""Localize TPU solve time: per-wave device time, sequential vs speculative,
-wave-size sweep, encode/decode host cost, speculative round count.
+"""Localize TPU solve time: per-wave device time, wave-size sweep,
+encode/decode host cost.
 
 Round-3 instrument for VERDICT.md weak #1 (p99 54.9s on chip vs 3.87s CPU).
 Usage: python scripts/profile_solver.py [--waves 4] [--sizes 16,64,256]
@@ -38,7 +38,6 @@ def main() -> None:
         coarse_dmax_of,
         decode_assignments,
         solve_batch,
-        solve_batch_speculative,
     )
     from grove_tpu.solver.encode import encode_gangs
     from grove_tpu.state import build_snapshot
@@ -91,7 +90,7 @@ def main() -> None:
             )
         enc_s = (time.perf_counter() - t0) / nw
 
-        for name, solver in (("seq", solve_batch), ("spec", solve_batch_speculative)):
+        for name, solver in (("seq", solve_batch),):
             free_arr = jnp.asarray(snapshot.free)
             ok_g = jnp.zeros((len(gangs),), dtype=bool)
             # compile

@@ -95,10 +95,10 @@ def test_sync_not_blocked_by_inflight_solve(monkeypatch):
     entered = threading.Event()
     orig = b._solve_unlocked
 
-    def slow_solve(work, speculative):
+    def slow_solve(work):
         entered.set()
         assert release.wait(timeout=30), "test deadlock"
-        return orig(work, speculative)
+        return orig(work)
 
     monkeypatch.setattr(b, "_solve_unlocked", slow_solve)
     t = threading.Thread(target=lambda: b.Solve(pb.SolveRequest(), _Ctx()))
@@ -121,8 +121,8 @@ def test_gang_deleted_mid_solve_not_committed(monkeypatch):
 
     orig = b._solve_unlocked
 
-    def delete_during_solve(work, speculative):
-        out = orig(work, speculative)
+    def delete_during_solve(work):
+        out = orig(work)
         # The gang vanishes between the device phase and the commit phase.
         b.OnPodGangDelete(pb.OnPodGangDeleteRequest(name="doomed"), _Ctx())
         return out
@@ -142,8 +142,8 @@ def test_node_removed_mid_solve_drops_whole_gang(monkeypatch):
     orig = b._solve_unlocked
     fired = {"done": False}
 
-    def shrink_during_solve(work, speculative):
-        out = orig(work, speculative)
+    def shrink_during_solve(work):
+        out = orig(work)
         if fired["done"]:
             return out
         fired["done"] = True
@@ -207,8 +207,8 @@ def test_spec_drift_mid_solve_not_committed(monkeypatch):
     orig = b._solve_unlocked
     fired = {"done": False}
 
-    def resync_during_solve(work, speculative):
-        out = orig(work, speculative)
+    def resync_during_solve(work):
+        out = orig(work)
         if not fired["done"]:
             fired["done"] = True
             b.SyncPodGang(
@@ -234,8 +234,8 @@ def test_cordon_mid_solve_not_committed(monkeypatch):
     orig = b._solve_unlocked
     fired = {"done": False}
 
-    def cordon_during_solve(work, speculative):
-        out = orig(work, speculative)
+    def cordon_during_solve(work):
+        out = orig(work)
         if not fired["done"]:
             fired["done"] = True
             used = set(out[0].get("g", {}).values())
@@ -280,8 +280,11 @@ def test_priority_classes_order_backend_solve():
     assert not by_name["a-low"].admitted
 
 
-def test_config_speculative_default_applies():
-    b = _backend(cfg=SolverConfig(speculative=True))
+def test_deprecated_speculative_flag_is_ignored():
+    """SolveRequest.speculative survives on the wire (deprecated, never
+    renumbered) but no longer selects a solver path — the speculative
+    engine was deleted after losing every measured regime."""
+    b = _backend()
     b.SyncPodGang(pb.SyncPodGangRequest(pod_gang=_gang_spec("s", n_pods=2)), _Ctx())
-    resp = b.Solve(pb.SolveRequest(), _Ctx())  # request leaves speculative unset
+    resp = b.Solve(pb.SolveRequest(speculative=True), _Ctx())
     assert [g for g in resp.gangs if g.admitted and g.name == "s"]

@@ -31,21 +31,21 @@ def _mgr(tmp_path, extra=None):
     return Manager(cfg)
 
 
-# --- solver.speculative + padGangsTo ----------------------------------------------
+# --- solver knobs (padGangsTo, portfolio) -----------------------------------------
 
 
 def test_solver_knobs_reach_controller(tmp_path):
-    m = _mgr(tmp_path, {"solver": {"speculative": True, "padGangsTo": 8}})
-    assert m.controller.speculative is True
+    m = _mgr(tmp_path, {"solver": {"portfolio": 2, "padGangsTo": 8}})
+    assert m.controller.portfolio == 2
     assert m.controller.pad_gangs_to == 8
 
 
 def test_solver_knobs_flow_through_solve(tmp_path, simple1):
-    """solve_pending runs the speculative path with a padded batch and still
-    binds everything."""
+    """solve_pending runs a padded portfolio batch and still binds
+    everything."""
     from grove_tpu.sim.workloads import bench_topology, synthetic_cluster
 
-    m = _mgr(tmp_path, {"solver": {"speculative": True, "padGangsTo": 4}})
+    m = _mgr(tmp_path, {"solver": {"portfolio": 2, "padGangsTo": 4}})
     m.cluster.podcliquesets[simple1.metadata.name] = simple1
     for node in synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=2):
         m.cluster.nodes[node.name] = node
@@ -54,7 +54,7 @@ def test_solver_knobs_flow_through_solve(tmp_path, simple1):
     outcome = m.reconcile_once(now=1.0)
     assert not outcome.has_errors
     gated = [p for p in m.cluster.pods.values() if p.is_gated]
-    assert not gated  # everything got bound via the speculative path
+    assert not gated  # everything got bound via the portfolio path
 
 
 # --- persistence.snapshotIntervalSeconds ------------------------------------------
@@ -453,22 +453,17 @@ def test_weight_fields_match_solver_params():
     assert _WEIGHT_FIELDS == frozenset(SolverParams._fields)
 
 
-def test_weight_duplicate_and_negative_jitter_rejected():
+def test_weight_duplicate_and_removed_jitter_rejected():
     _, errors = parse_operator_config(
         {"solver": {"weights": {"wPref": 9.0, "w_pref": 2.0}}}
     )
     assert any("duplicate" in e for e in errors)
+    # wJitter rode the deleted speculative path; it is now an unknown weight
+    # (loud, not silently ignored).
     _, errors = parse_operator_config(
-        {"solver": {"weights": {"wJitter": -0.5}}}
+        {"solver": {"weights": {"wJitter": 0.1}}}
     )
-    assert any("AUTO" in e for e in errors)
-    # Explicit zero jitter is legal and must be honored even in speculative
-    # mode (AUTO substitution keys on the NEGATIVE sentinel, not on zero).
-    cfg, errors = parse_operator_config(
-        {"solver": {"weights": {"wJitter": 0.0}, "speculative": True}}
-    )
-    assert not errors
-    assert float(cfg.solver.solver_params().w_jitter) == 0.0
+    assert any("unknown weight" in e for e in errors)
 
 
 def test_cluster_kwok_deep_topology_requires_explicit_factors():
@@ -524,7 +519,7 @@ def test_cluster_kwok_deep_topology_requires_explicit_factors():
 
 def test_solver_portfolio_knob_wiring(tmp_path):
     """solver.portfolio flows to the controller and the backend sidecar;
-    validation rejects bad widths and the speculative conflict."""
+    validation rejects bad widths."""
     from grove_tpu.runtime.manager import Manager
 
     cfg, errors = parse_operator_config(
@@ -540,10 +535,9 @@ def test_solver_portfolio_knob_wiring(tmp_path):
 
     _, errors = parse_operator_config({"solver": {"portfolio": 0}})
     assert any("solver.portfolio" in e for e in errors)
-    _, errors = parse_operator_config(
-        {"solver": {"portfolio": 4, "speculative": True}}
-    )
-    assert any("mutually exclusive" in e for e in errors)
+    # The deleted speculative knob is now an unknown field (loud).
+    _, errors = parse_operator_config({"solver": {"speculative": True}})
+    assert errors
 
 
 def test_portfolio_controller_schedules_workload(simple1):

@@ -113,3 +113,29 @@ def test_plan_waves_class_order_follows_input_order():
     waves_b = plan_waves(list(reversed(frontend_first)), wave_size=64)
     assert waves_a[0][0][0].name == frontend_first[0].name
     assert waves_b[0][0][0].name != frontend_first[0].name
+
+
+def test_drain_portfolio_beats_binpack_trap(simple1):
+    """drain_backlog(portfolio=P) runs every wave through the shared
+    portfolio solve: on the packing-polarity trap the base drain strands
+    gangs, P=2 admits all (coverage for the drain's portfolio closure —
+    hand-adapted to solve_batch's calling convention — and its hoisted
+    population/mesh)."""
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import binpack_trap_backlog, binpack_trap_cluster
+    from grove_tpu.state import build_snapshot
+
+    topo = DEFAULT_CLUSTER_TOPOLOGY
+    gangs, pods = [], {}
+    for pcs in binpack_trap_backlog():
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    snapshot = build_snapshot(binpack_trap_cluster(), topo)
+
+    _, base_stats = drain_backlog(gangs, pods, snapshot)
+    assert base_stats.admitted < len(gangs), "trap must bite the base drain"
+    bindings, stats = drain_backlog(gangs, pods, snapshot, portfolio=2)
+    assert stats.admitted == len(gangs)
+    assert sum(len(b) for b in bindings.values()) == 12

@@ -65,16 +65,17 @@ def test_chained_base_rejected_gates_scaled(simple1):
     assert not bool(np.asarray(r2.ok).any())
 
 
-def test_chained_speculative_matches(simple1):
-    """The speculative solver honors the same cross-wave gate."""
+def test_chained_portfolio_matches(simple1):
+    """The portfolio solve honors the same cross-wave gate (ok_global is
+    shared by every member; the winner's chain is the committed one)."""
     snap, pods, base, scaled, gidx, total = _setup(simple1)
     ok_g = jnp.zeros((total,), dtype=bool)
     b1, _ = encode_gangs(base, pods, snap, global_index_of=gidx)
-    r1 = solve(snap, b1, speculative=True, ok_global=ok_g)
+    r1 = solve(snap, b1, portfolio=2, ok_global=ok_g)
     assert bool(np.asarray(r1.ok).all())
     b2, _ = encode_gangs(scaled, pods, snap, global_index_of=gidx)
     r2 = solve(
-        snap, b2, speculative=True, free=r1.free_after, ok_global=r1.ok_global
+        snap, b2, portfolio=2, free=r1.free_after, ok_global=r1.ok_global
     )
     assert bool(np.asarray(r2.ok).all())
 
