@@ -283,9 +283,14 @@ def pod_payload(obj: dict) -> dict:
 
 @dataclass
 class _ResourceWatch:
-    kind: str  # "Node" | "Pod"
+    kind: str  # "Node" | "Pod" | "PodCliqueSet"
     list_path: str  # e.g. /api/v1/nodes
     selector: str = ""  # labelSelector value, if any
+    # 404 on the LIST means the resource type itself is absent (the grove.io
+    # CRD not installed): back off this long instead of hot-looping, and log
+    # the condition once, not per retry.
+    missing_backoff_s: float = 1.0
+    _missing_logged: bool = False
 
 
 class KubernetesWatchSource:
@@ -304,6 +309,7 @@ class KubernetesWatchSource:
         pod_manifest_for: Optional[Callable[[str], Optional[dict]]] = None,
         request_timeout_s: float = 10.0,
         watch_read_timeout_s: float = 30.0,
+        watch_workloads: bool = True,
     ):
         if pod_label_selector is None:
             pod_label_selector = DEFAULT_POD_LABEL_SELECTOR
@@ -317,10 +323,23 @@ class KubernetesWatchSource:
         self._watch_read_timeout_s = watch_read_timeout_s
         ns = urllib.parse.quote(ctx.namespace)
         self._pods_path = f"/api/v1/namespaces/{ns}/pods"
+        # The user workload API over the SAME apiserver: PodCliqueSet CRs
+        # arrive by watch exactly as the reference's controllers see them
+        # (kubectl apply -> etcd -> watch, SURVEY §3.2-3.3); reconciled
+        # status is written back to the CR's status subresource.
+        self._pcs_path = (
+            f"/apis/grove.io/v1alpha1/namespaces/{ns}/podcliquesets"
+        )
         self._watches = [
             _ResourceWatch("Node", "/api/v1/nodes"),
             _ResourceWatch("Pod", self._pods_path, selector=pod_label_selector),
         ]
+        if watch_workloads:
+            self._watches.append(
+                _ResourceWatch(
+                    "PodCliqueSet", self._pcs_path, missing_backoff_s=30.0
+                )
+            )
         # Wire-visible error log (last few), surfaced via statusz/tests.
         self.errors: list[str] = []
 
@@ -386,6 +405,28 @@ class KubernetesWatchSource:
             return False
         return True
 
+    def publish_workload_status(self, name: str, status: dict):
+        """Write reconciled status back to the PodCliqueSet CR's status
+        subresource (the reference persists status the same way,
+        reconcilestatus.go). GET-then-PUT with the live resourceVersion.
+
+        Returns True on success, None when no such CR exists at the
+        apiserver (a store-only workload applied via the operator's own
+        HTTP API — nothing to write to; the caller must NOT retry until
+        the status changes, or every tick pays a doomed GET), and False on
+        transient failures (conflict/wire) that should retry next tick."""
+        try:
+            cur = self._request("GET", f"{self._pcs_path}/{name}")
+            cur["status"] = status
+            self._request("PUT", f"{self._pcs_path}/{name}/status", cur)
+        except (KubeApiError, OSError, ValueError) as e:
+            if isinstance(e, KubeApiError) and e.status == 404:
+                return None
+            if not (isinstance(e, KubeApiError) and e.status == 409):
+                self._record_error(f"status write {name}: {e}")
+            return False
+        return True
+
     def observe_deletion(self, pod_name: str, now: float) -> bool:
         try:
             self._request("DELETE", f"{self._pods_path}/{pod_name}")
@@ -408,10 +449,25 @@ class KubernetesWatchSource:
         while not self._stop.is_set():
             try:
                 rv, names = self._list(rw, known)
+                rw._missing_logged = False
                 known = names
                 while not self._stop.is_set():
                     rv = self._stream_watch(rw, rv, known)
             except (OSError, KubeApiError, json.JSONDecodeError) as e:
+                if isinstance(e, KubeApiError) and e.status == 404:
+                    # Resource type absent (CRD not installed): long
+                    # backoff, one log line — not a hot loop that drowns
+                    # real Node/Pod errors out of the 20-entry buffer.
+                    if not rw._missing_logged:
+                        rw._missing_logged = True
+                        self._record_error(
+                            f"{rw.kind} watch: resource absent at the "
+                            f"apiserver (404); retrying every "
+                            f"{rw.missing_backoff_s:.0f}s"
+                        )
+                    if self._stop.wait(rw.missing_backoff_s):
+                        return
+                    continue
                 self._record_error(f"{rw.kind} watch: {e}")
                 if self._stop.wait(1.0):
                     return
@@ -494,7 +550,12 @@ class KubernetesWatchSource:
     def _emit(self, etype: EventType, kind: str, name: str, obj: dict) -> None:
         payload: dict = {}
         if etype != EventType.DELETED:
-            payload = node_payload(obj) if kind == "Node" else pod_payload(obj)
+            if kind == "Node":
+                payload = node_payload(obj)
+            elif kind == "Pod":
+                payload = pod_payload(obj)
+            else:  # PodCliqueSet: the raw CR — the admission chain parses it
+                payload = obj
         self._queue.put(WatchEvent(etype, kind, name, payload))
 
     # ---- HTTP plumbing --------------------------------------------------------------

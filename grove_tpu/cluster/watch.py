@@ -25,6 +25,7 @@ UpdateCluster, so an out-of-process solver sees the same fleet
 from __future__ import annotations
 
 import enum
+import json
 from dataclasses import dataclass, field
 from typing import Optional, Protocol
 
@@ -63,6 +64,10 @@ class WatchDriver:
     cluster: "object"  # orchestrator.store.Cluster (duck-typed to avoid cycle)
     source: WatchSource
     backend: Optional["object"] = None  # backend.client.BackendClient
+    # Workload CR events (PodCliqueSet, kubernetes source): handed to the
+    # manager's admission-gated apply/delete path, NOT written raw into the
+    # store — watch events never bypass the webhook-analog chain.
+    workload_sink: Optional[object] = None  # callable(WatchEvent)
     # pods we've told the source about (bind pushed), and known-deleted pods
     _pushed_bindings: set[str] = field(default_factory=set)
     # pods whose bind FAILED after the source may have already materialized
@@ -71,6 +76,9 @@ class WatchDriver:
     # cluster keeps an unschedulable Pending pod forever.
     _attempted_bindings: set[str] = field(default_factory=set)
     _nodes_dirty: bool = field(default=True)
+    # last-pushed CR status (JSON-canonical) per PCS: change detection for
+    # the status write-back
+    _pushed_status: dict = field(default_factory=dict)
 
     # ---- inbound: events -> store --------------------------------------------------
 
@@ -82,6 +90,8 @@ class WatchDriver:
                 self._apply_node(ev, now)
             elif ev.kind == "Pod":
                 self._apply_pod(ev, now)
+            elif ev.kind == "PodCliqueSet" and self.workload_sink is not None:
+                self.workload_sink(ev, now)
         # Dirty-flag, not event-count, gates forwarding: a failed UpdateCluster
         # (sidecar briefly down) must retry on the NEXT pump even if no new
         # node events arrive in between.
@@ -170,6 +180,34 @@ class WatchDriver:
                     self._pushed_bindings.discard(name)
                     self._attempted_bindings.discard(name)
                     pushed += 1
+        pushed += self._push_workload_statuses()
+        return pushed
+
+    def _push_workload_statuses(self) -> int:
+        """Reconciled PCS status -> the CR's status subresource (sources
+        without publish_workload_status — KWOK — skip). Change-detected so
+        a quiet control plane writes nothing."""
+        publish = getattr(self.source, "publish_workload_status", None)
+        if publish is None:
+            return 0
+        from grove_tpu.utils.serde import to_k8s
+
+        pushed = 0
+        for name, pcs in list(self.cluster.podcliquesets.items()):
+            doc = to_k8s(pcs.status)
+            key = json.dumps(doc, sort_keys=True)
+            if self._pushed_status.get(name) == key:
+                continue
+            ok = publish(name, doc)
+            # None = no CR at the apiserver (store-only workload): record
+            # the key so the doomed GET doesn't repeat every tick; False =
+            # transient, retry next tick.
+            if ok is not False:
+                self._pushed_status[name] = key
+                if ok is True:
+                    pushed += 1
+        for name in [n for n in self._pushed_status if n not in self.cluster.podcliquesets]:
+            del self._pushed_status[name]
         return pushed
 
     def step(self, now: float) -> None:

@@ -165,6 +165,10 @@ class ClusterConfig:
     # "" = the managed-by selector derived from api/constants
     # (cluster/kubernetes.py DEFAULT_POD_LABEL_SELECTOR).
     pod_label_selector: str = ""
+    # Watch PodCliqueSet CRs at the apiserver (kubectl-apply -> admission ->
+    # reconcile -> status write-back). Off = fleet mirroring only (workloads
+    # arrive via the operator's own HTTP API).
+    watch_workloads: bool = True
     kwok_nodes: int = 8
     kwok_cpu_per_node: float = 32.0
     kwok_memory_per_node: float = 128 * 2**30
@@ -272,6 +276,7 @@ _CAMEL_FIELDS = {
     "kubeContext": "kube_context",
     "kubeNamespace": "kube_namespace",
     "podLabelSelector": "pod_label_selector",
+    "watchWorkloads": "watch_workloads",
     "kwokNodes": "kwok_nodes",
     "kwokCpuPerNode": "kwok_cpu_per_node",
     "kwokMemoryPerNode": "kwok_memory_per_node",

@@ -459,6 +459,49 @@ class Manager:
         self.admission.admit_managed_mutation(actor, kind, name)
         fn(self.cluster)
 
+    def _apply_workload_event(self, ev, now: float) -> None:
+        """PodCliqueSet watch event -> admission-gated apply / cascade
+        delete. Rejections surface as control-plane events (the CR stays in
+        the cluster; its status never progresses) rather than crashing the
+        pump loop. `now` comes from the pump so CR events share the one
+        event timeline (virtual time in tests, wall time in production)."""
+        from grove_tpu.api import default_podcliqueset
+        from grove_tpu.api.admission import AdmissionError
+
+        name = ev.name
+        if ev.type.value == "DELETED":
+            if name in self.cluster.podcliquesets:
+                self.delete_podcliqueset(name, actor="apiserver")
+                self.cluster.record_event(
+                    now, name, "workload CR deleted (apiserver watch)"
+                )
+            return
+        try:
+            # Default BEFORE the echo comparison: the stored spec is the
+            # defaulted one, so comparing against the raw CR would never
+            # match and every echo would take the full re-apply path.
+            incoming = default_podcliqueset(PodCliqueSet.from_dict(ev.obj))
+            existing = self.cluster.podcliquesets.get(name)
+            if existing is not None and existing.spec == incoming.spec:
+                # Status-only MODIFIED — usually the echo of our own status
+                # write-back. Re-applying would replace the stored object
+                # and wipe the status we just computed (write loop).
+                return
+            applied = self.apply_podcliqueset(incoming, actor="apiserver")
+            if existing is not None:
+                # CR status is OURS (the operator is the status writer);
+                # a spec update must not reset reconciled state.
+                applied.status = existing.status
+        except AdmissionError as e:
+            self.cluster.record_event(
+                now, name,
+                f"workload CR rejected: {'; '.join(str(x) for x in e.errors)}",
+            )
+        except Exception as e:  # malformed CR must not kill the pump
+            self.cluster.record_event(
+                now, name, f"workload CR unparseable: {e}"
+            )
+
     def attach_watch(self, source, backend=None) -> "object":
         """Feed the store from an external cluster's watch stream
         (grove_tpu/cluster/watch.py). Returns the WatchDriver."""
@@ -612,10 +655,15 @@ class Manager:
                 ctx,
                 pod_label_selector=cfg.cluster.pod_label_selector or None,
                 pod_manifest_for=_manifest,
+                watch_workloads=cfg.cluster.watch_workloads,
             )
             source.start()
             self._kube_source = source
-            self.attach_watch(source, backend=backend_client)
+            driver = self.attach_watch(source, backend=backend_client)
+            # Workload CRs from the apiserver (kubectl apply -> watch ->
+            # admission -> store; SURVEY §3.2-3.3) — the same chain the
+            # HTTP apply path runs, so watch events can't bypass admission.
+            driver.workload_sink = self._apply_workload_event
             self.log.info(
                 "kubernetes cluster attached",
                 server=ctx.server,

@@ -100,3 +100,35 @@ def _lookup(name: str) -> type:
             "schema, or register_module() missing for its module"
         )
     return cls
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(w.capitalize() for w in parts[1:])
+
+
+def to_k8s(obj: Any) -> Any:
+    """Dataclass -> k8s-wire-shaped plain dict: camelCase keys, enum values,
+    empty/None fields dropped (CR status subresource convention). Used by
+    the kubernetes WatchSource to write PodCliqueSet status back to the CR
+    — the reference persists exactly this through the apiserver
+    (reconcilestatus.go)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(obj):
+            v = to_k8s(getattr(obj, f.name))
+            if v is None or v == [] or v == {}:
+                continue
+            out[_camel(f.name)] = v
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [to_k8s(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): to_k8s(v) for k, v in obj.items()}
+    if isinstance(obj, (set, frozenset)):
+        return sorted(to_k8s(x) for x in obj)
+    raise TypeError(f"cannot render {type(obj).__name__} for the k8s wire")

@@ -58,9 +58,15 @@ class FixtureApiServer:
         self.namespace = namespace
         self.nodes: dict[str, dict] = {}
         self.pods: dict[str, dict] = {}
+        self.podcliquesets: dict[str, dict] = {}  # the grove.io CRs
+        self.pcs_get_count: dict[str, int] = {}  # per-CR single-GET counter
         self._rv = 0
         self._lock = threading.Lock()
-        self._watchers: dict[str, list[queue.Queue]] = {"nodes": [], "pods": []}
+        self._watchers: dict[str, list[queue.Queue]] = {
+            "nodes": [],
+            "pods": [],
+            "podcliquesets": [],
+        }
         self._fail_watch_code: int | None = None
         self.binding_log: list[tuple[str, str]] = []  # (pod, node) in order
         self.created_pods: list[str] = []
@@ -89,6 +95,18 @@ class FixtureApiServer:
                     code, doc = fixture._lease_get(parsed.path)
                     self._json(code, doc)
                     return
+                if parsed.path.startswith(fixture._pcs_prefix + "/"):
+                    name = parsed.path[len(fixture._pcs_prefix) + 1:]
+                    with fixture._lock:
+                        fixture.pcs_get_count[name] = (
+                            fixture.pcs_get_count.get(name, 0) + 1
+                        )
+                        obj = fixture.podcliquesets.get(name)
+                    if obj is None:
+                        self._json(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._json(200, json.loads(json.dumps(obj)))
+                    return
                 resource = fixture._resource_for(parsed.path)
                 if resource is None:
                     self._json(404, {"kind": "Status", "code": 404})
@@ -114,6 +132,9 @@ class FixtureApiServer:
                 body = json.loads(self.rfile.read(length) or b"{}")
                 if parsed.path.startswith(fixture._leases_prefix):
                     code, doc = fixture._lease_put(parsed.path, body)
+                    self._json(code, doc)
+                elif parsed.path.startswith(fixture._pcs_prefix + "/"):
+                    code, doc = fixture._pcs_put(parsed.path, body)
                     self._json(code, doc)
                 else:
                     self._json(404, {"kind": "Status", "code": 404})
@@ -234,15 +255,27 @@ class FixtureApiServer:
             del self.leases[name]
             return 200, {"kind": "Status", "code": 200}
 
+    @property
+    def _pcs_prefix(self) -> str:
+        return (
+            f"/apis/grove.io/v1alpha1/namespaces/{self.namespace}/podcliquesets"
+        )
+
     def _resource_for(self, path: str):
         if path == "/api/v1/nodes":
             return "nodes"
         if path == f"/api/v1/namespaces/{self.namespace}/pods":
             return "pods"
+        if path == self._pcs_prefix:
+            return "podcliquesets"
         return None
 
     def _coll(self, resource: str) -> dict:
-        return self.nodes if resource == "nodes" else self.pods
+        return {
+            "nodes": self.nodes,
+            "pods": self.pods,
+            "podcliquesets": self.podcliquesets,
+        }[resource]
 
     def _matches(self, obj: dict, selector: str) -> bool:
         if not selector:
@@ -262,7 +295,11 @@ class FixtureApiServer:
                 if self._matches(obj, selector)
             ]
             rv = str(self._rv)
-        kind = "NodeList" if resource == "nodes" else "PodList"
+        kind = {
+            "nodes": "NodeList",
+            "pods": "PodList",
+            "podcliquesets": "PodCliqueSetList",
+        }[resource]
         return {
             "apiVersion": "v1",
             "kind": kind,
@@ -305,6 +342,43 @@ class FixtureApiServer:
         finally:
             with self._lock:
                 self._watchers[resource].remove(q)
+
+    # ---- PodCliqueSet CRs (test-facing: the kubectl-apply analog) ------------------
+
+    def apply_pcs(self, doc: dict):
+        """kubectl apply: create or replace the CR, preserving status."""
+        name = doc["metadata"]["name"]
+        with self._lock:
+            existing = self.podcliquesets.get(name)
+            if existing is not None:
+                doc = dict(doc)
+                doc["status"] = existing.get("status", {})
+                self.podcliquesets[name] = doc
+                self._emit("podcliquesets", "MODIFIED", doc)
+            else:
+                self.podcliquesets[name] = doc
+                self._emit("podcliquesets", "ADDED", doc)
+
+    def delete_pcs(self, name: str):
+        with self._lock:
+            obj = self.podcliquesets.pop(name, None)
+            if obj is not None:
+                self._emit("podcliquesets", "DELETED", obj)
+
+    def _pcs_put(self, path: str, body: dict):
+        """PUT .../podcliquesets/{name}/status — the operator's status
+        write-back (status subresource: only the status field is taken)."""
+        rest = path[len(self._pcs_prefix) + 1:]
+        name, _, sub = rest.partition("/")
+        if sub != "status":
+            return 404, {"kind": "Status", "code": 404}
+        with self._lock:
+            cur = self.podcliquesets.get(name)
+            if cur is None:
+                return 404, {"kind": "Status", "code": 404}
+            cur["status"] = body.get("status", {})
+            self._emit("podcliquesets", "MODIFIED", cur)
+            return 200, json.loads(json.dumps(cur))
 
     def _post(self, path: str, body: dict):
         pods_prefix = f"/api/v1/namespaces/{self.namespace}/pods"

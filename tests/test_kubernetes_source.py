@@ -602,3 +602,161 @@ def test_kube_lease_release_never_clobbers_successor(api):
         )
     assert ei.value.status == 409
     assert "grove-tpu-operator-leader" in api.leases  # survived the stale delete
+
+
+# --- workload CRs over the apiserver (the full reference loop) -------------------
+
+
+def test_workload_cr_watch_admission_and_status_writeback(api, tmp_path):
+    """The complete reference loop over the wire (SURVEY §3.2-3.3):
+    kubectl-apply of a PodCliqueSet CR at the APISERVER -> watch ->
+    admission -> reconcile -> bind -> Ready -> reconciled status written
+    back to the CR's status subresource; CR deletion cascades; an invalid
+    CR is rejected through the same admission chain with an event."""
+    import yaml as _yaml
+
+    from grove_tpu.api.podgang import PodGangPhase
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    for i in range(10):
+        api.add_node(
+            k8s_node(
+                f"n{i}", cpu="4", memory="16Gi",
+                labels={
+                    "topology.kubernetes.io/zone": "z0",
+                    "topology.kubernetes.io/block": "b0",
+                    "topology.kubernetes.io/rack": f"r{i % 2}",
+                },
+            )
+        )
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        with open("examples/simple1.yaml") as f:
+            doc = _yaml.safe_load(f)
+        api.apply_pcs(doc)  # kubectl apply at the APISERVER, not our API
+
+        deadline = time.monotonic() + 30.0
+        t = 0.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            for name, pod in list(api.pods.items()):
+                if pod.get("spec", {}).get("nodeName"):
+                    conds = pod.get("status", {}).get("conditions", [])
+                    if not any(
+                        c["type"] == "Ready" and c["status"] == "True"
+                        for c in conds
+                    ):
+                        api.advance_pod(name)
+            gangs = list(m.cluster.podgangs.values())
+            cr_status = api.podcliquesets.get("simple1", {}).get("status", {})
+            if (
+                gangs
+                and all(g.status.phase == PodGangPhase.RUNNING for g in gangs)
+                and cr_status.get("availableReplicas") == 1
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"CR loop never completed; store gangs="
+                f"{[(g.name, g.status.phase) for g in m.cluster.podgangs.values()]} "
+                f"cr_status={api.podcliquesets.get('simple1', {}).get('status')}"
+            )
+        # The CR's status subresource carries the reconciled rollup.
+        cr = api.podcliquesets["simple1"]
+        assert cr["status"]["availableReplicas"] == 1
+        assert {s["name"] for s in cr["status"]["podGangStatuses"]} == {
+            "simple1-0", "simple1-0-workers-0",
+        }
+
+        # Spec-echo guard: our own status write-back (MODIFIED) must not
+        # reset reconciled state — and must not even take the re-apply path
+        # (the guard compares DEFAULTED specs; a re-apply here would raise).
+        before = dict(cr["status"])
+        real_apply = m.apply_podcliqueset
+
+        def _boom(*a, **k):
+            raise AssertionError("echo took the re-apply path")
+
+        m.apply_podcliqueset = _boom
+        try:
+            m.reconcile_once(now=t + 1.0)
+            m.reconcile_once(now=t + 2.0)
+        finally:
+            m.apply_podcliqueset = real_apply
+        assert api.podcliquesets["simple1"]["status"] == before
+
+        # Invalid CR rejected through the same admission chain, with an event.
+        bad = _yaml.safe_load(open("examples/simple1.yaml"))
+        bad["metadata"]["name"] = "x" * 60  # name budget breach
+        api.apply_pcs(bad)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if any("rejected" in msg for _, obj, msg in m.cluster.events):
+                break
+            time.sleep(0.05)
+        assert any("rejected" in msg for _, obj, msg in m.cluster.events)
+        assert "x" * 60 not in m.cluster.podcliquesets
+
+        # kubectl delete of the CR cascades the whole workload.
+        api.delete_pcs("simple1")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if not m.cluster.pods and "simple1" not in m.cluster.podcliquesets:
+                break
+            time.sleep(0.05)
+        assert "simple1" not in m.cluster.podcliquesets
+        assert not m.cluster.pods
+    finally:
+        m.stop()
+
+
+def test_store_only_workload_does_not_hammer_apiserver(api, tmp_path, simple1):
+    """A PCS applied via the operator's own HTTP API has no CR at the
+    apiserver: the status push must probe once per status CHANGE, not GET a
+    guaranteed 404 on every reconcile tick."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    api.add_node(k8s_node("n0", cpu="16", memory="64Gi"))
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)  # operator API, not the apiserver
+        for t in range(1, 8):
+            m.reconcile_once(now=float(t))
+        # Status settles after the workload stops changing; the doomed GET
+        # count must be far below the tick count (one per status change).
+        assert api.pcs_get_count.get("simple1", 0) < 7
+        assert "simple1" not in api.podcliquesets
+    finally:
+        m.stop()
