@@ -405,6 +405,19 @@ class KubernetesWatchSource:
             return False
         return True
 
+    def delete_workload(self, name: str) -> bool:
+        """Delete the PodCliqueSet CR (an operator-API delete must also
+        remove the CR, or the next relist re-emits ADDED and resurrects the
+        workload). 404 = already gone = success."""
+        try:
+            self._request("DELETE", f"{self._pcs_path}/{name}")
+        except (KubeApiError, OSError, ValueError) as e:
+            if isinstance(e, KubeApiError) and e.status == 404:
+                return True
+            self._record_error(f"delete workload CR {name}: {e}")
+            return False
+        return True
+
     def publish_workload_status(self, name: str, status: dict):
         """Write reconciled status back to the PodCliqueSet CR's status
         subresource (the reference persists status the same way,

@@ -91,6 +91,11 @@ class WatchDriver:
             elif ev.kind == "Pod":
                 self._apply_pod(ev, now)
             elif ev.kind == "PodCliqueSet" and self.workload_sink is not None:
+                if ev.type == EventType.ADDED:
+                    # A CR (re)appeared at the apiserver: any cached "no CR
+                    # there" status-push verdict is stale — push again even
+                    # if the status itself hasn't changed since.
+                    self._pushed_status.pop(ev.name, None)
                 self.workload_sink(ev, now)
         # Dirty-flag, not event-count, gates forwarding: a failed UpdateCluster
         # (sidecar briefly down) must retry on the NEXT pump even if no new

@@ -30,6 +30,72 @@ APP = "grove-tpu-operator"
 IMAGE = "grove-tpu/operator:latest"
 
 
+def render_crd() -> dict:
+    """The PodCliqueSet CustomResourceDefinition (reference: generated CRDs
+    in `operator/api/core/v1alpha1/crds/`, shipped by the chart).
+
+    Deliberately a STRUCTURAL schema with preserve-unknown-fields rather
+    than a generated 10k-line OpenAPI dump: validation authority lives in
+    the operator's admission chain (api/validation.py), which the CR watch
+    runs for every object — the apiserver schema only needs to admit the
+    shape. Status and scale subresources mirror the reference
+    (`podcliqueset.go:27`): scale points at spec.replicas/status.replicas
+    with status.selector for HPA compatibility."""
+    preserve = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "podcliquesets.grove.io", "labels": _labels()},
+        "spec": {
+            "group": "grove.io",
+            "names": {
+                "kind": "PodCliqueSet",
+                "listKind": "PodCliqueSetList",
+                "plural": "podcliquesets",
+                "singular": "podcliqueset",
+                "shortNames": ["pcs"],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": "v1alpha1",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": preserve,
+                                "status": preserve,
+                            },
+                        }
+                    },
+                    "subresources": {
+                        "status": {},
+                        "scale": {
+                            "specReplicasPath": ".spec.replicas",
+                            "statusReplicasPath": ".status.replicas",
+                            "labelSelectorPath": ".status.selector",
+                        },
+                    },
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "Available",
+                            "type": "integer",
+                            "jsonPath": ".status.availableReplicas",
+                        },
+                        {
+                            "name": "Age",
+                            "type": "date",
+                            "jsonPath": ".metadata.creationTimestamp",
+                        },
+                    ],
+                }
+            ],
+        },
+    }
+
+
 def _labels() -> dict:
     return {"app.kubernetes.io/name": APP, "app.kubernetes.io/managed-by": "grove-tpu"}
 
@@ -117,7 +183,12 @@ def render_manifests(
     config_hash = hashlib.sha256(config_yaml.encode()).hexdigest()[:8]
     configmap_name = f"{APP}-config-{config_hash}"
 
-    docs: list[dict] = [
+    docs: list[dict] = []
+    if cfg.cluster.source == "kubernetes" and cfg.cluster.watch_workloads:
+        # The CR watch needs the grove.io CRD installed; ship it with the
+        # operator exactly as the reference chart ships its generated CRDs.
+        docs.append(render_crd())
+    docs += [
         {
             "apiVersion": "v1",
             "kind": "Namespace",
@@ -146,8 +217,18 @@ def render_manifests(
             "rules": [
                 {
                     "apiGroups": [""],
-                    "resources": ["pods", "nodes", "services", "secrets"],
+                    # pods/binding: the solver's placements land through the
+                    # scheduler binding subresource (cluster/kubernetes.py).
+                    "resources": ["pods", "pods/binding", "services", "secrets"],
                     "verbs": ["get", "list", "watch", "create", "update", "delete"],
+                },
+                {
+                    "apiGroups": ["grove.io"],
+                    # The CR watch + status write-back (status subresource);
+                    # delete: an operator-API delete must remove the CR too
+                    # or the next relist resurrects the workload.
+                    "resources": ["podcliquesets", "podcliquesets/status"],
+                    "verbs": ["get", "list", "watch", "update", "patch", "delete"],
                 },
                 {
                     "apiGroups": ["coordination.k8s.io"],
@@ -157,6 +238,41 @@ def render_manifests(
                     # leaseDurationSeconds of leaderless downtime.
                     "verbs": ["get", "create", "update", "delete"],
                 },
+            ],
+        },
+        {
+            # Nodes are cluster-scoped: a namespaced Role cannot grant them
+            # (listing them there is silently dead RBAC) — the node watch
+            # needs a ClusterRole.
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            # Namespace-qualified: cluster-scoped names collide across
+            # installs — a second install must not rewrite the first's
+            # binding subjects and revoke its node access.
+            "metadata": {"name": f"{APP}-{namespace}-nodes", "labels": _labels()},
+            "rules": [
+                {
+                    "apiGroups": [""],
+                    "resources": ["nodes"],
+                    "verbs": ["get", "list", "watch"],
+                }
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": f"{APP}-{namespace}-nodes", "labels": _labels()},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": f"{APP}-{namespace}-nodes",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": APP,
+                    "namespace": namespace,
+                }
             ],
         },
         {

@@ -367,6 +367,9 @@ class Manager:
         # Live-apiserver watch source (cluster.source: kubernetes); its
         # reader threads are stopped at manager stop().
         self._kube_source = None
+        # Rejected-CR dedupe: name -> repr of the last spec the admission
+        # chain rejected (one event per distinct bad spec, not per echo).
+        self._rejected_workload_specs: dict[str, str] = {}
         # HPA utilization feed (metrics-server analog): target FQN -> current
         # average utilization normalized to the target (1.0 == at target).
         # Pushed via POST /api/v1/metrics; consumed by the autoscale step.
@@ -409,6 +412,10 @@ class Manager:
 
     def delete_podcliqueset(self, name: str, actor: str = "user") -> None:
         self.cluster.delete_pcs_cascade(name)
+        # CR-backed workloads must ALSO be deleted at the apiserver, or the
+        # next watch relist re-emits ADDED and resurrects the workload.
+        if self._kube_source is not None and actor != "apiserver":
+            self._kube_source.delete_workload(name)
 
     def scale_target(
         self,
@@ -487,12 +494,23 @@ class Manager:
                 # write-back. Re-applying would replace the stored object
                 # and wipe the status we just computed (write loop).
                 return
+            spec_key = repr(incoming.spec)
+            if self._rejected_workload_specs.get(name) == spec_key:
+                return  # already rejected this exact spec; don't re-event
             applied = self.apply_podcliqueset(incoming, actor="apiserver")
+            self._rejected_workload_specs.pop(name, None)
             if existing is not None:
                 # CR status is OURS (the operator is the status writer);
                 # a spec update must not reset reconciled state.
                 applied.status = existing.status
         except AdmissionError as e:
+            # Async-validation reality: the reference rejects at the
+            # apiserver door (inbound webhook); our chain runs in-process
+            # AFTER etcd accepted the object, so a rejected edit leaves the
+            # CR and the store diverged until the user fixes the CR. Record
+            # ONE event per distinct rejected spec — the status write-back
+            # echo would otherwise re-emit it every tick.
+            self._rejected_workload_specs[name] = spec_key
             self.cluster.record_event(
                 now, name,
                 f"workload CR rejected: {'; '.join(str(x) for x in e.errors)}",

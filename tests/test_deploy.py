@@ -28,8 +28,18 @@ def test_render_covers_chart_surface():
     )
     assert set(by_kind) == {
         "Namespace", "ConfigMap", "ServiceAccount", "Role", "RoleBinding",
-        "Deployment", "Service",
+        "ClusterRole", "ClusterRoleBinding", "Deployment", "Service",
     }
+    # Nodes live in the ClusterRole (cluster-scoped; a namespaced Role
+    # cannot grant them), everything namespaced in the Role.
+    assert any(
+        "nodes" in r["resources"] for r in by_kind["ClusterRole"]["rules"]
+    )
+    role_resources = {
+        res for r in by_kind["Role"]["rules"] for res in r["resources"]
+    }
+    assert "nodes" not in role_resources
+    assert {"pods", "pods/binding", "podcliquesets", "podcliquesets/status"} <= role_resources
     dep = by_kind["Deployment"]["spec"]
     assert dep["replicas"] == 1  # no leader election: single replica
     container = dep["template"]["spec"]["containers"][0]
@@ -130,3 +140,27 @@ def test_multi_replica_requires_apiserver_lease(tmp_path):
     docs = render_manifests(cfg2, "cfg: {}")
     dep = next(d for d in docs if d["kind"] == "Deployment")
     assert dep["spec"]["replicas"] == 2  # HA-capable: apiserver lease
+
+
+def test_crd_rendered_for_kubernetes_source():
+    """cluster.source: kubernetes ships the grove.io PodCliqueSet CRD with
+    status + scale subresources (the chart's generated-CRDs analog)."""
+    by_kind = _render(
+        {
+            "servers": {"healthPort": 2751, "metricsPort": -1},
+            "cluster": {"source": "kubernetes"},
+        }
+    )
+    crd = by_kind["CustomResourceDefinition"]
+    assert crd["metadata"]["name"] == "podcliquesets.grove.io"
+    version = crd["spec"]["versions"][0]
+    schema = version["schema"]["openAPIV3Schema"]
+    assert schema["type"] == "object"  # structural schema requirement
+    assert schema["properties"]["spec"]["x-kubernetes-preserve-unknown-fields"]
+    assert version["subresources"]["status"] == {}
+    scale = version["subresources"]["scale"]
+    assert scale["specReplicasPath"] == ".spec.replicas"
+    assert "pcs" in crd["spec"]["names"]["shortNames"]
+    # Not rendered for non-kubernetes sources.
+    by_kind = _render({"servers": {"healthPort": 2751, "metricsPort": -1}})
+    assert "CustomResourceDefinition" not in by_kind

@@ -54,7 +54,10 @@ def _poll_until(src, pred, timeout=30.0):
         if pred(events):
             return events
         time.sleep(0.02)
-    raise AssertionError(f"timeout; saw {[(e.type, e.kind, e.name) for e in events]}")
+    raise AssertionError(
+        f"timeout; saw {[(e.type, e.kind, e.name) for e in events]}; "
+        f"source errors: {src.errors}"
+    )
 
 
 # --- pure translation ------------------------------------------------------------
