@@ -763,3 +763,74 @@ def test_store_only_workload_does_not_hammer_apiserver(api, tmp_path, simple1):
         assert "simple1" not in api.podcliquesets
     finally:
         m.stop()
+
+
+def test_watch_survives_repeated_stream_drops(api, tmp_path, simple1):
+    """Chaos tier: the informer loop must converge through repeated watch
+    failures (410 relists mid-reconcile) without losing node/pod state —
+    the resume/relist discipline under churn, not just a single 410."""
+    from grove_tpu.api.podgang import PodGangPhase
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    for i in range(10):
+        api.add_node(
+            k8s_node(
+                f"n{i}", cpu="4", memory="16Gi",
+                labels={
+                    "topology.kubernetes.io/zone": "z0",
+                    "topology.kubernetes.io/block": "b0",
+                    "topology.kubernetes.io/rack": f"r{i % 2}",
+                },
+            )
+        )
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)
+        deadline = time.monotonic() + 45.0
+        t = 0.0
+        drops = 0
+        while time.monotonic() < deadline:
+            t += 1.0
+            if int(t) % 3 == 0 and drops < 6:
+                api.fail_watch_once(410)  # chaos: next watch gets Gone
+                drops += 1
+            m.reconcile_once(now=t)
+            for name, pod in list(api.pods.items()):
+                if pod.get("spec", {}).get("nodeName"):
+                    conds = pod.get("status", {}).get("conditions", [])
+                    if not any(
+                        c["type"] == "Ready" and c["status"] == "True"
+                        for c in conds
+                    ):
+                        api.advance_pod(name)
+            gangs = list(m.cluster.podgangs.values())
+            if (
+                drops >= 4
+                and gangs
+                and all(g.status.phase == PodGangPhase.RUNNING for g in gangs)
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"never converged under churn (drops={drops}); gangs="
+                f"{[(g.name, g.status.phase) for g in m.cluster.podgangs.values()]} "
+                f"errors={m.watch.source.errors}"
+            )
+        assert len(m.cluster.nodes) == 10  # relists never lost the fleet
+        assert all(p.ready for p in m.cluster.pods.values())
+    finally:
+        m.stop()
