@@ -183,6 +183,16 @@ def render_manifests(
     config_hash = hashlib.sha256(config_yaml.encode()).hexdigest()[:8]
     configmap_name = f"{APP}-config-{config_hash}"
 
+    if cfg.cluster.source == "kubernetes" and not cfg.servers.advertise_url:
+        # Remote pods run the injected initc against --server; without an
+        # advertised URL they would poll localhost inside their own netns
+        # and never gate open. Fail with the answer in hand.
+        raise ValueError(
+            "servers.advertiseUrl is required for cluster.source: kubernetes "
+            f"deployments (the injected grove-initc polls it); set e.g. "
+            f"http://{APP}.{namespace}.svc:{cfg.servers.health_port}"
+        )
+
     docs: list[dict] = []
     if cfg.cluster.source == "kubernetes" and cfg.cluster.watch_workloads:
         # The CR watch needs the grove.io CRD installed; ship it with the

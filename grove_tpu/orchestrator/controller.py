@@ -80,6 +80,9 @@ class GroveController:
     # MNNVL-analog TPU-slice injection (networkAcceleration config section)
     auto_slice_enabled: bool = False
     slice_resource_name: str = "google.com/tpu"
+    # servers.advertiseUrl: the injected initc agent's --server ("" = the
+    # agent's localhost default; real clusters need the operator Service URL)
+    initc_server_url: str = ""
     # Preemption flap guard: a gang whose rejection is NOT capacity-caused
     # (e.g. a required rack that can never fit it) must not evict fresh
     # victims every pass — one preemption attempt per contender per window.
@@ -136,6 +139,7 @@ class GroveController:
             rng=rng if rng is not None else self.rng,
             auto_slice_enabled=self.auto_slice_enabled,
             slice_resource_name=self.slice_resource_name,
+            initc_server_url=self.initc_server_url,
         )
 
     def sync_workload(self, pcs: PodCliqueSet, now: float, desired=None) -> None:

@@ -140,6 +140,7 @@ def expand_podcliqueset(
     rng: random.Random | None = None,
     auto_slice_enabled: bool = False,
     slice_resource_name: str = "google.com/tpu",
+    initc_server_url: str = "",
 ) -> DesiredState:
     """Expand a defaulted PodCliqueSet into its full desired object set.
 
@@ -237,6 +238,7 @@ def expand_podcliqueset(
             pods = _build_pods(
                 pcs, pclq, clique_tmpl, svc, i, gen_hash, rng,
                 tmpl_hash=tmpl_hashes[clique_tmpl.name],
+                initc_server_url=initc_server_url,
             )
             group.pod_references = [NamespacedName(ns, p.name) for p in pods]
             out.pods.extend(pods)
@@ -307,6 +309,7 @@ def expand_podcliqueset(
                         tmpl_hash=tmpl_hashes[clique_tmpl.name],
                         pcsg_fqn=pcsg_fqn, pcsg_replica=j,
                         base_podgang_name=None if in_base else base_gang.name,
+                        initc_server_url=initc_server_url,
                     )
                     group.pod_references = [NamespacedName(ns, p.name) for p in pods]
                     out.pods.extend(pods)
@@ -561,7 +564,9 @@ INITC_TOKEN_MOUNT = f"{INITC_TOKEN_MOUNT_DIR}/token"
 INITC_TOKEN_VOLUME = "grove-sa-token"
 
 
-def _inject_initc(spec, args: list[str], pcs_name: str) -> None:
+def _inject_initc(
+    spec, args: list[str], pcs_name: str, server_url: str = ""
+) -> None:
     """Inject the startup-ordering init container (initcontainer.go:51,98-126);
     its args are exactly what the agent binary consumes (python -m
     grove_tpu.initc). The SA-token distribution is DECLARED in the pod spec
@@ -580,7 +585,11 @@ def _inject_initc(spec, args: list[str], pcs_name: str) -> None:
             name=INITC_CONTAINER_NAME,
             image="grove-initc",
             command=["python", "-m", "grove_tpu.initc"],
-            args=list(args) + [f"--token-file={INITC_TOKEN_MOUNT}"],
+            # --server: the operator's advertised URL (servers.advertiseUrl);
+            # unset keeps the agent's localhost default (single-host runs).
+            args=list(args)
+            + ([f"--server={server_url}"] if server_url else [])
+            + [f"--token-file={INITC_TOKEN_MOUNT}"],
             volume_mounts=[
                 {"name": INITC_TOKEN_VOLUME, "mountPath": INITC_TOKEN_MOUNT_DIR}
             ],
@@ -601,6 +610,7 @@ def _build_pods(
     pcsg_fqn: str | None = None,
     pcsg_replica: int | None = None,
     base_podgang_name: str | None = None,
+    initc_server_url: str = "",
 ) -> list[Pod]:
     """Build the pods of one clique (podclique/components/pod/pod.go:135-269)."""
     import copy
@@ -639,7 +649,9 @@ def _build_pods(
         spec.hostname = naming.pod_hostname(fqn, idx)
         spec.subdomain = headless_service
         if startup_args is not None:
-            _inject_initc(spec, startup_args, pcs.metadata.name)
+            _inject_initc(
+                spec, startup_args, pcs.metadata.name, initc_server_url
+            )
         pods.append(
             Pod(
                 name=naming.pod_name(fqn, rng),

@@ -42,6 +42,11 @@ class ServerConfig:
     bind_address: str = "127.0.0.1"  # 0.0.0.0 for in-cluster deployments
     health_port: int = 2751
     metrics_port: int = 2752
+    # URL WORKLOAD PODS reach the operator's HTTP API at (the injected
+    # grove-initc agent's --server). "" = the agent's localhost default —
+    # fine for single-host runs, wrong for real clusters, where this must
+    # be the operator Service, e.g. http://grove-tpu-operator.grove-system.svc:2751
+    advertise_url: str = ""
     profiling_enabled: bool = False  # pprof analog (manager.go:42-44)
     # TLS for the HTTP surface (cert mode auto/manual, types.go:154-169):
     # disabled | auto (self-signed into tlsCertDir) | manual (provided files).
@@ -249,6 +254,7 @@ _CAMEL_FIELDS = {
     "retryPeriodSeconds": "retry_period_seconds",
     "bindAddress": "bind_address",
     "healthPort": "health_port",
+    "advertiseUrl": "advertise_url",
     "metricsPort": "metrics_port",
     "profilingEnabled": "profiling_enabled",
     "tlsMode": "tls_mode",

@@ -340,6 +340,7 @@ class Manager:
             portfolio=config.solver.portfolio,
             auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
             slice_resource_name=config.network_acceleration.slice_resource_name,
+            initc_server_url=config.servers.advertise_url,
         )
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []

@@ -572,3 +572,40 @@ def test_portfolio_controller_schedules_workload(simple1):
         and all(p.is_scheduled for p in cluster.pods.values()),
         timeout=60,
     )
+
+
+def test_advertise_url_reaches_injected_initc(tmp_path):
+    """servers.advertiseUrl flows into the injected grove-initc's --server
+    (real clusters: the operator Service; unset keeps the agent's localhost
+    default for single-host runs)."""
+    import yaml as _yaml
+
+    from grove_tpu.api import PodCliqueSet, default_podcliqueset
+    from grove_tpu.orchestrator.expansion import INITC_CONTAINER_NAME
+
+    with open("examples/multi-node-disaggregated.yaml") as f:
+        pcs = default_podcliqueset(PodCliqueSet.from_dict(_yaml.safe_load(f)))
+
+    url = "http://grove-tpu-operator.grove-system.svc:2751"
+    m = _mgr(tmp_path, {"servers": {"advertiseUrl": url}})
+    m.cluster.podcliquesets[pcs.metadata.name] = pcs
+    desired = m.controller.compute_desired(pcs)
+    gated = [
+        p for p in desired.pods
+        if any(c.name == INITC_CONTAINER_NAME for c in p.spec.init_containers)
+    ]
+    assert gated, "workload has startsAfter cliques; initc must be injected"
+    for p in gated:
+        initc = next(
+            c for c in p.spec.init_containers if c.name == INITC_CONTAINER_NAME
+        )
+        assert f"--server={url}" in initc.args
+
+    # Unset: no --server arg (agent default).
+    m2 = _mgr(tmp_path, {})
+    m2.cluster.podcliquesets[pcs.metadata.name] = pcs
+    desired = m2.controller.compute_desired(pcs)
+    for p in desired.pods:
+        for c in p.spec.init_containers:
+            if c.name == INITC_CONTAINER_NAME:
+                assert not any(a.startswith("--server=") for a in c.args)

@@ -61,8 +61,12 @@ def test_leader_election_enables_ha_replicas():
     by_kind = _render(
         {
             "leaderElection": {"enabled": True, "leaseFile": "/var/lock/g"},
-            "servers": {"healthPort": 2751, "metricsPort": -1},
             "cluster": {"source": "kubernetes"},
+            "servers": {
+                "healthPort": 2751,
+                "metricsPort": -1,
+                "advertiseUrl": "http://grove-tpu-operator.grove-system.svc:2751",
+            },
         }
     )
     assert by_kind["Deployment"]["spec"]["replicas"] == 2
@@ -135,6 +139,11 @@ def test_multi_replica_requires_apiserver_lease(tmp_path):
 
     kube = dict(base)
     kube["cluster"] = {"source": "kubernetes"}
+    kube["servers"] = {
+        **kube.get("servers", {}),
+        "bindAddress": "0.0.0.0",
+        "advertiseUrl": "http://grove-tpu-operator.grove-system.svc:2751",
+    }
     cfg2, errors = parse_operator_config(kube)
     assert not errors
     docs = render_manifests(cfg2, "cfg: {}")
@@ -147,7 +156,11 @@ def test_crd_rendered_for_kubernetes_source():
     status + scale subresources (the chart's generated-CRDs analog)."""
     by_kind = _render(
         {
-            "servers": {"healthPort": 2751, "metricsPort": -1},
+            "servers": {
+                "healthPort": 2751,
+                "metricsPort": -1,
+                "advertiseUrl": "http://grove-tpu-operator.grove-system.svc:2751",
+            },
             "cluster": {"source": "kubernetes"},
         }
     )
@@ -164,3 +177,23 @@ def test_crd_rendered_for_kubernetes_source():
     # Not rendered for non-kubernetes sources.
     by_kind = _render({"servers": {"healthPort": 2751, "metricsPort": -1}})
     assert "CustomResourceDefinition" not in by_kind
+
+
+def test_kubernetes_deploy_requires_advertise_url():
+    """Remote pods poll the injected initc's --server; rendering a
+    kubernetes-source deployment without servers.advertiseUrl would ship
+    pods that poll localhost forever — loud error with the answer."""
+    import pytest
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"bindAddress": "0.0.0.0", "healthPort": 2751},
+            "cluster": {"source": "kubernetes"},
+        }
+    )
+    assert not errors
+    with pytest.raises(ValueError, match="advertiseUrl"):
+        render_manifests(cfg, "cfg: {}")
+    cfg.servers.advertise_url = "http://grove-tpu-operator.grove-system.svc:2751"
+    docs = render_manifests(cfg, "cfg: {}")
+    assert any(d["kind"] == "CustomResourceDefinition" for d in docs)
