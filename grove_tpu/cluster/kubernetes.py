@@ -405,6 +405,41 @@ class KubernetesWatchSource:
             return False
         return True
 
+    def sync_cluster_topology(self, topology) -> bool:
+        """Create/update the cluster-scoped ClusterTopology CR from the
+        operator config (the reference's startup sync,
+        `internal/clustertopology/clustertopology.go:39-51`; CR name
+        `grove-topology` per DefaultClusterTopologyName). Best-effort: a
+        cluster without the CRD returns False and the operator runs on its
+        in-memory topology."""
+        path = "/apis/grove.io/v1alpha1/clustertopologies/grove-topology"
+        levels = [
+            {"domain": lvl.domain.value, "nodeLabelKey": lvl.node_label_key}
+            for lvl in topology.with_host_level().sorted_levels()
+        ]
+        body = {
+            "apiVersion": "grove.io/v1alpha1",
+            "kind": "ClusterTopology",
+            "metadata": {"name": "grove-topology"},
+            "spec": {"levels": levels},
+        }
+        try:
+            try:
+                cur = self._request("GET", path)
+            except KubeApiError as e:
+                if e.status != 404:
+                    raise
+                self._request(
+                    "POST", "/apis/grove.io/v1alpha1/clustertopologies", body
+                )
+                return True
+            cur["spec"] = body["spec"]
+            self._request("PUT", path, cur)
+            return True
+        except (KubeApiError, OSError, ValueError) as e:
+            self._record_error(f"ClusterTopology sync: {e}")
+            return False
+
     def delete_workload(self, name: str) -> bool:
         """Delete the PodCliqueSet CR (an operator-API delete must also
         remove the CR, or the next relist re-emits ADDED and resurrects the

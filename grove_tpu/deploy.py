@@ -96,6 +96,43 @@ def render_crd() -> dict:
     }
 
 
+def render_topology_crd() -> dict:
+    """The cluster-scoped ClusterTopology CRD (`grove.io_clustertopologies`
+    upstream; name `grove-topology`, short name `ct`) — the operator writes
+    it at startup from the config's TAS levels (cluster/kubernetes.py
+    sync_cluster_topology)."""
+    preserve = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "clustertopologies.grove.io", "labels": _labels()},
+        "spec": {
+            "group": "grove.io",
+            "names": {
+                "kind": "ClusterTopology",
+                "listKind": "ClusterTopologyList",
+                "plural": "clustertopologies",
+                "singular": "clustertopology",
+                "shortNames": ["ct"],
+            },
+            "scope": "Cluster",
+            "versions": [
+                {
+                    "name": "v1alpha1",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {"spec": preserve},
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
 def _labels() -> dict:
     return {"app.kubernetes.io/name": APP, "app.kubernetes.io/managed-by": "grove-tpu"}
 
@@ -183,17 +220,45 @@ def render_manifests(
     config_hash = hashlib.sha256(config_yaml.encode()).hexdigest()[:8]
     configmap_name = f"{APP}-config-{config_hash}"
 
-    if cfg.cluster.source == "kubernetes" and not cfg.servers.advertise_url:
-        # Remote pods run the injected initc against --server; without an
-        # advertised URL they would poll localhost inside their own netns
-        # and never gate open. Fail with the answer in hand.
-        raise ValueError(
-            "servers.advertiseUrl is required for cluster.source: kubernetes "
-            f"deployments (the injected grove-initc polls it); set e.g. "
-            f"http://{APP}.{namespace}.svc:{cfg.servers.health_port}"
-        )
+    if cfg.cluster.source == "kubernetes":
+        # Remote pods run the injected initc against --server: the URL must
+        # exist (else pods poll localhost in their own netns), the serving
+        # port must actually be enabled, and the scheme must be one the
+        # agent can speak (no CA distribution to workload pods yet, so the
+        # advertised surface must be plaintext; terminate TLS in front if
+        # needed). Each failure here would otherwise be silent gang pods
+        # gating until init timeout.
+        if cfg.servers.health_port < 0:
+            raise ValueError(
+                "servers.healthPort must be enabled for cluster.source: "
+                "kubernetes deployments — the workload API the injected "
+                "grove-initc polls is served there"
+            )
+        if not cfg.servers.advertise_url:
+            raise ValueError(
+                "servers.advertiseUrl is required for cluster.source: "
+                "kubernetes deployments (the injected grove-initc polls it); "
+                f"set e.g. http://{APP}.{namespace}.svc:{cfg.servers.health_port}"
+            )
+        if cfg.servers.tls_mode != "disabled":
+            raise ValueError(
+                "cluster.source: kubernetes deployments require servers."
+                "tlsMode: disabled for now — the injected grove-initc has no "
+                "CA distribution, so an HTTPS workload API would fail cert "
+                "verification in every pod; terminate TLS in front of the "
+                "operator instead"
+            )
+        if not cfg.servers.advertise_url.startswith("http://"):
+            raise ValueError(
+                "servers.advertiseUrl must be a plaintext http:// URL (the "
+                "injected grove-initc has no CA material for https)"
+            )
 
     docs: list[dict] = []
+    if cfg.cluster.source == "kubernetes":
+        # The topology CR is written at startup regardless of the workload
+        # watch; its CRD ships with every kubernetes-source deployment.
+        docs.append(render_topology_crd())
     if cfg.cluster.source == "kubernetes" and cfg.cluster.watch_workloads:
         # The CR watch needs the grove.io CRD installed; ship it with the
         # operator exactly as the reference chart ships its generated CRDs.
@@ -265,7 +330,13 @@ def render_manifests(
                     "apiGroups": [""],
                     "resources": ["nodes"],
                     "verbs": ["get", "list", "watch"],
-                }
+                },
+                {
+                    "apiGroups": ["grove.io"],
+                    # Startup topology sync writes this cluster-scoped CR.
+                    "resources": ["clustertopologies"],
+                    "verbs": ["get", "create", "update"],
+                },
             ],
         },
         {

@@ -678,6 +678,11 @@ class Manager:
             )
             source.start()
             self._kube_source = source
+            # Startup topology sync (clustertopology.go:39-51): publish the
+            # config's ClusterTopology as a CR so cluster users can kubectl
+            # get it; best-effort — a CRD-less cluster just logs.
+            if not source.sync_cluster_topology(self.topology):
+                self.log.info("ClusterTopology CR sync unavailable")
             driver = self.attach_watch(source, backend=backend_client)
             # Workload CRs from the apiserver (kubectl apply -> watch ->
             # admission -> store; SURVEY §3.2-3.3) — the same chain the

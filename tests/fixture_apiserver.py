@@ -59,6 +59,7 @@ class FixtureApiServer:
         self.nodes: dict[str, dict] = {}
         self.pods: dict[str, dict] = {}
         self.podcliquesets: dict[str, dict] = {}  # the grove.io CRs
+        self.clustertopologies: dict[str, dict] = {}  # cluster-scoped CRs
         self.pcs_get_count: dict[str, int] = {}  # per-CR single-GET counter
         self._rv = 0
         self._lock = threading.Lock()
@@ -94,6 +95,15 @@ class FixtureApiServer:
                 if parsed.path.startswith(fixture._leases_prefix):
                     code, doc = fixture._lease_get(parsed.path)
                     self._json(code, doc)
+                    return
+                if parsed.path.startswith(fixture._ct_prefix):
+                    name = parsed.path[len(fixture._ct_prefix):].lstrip("/")
+                    with fixture._lock:
+                        obj = fixture.clustertopologies.get(name)
+                    if obj is None:
+                        self._json(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._json(200, json.loads(json.dumps(obj)))
                     return
                 if parsed.path.startswith(fixture._pcs_prefix + "/"):
                     name = parsed.path[len(fixture._pcs_prefix) + 1:]
@@ -133,6 +143,14 @@ class FixtureApiServer:
                 if parsed.path.startswith(fixture._leases_prefix):
                     code, doc = fixture._lease_put(parsed.path, body)
                     self._json(code, doc)
+                elif parsed.path.startswith(fixture._ct_prefix + "/"):
+                    name = parsed.path[len(fixture._ct_prefix) + 1:]
+                    with fixture._lock:
+                        if name not in fixture.clustertopologies:
+                            self._json(404, {"kind": "Status", "code": 404})
+                            return
+                        fixture.clustertopologies[name] = body
+                    self._json(200, json.loads(json.dumps(body)))
                 elif parsed.path.startswith(fixture._pcs_prefix + "/"):
                     code, doc = fixture._pcs_put(parsed.path, body)
                     self._json(code, doc)
@@ -254,6 +272,10 @@ class FixtureApiServer:
                 return 409, {"kind": "Status", "code": 409, "reason": "Conflict"}
             del self.leases[name]
             return 200, {"kind": "Status", "code": 200}
+
+    @property
+    def _ct_prefix(self) -> str:
+        return "/apis/grove.io/v1alpha1/clustertopologies"
 
     @property
     def _pcs_prefix(self) -> str:
@@ -381,6 +403,13 @@ class FixtureApiServer:
             return 200, json.loads(json.dumps(cur))
 
     def _post(self, path: str, body: dict):
+        if path == self._ct_prefix:
+            name = body["metadata"]["name"]
+            with self._lock:
+                if name in self.clustertopologies:
+                    return 409, {"kind": "Status", "code": 409}
+                self.clustertopologies[name] = body
+            return 201, json.loads(json.dumps(body))
         pods_prefix = f"/api/v1/namespaces/{self.namespace}/pods"
         if path == pods_prefix:
             name = body["metadata"]["name"]

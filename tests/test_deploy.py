@@ -197,3 +197,48 @@ def test_kubernetes_deploy_requires_advertise_url():
     cfg.servers.advertise_url = "http://grove-tpu-operator.grove-system.svc:2751"
     docs = render_manifests(cfg, "cfg: {}")
     assert any(d["kind"] == "CustomResourceDefinition" for d in docs)
+
+
+def test_kubernetes_deploy_rejects_unservable_advertise_combos():
+    """advertiseUrl must point at a surface that exists and that the initc
+    can actually speak: disabled health port, TLS-enabled serving, or an
+    https URL all render silent gate-forever pods — loud errors instead."""
+    import pytest
+
+    def cfg_of(servers):
+        doc = {
+            "servers": {"bindAddress": "0.0.0.0", **servers},
+            "cluster": {"source": "kubernetes"},
+        }
+        cfg, errors = parse_operator_config(doc)
+        assert not errors, errors
+        return cfg
+
+    with pytest.raises(ValueError, match="healthPort must be enabled"):
+        render_manifests(
+            cfg_of({"healthPort": -1, "advertiseUrl": "http://x.svc:2751"}),
+            "cfg: {}",
+        )
+    with pytest.raises(ValueError, match="tlsMode: disabled"):
+        render_manifests(
+            cfg_of(
+                {
+                    "healthPort": 2751,
+                    "metricsPort": -1,
+                    "advertiseUrl": "http://x.svc:2751",
+                    "tlsMode": "auto",
+                }
+            ),
+            "cfg: {}",
+        )
+    with pytest.raises(ValueError, match="plaintext http"):
+        render_manifests(
+            cfg_of(
+                {
+                    "healthPort": 2751,
+                    "metricsPort": -1,
+                    "advertiseUrl": "https://x.svc:2751",
+                }
+            ),
+            "cfg: {}",
+        )

@@ -834,3 +834,114 @@ def test_watch_survives_repeated_stream_drops(api, tmp_path, simple1):
         assert all(p.ready for p in m.cluster.pods.values())
     finally:
         m.stop()
+
+
+def test_kubectl_scale_via_cr_spec_change(api, tmp_path, simple1):
+    """kubectl scale pcs (the CRD's scale subresource writes spec.replicas)
+    flows through the CR watch as a spec change: the operator expands the
+    new replica count without any operator-API involvement."""
+    import yaml as _yaml
+
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    for i in range(24):
+        api.add_node(
+            k8s_node(
+                f"n{i}", cpu="4", memory="16Gi",
+                labels={
+                    "topology.kubernetes.io/zone": "z0",
+                    "topology.kubernetes.io/block": "b0",
+                    "topology.kubernetes.io/rack": f"r{i % 2}",
+                },
+            )
+        )
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        with open("examples/simple1.yaml") as f:
+            doc = _yaml.safe_load(f)
+        api.apply_pcs(doc)
+        deadline = time.monotonic() + 20.0
+        t = 0.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if len(m.cluster.pods) == 13:
+                break
+            time.sleep(0.05)
+        assert len(m.cluster.pods) == 13
+
+        # kubectl scale pcs simple1 --replicas=2: the scale subresource
+        # writes spec.replicas on the CR; emulate the resulting MODIFIED.
+        scaled = _yaml.safe_load(open("examples/simple1.yaml"))
+        scaled["spec"]["replicas"] = 2
+        api.apply_pcs(scaled)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if len(m.cluster.pods) == 26:
+                break
+            time.sleep(0.05)
+        assert len(m.cluster.pods) == 26, "scale-out never expanded"
+        assert m.cluster.podcliquesets["simple1"].spec.replicas == 2
+    finally:
+        m.stop()
+
+
+def test_cluster_topology_cr_synced_at_boot(api, tmp_path):
+    """Startup topology sync (clustertopology.go:39-51 analog): the
+    operator publishes its config's levels as the cluster-scoped
+    grove-topology CR, update-in-place on re-boot."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    def boot(levels):
+        cfg, errors = parse_operator_config(
+            {
+                "servers": {"healthPort": -1, "metricsPort": -1},
+                "backend": {"enabled": False},
+                "topologyAwareScheduling": {"enabled": True, "levels": levels},
+                "cluster": {
+                    "source": "kubernetes",
+                    "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+                },
+            }
+        )
+        assert not errors, errors
+        m = Manager(cfg)
+        m.start()
+        m.stop()
+
+    boot([
+        {"domain": "rack", "nodeLabelKey": "topology.kubernetes.io/rack"},
+    ])
+    cr = api.clustertopologies["grove-topology"]
+    keys = [lvl["nodeLabelKey"] for lvl in cr["spec"]["levels"]]
+    assert keys == ["topology.kubernetes.io/rack", "kubernetes.io/hostname"]
+
+    # Re-boot with more levels: update, not duplicate.
+    boot([
+        {"domain": "zone", "nodeLabelKey": "topology.kubernetes.io/zone"},
+        {"domain": "rack", "nodeLabelKey": "topology.kubernetes.io/rack"},
+    ])
+    cr = api.clustertopologies["grove-topology"]
+    keys = [lvl["nodeLabelKey"] for lvl in cr["spec"]["levels"]]
+    assert keys == [
+        "topology.kubernetes.io/zone",
+        "topology.kubernetes.io/rack",
+        "kubernetes.io/hostname",
+    ]
+    assert len(api.clustertopologies) == 1
