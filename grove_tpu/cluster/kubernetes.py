@@ -342,6 +342,13 @@ class KubernetesWatchSource:
             )
         # Wire-visible error log (last few), surfaced via statusz/tests.
         self.errors: list[str] = []
+        # Managed Services mirrored to the cluster: name -> last manifest.
+        self._synced_services: dict[str, dict] = {}
+        # Child CR projections (podcliques/pcsgs): plural -> name -> manifest.
+        self._synced_children: dict[str, dict] = {}
+        # Collections whose cluster-side members have been LISTed into the
+        # cache (crash-orphan GC; _sync_collection).
+        self._seeded_bases: set[str] = set()
 
     # ---- lifecycle ----------------------------------------------------------------
 
@@ -404,6 +411,188 @@ class KubernetesWatchSource:
             self._record_error(f"bind pod {pod_name} -> {node_name}: {e}")
             return False
         return True
+
+    def sync_services(self, services: list) -> bool:
+        """Mirror the store's HeadlessService objects into real cluster
+        Services (service.go:137-155): pod DNS (`<hostname>.<subdomain>`)
+        only resolves when the headless Service actually exists at the
+        apiserver. Create-or-update for desired, delete for stale managed
+        ones; returns False when any write failed (retried next push)."""
+        ns = urllib.parse.quote(self.ctx.namespace)
+        path = f"/api/v1/namespaces/{ns}/services"
+        desired = {}
+        for svc in services:
+            desired[svc.name] = {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {
+                    "name": svc.name,
+                    "namespace": self.ctx.namespace,
+                    "labels": {
+                        api_constants.LABEL_MANAGED_BY: api_constants.LABEL_MANAGED_BY_VALUE,
+                        api_constants.LABEL_PART_OF: svc.pcs_name,
+                    },
+                },
+                "spec": {
+                    "clusterIP": "None",
+                    "selector": dict(svc.selector),
+                    "publishNotReadyAddresses": bool(
+                        svc.publish_not_ready_addresses
+                    ),
+                },
+            }
+        return self._sync_collection(path, desired, self._synced_services)
+
+    # ---- managed-object sync plumbing ----------------------------------------------
+
+    _PREEXISTING = {"_preexisting": True}  # cache sentinel from seeding
+
+    def _seed_cache(self, base: str, cache: dict) -> bool:
+        """First sync after (re)start: LIST the cluster's managed objects so
+        ones surviving a crash participate in GC — an in-memory cache alone
+        would orphan them forever (live DNS records, stale CRs)."""
+        try:
+            doc = self._request(
+                "GET", base, query={"labelSelector": DEFAULT_POD_LABEL_SELECTOR}
+            )
+        except (KubeApiError, OSError, ValueError) as e:
+            self._record_error(f"seed {base}: {e}")
+            return False
+        for item in doc.get("items", []) or []:
+            cache.setdefault(item["metadata"]["name"], dict(self._PREEXISTING))
+        return True
+
+    def _upsert_object(
+        self, base: str, name: str, manifest: dict, known: bool,
+        status_subresource: bool = False,
+    ) -> bool:
+        """Create-or-update with real apiserver semantics: updates are
+        GET-then-PUT (resourceVersion threaded through), and when the CRD
+        declares a status subresource the .status field — which the main
+        PUT/POST STRIPS — is written with a second PUT to /status."""
+
+        def _put_main() -> None:
+            cur = self._request("GET", f"{base}/{name}")
+            body = dict(manifest)
+            rv = (cur.get("metadata", {}) or {}).get("resourceVersion")
+            if rv:
+                body["metadata"] = {**manifest["metadata"], "resourceVersion": rv}
+            self._request("PUT", f"{base}/{name}", body)
+
+        try:
+            if known:
+                _put_main()
+            else:
+                try:
+                    self._request("POST", base, manifest)
+                except KubeApiError as e:
+                    if e.status != 409:
+                        raise
+                    _put_main()
+            if status_subresource and "status" in manifest:
+                cur = self._request("GET", f"{base}/{name}")
+                cur["status"] = manifest["status"]
+                self._request("PUT", f"{base}/{name}/status", cur)
+        except (KubeApiError, OSError, ValueError) as e:
+            self._record_error(f"sync {base}/{name}: {e}")
+            return False
+        return True
+
+    def _sync_collection(
+        self, base: str, desired: dict, cache: dict,
+        status_subresource: bool = False,
+    ) -> bool:
+        """Reconcile one managed collection: seed once, upsert changed,
+        delete stale. `cache` maps name -> last-pushed manifest (or the
+        seeding sentinel, which never equals a desired manifest)."""
+        ok = True
+        if base not in self._seeded_bases:
+            if self._seed_cache(base, cache):
+                self._seeded_bases.add(base)
+            else:
+                ok = False  # retry the seed next push; GC waits for it
+        for name, manifest in desired.items():
+            if cache.get(name) == manifest:
+                continue
+            known = name in cache
+            if self._upsert_object(
+                base, name, manifest, known, status_subresource
+            ):
+                cache[name] = manifest
+            else:
+                ok = False
+        for name in [n for n in cache if n not in desired]:
+            try:
+                self._request("DELETE", f"{base}/{name}")
+            except (KubeApiError, OSError, ValueError) as e:
+                if not (isinstance(e, KubeApiError) and e.status == 404):
+                    self._record_error(f"delete {base}/{name}: {e}")
+                    ok = False
+                    continue
+            del cache[name]
+        return ok
+
+    def sync_workload_children(self, podcliques: list, scaling_groups: list) -> bool:
+        """Mirror the operator-owned PodClique / PodCliqueScalingGroup
+        objects to the apiserver as CRs (the reference materializes these
+        as CRs with status; here the store is authoritative and the CRs are
+        a one-way kubectl-visible projection: `kubectl get pclq,pcsg`).
+        Spec carries the scale-relevant fields; status is the full rollup."""
+        from grove_tpu.utils.serde import to_k8s
+
+        ns = urllib.parse.quote(self.ctx.namespace)
+        ok = True
+        for plural, kind, objs, spec_of in (
+            (
+                "podcliques",
+                "PodClique",
+                podcliques,
+                lambda o: {
+                    "roleName": o.spec.role_name,
+                    "replicas": o.spec.replicas,
+                    "minAvailable": o.min_available,
+                },
+            ),
+            (
+                "podcliquescalinggroups",
+                "PodCliqueScalingGroup",
+                scaling_groups,
+                lambda o: {
+                    "replicas": o.spec.replicas,
+                    "minAvailable": o.spec.min_available,
+                    "cliqueNames": list(o.spec.clique_names),
+                },
+            ),
+        ):
+            base = f"/apis/grove.io/v1alpha1/namespaces/{ns}/{plural}"
+            desired = {}
+            for obj in objs:
+                name = obj.metadata.name
+                desired[name] = {
+                    "apiVersion": "grove.io/v1alpha1",
+                    "kind": kind,
+                    "metadata": {
+                        "name": name,
+                        "namespace": self.ctx.namespace,
+                        "labels": {
+                            api_constants.LABEL_MANAGED_BY: api_constants.LABEL_MANAGED_BY_VALUE,
+                            api_constants.LABEL_PART_OF: obj.pcs_name,
+                        },
+                    },
+                    "spec": spec_of(obj),
+                    "status": to_k8s(obj.status),
+                }
+            cache = self._synced_children.setdefault(plural, {})
+            # status_subresource: the child CRDs declare one, so a real
+            # apiserver STRIPS .status from the main POST/PUT — the rollup
+            # must land through PUT .../status or kubectl shows none.
+            ok = (
+                self._sync_collection(
+                    base, desired, cache, status_subresource=True
+                )
+                and ok
+            )
+        return ok
 
     def sync_cluster_topology(self, topology) -> bool:
         """Create/update the cluster-scoped ClusterTopology CR from the

@@ -186,6 +186,18 @@ class WatchDriver:
                     self._attempted_bindings.discard(name)
                     pushed += 1
         pushed += self._push_workload_statuses()
+        sync_services = getattr(self.source, "sync_services", None)
+        if sync_services is not None:
+            # Managed headless Services mirror to the real cluster (pod DNS
+            # needs them); the source change-detects, so this is cheap.
+            sync_services(list(self.cluster.services.values()))
+        sync_children = getattr(self.source, "sync_workload_children", None)
+        if sync_children is not None:
+            # kubectl-visible PodClique/PCSG projections (status included).
+            sync_children(
+                list(self.cluster.podcliques.values()),
+                list(self.cluster.scaling_groups.values()),
+            )
         return pushed
 
     def _push_workload_statuses(self) -> int:

@@ -96,6 +96,63 @@ def render_crd() -> dict:
     }
 
 
+def render_child_crds() -> list[dict]:
+    """PodClique + PodCliqueScalingGroup CRDs: the operator-owned child
+    objects are projected to the apiserver as CRs with live status
+    (`kubectl get pclq,pcsg` — the reference materializes the same kinds).
+    Read-only projections: no scale subresource — the operator is the sole
+    writer of these CRs (an HPA writing spec.replicas here would silently
+    fight the projection; scale through the PodCliqueSet CR or the
+    operator's API instead)."""
+    preserve = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    out = []
+    for kind, plural, singular, short in (
+        ("PodClique", "podcliques", "podclique", "pclq"),
+        (
+            "PodCliqueScalingGroup",
+            "podcliquescalinggroups",
+            "podcliquescalinggroup",
+            "pcsg",
+        ),
+    ):
+        out.append(
+            {
+                "apiVersion": "apiextensions.k8s.io/v1",
+                "kind": "CustomResourceDefinition",
+                "metadata": {"name": f"{plural}.grove.io", "labels": _labels()},
+                "spec": {
+                    "group": "grove.io",
+                    "names": {
+                        "kind": kind,
+                        "listKind": f"{kind}List",
+                        "plural": plural,
+                        "singular": singular,
+                        "shortNames": [short],
+                    },
+                    "scope": "Namespaced",
+                    "versions": [
+                        {
+                            "name": "v1alpha1",
+                            "served": True,
+                            "storage": True,
+                            "schema": {
+                                "openAPIV3Schema": {
+                                    "type": "object",
+                                    "properties": {
+                                        "spec": preserve,
+                                        "status": preserve,
+                                    },
+                                }
+                            },
+                            "subresources": {"status": {}},
+                        }
+                    ],
+                },
+            }
+        )
+    return out
+
+
 def render_topology_crd() -> dict:
     """The cluster-scoped ClusterTopology CRD (`grove.io_clustertopologies`
     upstream; name `grove-topology`, short name `ct`) — the operator writes
@@ -259,6 +316,9 @@ def render_manifests(
         # The topology CR is written at startup regardless of the workload
         # watch; its CRD ships with every kubernetes-source deployment.
         docs.append(render_topology_crd())
+    if cfg.cluster.source == "kubernetes":
+        # Child CR projections (kubectl get pclq,pcsg) ship their CRDs too.
+        docs.extend(render_child_crds())
     if cfg.cluster.source == "kubernetes" and cfg.cluster.watch_workloads:
         # The CR watch needs the grove.io CRD installed; ship it with the
         # operator exactly as the reference chart ships its generated CRDs.
@@ -301,9 +361,21 @@ def render_manifests(
                     "apiGroups": ["grove.io"],
                     # The CR watch + status write-back (status subresource);
                     # delete: an operator-API delete must remove the CR too
-                    # or the next relist resurrects the workload.
-                    "resources": ["podcliquesets", "podcliquesets/status"],
-                    "verbs": ["get", "list", "watch", "update", "patch", "delete"],
+                    # or the next relist resurrects the workload. Child CR
+                    # projections (podcliques/pcsgs) are created and GC'd by
+                    # the operator outright.
+                    "resources": [
+                        "podcliquesets",
+                        "podcliquesets/status",
+                        "podcliques",
+                        "podcliques/status",
+                        "podcliquescalinggroups",
+                        "podcliquescalinggroups/status",
+                    ],
+                    "verbs": [
+                        "get", "list", "watch", "create", "update", "patch",
+                        "delete",
+                    ],
                 },
                 {
                     "apiGroups": ["coordination.k8s.io"],

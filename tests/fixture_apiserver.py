@@ -60,6 +60,12 @@ class FixtureApiServer:
         self.pods: dict[str, dict] = {}
         self.podcliquesets: dict[str, dict] = {}  # the grove.io CRs
         self.clustertopologies: dict[str, dict] = {}  # cluster-scoped CRs
+        self.services: dict[str, dict] = {}  # mirrored headless Services
+        # Child CR projections: plural -> name -> manifest.
+        self.child_crs: dict[str, dict[str, dict]] = {
+            "podcliques": {},
+            "podcliquescalinggroups": {},
+        }
         self.pcs_get_count: dict[str, int] = {}  # per-CR single-GET counter
         self._rv = 0
         self._lock = threading.Lock()
@@ -105,6 +111,44 @@ class FixtureApiServer:
                     else:
                         self._json(200, json.loads(json.dumps(obj)))
                     return
+                svc_prefix = f"/api/v1/namespaces/{fixture.namespace}/services"
+                if parsed.path == svc_prefix:
+                    with fixture._lock:
+                        items = [
+                            o for o in fixture.services.values()
+                            if fixture._matches(o, qs.get("labelSelector", ""))
+                        ]
+                    self._json(200, {"kind": "ServiceList", "items": items})
+                    return
+                if parsed.path.startswith(svc_prefix + "/"):
+                    name = parsed.path[len(svc_prefix) + 1:]
+                    with fixture._lock:
+                        obj = fixture.services.get(name)
+                    if obj is None:
+                        self._json(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._json(200, json.loads(json.dumps(obj)))
+                    return
+                plural = fixture._child_plural_of(parsed.path)
+                if plural is not None:
+                    rest = parsed.path[len(fixture._child_prefix(plural)):]
+                    name = rest.lstrip("/")
+                    with fixture._lock:
+                        if not name:  # list
+                            items = [
+                                o for o in fixture.child_crs[plural].values()
+                                if fixture._matches(
+                                    o, qs.get("labelSelector", "")
+                                )
+                            ]
+                            self._json(200, {"kind": "List", "items": items})
+                            return
+                        obj = fixture.child_crs[plural].get(name)
+                    if obj is None:
+                        self._json(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._json(200, json.loads(json.dumps(obj)))
+                    return
                 if parsed.path.startswith(fixture._pcs_prefix + "/"):
                     name = parsed.path[len(fixture._pcs_prefix) + 1:]
                     with fixture._lock:
@@ -143,6 +187,33 @@ class FixtureApiServer:
                 if parsed.path.startswith(fixture._leases_prefix):
                     code, doc = fixture._lease_put(parsed.path, body)
                     self._json(code, doc)
+                elif fixture._child_plural_of(parsed.path) is not None:
+                    plural = fixture._child_plural_of(parsed.path)
+                    rest = parsed.path[len(fixture._child_prefix(plural)) + 1:]
+                    name, _, sub = rest.partition("/")
+                    with fixture._lock:
+                        cur = fixture.child_crs[plural].get(name)
+                        if cur is None:
+                            self._json(404, {"kind": "Status", "code": 404})
+                            return
+                        if sub == "status":
+                            cur["status"] = body.get("status", {})
+                            self._json(200, json.loads(json.dumps(cur)))
+                            return
+                        sent_rv = body.get("metadata", {}).get("resourceVersion")
+                        if sent_rv != cur["metadata"].get("resourceVersion"):
+                            self._json(409, {"kind": "Status", "code": 409})
+                            return
+                        body = dict(body)
+                        # Status subresource: the main PUT strips status and
+                        # preserves the stored one (real apiserver behavior).
+                        body.pop("status", None)
+                        if "status" in cur:
+                            body["status"] = cur["status"]
+                        fixture._rv += 1
+                        body["metadata"]["resourceVersion"] = str(fixture._rv)
+                        fixture.child_crs[plural][name] = body
+                    self._json(200, json.loads(json.dumps(body)))
                 elif parsed.path.startswith(fixture._ct_prefix + "/"):
                     name = parsed.path[len(fixture._ct_prefix) + 1:]
                     with fixture._lock:
@@ -277,6 +348,17 @@ class FixtureApiServer:
     def _ct_prefix(self) -> str:
         return "/apis/grove.io/v1alpha1/clustertopologies"
 
+    def _child_prefix(self, plural: str) -> str:
+        return f"/apis/grove.io/v1alpha1/namespaces/{self.namespace}/{plural}"
+
+    def _child_plural_of(self, path: str) -> str | None:
+        for plural in self.child_crs:
+            if path == self._child_prefix(plural) or path.startswith(
+                self._child_prefix(plural) + "/"
+            ):
+                return plural
+        return None
+
     @property
     def _pcs_prefix(self) -> str:
         return (
@@ -403,6 +485,26 @@ class FixtureApiServer:
             return 200, json.loads(json.dumps(cur))
 
     def _post(self, path: str, body: dict):
+        plural = self._child_plural_of(path)
+        if plural is not None:
+            name = body["metadata"]["name"]
+            body = dict(body)
+            body.pop("status", None)  # status subresource: main write strips it
+            with self._lock:
+                if name in self.child_crs[plural]:
+                    return 409, {"kind": "Status", "code": 409}
+                self._rv += 1
+                body.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+                self.child_crs[plural][name] = body
+            return 201, json.loads(json.dumps(body))
+        svc_prefix = f"/api/v1/namespaces/{self.namespace}/services"
+        if path == svc_prefix:
+            name = body["metadata"]["name"]
+            with self._lock:
+                if name in self.services:
+                    return 409, {"kind": "Status", "code": 409}
+                self.services[name] = body
+            return 201, json.loads(json.dumps(body))
         if path == self._ct_prefix:
             name = body["metadata"]["name"]
             with self._lock:
@@ -436,6 +538,20 @@ class FixtureApiServer:
         return 404, {"kind": "Status", "code": 404}
 
     def _delete(self, path: str):
+        plural = self._child_plural_of(path)
+        if plural is not None:
+            name = path[len(self._child_prefix(plural)) + 1:]
+            with self._lock:
+                if self.child_crs[plural].pop(name, None) is None:
+                    return 404, {"kind": "Status", "code": 404}
+            return 200, {"kind": "Status", "code": 200}
+        svc_prefix = f"/api/v1/namespaces/{self.namespace}/services/"
+        if path.startswith(svc_prefix):
+            name = path[len(svc_prefix):]
+            with self._lock:
+                if self.services.pop(name, None) is None:
+                    return 404, {"kind": "Status", "code": 404}
+            return 200, {"kind": "Status", "code": 200}
         pods_prefix = f"/api/v1/namespaces/{self.namespace}/pods/"
         if not path.startswith(pods_prefix):
             return 404, {"kind": "Status", "code": 404}

@@ -945,3 +945,162 @@ def test_cluster_topology_cr_synced_at_boot(api, tmp_path):
         "kubernetes.io/hostname",
     ]
     assert len(api.clustertopologies) == 1
+
+
+def test_headless_services_mirrored_to_cluster(api, tmp_path, simple1):
+    """Pod DNS (hostname.subdomain) needs the headless Services to EXIST at
+    the apiserver: the managed Service objects mirror out on push and are
+    deleted when the workload goes."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    api.add_node(k8s_node("n0", cpu="16", memory="64Gi"))
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)
+        deadline = time.monotonic() + 15.0
+        t = 0.0
+        while time.monotonic() < deadline and not api.services:
+            t += 1.0
+            m.reconcile_once(now=t)
+            time.sleep(0.05)
+        assert "simple1-0" in api.services
+        svc = api.services["simple1-0"]
+        assert svc["spec"]["clusterIP"] == "None"
+        assert svc["spec"]["publishNotReadyAddresses"] is True
+        assert svc["spec"]["selector"]
+        m.delete_podcliqueset("simple1")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and api.services:
+            t += 1.0
+            m.reconcile_once(now=t)
+            time.sleep(0.05)
+        assert not api.services, "stale Services must be GC'd"
+    finally:
+        m.stop()
+
+
+def test_child_crs_projected_with_status(api, tmp_path, simple1):
+    """kubectl get pclq,pcsg on a real cluster: the operator projects its
+    PodClique/PCSG objects as CRs with live status, and GCs them with the
+    workload."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    api.add_node(k8s_node("n0", cpu="16", memory="64Gi"))
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)
+        deadline = time.monotonic() + 20.0
+        t = 0.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if api.child_crs["podcliques"] and api.child_crs["podcliquescalinggroups"]:
+                break
+            time.sleep(0.05)
+        pclqs = api.child_crs["podcliques"]
+        assert "simple1-0-frontend" in pclqs
+        assert pclqs["simple1-0-frontend"]["spec"]["roleName"] == "frontend"
+        assert "status" in pclqs["simple1-0-frontend"]
+        pcsgs = api.child_crs["podcliquescalinggroups"]
+        assert "simple1-0-workers" in pcsgs
+        assert pcsgs["simple1-0-workers"]["spec"]["cliqueNames"] == [
+            "prefill", "decode",
+        ]
+        m.delete_podcliqueset("simple1")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if not api.child_crs["podcliques"] and not api.child_crs[
+                "podcliquescalinggroups"
+            ]:
+                break
+            time.sleep(0.05)
+        assert not api.child_crs["podcliques"], "stale pclq CRs must be GC'd"
+        assert not api.child_crs["podcliquescalinggroups"]
+    finally:
+        m.stop()
+
+
+def test_crash_orphans_garbage_collected_on_restart(api, tmp_path):
+    """Managed objects surviving an operator crash (Services, child CRs
+    labeled managed-by) are LISTed into the sync cache at (re)start and
+    GC'd when no workload claims them — an in-memory-only cache would
+    orphan live DNS records forever."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    managed = {"app.kubernetes.io/managed-by": "grove-tpu-operator"}
+    api.services["ghost-0"] = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "ghost-0", "labels": dict(managed)},
+        "spec": {"clusterIP": "None"},
+    }
+    api.child_crs["podcliques"]["ghost-0-w"] = {
+        "apiVersion": "grove.io/v1alpha1", "kind": "PodClique",
+        "metadata": {"name": "ghost-0-w", "labels": dict(managed),
+                     "resourceVersion": "1"},
+        "spec": {},
+    }
+    # An UNMANAGED service must never be touched.
+    api.services["someone-elses"] = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "someone-elses", "labels": {}},
+        "spec": {},
+    }
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        deadline = time.monotonic() + 15.0
+        t = 0.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if (
+                "ghost-0" not in api.services
+                and "ghost-0-w" not in api.child_crs["podcliques"]
+            ):
+                break
+            time.sleep(0.05)
+        assert "ghost-0" not in api.services, "crash orphan must be GC'd"
+        assert "ghost-0-w" not in api.child_crs["podcliques"]
+        assert "someone-elses" in api.services, "unmanaged objects untouched"
+    finally:
+        m.stop()
