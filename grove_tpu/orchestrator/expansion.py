@@ -645,6 +645,13 @@ def _build_pods(
         }
         if base_podgang_name is not None:
             labels[constants.LABEL_BASE_PODGANG] = base_podgang_name
+        if pcsg_fqn is not None:
+            # Member pods carry the PCSG identity (the reference labels
+            # member cliques and their pods the same way,
+            # podcliquescalinggroup/components/podclique/podclique.go:209)
+            # — the PCSG's HPA status.selector selects by this label.
+            labels[constants.LABEL_SCALING_GROUP] = pcsg_fqn
+            labels[constants.LABEL_PCSG_REPLICA_INDEX] = str(pcsg_replica)
         spec = copy.deepcopy(clique_tmpl.spec.pod_spec)
         spec.hostname = naming.pod_hostname(fqn, idx)
         spec.subdomain = headless_service

@@ -39,10 +39,34 @@ def is_starting(pod: Pod) -> bool:
     return pod.is_scheduled and pod.is_active and not pod.ready and not pod.crashlooping
 
 
+
+
+def _hpa_selector(extra_key: str, extra_val: str, pcs_name: str) -> str:
+    """Label-selector string for autoscaler use (mutateSelector analog,
+    podclique/reconcilestatus.go:150-167): the managed-by + part-of labels
+    every built pod carries, narrowed to the owning object."""
+    return ",".join(
+        f"{k}={v}"
+        for k, v in (
+            (constants.LABEL_MANAGED_BY, constants.LABEL_MANAGED_BY_VALUE),
+            (constants.LABEL_PART_OF, pcs_name),
+            (extra_key, extra_val),
+        )
+    )
+
+
 def compute_podclique_status(
     cluster: Cluster, clique: PodClique, now: float, updating: bool = False
 ) -> None:
     """Recompute clique status + conditions in place."""
+    if clique.spec.scale_config is not None:
+        # Autoscaler selector (reference fills it only when scaling is
+        # configured, reconcilestatus.go:150-167).
+        clique.status.selector = _hpa_selector(
+            constants.LABEL_PODCLIQUE, clique.metadata.name, clique.pcs_name
+        )
+    else:
+        clique.status.selector = ""  # scaleConfig removed: no stale selector
     pods = [p for p in cluster.pods_of_clique(clique.metadata.name) if p.is_active]
     scheduled = sum(1 for p in pods if p.is_scheduled)
     ready = sum(1 for p in pods if p.ready)
@@ -101,6 +125,18 @@ def compute_pcsg_status(
     cluster: Cluster, pcsg: PodCliqueScalingGroup, now: float, updating: bool = False
 ) -> None:
     """Aggregate member-clique state per PCSG replica."""
+    owner = cluster.podcliquesets.get(pcsg.pcs_name)
+    if owner is not None and any(
+        cfg.name == pcsg.template_name and cfg.scale_config is not None
+        for cfg in owner.spec.template.pod_clique_scaling_group_configs
+    ):
+        # Autoscaler selector, only when scaling is configured (the
+        # reference's gate, podcliquescalinggroup/reconcilestatus.go:245).
+        pcsg.status.selector = _hpa_selector(
+            constants.LABEL_SCALING_GROUP, pcsg.metadata.name, pcsg.pcs_name
+        )
+    else:
+        pcsg.status.selector = ""
     members = cluster.cliques_of_pcsg(pcsg.metadata.name)
     by_replica: dict[int, list[PodClique]] = defaultdict(list)
     for c in members:
@@ -329,6 +365,16 @@ def compute_pcs_status(cluster: Cluster, pcs: PodCliqueSet, now: float) -> None:
     name = pcs.metadata.name
     st = pcs.status
     st.replicas = pcs.spec.replicas
+    # The PCS CRD's scale subresource points labelSelectorPath here — a
+    # pod-metrics HPA targeting the PCS /scale needs a selector that
+    # matches ALL the set's pods.
+    st.selector = ",".join(
+        f"{k}={v}"
+        for k, v in (
+            (constants.LABEL_MANAGED_BY, constants.LABEL_MANAGED_BY_VALUE),
+            (constants.LABEL_PART_OF, name),
+        )
+    )
     available = 0
     for i in range(pcs.spec.replicas):
         cliques = cluster.cliques_of_pcs_replica(name, i)

@@ -172,3 +172,53 @@ def test_initc_binary_authenticates_with_token_file(tmp_path):
         assert proc.returncode == 0, proc.stdout + proc.stderr
     finally:
         m.stop()
+
+
+def test_hpa_selector_populated_for_scaled_targets(simple1):
+    """status.selector (the HPA labelSelectorPath) is filled exactly for
+    scaled targets (mutateSelector analog): the auto-scaled frontend clique
+    and the workers PCSG get selectors matching their pods' labels; plain
+    cliques stay empty."""
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+
+    c = Cluster()
+    c.podcliquesets["simple1"] = simple1
+    ctrl = GroveController(cluster=c, topology=DEFAULT_CLUSTER_TOPOLOGY)
+    ctrl.sync_workload(simple1, now=1.0)
+    ctrl.update_statuses(now=1.0)
+
+    frontend = c.podcliques["simple1-0-frontend"]
+    sel = frontend.status.selector
+    assert "grove.io/podclique=simple1-0-frontend" in sel
+    assert "app.kubernetes.io/part-of=simple1" in sel
+    # The selector must actually match the clique's pods' labels.
+    pod = next(p for p in c.pods.values() if p.pclq_fqn == "simple1-0-frontend")
+    for clause in sel.split(","):
+        k, _, v = clause.partition("=")
+        assert pod.labels.get(k) == v, f"selector clause {clause} unmatched"
+
+    router = c.podcliques["simple1-0-router"]
+    assert router.status.selector == ""  # no scaleConfig: no selector
+
+    pcsg = c.scaling_groups["simple1-0-workers"]
+    sel = pcsg.status.selector
+    assert "grove.io/podcliquescalinggroup=simple1-0-workers" in sel
+    # The PCSG selector must actually match its member pods (the round-4
+    # review caught a selector over a label pods never carried).
+    member = next(
+        p for p in c.pods.values() if p.pclq_fqn.startswith("simple1-0-workers-")
+    )
+    for clause in sel.split(","):
+        k, _, v = clause.partition("=")
+        assert member.labels.get(k) == v, f"PCSG clause {clause} unmatched"
+    # And the PCS-level selector (the CRD scale labelSelectorPath) matches
+    # EVERY pod of the set.
+    from grove_tpu.orchestrator.status import compute_pcs_status
+
+    compute_pcs_status(c, simple1, now=2.0)
+    pcs_sel = simple1.status.selector
+    assert pcs_sel
+    for pod in c.pods.values():
+        for clause in pcs_sel.split(","):
+            k, _, v = clause.partition("=")
+            assert pod.labels.get(k) == v
