@@ -1104,3 +1104,26 @@ def test_crash_orphans_garbage_collected_on_restart(api, tmp_path):
         assert "someone-elses" in api.services, "unmanaged objects untouched"
     finally:
         m.stop()
+
+
+def test_list_ingest_scales_to_thousands_of_nodes(api):
+    """Scale floor for the informer path: a 2000-node LIST must ingest in
+    seconds, not minutes (one JSON list + translation, no per-node round
+    trips)."""
+    for i in range(2000):
+        api.nodes[f"n{i}"] = k8s_node(
+            f"n{i}", labels={"topology.kubernetes.io/rack": f"r{i // 8}"}
+        )
+    src = _source(api)
+    t0 = time.monotonic()
+    src.start()
+    try:
+        seen = 0
+        while seen < 2000 and time.monotonic() - t0 < 30:
+            seen += len([e for e in src.poll(0.0) if e.kind == "Node"])
+            time.sleep(0.01)
+        elapsed = time.monotonic() - t0
+        assert seen == 2000, f"only {seen} node events after {elapsed:.1f}s"
+        assert elapsed < 30
+    finally:
+        src.stop()
