@@ -3,11 +3,12 @@
 Talks to a running manager (`python -m grove_tpu.runtime`) over its object
 API via the typed client. Commands:
 
-  get pcs|podgangs|pods|nodes|services|hpas     table listing
+  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas   table listing
   get <kind> <name>                             full object as JSON
   apply -f <file.yaml>                          admit a PodCliqueSet
   delete pcs <name>                             cascade-delete
   top                                           per-node requested/capacity
+  scale <fqn> --replicas N                      kubectl-scale analog
   validate -f <file.yaml>                       dry-run admission check
   events [--tail N]                             recent control-plane events
 
@@ -27,6 +28,12 @@ KIND_ALIASES = {
     "pcs": "podcliquesets",
     "podcliqueset": "podcliquesets",
     "podcliquesets": "podcliquesets",
+    "pclq": "podcliques",
+    "podclique": "podcliques",
+    "podcliques": "podcliques",
+    "pcsg": "podcliquescalinggroups",
+    "podcliquescalinggroup": "podcliquescalinggroups",
+    "podcliquescalinggroups": "podcliquescalinggroups",
     "pg": "podgangs",
     "podgang": "podgangs",
     "podgangs": "podgangs",
@@ -62,6 +69,22 @@ def _get_table(client: GroveClient, kind: str) -> str:
             for name, obj in client.list_podcliquesets_full().items()
         ]
         return _table(rows, ["NAME", "REPLICAS", "AVAILABLE"])
+    if kind == "podcliques":
+        rows = []
+        for name, obj in client.list_podcliques_full().items():
+            st = obj.status
+            rows.append(
+                [name, obj.spec.replicas, st.ready_replicas, st.scheduled_replicas]
+            )
+        return _table(rows, ["NAME", "REPLICAS", "READY", "SCHEDULED"])
+    if kind == "podcliquescalinggroups":
+        rows = []
+        for name, obj in client.list_scaling_groups_full().items():
+            st = obj.status
+            rows.append(
+                [name, obj.spec.replicas, st.available_replicas, st.scheduled_replicas]
+            )
+        return _table(rows, ["NAME", "REPLICAS", "AVAILABLE", "SCHEDULED"])
     if kind == "podgangs":
         rows = []
         for name, obj in client.list_podgangs_full().items():

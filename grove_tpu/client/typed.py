@@ -112,6 +112,12 @@ class GroveClient:
     def list_podgangs_full(self) -> dict[str, Any]:
         return self._list_full("podgangs")
 
+    def list_podcliques_full(self) -> dict[str, Any]:
+        return self._list_full("podcliques")
+
+    def list_scaling_groups_full(self) -> dict[str, Any]:
+        return self._list_full("podcliquescalinggroups")
+
     def list_pods_full(self) -> dict[str, Any]:
         return self._list_full("pods")
 
@@ -189,6 +195,8 @@ class FakeGroveClient:
     def _coll(self, kind: str) -> dict:
         return {
             "podcliquesets": self.manager.cluster.podcliquesets,
+            "podcliques": self.manager.cluster.podcliques,
+            "podcliquescalinggroups": self.manager.cluster.scaling_groups,
             "podgangs": self.manager.cluster.podgangs,
             "pods": self.manager.cluster.pods,
             "nodes": self.manager.cluster.nodes,
@@ -217,6 +225,8 @@ class FakeGroveClient:
 
     list_podcliquesets_full = lambda self: self._list_full("podcliquesets")  # noqa: E731
     list_podgangs_full = lambda self: self._list_full("podgangs")  # noqa: E731
+    list_podcliques_full = lambda self: self._list_full("podcliques")  # noqa: E731
+    list_scaling_groups_full = lambda self: self._list_full("podcliquescalinggroups")  # noqa: E731
     list_pods_full = lambda self: self._list_full("pods")  # noqa: E731
     list_nodes_full = lambda self: self._list_full("nodes")  # noqa: E731
 

@@ -117,6 +117,16 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
 
     _COLLECTIONS = {
         "podcliquesets": "podcliquesets",
+        # Cliques/PCSGs are LIST-only here (by-name GET on
+        # /api/v1/podcliques/<fqn> is the initc readiness endpoint, matched
+        # earlier in do_GET; by-name PCSG is blocked for symmetry). With the
+        # authorizer on, these listings are scoped to the presented token's
+        # OWNING PCS — the per-PCS RBAC discipline of the readiness
+        # endpoint. (Pod listings stay namespace-wide for any valid token:
+        # the reference's workload SA Role can list all pods too,
+        # initc/internal/wait.go informers.)
+        "podcliques": "podcliques",
+        "podcliquescalinggroups": "scaling_groups",
         "podgangs": "podgangs",
         "pods": "pods",
         "nodes": "nodes",
@@ -143,6 +153,17 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
             )
             return
         coll = getattr(c, self._COLLECTIONS[kind])
+        scoped = kind in ("podcliques", "podcliquescalinggroups")
+        if scoped and len(parts) > 1:
+            self._respond(404, "not found")  # LIST-only collections
+            return
+        if scoped and self.manager.config.authorizer.enabled:
+            owner = self._token_pcs()
+            coll = {
+                name: obj
+                for name, obj in coll.items()
+                if getattr(obj, "pcs_name", None) == owner
+            }
         if len(parts) == 1:
             if query == "full=1":
                 # Bulk listing: one response with every object, so table
@@ -274,6 +295,24 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
             return
         self.manager.delete_podcliqueset(name, actor=actor)
         self._respond(200, json.dumps({"deleted": name}), "application/json")
+
+    def _token_pcs(self):
+        """The PCS whose initc token secret matches the presented bearer
+        credential, or None — the per-PCS scope for clique/PCSG listings."""
+        import hmac
+
+        from grove_tpu.api import naming
+
+        auth = self.headers.get("Authorization", "")
+        for pcs_name in list(self.manager.cluster.podcliquesets):
+            secret = self.manager.cluster.secrets.get(
+                naming.initc_sa_token_secret_name(pcs_name)
+            )
+            if secret is not None and hmac.compare_digest(
+                auth, f"Bearer {secret.token}"
+            ):
+                return pcs_name
+        return None
 
     def _authorized(self, clique) -> bool:
         """SA-token check (satokensecret component made real): when the

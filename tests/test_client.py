@@ -154,3 +154,62 @@ def test_cli_validate_dry_run(tmp_path):
     )
     assert r.returncode == 1
     assert "minAvailable" in r.stderr
+
+
+def test_clique_and_pcsg_listings(served, simple1):
+    """The pclq/pcsg collections serve bulk listings on both client
+    surfaces (LIST-only: by-name GET on /api/v1/podcliques/<fqn> is the
+    initc readiness endpoint)."""
+    m, http_client = served
+    m.cluster.podcliquesets[simple1.metadata.name] = simple1
+    m.reconcile_once(now=1.0)
+    cliques = http_client.list_podcliques_full()
+    assert "simple1-0-frontend" in cliques
+    assert cliques["simple1-0-frontend"].spec.role_name == "frontend"
+    pcsgs = http_client.list_scaling_groups_full()
+    assert "simple1-0-workers" in pcsgs
+    fake = FakeGroveClient(m)
+    assert set(fake.list_podcliques_full()) == set(cliques)
+    assert set(fake.list_scaling_groups_full()) == set(pcsgs)
+
+
+def test_clique_listing_scoped_to_token_pcs(simple1, simple1_variant):
+    """With the authorizer on, clique/PCSG listings are scoped to the
+    presented token's owning PCS (per-PCS RBAC: workload A's credential
+    must not enumerate workload B's clique objects); by-name PCSG GET is
+    blocked (LIST-only)."""
+    import urllib.error
+
+    from grove_tpu.api import naming
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": 0, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "authorizer": {"enabled": True},
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.cluster.podcliquesets[simple1.metadata.name] = simple1
+        m.cluster.podcliquesets[simple1_variant.metadata.name] = simple1_variant
+        m.reconcile_once(now=1.0)
+        token_a = m.cluster.secrets[
+            naming.initc_sa_token_secret_name("simple1")
+        ].token
+        client_a = GroveClient(
+            f"http://127.0.0.1:{m.health_port}", token=token_a
+        )
+        cliques = client_a.list_podcliques_full()
+        assert cliques and all(n.startswith("simple1-") for n in cliques)
+        assert not any(n.startswith("variant1-") for n in cliques)
+        pcsgs = client_a.list_scaling_groups_full()
+        assert set(pcsgs) == {"simple1-0-workers"}
+        # By-name PCSG is LIST-only.
+        with pytest.raises(GroveApiError) as ei:
+            client_a._get("podcliquescalinggroups", "simple1-0-workers")
+        assert ei.value.status == 404
+    finally:
+        m.stop()
