@@ -16,9 +16,14 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from grove_tpu.api import constants
 from grove_tpu.api.defaulting import default_podcliqueset
 from grove_tpu.api.types import ClusterTopology, PodCliqueSet
-from grove_tpu.api.validation import validate_podcliqueset, validate_update
+from grove_tpu.api.validation import (
+    ValidationError,
+    validate_podcliqueset,
+    validate_update,
+)
 
 # The reconciler's own identity; always allowed to touch managed resources.
 OPERATOR_ACTOR = "system:grove-operator"
@@ -61,6 +66,11 @@ class AdmissionChain:
 
     topology: ClusterTopology | None = None
     authorizer: Authorizer = None  # type: ignore[assignment]
+    # Configured capacity queue names (scheduling.queues); None = don't
+    # check (e.g. the CLI's config-less dry run). A workload naming an
+    # unknown queue is rejected at the door — a typo'd queue would
+    # otherwise silently run unquoted.
+    known_queues: frozenset | None = None
 
     def __post_init__(self):
         if self.authorizer is None:
@@ -79,6 +89,15 @@ class AdmissionChain:
         errors = validate_podcliqueset(pcs, self.topology)
         if old is not None:
             errors += validate_update(old, pcs)
+        queue = pcs.metadata.annotations.get(constants.ANNOTATION_QUEUE, "")
+        if queue and self.known_queues is not None and queue not in self.known_queues:
+            errors = errors + [
+                ValidationError(
+                    f"metadata.annotations[{constants.ANNOTATION_QUEUE}]",
+                    f"unknown queue {queue!r} (configured: "
+                    f"{sorted(self.known_queues) or 'none'})",
+                )
+            ]
         if errors:
             raise AdmissionError(errors)
         return pcs

@@ -71,6 +71,9 @@ FINALIZER_PCSG = "grove.io/podcliquescalinggroup-protection"
 
 ANNOTATION_MNNVL = "grove.io/network-acceleration"  # analog: TPU slice acceleration
 ANNOTATION_ICI_DOMAIN = "grove.io/ici-domain"  # TPU-native: pin gang to ICI domain
+# Capacity queue this workload's gangs draw quota from (the KAI Queue
+# analog, e2e/yaml/queues.yaml; scheduling.queues in the operator config).
+ANNOTATION_QUEUE = "grove.io/queue"
 
 # Default PodCliqueSet name budget: pod names must fit the 63-char DNS label after
 # the operator appends `-<i>-[<pcsg>-<j>-]<pclq>-<5char suffix>`

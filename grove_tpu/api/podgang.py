@@ -123,6 +123,10 @@ class PodGang:
     status: PodGangStatus = field(default_factory=PodGangStatus)
     # Bookkeeping mirrored from labels in the reference:
     pcs_name: str = ""
+    # Capacity queue (grove.io/queue annotation; "" = unquoted). The KAI
+    # Queue analog — quota enforcement is the controller's pre-solve
+    # admission filter (orchestrator/controller.py _solve_wave).
+    queue: str = ""
     pcs_replica_index: int = 0
     # For scaled gangs: the base gang that must schedule first
     # (grove.io/base-podgang label; podclique/components/pod/syncflow.go:347-387).

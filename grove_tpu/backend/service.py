@@ -16,7 +16,10 @@ plus the placement cycle KAI performs out-of-band in the reference:
   UpdateCluster        — node snapshot feed (the informer-cache analog)
   ReleasePods          — free capacity for externally deleted pods
   Solve                — drain pending gangs through the JAX batched solver;
-                         whole-gang bindings + PlacementScore out
+                         whole-gang bindings + PlacementScore out. (Capacity
+                         queues — scheduling.queues — are enforced by the
+                         OPERATOR path's admission filter, not here: an
+                         external Go operator brings its own quota system.)
 
 The service is a thin, locked translation layer: proto -> PodGang IR ->
 dense encode -> jitted solve -> bindings. All placement state (nodes, gangs,

@@ -241,16 +241,21 @@ def main(argv=None) -> int:
             from grove_tpu.api.admission import AdmissionChain, AdmissionError
 
             topology = DEFAULT_CLUSTER_TOPOLOGY
+            known_queues = None
             if args.config:
                 from grove_tpu.runtime.config import load_operator_config
 
-                topology = load_operator_config(args.config).cluster_topology()
+                opcfg = load_operator_config(args.config)
+                topology = opcfg.cluster_topology()
+                # The server rejects unknown queues; the dry run must too
+                # or validate would bless a file apply then bounces.
+                known_queues = frozenset(opcfg.scheduling.queues)
             try:
                 with open(args.filename) as f:
                     doc = _yaml.safe_load(f)
-                pcs = AdmissionChain(topology=topology).admit_podcliqueset(
-                    PodCliqueSet.from_dict(doc)
-                )
+                pcs = AdmissionChain(
+                    topology=topology, known_queues=known_queues
+                ).admit_podcliqueset(PodCliqueSet.from_dict(doc))
             except AdmissionError as e:
                 for err in e.errors:
                     print(f"invalid: {err}", file=sys.stderr)

@@ -91,6 +91,12 @@ class GroveController:
     # set by the floors wave when some gang has gated pods beyond its floor;
     # gates the extras wave (see solve_pending)
     _extras_candidates: bool = False
+    # Capacity queues (scheduling.queues; KAI Queue analog): name ->
+    # {resource: quota-or--1}; gangs opt in via the grove.io/queue
+    # annotation (expansion stamps PodGang.queue).
+    queues: dict = field(default_factory=dict)
+    # Event dedupe for quota-blocked gangs (one event per block episode).
+    _quota_blocked: set = field(default_factory=set)
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -216,6 +222,10 @@ class GroveController:
                 existing.spec.topology_constraint_group_configs = (
                     gang.spec.topology_constraint_group_configs
                 )
+                # Annotations are mutable: a live gang must follow its PCS
+                # to a new capacity queue or it would silently keep draining
+                # the old queue's quota forever.
+                existing.queue = gang.queue
                 existing.spec.pod_groups = _merge_pod_groups(
                     existing.spec.pod_groups, gang.spec.pod_groups
                 )
@@ -321,6 +331,10 @@ class GroveController:
         exception, not the rule) — otherwise the second scan over every gang
         and pod is pure overhead at fleet scale."""
         self._extras_candidates = False
+        # Prune quota-block dedupe entries for gangs that no longer exist
+        # (rolling updates churn gang names; same discipline as
+        # _preempted_for_at): a recreated namesake must event again.
+        self._quota_blocked &= set(self.cluster.podgangs)
         admitted = self._solve_wave(now, floors_only=True)
         if self._extras_candidates:
             self._solve_wave(now, floors_only=False)
@@ -344,6 +358,31 @@ class GroveController:
         pending = sort_pending(
             pending, lambda g: self.priority_classes.get(g.spec.priority_class_name, 0)
         )
+
+        # Queue quotas (the KAI Queue analog): remaining headroom per queue
+        # from the CURRENT bound usage; each gang's encode-set demand draws
+        # it down in priority order below. Only built when queues exist.
+        queue_remaining: dict[str, dict[str, float | None]] = {}
+        if self.queues:
+            usage: dict[str, dict[str, float]] = {}
+            for pod in c.pods.values():
+                if not (pod.is_scheduled and pod.is_active):
+                    continue
+                owner = c.podgangs.get(pod.podgang_name)
+                qname = getattr(owner, "queue", "") if owner else ""
+                if not qname:
+                    continue
+                acc = usage.setdefault(qname, {})
+                for res, qty in pod.spec.total_requests().items():
+                    acc[res] = acc.get(res, 0.0) + qty
+            for qname, res in self.queues.items():
+                used = usage.get(qname, {})
+                queue_remaining[qname] = {
+                    rname: (
+                        None if quota == -1 else float(quota) - used.get(rname, 0.0)
+                    )
+                    for rname, quota in res.items()
+                }
 
         # Partial gangs: encode only gated pods; floors shrink by bound pods
         # (shared discipline: solver/planner.py). Bound pods' node NAMES are
@@ -392,6 +431,37 @@ class GroveController:
             sub = build_pending_subgang(gang, unbound_refs, bound_counts)
             if sub is None:
                 continue
+            rem = queue_remaining.get(gang.queue) if gang.queue else None
+            if rem is not None:
+                # Hard quota: this wave's encode-set demand must fit the
+                # queue's remaining headroom or the gang waits (no solver
+                # cost; re-offered next pass as usage frees). Granted in
+                # priority order — `pending` is already sorted.
+                demand: dict[str, float] = {}
+                for refs in unbound_refs.values():
+                    for ref in refs:
+                        pod = c.pods.get(ref.name)
+                        if pod is None:
+                            continue
+                        for res, qty in pod.spec.total_requests().items():
+                            demand[res] = demand.get(res, 0.0) + qty
+                if all(
+                    lim is None or demand.get(rname, 0.0) <= lim + 1e-9
+                    for rname, lim in rem.items()
+                ):
+                    for rname, lim in rem.items():
+                        if lim is not None:
+                            rem[rname] = lim - demand.get(rname, 0.0)
+                    self._quota_blocked.discard(gang.name)
+                else:
+                    if gang.name not in self._quota_blocked:
+                        self._quota_blocked.add(gang.name)
+                        c.record_event(
+                            now,
+                            gang.name,
+                            f"gang waiting on queue {gang.queue!r} quota",
+                        )
+                    continue
             sub_gangs.append(sub)
             if per_group_nodes:
                 bound_node_names[gang.name] = per_group_nodes

@@ -183,6 +183,9 @@ def expand_podcliqueset(
             pcs_replica_index=pcs_replica,
             base_podgang_name=base_name,
             scaled_index=scaled_index,
+            # Capacity queue rides the PCS annotation (KAI Queue analog);
+            # every gang of the set draws from the same queue.
+            queue=pcs.metadata.annotations.get(constants.ANNOTATION_QUEUE, ""),
             spec=PodGangSpec(
                 priority_class_name=tmpl.priority_class_name,
                 topology_constraint=translate_pack_constraint(

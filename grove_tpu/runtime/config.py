@@ -98,9 +98,17 @@ class NetworkAccelerationConfig:
 @dataclass
 class SchedulingConfig:
     """Priority classes (the chart's priorityclass.yaml analog): name ->
-    numeric priority consumed by the preemption pass and pending-sort."""
+    numeric priority consumed by the preemption pass and pending-sort.
+
+    `queues` is the KAI Queue analog (the reference deploys KAI queues,
+    e2e/setup/kai_scheduler.go:90): name -> {resource: quota}, quantity
+    strings or -1 for unlimited. A PodCliqueSet opts in with the
+    `grove.io/queue` annotation; its gangs' floors are admitted only while
+    the queue's cumulative usage fits the quota (hard quota — KAI's
+    over-quota fair-share borrowing is out of scope)."""
 
     priority_classes: dict[str, int] = field(default_factory=dict)
+    queues: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -267,6 +275,7 @@ _CAMEL_FIELDS = {
     "autoSliceEnabled": "auto_slice_enabled",
     "sliceResourceName": "slice_resource_name",
     "priorityClasses": "priority_classes",
+    "queues": "queues",
     "maxGroups": "max_groups",
     "maxSets": "max_sets",
     "maxPods": "max_pods",
@@ -404,6 +413,28 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             errors.append(f"topologyAwareScheduling.levels: {e}")
     if cfg.persistence.enabled and not cfg.persistence.path:
         errors.append("persistence.path: required when persistence is enabled")
+    if not isinstance(cfg.scheduling.queues, dict):
+        errors.append("scheduling.queues: must be a mapping of name -> quotas")
+    else:
+        from grove_tpu.api.quantity import parse_quantity as _pq
+
+        for qname, res in cfg.scheduling.queues.items():
+            if not isinstance(res, dict):
+                errors.append(
+                    f"scheduling.queues.{qname}: must map resource -> quota"
+                )
+                continue
+            for rname, quota in res.items():
+                if quota == -1:
+                    continue  # unlimited (the KAI -1 convention)
+                try:
+                    if _pq(quota) < 0:
+                        raise ValueError("negative")
+                except (ValueError, TypeError):
+                    errors.append(
+                        f"scheduling.queues.{qname}.{rname}: {quota!r} is "
+                        "not a quantity or -1"
+                    )
     pf = cfg.solver.portfolio
     if not isinstance(pf, int) or isinstance(pf, bool) or pf < 1:
         errors.append("solver.portfolio: must be an int >= 1")

@@ -352,6 +352,20 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
         pass
 
 
+def _parse_queue_quotas(queues: dict) -> dict:
+    """scheduling.queues (quantity strings / -1) -> numeric quotas for the
+    controller's admission filter (validated at config load)."""
+    from grove_tpu.api.quantity import parse_quantity
+
+    return {
+        qname: {
+            rname: (-1 if quota == -1 else parse_quantity(quota))
+            for rname, quota in res.items()
+        }
+        for qname, res in queues.items()
+    }
+
+
 class Manager:
     """Boots and runs the control plane from one OperatorConfiguration."""
 
@@ -371,6 +385,7 @@ class Manager:
             topology=self.topology,
             solver_params=config.solver.solver_params(),
             priority_classes=dict(config.scheduling.priority_classes),
+            queues=_parse_queue_quotas(config.scheduling.queues),
             tas_enabled=config.topology_aware_scheduling.enabled,
             max_groups=config.solver.max_groups,
             max_sets=config.solver.max_sets,
@@ -422,6 +437,7 @@ class Manager:
                 enabled=config.authorizer.enabled,
                 exempt_actors=tuple(config.authorizer.exempt_actors),
             ),
+            known_queues=frozenset(config.scheduling.queues),
         )
 
         self._m_reconciles = self.metrics.counter(

@@ -1,0 +1,174 @@
+"""Capacity queues (the KAI Queue analog, `e2e/yaml/queues.yaml`):
+scheduling.queues quotas + the grove.io/queue annotation gate gang
+admission at the solver door — hard quota, priority-ordered grants,
+re-offered as usage frees."""
+
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from grove_tpu.api import PodCliqueSet, constants
+from grove_tpu.client.typed import GroveApiError
+from grove_tpu.runtime.config import parse_operator_config
+from grove_tpu.runtime.manager import Manager
+
+
+def _mgr(queues: dict) -> Manager:
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "scheduling": {"queues": queues},
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    # An 8-node fleet with plenty of raw capacity: quota, not capacity,
+    # must be the binding constraint in these tests.
+    from grove_tpu.state import Node
+
+    for i in range(8):
+        m.cluster.nodes[f"n{i}"] = Node(
+            name=f"n{i}",
+            capacity={"cpu": 64.0, "memory": 256 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/block": "b0",
+                "topology.kubernetes.io/rack": f"r{i % 2}",
+            },
+        )
+    return m
+
+
+def test_queue_config_validation():
+    _, errors = parse_operator_config(
+        {"scheduling": {"queues": {"team-a": {"cpu": "10", "memory": "32Gi"}}}}
+    )
+    assert not errors
+    _, errors = parse_operator_config(
+        {"scheduling": {"queues": {"team-a": {"cpu": "ten"}}}}
+    )
+    assert any("team-a.cpu" in e for e in errors)
+    _, errors = parse_operator_config(
+        {"scheduling": {"queues": {"team-a": "nope"}}}
+    )
+    assert any("team-a" in e for e in errors)
+    # -1 = unlimited (KAI's convention).
+    _, errors = parse_operator_config(
+        {"scheduling": {"queues": {"team-a": {"cpu": -1}}}}
+    )
+    assert not errors
+
+
+def test_unknown_queue_rejected_at_admission(simple1):
+    m = _mgr({"team-a": {"cpu": "10"}})
+    bad = copy.deepcopy(simple1)
+    bad.metadata.annotations[constants.ANNOTATION_QUEUE] = "no-such-queue"
+    from grove_tpu.api.admission import AdmissionError
+
+    with pytest.raises(AdmissionError, match="unknown queue"):
+        m.apply_podcliqueset(bad)
+    good = copy.deepcopy(simple1)
+    good.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+    m.apply_podcliqueset(good)
+
+
+def test_quota_gates_admission_and_frees_with_usage(simple1, simple1_variant):
+    """Two workloads in one queue whose quota fits only one: the first
+    admits, the second waits with an event, and deleting the first lets
+    the second through — capacity was never the constraint."""
+    # simple1's base gang floor requests 13 pods x 10m cpu = 0.13 cpu.
+    # Quota 0.15 cpu fits exactly one workload's gangs.
+    m = _mgr({"team-a": {"cpu": "150m"}})
+    a = copy.deepcopy(simple1)
+    a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+    b = copy.deepcopy(simple1_variant)
+    b.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+    m.apply_podcliqueset(a)
+    m.apply_podcliqueset(b)
+    for t in range(1, 6):
+        m.reconcile_once(now=float(t))
+    bound_a = [
+        p for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("simple1-") and p.is_scheduled
+    ]
+    bound_b = [
+        p for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("variant1-") and p.is_scheduled
+    ]
+    assert len(bound_a) == 13, "first workload fills the quota"
+    assert not bound_b, "second workload must wait on quota"
+    assert any(
+        "queue 'team-a' quota" in msg for _, _, msg in m.cluster.events
+    )
+    # Quota frees when the first workload goes.
+    m.delete_podcliqueset("simple1")
+    for t in range(6, 12):
+        m.reconcile_once(now=float(t))
+    bound_b = [
+        p for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("variant1-") and p.is_scheduled
+    ]
+    assert len(bound_b) == 13, "quota released; second workload admits"
+
+
+def test_unquoted_workloads_ignore_queues(simple1):
+    """No annotation = unquoted: queues in config never throttle it."""
+    m = _mgr({"team-a": {"cpu": "1m"}})  # tiny quota, irrelevant
+    m.apply_podcliqueset(copy.deepcopy(simple1))
+    for t in range(1, 5):
+        m.reconcile_once(now=float(t))
+    assert all(p.is_scheduled for p in m.cluster.pods.values())
+
+
+def test_unlimited_quota_never_blocks(simple1):
+    m = _mgr({"team-a": {"cpu": -1, "memory": "1Ti"}})
+    a = copy.deepcopy(simple1)
+    a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+    m.apply_podcliqueset(a)
+    for t in range(1, 5):
+        m.reconcile_once(now=float(t))
+    assert all(p.is_scheduled for p in m.cluster.pods.values())
+
+
+def test_annotation_update_moves_live_gangs_between_queues(simple1):
+    """Annotations are mutable: updating grove.io/queue on a live PCS must
+    move its EXISTING gangs to the new queue (review finding: the gang
+    upsert previously kept the old queue forever)."""
+    m = _mgr({"team-a": {"cpu": "10"}, "team-b": {"cpu": "10"}})
+    a = copy.deepcopy(simple1)
+    a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+    m.apply_podcliqueset(a)
+    m.reconcile_once(now=1.0)
+    assert all(g.queue == "team-a" for g in m.cluster.podgangs.values())
+    moved = copy.deepcopy(a)
+    moved.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-b"
+    m.apply_podcliqueset(moved)
+    m.reconcile_once(now=2.0)
+    assert m.cluster.podgangs, "gangs survive the annotation update"
+    assert all(g.queue == "team-b" for g in m.cluster.podgangs.values())
+
+
+def test_cli_validate_checks_queues_with_config(tmp_path, capsys):
+    """`validate --config` runs the SAME queue check the server runs."""
+    import yaml as _yaml
+
+    from grove_tpu.cli.main import main as cli_main
+
+    opcfg = tmp_path / "op.yaml"
+    opcfg.write_text(_yaml.safe_dump({"scheduling": {"queues": {"team-a": {"cpu": "10"}}}}))
+    doc = _yaml.safe_load(open("examples/simple1.yaml"))
+    doc.setdefault("metadata", {}).setdefault("annotations", {})[
+        "grove.io/queue"
+    ] = "no-such-queue"
+    wl = tmp_path / "wl.yaml"
+    wl.write_text(_yaml.safe_dump(doc))
+    rc = cli_main(["validate", "-f", str(wl), "--config", str(opcfg)])
+    assert rc == 1
+    assert "unknown queue" in capsys.readouterr().err
+    doc["metadata"]["annotations"]["grove.io/queue"] = "team-a"
+    wl.write_text(_yaml.safe_dump(doc))
+    rc = cli_main(["validate", "-f", str(wl), "--config", str(opcfg)])
+    assert rc == 0
