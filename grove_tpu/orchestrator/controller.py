@@ -97,6 +97,9 @@ class GroveController:
     queues: dict = field(default_factory=dict)
     # Event dedupe for quota-blocked gangs (one event per block episode).
     _quota_blocked: set = field(default_factory=set)
+    # Floors wave's post-grant remaining quota, consumed by the extras wave
+    # (see solve_pending) — saves a full pod scan per pass.
+    _queue_remaining_carry: dict | None = None
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -335,6 +338,12 @@ class GroveController:
         # (rolling updates churn gang names; same discipline as
         # _preempted_for_at): a recreated namesake must event again.
         self._quota_blocked &= set(self.cluster.podgangs)
+        # One queue-usage scan per pass: the floors wave computes remaining
+        # quota from live usage and leaves its post-grant remainder here for
+        # the extras wave (a floor grant the SOLVER then rejected makes the
+        # extras view conservative for one pass — extras are best-effort
+        # and the next pass recomputes from real bindings).
+        self._queue_remaining_carry = None
         admitted = self._solve_wave(now, floors_only=True)
         if self._extras_candidates:
             self._solve_wave(now, floors_only=False)
@@ -364,25 +373,21 @@ class GroveController:
         # it down in priority order below. Only built when queues exist.
         queue_remaining: dict[str, dict[str, float | None]] = {}
         if self.queues:
-            usage: dict[str, dict[str, float]] = {}
-            for pod in c.pods.values():
-                if not (pod.is_scheduled and pod.is_active):
-                    continue
-                owner = c.podgangs.get(pod.podgang_name)
-                qname = getattr(owner, "queue", "") if owner else ""
-                if not qname:
-                    continue
-                acc = usage.setdefault(qname, {})
-                for res, qty in pod.spec.total_requests().items():
-                    acc[res] = acc.get(res, 0.0) + qty
-            for qname, res in self.queues.items():
-                used = usage.get(qname, {})
-                queue_remaining[qname] = {
-                    rname: (
-                        None if quota == -1 else float(quota) - used.get(rname, 0.0)
-                    )
-                    for rname, quota in res.items()
-                }
+            if not floors_only and self._queue_remaining_carry is not None:
+                queue_remaining = self._queue_remaining_carry
+            else:
+                usage = self.queue_usage()
+                for qname, res in self.queues.items():
+                    used = usage.get(qname, {})
+                    queue_remaining[qname] = {
+                        rname: (
+                            None
+                            if quota == -1
+                            else float(quota) - used.get(rname, 0.0)
+                        )
+                        for rname, quota in res.items()
+                    }
+                self._queue_remaining_carry = queue_remaining
 
         # Partial gangs: encode only gated pods; floors shrink by bound pods
         # (shared discipline: solver/planner.py). Bound pods' node NAMES are
@@ -599,6 +604,24 @@ class GroveController:
             if rejected:
                 self._preempt_for_rejected(rejected, now)
         return admitted
+
+    def queue_usage(self) -> dict[str, dict[str, float]]:
+        """Bound-and-active resource usage per capacity queue — the number
+        the quota filter subtracts and the observability surfaces report
+        (statusz/metrics)."""
+        c = self.cluster
+        usage: dict[str, dict[str, float]] = {}
+        for pod in c.pods.values():
+            if not (pod.is_scheduled and pod.is_active):
+                continue
+            owner = c.podgangs.get(pod.podgang_name)
+            qname = getattr(owner, "queue", "") if owner else ""
+            if not qname:
+                continue
+            acc = usage.setdefault(qname, {})
+            for res, qty in pod.spec.total_requests().items():
+                acc[res] = acc.get(res, 0.0) + qty
+        return usage
 
     def _priority_of(self, gang: PodGang) -> int:
         return self.priority_classes.get(gang.spec.priority_class_name, 0)

@@ -455,6 +455,12 @@ class Manager:
         self._m_gangs_admitted = self.metrics.counter(
             "grove_gangs_admitted_total", "Gangs admitted by the solver"
         )
+        self._m_queue_used = self.metrics.gauge(
+            "grove_queue_used", "Bound resource usage per capacity queue"
+        )
+        # Every (queue, resource) series ever emitted — re-zeroed each pass
+        # when usage disappears (gauge values persist otherwise).
+        self._queue_metric_keys: dict[str, set] = {}
 
     # --- object apply surface (admission-gated; kubectl-apply analog) -------------
 
@@ -602,8 +608,29 @@ class Manager:
     def statusz(self) -> dict:
         from grove_tpu.version import build_info
 
+        queues = {}
+        if self.controller.queues:
+            # HTTP thread vs reconcile thread: queue_usage iterates the pod
+            # dict, so retry the rare mid-iteration resize (same discipline
+            # as the object-API bulk reads).
+            for _ in range(8):
+                try:
+                    usage = self.controller.queue_usage()
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                usage = {}
+            queues = {
+                qname: {
+                    "quota": dict(res),
+                    "used": dict(usage.get(qname, {})),
+                }
+                for qname, res in self.controller.queues.items()
+            }
         return {
             "build": build_info(),
+            "queues": queues,
             "leader": self._is_leader,
             "backend_port": self.backend_port,
             "objects": {
@@ -922,6 +949,22 @@ class Manager:
         if admitted_box["n"]:
             self._m_gangs_admitted.inc(admitted_box["n"])
         self._next_requeue = outcome.requeue_after_seconds
+        if self.controller.queues:
+            # Per-queue usage gauges (GREP-244 metrics direction): refreshed
+            # per pass so /metrics mirrors the quota filter's view. Every
+            # series ever emitted is re-set each pass (zero when usage is
+            # gone) — gauges are persistent, so skip-when-absent would
+            # freeze a drained queue at its last nonzero value forever.
+            usage = self.controller.queue_usage()
+            for qname, res in self.controller.queues.items():
+                keys = set(res) | set(usage.get(qname, {}))
+                self._queue_metric_keys.setdefault(qname, set()).update(keys)
+                for rname in self._queue_metric_keys[qname]:
+                    self._m_queue_used.set(
+                        usage.get(qname, {}).get(rname, 0.0),
+                        queue=qname,
+                        resource=rname,
+                    )
         if self.watch is not None:
             try:
                 self.watch.push(now)

@@ -172,3 +172,86 @@ def test_cli_validate_checks_queues_with_config(tmp_path, capsys):
     wl.write_text(_yaml.safe_dump(doc))
     rc = cli_main(["validate", "-f", str(wl), "--config", str(opcfg)])
     assert rc == 0
+
+
+def test_queue_observability_statusz_and_metrics(simple1):
+    """Per-queue quota + live usage surface on /statusz and /metrics."""
+    import json
+    import urllib.request
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": 0, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "scheduling": {"queues": {"team-a": {"cpu": "10"}}},
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    from grove_tpu.state import Node
+
+    for i in range(4):
+        m.cluster.nodes[f"n{i}"] = Node(
+            name=f"n{i}",
+            capacity={"cpu": 64.0, "memory": 256 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/block": "b0",
+                "topology.kubernetes.io/rack": f"r{i % 2}",
+            },
+        )
+    m.start()
+    try:
+        a = copy.deepcopy(simple1)
+        a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+        m.apply_podcliqueset(a)
+        for t in range(1, 4):
+            m.reconcile_once(now=float(t))
+        base = f"http://127.0.0.1:{m.health_port}"
+        st = json.loads(urllib.request.urlopen(f"{base}/statusz").read())
+        q = st["queues"]["team-a"]
+        assert q["quota"] == {"cpu": 10.0}
+        assert abs(q["used"]["cpu"] - 0.13) < 1e-6  # 13 pods x 10m
+        metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        line = next(
+            ln for ln in metrics.splitlines()
+            if ln.startswith('grove_queue_used{queue="team-a",resource="cpu"}')
+        )
+        assert abs(float(line.split()[-1]) - 0.13) < 1e-6
+    finally:
+        m.stop()
+
+
+def test_queue_gauge_zeroes_when_usage_drains(simple1):
+    """Gauges persist: a drained queue must report 0, not its last nonzero
+    value (review finding)."""
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "scheduling": {"queues": {"team-a": {"cpu": "10"}}},
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    from grove_tpu.state import Node
+
+    for i in range(4):
+        m.cluster.nodes[f"n{i}"] = Node(
+            name=f"n{i}",
+            capacity={"cpu": 64.0, "memory": 256 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/block": "b0",
+                "topology.kubernetes.io/rack": f"r{i % 2}",
+            },
+        )
+    a = copy.deepcopy(simple1)
+    a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+    m.apply_podcliqueset(a)
+    for t in range(1, 4):
+        m.reconcile_once(now=float(t))
+    assert m._m_queue_used.value(queue="team-a", resource="cpu") > 0
+    m.delete_podcliqueset("simple1")
+    m.reconcile_once(now=5.0)
+    assert m._m_queue_used.value(queue="team-a", resource="cpu") == 0.0
