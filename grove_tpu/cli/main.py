@@ -46,6 +46,8 @@ KIND_ALIASES = {
     "services": "services",
     "hpa": "hpas",
     "hpas": "hpas",
+    "queue": "queues",
+    "queues": "queues",
 }
 
 
@@ -106,6 +108,16 @@ def _get_table(client: GroveClient, kind: str) -> str:
             cap = ",".join(f"{k}={v:g}" for k, v in sorted(obj.capacity.items()))
             rows.append([name, "yes" if obj.schedulable else "no", cap])
         return _table(rows, ["NAME", "SCHEDULABLE", "CAPACITY"])
+    if kind == "queues":
+        rows = []
+        for qname, doc in sorted(client.statusz().get("queues", {}).items()):
+            quota = ",".join(
+                f"{r}={'unlimited' if q == -1 else q}"
+                for r, q in sorted(doc["quota"].items())
+            )
+            used = ",".join(f"{r}={v:g}" for r, v in sorted(doc["used"].items()))
+            rows.append([qname, quota or "-", used or "-"])
+        return _table(rows, ["NAME", "QUOTA", "USED"])
     if kind == "services":
         return _table([[n] for n in client.list_services()], ["NAME"])
     if kind == "hpas":

@@ -181,6 +181,11 @@ class GroveClient:
         )
         return resp["previous"]
 
+    def statusz(self) -> dict:
+        """Operator status document (build info, leadership, queue
+        quota/usage, object counts)."""
+        return self._request("GET", "/statusz")
+
 
 class FakeGroveClient:
     """In-process fake with the same typed surface (fake-clientset analog).
@@ -276,6 +281,9 @@ class FakeGroveClient:
         if name not in self.manager.cluster.podcliquesets:
             raise GroveApiError(404, ["not found"])
         self.manager.delete_podcliqueset(name, actor=self.actor)
+
+    def statusz(self) -> dict:
+        return self.manager.statusz()
 
     def scale(self, target: str, replicas: int) -> int:
         if not isinstance(replicas, int) or isinstance(replicas, bool):

@@ -255,3 +255,46 @@ def test_queue_gauge_zeroes_when_usage_drains(simple1):
     m.delete_podcliqueset("simple1")
     m.reconcile_once(now=5.0)
     assert m._m_queue_used.value(queue="team-a", resource="cpu") == 0.0
+
+
+def test_cli_get_queues_table(simple1, capsys):
+    """`grove-tpu get queues` renders quota/usage from statusz."""
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": 0, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "scheduling": {"queues": {"team-a": {"cpu": "10", "memory": -1}}},
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    from grove_tpu.state import Node
+
+    for i in range(4):
+        m.cluster.nodes[f"n{i}"] = Node(
+            name=f"n{i}",
+            capacity={"cpu": 64.0, "memory": 256 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/block": "b0",
+                "topology.kubernetes.io/rack": f"r{i % 2}",
+            },
+        )
+    m.start()
+    try:
+        a = copy.deepcopy(simple1)
+        a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+        m.apply_podcliqueset(a)
+        m.reconcile_once(now=1.0)
+        from grove_tpu.cli.main import main as cli_main
+
+        rc = cli_main(
+            ["--server", f"http://127.0.0.1:{m.health_port}", "get", "queues"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "team-a" in out
+        assert "memory=unlimited" in out
+        assert "cpu=0.13" in out
+    finally:
+        m.stop()
