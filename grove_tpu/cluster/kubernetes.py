@@ -594,6 +594,53 @@ class KubernetesWatchSource:
             )
         return ok
 
+    def publish_events(self, events: list) -> int:
+        """Mirror control-plane events ((ts, object, message) tuples) as
+        corev1 Events — the reference records a k8s Event on every component
+        action (`podgang/syncflow.go:451-458,547-554`); this is that
+        visibility for `kubectl get events`. Returns how many landed (the
+        caller advances its high-water mark by the return value, so a
+        mid-batch failure retries only the tail)."""
+        ns = urllib.parse.quote(self.ctx.namespace)
+        path = f"/api/v1/namespaces/{ns}/events"
+        landed = 0
+        for ts, obj, msg in events:
+            stamp = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts if ts > 1e6 else time.time())
+            )
+            name = f"grove-{abs(hash((round(ts, 3), obj, msg))):x}"
+            body = {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": name, "namespace": self.ctx.namespace},
+                "involvedObject": {
+                    "namespace": self.ctx.namespace,
+                    "name": obj,
+                },
+                "reason": "GroveReconcile",
+                "message": msg,
+                "type": "Normal",
+                "firstTimestamp": stamp,
+                "lastTimestamp": stamp,
+                "count": 1,
+                "source": {"component": "grove-tpu-operator"},
+            }
+            try:
+                self._request("POST", path, body)
+            except (KubeApiError, OSError, ValueError) as e:
+                if isinstance(e, KubeApiError) and e.status == 409:
+                    pass  # already mirrored (retry overlap): landed
+                elif isinstance(e, KubeApiError) and 400 <= e.status < 500:
+                    # Permanent rejection (e.g. stricter Event validation):
+                    # SKIP it — a poison event must not head-of-line block
+                    # every later event forever.
+                    self._record_error(f"event publish (skipped): {e}")
+                else:
+                    self._record_error(f"event publish: {e}")
+                    break  # transient: retry from here next push
+            landed += 1
+        return landed
+
     def sync_cluster_topology(self, topology) -> bool:
         """Create/update the cluster-scoped ClusterTopology CR from the
         operator config (the reference's startup sync,

@@ -79,6 +79,9 @@ class WatchDriver:
     # last-pushed CR status (JSON-canonical) per PCS: change detection for
     # the status write-back
     _pushed_status: dict = field(default_factory=dict)
+    # control-plane events already mirrored as corev1 Events (index into
+    # cluster.events)
+    _pushed_events: int = 0
 
     # ---- inbound: events -> store --------------------------------------------------
 
@@ -198,6 +201,15 @@ class WatchDriver:
                 list(self.cluster.podcliques.values()),
                 list(self.cluster.scaling_groups.values()),
             )
+        publish_events = getattr(self.source, "publish_events", None)
+        if publish_events is not None:
+            # Control-plane events -> corev1 Events (kubectl get events).
+            # High-water mark in EVENT COUNT; bounded batch per push.
+            new = self.cluster.events[
+                self._pushed_events : self._pushed_events + 100
+            ]
+            if new:
+                self._pushed_events += publish_events(new)
         return pushed
 
     def _push_workload_statuses(self) -> int:

@@ -312,6 +312,21 @@ def render_manifests(
             )
 
     docs: list[dict] = []
+    # PriorityClasses from scheduling.priorityClasses (the chart's
+    # priorityclass.yaml analog): cluster-scoped, consumed by pod specs'
+    # priorityClassName and the solver's preemption ordering.
+    for pc_name, value in sorted(cfg.scheduling.priority_classes.items()):
+        docs.append(
+            {
+                "apiVersion": "scheduling.k8s.io/v1",
+                "kind": "PriorityClass",
+                "metadata": {"name": pc_name, "labels": _labels()},
+                "value": int(value),
+                "globalDefault": False,
+                "description": "grove-tpu workload priority "
+                "(scheduling.priorityClasses)",
+            }
+        )
     if cfg.cluster.source == "kubernetes":
         # The topology CR is written at startup regardless of the workload
         # watch; its CRD ships with every kubernetes-source deployment.
@@ -356,6 +371,13 @@ def render_manifests(
                     # scheduler binding subresource (cluster/kubernetes.py).
                     "resources": ["pods", "pods/binding", "services", "secrets"],
                     "verbs": ["get", "list", "watch", "create", "update", "delete"],
+                },
+                {
+                    "apiGroups": [""],
+                    # Control-plane events mirror to corev1 Events
+                    # (kubectl get events; publish_events).
+                    "resources": ["events"],
+                    "verbs": ["create"],
                 },
                 {
                     "apiGroups": ["grove.io"],

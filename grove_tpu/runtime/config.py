@@ -413,6 +413,18 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             errors.append(f"topologyAwareScheduling.levels: {e}")
     if cfg.persistence.enabled and not cfg.persistence.path:
         errors.append("persistence.path: required when persistence is enabled")
+    import re as _re
+
+    pcs_map = cfg.scheduling.priority_classes
+    for pc_name in (pcs_map if isinstance(pcs_map, dict) else ()):
+        # Rendered as cluster-scoped PriorityClass manifests (deploy.py):
+        # the name must be a DNS-1123 subdomain or kubectl apply rejects
+        # it (and a "/" would even break the --out file write).
+        if not _re.fullmatch(r"[a-z0-9]([-a-z0-9.]*[a-z0-9])?", str(pc_name)):
+            errors.append(
+                f"scheduling.priorityClasses.{pc_name}: name must be a "
+                "lowercase DNS-1123 subdomain"
+            )
     if not isinstance(cfg.scheduling.queues, dict):
         errors.append("scheduling.queues: must be a mapping of name -> quotas")
     else:

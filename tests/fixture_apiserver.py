@@ -78,6 +78,7 @@ class FixtureApiServer:
         self.binding_log: list[tuple[str, str]] = []  # (pod, node) in order
         self.created_pods: list[str] = []
         self.leases: dict[str, dict] = {}
+        self.events: list[dict] = []  # mirrored corev1 Events, in order
 
         fixture = self
 
@@ -485,6 +486,15 @@ class FixtureApiServer:
             return 200, json.loads(json.dumps(cur))
 
     def _post(self, path: str, body: dict):
+        if path == f"/api/v1/namespaces/{self.namespace}/events":
+            with self._lock:
+                if any(
+                    e["metadata"]["name"] == body["metadata"]["name"]
+                    for e in self.events
+                ):
+                    return 409, {"kind": "Status", "code": 409}
+                self.events.append(body)
+            return 201, json.loads(json.dumps(body))
         plural = self._child_plural_of(path)
         if plural is not None:
             name = body["metadata"]["name"]

@@ -609,3 +609,20 @@ def test_advertise_url_reaches_injected_initc(tmp_path):
         for c in p.spec.init_containers:
             if c.name == INITC_CONTAINER_NAME:
                 assert not any(a.startswith("--server=") for a in c.args)
+
+
+def test_priority_class_names_must_be_dns1123():
+    """PriorityClass manifests render from these keys; a name kubectl would
+    reject (or that breaks the --out file write) fails config validation."""
+    _, errors = parse_operator_config(
+        {"scheduling": {"priorityClasses": {"Critical": 1000}}}
+    )
+    assert any("DNS-1123" in e for e in errors)
+    _, errors = parse_operator_config(
+        {"scheduling": {"priorityClasses": {"team/high": 1000}}}
+    )
+    assert any("DNS-1123" in e for e in errors)
+    _, errors = parse_operator_config(
+        {"scheduling": {"priorityClasses": {"critical-high.v2": 1000}}}
+    )
+    assert not errors

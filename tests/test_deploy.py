@@ -242,3 +242,21 @@ def test_kubernetes_deploy_rejects_unservable_advertise_combos():
             ),
             "cfg: {}",
         )
+
+
+def test_priority_classes_rendered():
+    """scheduling.priorityClasses -> PriorityClass manifests (the chart's
+    priorityclass.yaml analog)."""
+    docs = render_manifests(
+        parse_operator_config(
+            {
+                "servers": {"bindAddress": "0.0.0.0"},
+                "scheduling": {"priorityClasses": {"critical": 1000, "batch": 10}},
+            }
+        )[0],
+        "cfg: {}",
+    )
+    pcs = {d["metadata"]["name"]: d for d in docs if d["kind"] == "PriorityClass"}
+    assert set(pcs) == {"critical", "batch"}
+    assert pcs["critical"]["value"] == 1000
+    assert pcs["critical"]["globalDefault"] is False

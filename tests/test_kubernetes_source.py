@@ -1127,3 +1127,41 @@ def test_list_ingest_scales_to_thousands_of_nodes(api):
         assert elapsed < 30
     finally:
         src.stop()
+
+
+def test_control_plane_events_mirror_to_cluster(api, tmp_path, simple1):
+    """kubectl get events on a real cluster shows the operator's actions:
+    store events mirror out as corev1 Events, exactly once each."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    api.add_node(k8s_node("n0", cpu="16", memory="64Gi"))
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)
+        for t in range(1, 6):
+            m.reconcile_once(now=float(t))
+        store_count = len(m.cluster.events)
+        assert store_count > 0
+        assert len(api.events) == store_count, "each event mirrors exactly once"
+        assert any("gang admitted" in e["message"] for e in api.events)
+        ev = api.events[0]
+        assert ev["source"]["component"] == "grove-tpu-operator"
+        assert ev["reason"] == "GroveReconcile"
+        # No duplicates on further quiet passes.
+        m.reconcile_once(now=7.0)
+        assert len(api.events) == store_count
+    finally:
+        m.stop()
