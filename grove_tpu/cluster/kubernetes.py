@@ -1103,6 +1103,10 @@ def render_pod_manifest(pod) -> dict:
             cdoc["resources"] = res
         if c.ports:
             cdoc["ports"] = [{"containerPort": p} for p in c.ports]
+        if c.volume_mounts:
+            # e.g. the injected initc's SA-token mount — dropping it would
+            # leave the agent credential-less on a real cluster.
+            cdoc["volumeMounts"] = [dict(vm) for vm in c.volume_mounts]
         return cdoc
 
     spec: dict = {
@@ -1128,6 +1132,16 @@ def render_pod_manifest(pod) -> dict:
         spec["tolerations"] = list(pod.spec.tolerations)
     if pod.spec.priority_class_name:
         spec["priorityClassName"] = pod.spec.priority_class_name
+    if pod.spec.volumes:
+        # Declared volumes (the initc token secret volume among them).
+        spec["volumes"] = [dict(v) for v in pod.spec.volumes]
+    if pod.spec.resource_claims:
+        # MNNVL-analog ICI-slice claims (networkAcceleration injection).
+        spec["resourceClaims"] = [dict(rc) for rc in pod.spec.resource_claims]
+    if pod.spec.termination_grace_period_seconds != 30:
+        spec["terminationGracePeriodSeconds"] = (
+            pod.spec.termination_grace_period_seconds
+        )
     return {
         "apiVersion": "v1",
         "kind": "Pod",

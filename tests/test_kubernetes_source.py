@@ -1165,3 +1165,32 @@ def test_control_plane_events_mirror_to_cluster(api, tmp_path, simple1):
         assert len(api.events) == store_count
     finally:
         m.stop()
+
+
+def test_manifest_carries_volumes_claims_and_mounts():
+    """The rendered pod manifest must carry everything the kubelet needs:
+    the initc SA-token volume + mount and the ICI-slice resource claims
+    (dropping them would strand startup ordering and slice injection on
+    real clusters)."""
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY, constants
+    from grove_tpu.orchestrator import expand_podcliqueset
+    import yaml as _yaml
+
+    from grove_tpu.api import PodCliqueSet, default_podcliqueset
+
+    with open("examples/multi-node-disaggregated.yaml") as f:
+        pcs = default_podcliqueset(PodCliqueSet.from_dict(_yaml.safe_load(f)))
+    pcs.metadata.annotations[constants.ANNOTATION_MNNVL] = "enabled"
+    ds = expand_podcliqueset(
+        pcs, DEFAULT_CLUSTER_TOPOLOGY, auto_slice_enabled=True
+    )
+    gated = next(p for p in ds.pods if p.spec.init_containers)
+    manifest = render_pod_manifest(gated)
+    assert any(
+        v.get("secret") for v in manifest["spec"]["volumes"]
+    ), "initc token volume missing"
+    initc = manifest["spec"]["initContainers"][0]
+    assert initc["volumeMounts"], "initc token mount missing"
+    claimed = next(p for p in ds.pods if p.spec.resource_claims)
+    m2 = render_pod_manifest(claimed)
+    assert m2["spec"]["resourceClaims"][0]["name"] == "tpu-ici-slice"
