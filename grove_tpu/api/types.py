@@ -196,6 +196,7 @@ class Container:
             requests=requests,
             limits=limits,
             ports=ports,
+            volume_mounts=[dict(v) for v in d.get("volumeMounts", []) or []],
         )
 
 
@@ -237,9 +238,16 @@ class PodSpec:
             node_selector=dict(d.get("nodeSelector", {}) or {}),
             priority_class_name=d.get("priorityClassName", ""),
             restart_policy=d.get("restartPolicy", "Always") or "Always",
-            termination_grace_period_seconds=d.get("terminationGracePeriodSeconds", 30) or 30,
+            # `or 30` would coerce an explicit 0 (force-immediate-kill, a
+            # standard k8s idiom) back to the default — only None defaults.
+            termination_grace_period_seconds=(
+                30
+                if d.get("terminationGracePeriodSeconds") is None
+                else d["terminationGracePeriodSeconds"]
+            ),
             tolerations=list(d.get("tolerations", []) or []),
             resource_claims=list(d.get("resourceClaims", []) or []),
+            volumes=[dict(v) for v in d.get("volumes", []) or []],
         )
 
 

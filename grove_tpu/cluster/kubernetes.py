@@ -346,6 +346,8 @@ class KubernetesWatchSource:
         self._synced_services: dict[str, dict] = {}
         # Child CR projections (podcliques/pcsgs): plural -> name -> manifest.
         self._synced_children: dict[str, dict] = {}
+        # SA-token Secrets mirrored (pods mount them): name -> manifest.
+        self._synced_secrets: dict[str, dict] = {}
         # Collections whose cluster-side members have been LISTed into the
         # cache (crash-orphan GC; _sync_collection).
         self._seeded_bases: set[str] = set()
@@ -442,6 +444,31 @@ class KubernetesWatchSource:
                 },
             }
         return self._sync_collection(path, desired, self._synced_services)
+
+    def sync_secrets(self, secrets: list) -> bool:
+        """Mirror the store's SA-token Secrets to the cluster — the rendered
+        pods MOUNT them (initc token volume, satokensecret component
+        analog); without this mirror every gated pod wedges in
+        ContainerCreating on FailedMount."""
+        ns = urllib.parse.quote(self.ctx.namespace)
+        path = f"/api/v1/namespaces/{ns}/secrets"
+        desired = {}
+        for sec in secrets:
+            desired[sec.name] = {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {
+                    "name": sec.name,
+                    "namespace": self.ctx.namespace,
+                    "labels": {
+                        api_constants.LABEL_MANAGED_BY: api_constants.LABEL_MANAGED_BY_VALUE,
+                        api_constants.LABEL_PART_OF: getattr(sec, "pcs_name", ""),
+                    },
+                },
+                "type": "Opaque",
+                "stringData": {"token": sec.token},
+            }
+        return self._sync_collection(path, desired, self._synced_secrets)
 
     # ---- managed-object sync plumbing ----------------------------------------------
 
@@ -1135,13 +1162,20 @@ def render_pod_manifest(pod) -> dict:
     if pod.spec.volumes:
         # Declared volumes (the initc token secret volume among them).
         spec["volumes"] = [dict(v) for v in pod.spec.volumes]
-    if pod.spec.resource_claims:
-        # MNNVL-analog ICI-slice claims (networkAcceleration injection).
-        spec["resourceClaims"] = [dict(rc) for rc in pod.spec.resource_claims]
-    if pod.spec.termination_grace_period_seconds != 30:
-        spec["terminationGracePeriodSeconds"] = (
-            pod.spec.termination_grace_period_seconds
-        )
+    annotations = dict(pod.annotations)
+    for rc in pod.spec.resource_claims:
+        # The store-level ICI-slice claim shape is OUR analog, not valid
+        # corev1 PodResourceClaim (which requires resourceClaimName/
+        # ...TemplateName backed by DRA objects) — rendering it verbatim
+        # would 422 every MNNVL-annotated pod create. Carry the intent as
+        # annotations until real DRA wiring exists; the node runtime /
+        # device plugin reads them.
+        src = rc.get("source", {}) or {}
+        if src.get("iciDomain"):
+            annotations[api_constants.ANNOTATION_ICI_DOMAIN] = src["iciDomain"]
+    spec["terminationGracePeriodSeconds"] = (
+        pod.spec.termination_grace_period_seconds
+    )
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -1149,7 +1183,7 @@ def render_pod_manifest(pod) -> dict:
             "name": pod.name,
             "namespace": pod.namespace,
             "labels": dict(pod.labels),
-            "annotations": dict(pod.annotations),
+            "annotations": annotations,
         },
         "spec": spec,
     }

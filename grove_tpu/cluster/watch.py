@@ -194,6 +194,10 @@ class WatchDriver:
             # Managed headless Services mirror to the real cluster (pod DNS
             # needs them); the source change-detects, so this is cheap.
             sync_services(list(self.cluster.services.values()))
+        sync_secrets = getattr(self.source, "sync_secrets", None)
+        if sync_secrets is not None:
+            # SA-token Secrets BEFORE pods need their mounts.
+            sync_secrets(list(self.cluster.secrets.values()))
         sync_children = getattr(self.source, "sync_workload_children", None)
         if sync_children is not None:
             # kubectl-visible PodClique/PCSG projections (status included).

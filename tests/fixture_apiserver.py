@@ -61,6 +61,7 @@ class FixtureApiServer:
         self.podcliquesets: dict[str, dict] = {}  # the grove.io CRs
         self.clustertopologies: dict[str, dict] = {}  # cluster-scoped CRs
         self.services: dict[str, dict] = {}  # mirrored headless Services
+        self.secrets: dict[str, dict] = {}  # mirrored SA-token Secrets
         # Child CR projections: plural -> name -> manifest.
         self.child_crs: dict[str, dict[str, dict]] = {
             "podcliques": {},
@@ -107,6 +108,24 @@ class FixtureApiServer:
                     name = parsed.path[len(fixture._ct_prefix):].lstrip("/")
                     with fixture._lock:
                         obj = fixture.clustertopologies.get(name)
+                    if obj is None:
+                        self._json(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._json(200, json.loads(json.dumps(obj)))
+                    return
+                sec_prefix = f"/api/v1/namespaces/{fixture.namespace}/secrets"
+                if parsed.path == sec_prefix:
+                    with fixture._lock:
+                        items = [
+                            o for o in fixture.secrets.values()
+                            if fixture._matches(o, qs.get("labelSelector", ""))
+                        ]
+                    self._json(200, {"kind": "SecretList", "items": items})
+                    return
+                if parsed.path.startswith(sec_prefix + "/"):
+                    name = parsed.path[len(sec_prefix) + 1:]
+                    with fixture._lock:
+                        obj = fixture.secrets.get(name)
                     if obj is None:
                         self._json(404, {"kind": "Status", "code": 404})
                     else:
@@ -486,6 +505,13 @@ class FixtureApiServer:
             return 200, json.loads(json.dumps(cur))
 
     def _post(self, path: str, body: dict):
+        if path == f"/api/v1/namespaces/{self.namespace}/secrets":
+            name = body["metadata"]["name"]
+            with self._lock:
+                if name in self.secrets:
+                    return 409, {"kind": "Status", "code": 409}
+                self.secrets[name] = body
+            return 201, json.loads(json.dumps(body))
         if path == f"/api/v1/namespaces/{self.namespace}/events":
             with self._lock:
                 if any(
@@ -553,6 +579,13 @@ class FixtureApiServer:
             name = path[len(self._child_prefix(plural)) + 1:]
             with self._lock:
                 if self.child_crs[plural].pop(name, None) is None:
+                    return 404, {"kind": "Status", "code": 404}
+            return 200, {"kind": "Status", "code": 200}
+        sec_prefix = f"/api/v1/namespaces/{self.namespace}/secrets/"
+        if path.startswith(sec_prefix):
+            name = path[len(sec_prefix):]
+            with self._lock:
+                if self.secrets.pop(name, None) is None:
                     return 404, {"kind": "Status", "code": 404}
             return 200, {"kind": "Status", "code": 200}
         svc_prefix = f"/api/v1/namespaces/{self.namespace}/services/"

@@ -1193,4 +1193,90 @@ def test_manifest_carries_volumes_claims_and_mounts():
     assert initc["volumeMounts"], "initc token mount missing"
     claimed = next(p for p in ds.pods if p.spec.resource_claims)
     m2 = render_pod_manifest(claimed)
-    assert m2["spec"]["resourceClaims"][0]["name"] == "tpu-ici-slice"
+    # The invented claim shape would 422 a real apiserver; the intent rides
+    # the ICI-domain annotation until real DRA wiring exists.
+    assert "resourceClaims" not in m2["spec"]
+    assert (
+        m2["metadata"]["annotations"][constants.ANNOTATION_ICI_DOMAIN]
+        == claimed.podgang_name
+    )
+
+
+def test_sa_token_secrets_mirrored(api, tmp_path, simple1):
+    """The pods MOUNT the SA-token Secret: it must exist at the apiserver
+    or every gated pod wedges in ContainerCreating (review finding)."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    api.add_node(k8s_node("n0", cpu="16", memory="64Gi"))
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)
+        deadline = time.monotonic() + 15.0
+        t = 0.0
+        while time.monotonic() < deadline and not api.secrets:
+            t += 1.0
+            m.reconcile_once(now=t)
+            time.sleep(0.05)
+        from grove_tpu.api import naming
+
+        name = naming.initc_sa_token_secret_name("simple1")
+        assert name in api.secrets
+        token = m.cluster.secrets[name].token
+        assert api.secrets[name]["stringData"]["token"] == token
+        m.delete_podcliqueset("simple1")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and api.secrets:
+            t += 1.0
+            m.reconcile_once(now=t)
+            time.sleep(0.05)
+        assert not api.secrets, "stale Secrets must be GC'd"
+    finally:
+        m.stop()
+
+
+def test_user_volumes_and_tgp_roundtrip():
+    """User-declared volumes/volumeMounts and an explicit
+    terminationGracePeriodSeconds: 0 survive parse AND render (review
+    findings: from_dict silently dropped both)."""
+    from grove_tpu.api.types import PodSpec
+
+    spec = PodSpec.from_dict(
+        {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "img",
+                    "volumeMounts": [{"name": "data", "mountPath": "/data"}],
+                }
+            ],
+            "volumes": [{"name": "data", "emptyDir": {}}],
+            "terminationGracePeriodSeconds": 0,
+        }
+    )
+    assert spec.containers[0].volume_mounts == [
+        {"name": "data", "mountPath": "/data"}
+    ]
+    assert spec.volumes == [{"name": "data", "emptyDir": {}}]
+    assert spec.termination_grace_period_seconds == 0
+
+    from grove_tpu.api.pod import Pod
+
+    manifest = render_pod_manifest(Pod(name="p", spec=spec))
+    assert manifest["spec"]["volumes"] == [{"name": "data", "emptyDir": {}}]
+    assert manifest["spec"]["containers"][0]["volumeMounts"] == [
+        {"name": "data", "mountPath": "/data"}
+    ]
+    assert manifest["spec"]["terminationGracePeriodSeconds"] == 0
