@@ -100,6 +100,13 @@ class GroveController:
     # Floors wave's post-grant remaining quota, consumed by the extras wave
     # (see solve_pending) — saves a full pod scan per pass.
     _queue_remaining_carry: dict | None = None
+    # PlacementScores of gangs first-admitted in the LAST solve_pending pass
+    # (GREP-244 metrics direction) — the manager drains this into the
+    # grove_placement_score histogram each reconcile.
+    last_admission_scores: list = field(default_factory=list)
+    # First-admissions of the current pass (floors wave), so the extras wave
+    # can't double-count them (see solve_pending).
+    _admitted_this_pass: set = field(default_factory=set)
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -334,6 +341,14 @@ class GroveController:
         exception, not the rule) — otherwise the second scan over every gang
         and pod is pure overhead at fleet scale."""
         self._extras_candidates = False
+        self.last_admission_scores = []
+        # Gangs first-admitted by THIS pass's floors wave. The extras wave's
+        # scheduled_names is rebuilt from gang status, which update_statuses
+        # only refreshes AFTER solve_pending — without this set, a gang
+        # admitted in the floors wave and topped up in the same pass's extras
+        # wave would re-enter the first-admission branch (duplicate admitted
+        # event, floor score overwritten by the extras-only score).
+        self._admitted_this_pass = set()
         # Prune quota-block dedupe entries for gangs that no longer exist
         # (rolling updates churn gang names; same discipline as
         # _preempted_for_at): a recreated namesake must event again.
@@ -575,11 +590,15 @@ class GroveController:
                 pod.node_name = node_name
                 pod.scheduling_gates = []
                 pod.phase = PodPhase.PENDING
-            if gang_name not in scheduled_names:
+            if gang_name not in scheduled_names and gang_name not in self._admitted_this_pass:
                 # First admission only: extras top-ups of an already-admitted
                 # gang must not re-emit the admission event, inflate the
                 # admitted count, or overwrite the floor solve's score.
+                # scheduled_names covers earlier passes (via status);
+                # _admitted_this_pass covers the floors wave of THIS pass.
+                self._admitted_this_pass.add(gang_name)
                 gang.status.placement_score = float(scores.get(gang_name, 0.0))
+                self.last_admission_scores.append(gang.status.placement_score)
                 c.record_event(
                     now, gang_name, f"gang admitted ({len(pod_bindings)} pods bound)"
                 )

@@ -458,6 +458,15 @@ class Manager:
         self._m_queue_used = self.metrics.gauge(
             "grove_queue_used", "Bound resource usage per capacity queue"
         )
+        # GREP-244 "TAS metrics" direction: PlacementScore distribution of
+        # admitted gangs (scheduler podgang.go:176-178; 1.0 = optimal).
+        # Buckets cover the score's [0,1] range, dense near the top where
+        # placement-quality regressions show first.
+        self._m_placement_score = self.metrics.histogram(
+            "grove_placement_score",
+            "PlacementScore of gangs at first admission (1.0 = optimal)",
+            buckets=(0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0),
+        )
         # Every (queue, resource) series ever emitted — re-zeroed each pass
         # when usage disappears (gauge values persist otherwise).
         self._queue_metric_keys: dict[str, set] = {}
@@ -948,6 +957,8 @@ class Manager:
                 self.log.error("reconcile step failed", step=e.operation, err=str(e))
         if admitted_box["n"]:
             self._m_gangs_admitted.inc(admitted_box["n"])
+            for score in ctrl.last_admission_scores:
+                self._m_placement_score.observe(score)
         self._next_requeue = outcome.requeue_after_seconds
         if self.controller.queues:
             # Per-queue usage gauges (GREP-244 metrics direction): refreshed

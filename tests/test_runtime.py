@@ -306,6 +306,51 @@ def test_manager_records_last_errors(booted_manager, simple1, monkeypatch):
     assert simple1.status.last_errors == []
 
 
+def test_manager_placement_score_histogram(simple1):
+    """Admitted gangs feed the grove_placement_score histogram (GREP-244
+    TAS-metrics direction; PlacementScore semantics podgang.go:176-178)."""
+    from grove_tpu.state import Node
+
+    cfg, errors = parse_operator_config(
+        {"servers": {"healthPort": 0, "metricsPort": -1}, "backend": {"enabled": False}}
+    )
+    assert not errors
+    m = Manager(cfg)
+    for i in range(4):
+        m.cluster.nodes[f"n{i}"] = Node(
+            name=f"n{i}",
+            capacity={"cpu": 64.0, "memory": 256 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/block": "b0",
+                "topology.kubernetes.io/rack": f"r{i % 2}",
+            },
+        )
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)
+        for t in range(1, 4):
+            m.reconcile_once(now=float(t))
+        admitted = m.metrics.counter("grove_gangs_admitted_total").value()
+        assert admitted > 0
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{m.health_port}/metrics"
+        ).read().decode()
+        count_line = next(
+            ln for ln in metrics.splitlines()
+            if ln.startswith("grove_placement_score_count")
+        )
+        assert float(count_line.split()[-1]) == admitted
+        # scores live in (0, 1]: every observation lands at or below le="1"
+        top_bucket = next(
+            ln for ln in metrics.splitlines()
+            if ln.startswith('grove_placement_score_bucket{le="1"}')
+        )
+        assert float(top_bucket.split()[-1]) == admitted
+    finally:
+        m.stop()
+
+
 def test_manager_backend_sidecar_boots(tmp_path):
     cfg, errors = parse_operator_config(
         {"servers": {"healthPort": 0, "metricsPort": 0}, "backend": {"enabled": True, "port": 0}}

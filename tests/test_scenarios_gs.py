@@ -261,6 +261,29 @@ def test_gs12_complex_pcsg_scaling():
     assert s.until_scheduled(14)
 
 
+def test_extras_wave_does_not_double_admit_same_pass():
+    """A gang admitted by the floors wave and topped up by the SAME pass's
+    extras wave is first-admitted exactly once: one admitted event, one
+    entry in last_admission_scores (the extras wave's scheduled_names view
+    is stale — status refreshes only after solve_pending — so the dedup
+    must come from the pass-local set; review finding, round 4)."""
+    s = Scenario(12)  # ample capacity: floors AND extras bind in pass one
+    s.deploy(wl2())
+    s.settle(3)
+    assert len(s.scheduled()) == len(s.pods()), "extras should have bound too"
+    admitted_events = [
+        (obj, msg)
+        for _, obj, msg in s.cluster.events
+        if "gang admitted" in msg
+    ]
+    gangs_evented = [obj for obj, _ in admitted_events]
+    assert sorted(set(gangs_evented)) == sorted(gangs_evented), (
+        f"duplicate admission events: {admitted_events}"
+    )
+    # the last solve pass that admitted anything recorded each gang once
+    assert len(s.controller.last_admission_scores) <= len(set(gangs_evented))
+
+
 def test_extras_wave_only_runs_with_best_effort_pods(monkeypatch):
     """solve_pending's second (extras) wave is gated on the floors pass
     having seen gated pods beyond a floor: WL1 (minAvailable == replicas
