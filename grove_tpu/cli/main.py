@@ -5,6 +5,7 @@ API via the typed client. Commands:
 
   get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas   table listing
   get <kind> <name>                             full object as JSON
+  describe <kind> <name>                        human detail + object events
   apply -f <file.yaml>                          admit a PodCliqueSet
   delete pcs <name>                             cascade-delete
   top                                           per-node requested/capacity
@@ -125,6 +126,108 @@ def _get_table(client: GroveClient, kind: str) -> str:
     raise AssertionError(kind)
 
 
+_DESCRIBE_KINDS = ("podcliquesets", "podgangs", "pods", "nodes")
+
+
+def _fmt_conditions(conditions) -> list[str]:
+    out = []
+    for c in conditions:
+        detail = ": ".join(p for p in (c.reason, c.message) if p)
+        out.append(f"  {c.type}={c.status}" + (f" ({detail})" if detail else ""))
+    return out
+
+
+def _describe(client: GroveClient, kind: str, name: str) -> str:
+    """kubectl-describe analog: key fields in human form, then the object's
+    events (prefix match pulls in children — a PCS shows its gangs' events,
+    matching how kubectl describe surfaces involved-object events)."""
+    lines: list[str] = []
+    if kind == "podcliquesets":
+        obj = client.get_podcliqueset(name)
+        st = obj.status
+        lines += [
+            f"Name:      {name}",
+            f"Replicas:  {obj.spec.replicas} desired, {st.available_replicas} available, {st.updated_replicas} updated",
+            f"Startup:   {getattr(obj.spec.template.startup_type, 'value', obj.spec.template.startup_type)}",
+        ]
+        if st.rolling_update_progress is not None:
+            ru = st.rolling_update_progress
+            lines.append(
+                f"RollingUpdate: current={getattr(ru, 'current_replica_index', '?')}"
+            )
+        if st.pod_gang_statuses:
+            lines.append("PodGangs:")
+            lines += [
+                f"  {g.name}  phase={g.phase}" for g in st.pod_gang_statuses
+            ]
+        if st.conditions:
+            lines.append("Conditions:")
+            lines += _fmt_conditions(st.conditions)
+        if st.last_errors:
+            lines.append("LastErrors:")
+            lines += [f"  {e}" for e in st.last_errors]
+    elif kind == "podgangs":
+        obj = client.get_podgang(name)
+        st = obj.status
+        lines += [
+            f"Name:   {name}",
+            f"Phase:  {getattr(st.phase, 'value', st.phase)}",
+            f"Score:  {'-' if st.placement_score is None else f'{st.placement_score:.3f}'}",
+        ]
+        if obj.spec.priority_class_name:
+            lines.append(f"PriorityClass: {obj.spec.priority_class_name}")
+        lines.append("PodGroups:")
+        lines += [
+            f"  {g.name}  pods={len(g.pod_references)} minReplicas={g.min_replicas}"
+            for g in obj.spec.pod_groups
+        ]
+        if st.conditions:
+            lines.append("Conditions:")
+            lines += _fmt_conditions(st.conditions)
+    elif kind == "pods":
+        obj = client.get_pod(name)
+        lines += [
+            f"Name:    {name}",
+            f"Clique:  {obj.pclq_fqn}",
+            f"PodGang: {obj.podgang_name}",
+            f"Node:    {obj.node_name or '<none>'}",
+            f"Phase:   {getattr(obj.phase, 'value', obj.phase)}",
+            f"Ready:   {'yes' if obj.ready else 'no'}",
+        ]
+        if obj.scheduling_gates:
+            lines.append(f"Gates:   {','.join(obj.scheduling_gates)}")
+    elif kind == "nodes":
+        obj = client.get_node(name)
+        cap = " ".join(f"{k}={v:g}" for k, v in sorted(obj.capacity.items()))
+        lines += [
+            f"Name:        {name}",
+            f"Schedulable: {'yes' if obj.schedulable else 'no'}",
+            f"Capacity:    {cap}",
+        ]
+        if obj.labels:
+            lines.append("Labels:")
+            lines += [f"  {k}={v}" for k, v in sorted(obj.labels.items())]
+    else:
+        raise AssertionError(kind)  # main() gates on _DESCRIBE_KINDS
+    # A PCS owns everything under its name prefix, so its describe pulls in
+    # children's events (kubectl-describe involved-object behavior). Other
+    # kinds match exactly — a podgang's prefix would also catch sibling
+    # cliques of the same PCS replica.
+    include = (
+        (lambda o: o == name or o.startswith(name + "-"))
+        if kind == "podcliquesets"
+        else (lambda o: o == name)
+    )
+    matched = [
+        (ts, obj_name, msg)
+        for ts, obj_name, msg in client.events()
+        if include(obj_name)
+    ]
+    lines.append("Events:" if matched else "Events:  <none>")
+    lines += [f"  {ts:10.1f}  {obj_name:<30}  {msg}" for ts, obj_name, msg in matched]
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     from grove_tpu.version import version_string
 
@@ -140,6 +243,12 @@ def main(argv=None) -> int:
     p_get = sub.add_parser("get", help="list a kind, or fetch one object")
     p_get.add_argument("kind")
     p_get.add_argument("name", nargs="?", default=None)
+
+    p_desc = sub.add_parser(
+        "describe", help="human-readable object detail + its events"
+    )
+    p_desc.add_argument("kind")
+    p_desc.add_argument("name")
 
     p_apply = sub.add_parser("apply", help="admit a PodCliqueSet")
     p_apply.add_argument("-f", "--filename", required=True)
@@ -212,6 +321,12 @@ def main(argv=None) -> int:
                     print(f"get-by-name unsupported for {kind}", file=sys.stderr)
                     return 2
                 print(json.dumps(serde.encode(getter(args.name)), indent=2))
+        elif args.cmd == "describe":
+            kind = KIND_ALIASES.get(args.kind)
+            if kind not in _DESCRIBE_KINDS:
+                print("describe supports: pcs, podgangs, pods, nodes", file=sys.stderr)
+                return 2
+            print(_describe(client, kind, args.name))
         elif args.cmd == "apply":
             with open(args.filename) as f:
                 name = client.apply_podcliqueset(f.read())

@@ -845,7 +845,6 @@ class Manager:
                 self._m_reconcile_errors.inc()
                 self.log.error("watch pump failed", err=str(e))
         ctrl = self.controller
-        admitted_box = {"n": 0}
 
         def _timed(name, body):
             def run():
@@ -914,7 +913,7 @@ class Manager:
             return continue_reconcile()
 
         def _solve():
-            admitted_box["n"] = ctrl.solve_pending(now) or 0
+            ctrl.solve_pending(now)
             return continue_reconcile()
 
         def _record(errors):
@@ -955,10 +954,19 @@ class Manager:
             self._m_reconcile_errors.inc(len(outcome.errors))
             for e in outcome.errors:
                 self.log.error("reconcile step failed", step=e.operation, err=str(e))
-        if admitted_box["n"]:
-            self._m_gangs_admitted.inc(admitted_box["n"])
+        # last_admission_scores is the ground truth of first admissions this
+        # pass (both waves; solve_pending's int return counts the floors wave
+        # only) — driving BOTH metrics from it keeps
+        # grove_gangs_admitted_total == grove_placement_score_count by
+        # construction, even when an extras wave first-admits a gang whose
+        # floor was already met (stale-status edge).
+        if ctrl.last_admission_scores:
+            self._m_gangs_admitted.inc(len(ctrl.last_admission_scores))
             for score in ctrl.last_admission_scores:
                 self._m_placement_score.observe(score)
+            # Consume-once: a later pass that short-circuits before
+            # solve_pending (which resets the list) must not re-observe.
+            ctrl.last_admission_scores = []
         self._next_requeue = outcome.requeue_after_seconds
         if self.controller.queues:
             # Per-queue usage gauges (GREP-244 metrics direction): refreshed

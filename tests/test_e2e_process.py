@@ -251,6 +251,18 @@ def test_cli_against_live_operator(operator_proc, tmp_path):
     r = cli("events", "--tail", "5")
     assert r.returncode == 0 and r.stdout.strip()
 
+    # kubectl-describe analog: human detail + the object's (and children's)
+    # events; a PCS describe surfaces its gangs' admission events.
+    r = cli("describe", "pcs", "simple1")
+    assert r.returncode == 0, r.stderr
+    assert "Replicas:" in r.stdout and "Events:" in r.stdout
+    assert "gang admitted" in r.stdout, r.stdout
+    r = cli("describe", "pg", "simple1-0")
+    assert r.returncode == 0, r.stderr
+    assert "PodGroups:" in r.stdout and "Score:" in r.stdout
+    r = cli("describe", "svc", "anything")
+    assert r.returncode == 2
+
     r = cli("get", "frobs")
     assert r.returncode == 2
 
