@@ -8,6 +8,13 @@ Configs (BASELINE.md "Reference configs to validate against"):
      rack-packed instances, minAvailable
   4. multi-node-disaggregated.yaml — DeepSeek-R1-style router + prefill +
      decode PCSGs with block/rack topology packing, explicit startup DAG
+
+Plus the remaining reference sample shapes:
+  5. complete-inference-pipeline.yaml — single-node roles (gateway,
+     embedder) coexisting with prefill/decode PCSGs in one PCS
+     (complete-inference-pipeline.yaml upstream)
+  6. explicit-startup-order.yaml — Explicit startup diamond DAG with an
+     auto-scaled clique (simple2/simple3 upstream)
 """
 
 from __future__ import annotations
@@ -35,6 +42,8 @@ WORKLOADS = [
     "single-node-disaggregated.yaml",
     "multi-node-aggregated.yaml",
     "multi-node-disaggregated.yaml",
+    "complete-inference-pipeline.yaml",
+    "explicit-startup-order.yaml",
 ]
 
 
@@ -66,6 +75,39 @@ def test_example_schedules_to_running(name):
         and all(p.ready for p in cluster.pods.values() if p.is_active),
         timeout=240,
     ), f"{name}: {sum(p.ready for p in cluster.pods.values())}/{len(cluster.pods)} ready"
+
+
+def test_explicit_startup_order_diamond_honored():
+    """Config #6's guarantee: the Explicit startup diamond is honored —
+    warmup starts before tokenizer AND kvstore, which start before server."""
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=4, hosts_per_rack=7
+    ):
+        cluster.nodes[n.name] = n
+    ctrl = GroveController(cluster=cluster, topology=bench_topology())
+    pcs = _load("explicit-startup-order.yaml")
+    cluster.podcliquesets[pcs.metadata.name] = pcs
+    sim = Simulator(cluster=cluster, controller=ctrl)
+    assert sim.run_until(
+        lambda: bool(cluster.pods)
+        and all(p.ready for p in cluster.pods.values() if p.is_active),
+        timeout=240,
+    )
+
+    def first_start(role):
+        return min(
+            p.started_at
+            for p in cluster.pods.values()
+            if p.pclq_fqn.endswith(f"-{role}")
+        )
+
+    assert first_start("warmup") < first_start("tokenizer")
+    assert first_start("warmup") < first_start("kvstore")
+    assert first_start("tokenizer") < first_start("server")
+    assert first_start("kvstore") < first_start("server")
+    # the auto-scaled clique materialized its HPA
+    assert any("tokenizer" in name for name in cluster.hpas)
 
 
 def test_multi_node_disaggregated_topology_honored():
