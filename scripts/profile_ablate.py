@@ -13,6 +13,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Relay hardening BEFORE first device use (a wedged relay would hang the
+# script otherwise; GROVE_FORCE_CPU=1 skips the probe entirely).
+from grove_tpu.utils.platform import ensure_usable_backend  # noqa: E402
+
+_platform, _plat_err = ensure_usable_backend()
+if _plat_err:
+    print(f"[profile] {_plat_err}", file=sys.stderr)
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -15,6 +15,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Relay hardening BEFORE first device use: GROVE_FORCE_CPU skips the probe;
+# otherwise a wedged relay degrades to CPU instead of hanging the script
+# (JAX_PLATFORMS alone is overridden by the relay's sitecustomize).
+from grove_tpu.utils.platform import ensure_usable_backend  # noqa: E402
+
+_platform, _plat_err = ensure_usable_backend()
+if _plat_err:
+    print(f"[profile] {_plat_err}", file=sys.stderr)
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -25,6 +34,15 @@ def main() -> None:
     ap.add_argument("--waves", type=int, default=4, help="timed waves per config")
     ap.add_argument("--sizes", type=str, default="64")
     ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument(
+        "--tick",
+        type=int,
+        default=0,
+        metavar="K",
+        help="steady-state mode: K single-gang ticks (encode+solve+sync+"
+        "decode each, warm program) — the per-event scheduling latency the "
+        "reference pays per pod, measured per GANG here",
+    )
     args = ap.parse_args()
 
     from grove_tpu.orchestrator import expand_podcliqueset
@@ -72,6 +90,42 @@ def main() -> None:
         f"MG={mg} MS={ms} MP={mp} N={snapshot.free.shape[0]} "
         f"R={snapshot.free.shape[1]} coarse_dmax={dmax}"
     )
+
+    if args.tick:
+        # Steady state: one gang arrives on a warm cluster/program. This is
+        # the per-tick serving path's floor (controller/sidecar solve one
+        # small batch per reconcile), dominated on TPU by the device->host
+        # verdict fetch, not compute.
+        free_arr = jnp.asarray(snapshot.free)
+        ok_g = jnp.zeros((len(gangs),), dtype=bool)
+        lat = []
+        warm = None
+        for k in range(args.tick + 1):  # +1: first iteration compiles
+            g = gangs[k % len(gangs)]
+            t0 = time.perf_counter()
+            batch, decode = encode_gangs(
+                [g], pods, snapshot,
+                max_groups=mg, max_sets=ms, max_pods=mp,
+                pad_gangs_to=1, global_index_of=gidx,
+            )
+            r = solve_batch(free_arr, capacity, schedulable, node_domain_id,
+                            batch, params, ok_g, coarse_dmax=dmax)
+            np.asarray(r.ok)  # forced sync incl. the relay fetch
+            decode_assignments(r, decode, snapshot)
+            dt = time.perf_counter() - t0
+            if k == 0:
+                warm = dt
+                continue
+            lat.append(dt)
+        lat = np.asarray(lat)
+        print(
+            f"tick (1 gang, N={snapshot.free.shape[0]}): "
+            f"p50={np.percentile(lat, 50)*1e3:.1f}ms "
+            f"p99={np.percentile(lat, 99)*1e3:.1f}ms "
+            f"mean={lat.mean()*1e3:.1f}ms min={lat.min()*1e3:.1f}ms "
+            f"(first/compile={warm:.2f}s, K={len(lat)})"
+        )
+        return
 
     for wave_size in [int(s) for s in args.sizes.split(",")]:
         waves = [gangs[i : i + wave_size] for i in range(0, len(gangs), wave_size)]
