@@ -361,6 +361,9 @@ class PodCliqueStatus:
     replicas: int = 0
     ready_replicas: int = 0
     scheduled_replicas: int = 0
+    # Pods still holding the podgang-pending scheduling gate
+    # (scheduleGatedReplicas, podclique.go status).
+    schedule_gated_replicas: int = 0
     updated_replicas: int = 0
     conditions: list["Condition"] = field(default_factory=list)
     current_pod_template_hash: Optional[str] = None

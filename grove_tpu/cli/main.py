@@ -77,9 +77,15 @@ def _get_table(client: GroveClient, kind: str) -> str:
         for name, obj in client.list_podcliques_full().items():
             st = obj.status
             rows.append(
-                [name, obj.spec.replicas, st.ready_replicas, st.scheduled_replicas]
+                [
+                    name,
+                    obj.spec.replicas,
+                    st.ready_replicas,
+                    st.scheduled_replicas,
+                    st.schedule_gated_replicas,
+                ]
             )
-        return _table(rows, ["NAME", "REPLICAS", "READY", "SCHEDULED"])
+        return _table(rows, ["NAME", "REPLICAS", "READY", "SCHEDULED", "GATED"])
     if kind == "podcliquescalinggroups":
         rows = []
         for name, obj in client.list_scaling_groups_full().items():

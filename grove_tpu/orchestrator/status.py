@@ -77,6 +77,7 @@ def compute_podclique_status(
     st.replicas = len(pods)
     st.scheduled_replicas = scheduled
     st.ready_replicas = ready
+    st.schedule_gated_replicas = sum(1 for p in pods if p.is_gated)
     st.updated_replicas = sum(
         1
         for p in pods

@@ -78,9 +78,15 @@ def test_gang_stays_pending_without_capacity(simple1: PodCliqueSet):
     assert all(not p.is_scheduled for p in sim.cluster.pods.values())
     for gang in sim.cluster.podgangs.values():
         assert gang.status.phase == PodGangPhase.PENDING
+    # scheduleGatedReplicas (podclique.go status): while unplaced, every
+    # clique pod is gated; after admission the count drains to zero.
+    for clique in sim.cluster.podcliques.values():
+        assert clique.status.schedule_gated_replicas == clique.status.replicas > 0
     # capacity freed later -> gang admits (GS recovery)
     sim.cluster.nodes["n0"].capacity["cpu"] = 4.0
     assert sim.run_until(all_gangs_running(sim.cluster), timeout=60)
+    for clique in sim.cluster.podcliques.values():
+        assert clique.status.schedule_gated_replicas == 0
 
 
 def test_pod_failure_recovers(simple1: PodCliqueSet):
