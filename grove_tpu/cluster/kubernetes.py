@@ -351,6 +351,8 @@ class KubernetesWatchSource:
         # Collections whose cluster-side members have been LISTed into the
         # cache (crash-orphan GC; _sync_collection).
         self._seeded_bases: set[str] = set()
+        # Per-collection sync counter driving the periodic resync relist.
+        self._resync_counts: dict[str, int] = {}
 
     # ---- lifecycle ----------------------------------------------------------------
 
@@ -473,21 +475,34 @@ class KubernetesWatchSource:
     # ---- managed-object sync plumbing ----------------------------------------------
 
     _PREEXISTING = {"_preexisting": True}  # cache sentinel from seeding
+    # Managed-collection syncs between resync relists (the informer-resync
+    # analog healing out-of-band deletes of unchanged objects).
+    RESYNC_SYNCS = 30
 
     def _seed_cache(self, base: str, cache: dict) -> bool:
         """First sync after (re)start: LIST the cluster's managed objects so
         ones surviving a crash participate in GC — an in-memory cache alone
         would orphan them forever (live DNS records, stale CRs)."""
+        names = self._list_names(base, op="seed")
+        if names is None:
+            return False
+        for name in names:
+            cache.setdefault(name, dict(self._PREEXISTING))
+        return True
+
+    def _list_names(self, base: str, op: str = "resync") -> set | None:
+        """Names of the collection's live managed objects, None on failure
+        (a failed seed retries; a failed resync LIST must not evict)."""
         try:
             doc = self._request(
                 "GET", base, query={"labelSelector": DEFAULT_POD_LABEL_SELECTOR}
             )
         except (KubeApiError, OSError, ValueError) as e:
-            self._record_error(f"seed {base}: {e}")
-            return False
-        for item in doc.get("items", []) or []:
-            cache.setdefault(item["metadata"]["name"], dict(self._PREEXISTING))
-        return True
+            self._record_error(f"{op} {base}: {e}")
+            return None
+        return {
+            item["metadata"]["name"] for item in doc.get("items", []) or []
+        }
 
     def _upsert_object(
         self, base: str, name: str, manifest: dict, known: bool,
@@ -499,7 +514,16 @@ class KubernetesWatchSource:
         PUT/POST STRIPS — is written with a second PUT to /status."""
 
         def _put_main() -> None:
-            cur = self._request("GET", f"{base}/{name}")
+            try:
+                cur = self._request("GET", f"{base}/{name}")
+            except KubeApiError as e:
+                if e.status != 404:
+                    raise
+                # Known-to-us but gone from the cluster (out-of-band
+                # kubectl delete): heal by re-creating instead of failing
+                # the GET-then-PUT forever.
+                self._request("POST", base, manifest)
+                return
             body = dict(manifest)
             rv = (cur.get("metadata", {}) or {}).get("resourceVersion")
             if rv:
@@ -538,6 +562,21 @@ class KubernetesWatchSource:
                 self._seeded_bases.add(base)
             else:
                 ok = False  # retry the seed next push; GC waits for it
+        else:
+            # Informer-resync analog: every RESYNC_SYNCS passes, re-LIST and
+            # evict cache entries whose live object vanished (out-of-band
+            # kubectl delete of an UNCHANGED object would otherwise be
+            # skipped-as-synced forever; the upsert loop below re-creates
+            # evicted names). Counted per collection, cheap: one LIST.
+            self._resync_counts[base] = self._resync_counts.get(base, 0) + 1
+            if self._resync_counts[base] >= self.RESYNC_SYNCS:
+                live = self._list_names(base)
+                if live is not None:
+                    # Reset only on success: a failed relist retries next
+                    # pass instead of waiting out another full interval.
+                    self._resync_counts[base] = 0
+                    for name in [n for n in cache if n not in live]:
+                        del cache[name]
         for name, manifest in desired.items():
             if cache.get(name) == manifest:
                 continue

@@ -992,6 +992,50 @@ def test_headless_services_mirrored_to_cluster(api, tmp_path, simple1):
         m.stop()
 
 
+def test_out_of_band_delete_of_mirrored_service_heals(api, tmp_path, simple1):
+    """kubectl delete of a mirrored managed object is healed: the periodic
+    resync relist (RESYNC_SYNCS) evicts the cache entry so the next sync
+    re-creates it — without it, an unchanged object would be
+    skipped-as-synced forever (review finding, round 4)."""
+    from grove_tpu.cluster.kubernetes import KubernetesWatchSource
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    api.add_node(k8s_node("n0", cpu="16", memory="64Gi"))
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        m.apply_podcliqueset(simple1)
+        deadline = time.monotonic() + 15.0
+        t = 0.0
+        while time.monotonic() < deadline and not api.services:
+            t += 1.0
+            m.reconcile_once(now=t)
+            time.sleep(0.05)
+        assert "simple1-0" in api.services
+        # the out-of-band delete (kubectl delete svc simple1-0)
+        del api.services["simple1-0"]
+        # more passes than the resync interval: the relist must evict the
+        # stale cache entry and the sync loop must re-create the Service
+        for _ in range(KubernetesWatchSource.RESYNC_SYNCS + 5):
+            t += 1.0
+            m.reconcile_once(now=t)
+        assert "simple1-0" in api.services, "deleted Service never healed"
+    finally:
+        m.stop()
+
+
 def test_child_crs_projected_with_status(api, tmp_path, simple1):
     """kubectl get pclq,pcsg on a real cluster: the operator projects its
     PodClique/PCSG objects as CRs with live status, and GCs them with the
