@@ -71,6 +71,12 @@ class AdmissionChain:
     # unknown queue is rejected at the door — a typo'd queue would
     # otherwise silently run unquoted.
     known_queues: frozenset | None = None
+    # networkAcceleration.autoSliceEnabled (the MNNVL webhook's feature
+    # gate, mnnvl/webhook.go:33-169). None = config unknown (CLI dry run
+    # without --config): the annotation value is still checked but the
+    # feature-enabled cross-check is skipped.
+    auto_slice_enabled: bool | None = None
+    slice_resource_name: str = constants.DEFAULT_SLICE_RESOURCE
 
     def __post_init__(self):
         if self.authorizer is None:
@@ -86,7 +92,12 @@ class AdmissionChain:
         `old` triggers update-path immutability checks
         (validation/podcliqueset.go:440-508)."""
         pcs = default_podcliqueset(pcs)
+        if old is None:
+            # Auto-annotation is applied only on creation
+            # (defaulting/handler.go:62-65).
+            self._default_auto_slice(pcs)
         errors = validate_podcliqueset(pcs, self.topology)
+        errors += self._validate_auto_slice(pcs, old)
         if old is not None:
             errors += validate_update(old, pcs)
         queue = pcs.metadata.annotations.get(constants.ANNOTATION_QUEUE, "")
@@ -101,6 +112,92 @@ class AdmissionChain:
         if errors:
             raise AdmissionError(errors)
         return pcs
+
+    def _requests_slice(self, pcs: PodCliqueSet) -> bool:
+        """hasGPURequirement analog (mnnvl/webhook.go:~57): any clique
+        template requesting the slice resource."""
+        for tmpl in pcs.spec.template.cliques:
+            if (
+                tmpl.spec.pod_spec.total_requests().get(self.slice_resource_name, 0.0)
+                > 0
+            ):
+                return True
+        return False
+
+    def _default_auto_slice(self, pcs: PodCliqueSet) -> None:
+        """MutateAutoMNNVL analog (mnnvl/webhook.go:33-66): when the feature
+        is globally enabled and the workload requests the slice resource,
+        stamp grove.io/auto-slice: enabled — unless the user already set the
+        annotation (explicit values, including "disabled", are never
+        overridden)."""
+        if not self.auto_slice_enabled:
+            return
+        if constants.ANNOTATION_AUTO_SLICE in pcs.metadata.annotations:
+            return
+        if not self._requests_slice(pcs):
+            return
+        pcs.metadata.annotations[constants.ANNOTATION_AUTO_SLICE] = (
+            constants.AUTO_SLICE_ENABLED
+        )
+
+    def _validate_auto_slice(self, pcs: PodCliqueSet, old: PodCliqueSet | None) -> list:
+        """auto-slice annotation validation, mirroring the MNNVL webhook:
+
+        CREATE (ValidateMetadataOnCreate, mnnvl/webhook.go:69-118): value
+        must be enabled|disabled; "enabled" while the feature is off is an
+        error (the injection would silently never happen). The feature
+        cross-check is create-only — flipping the feature off later must not
+        brick updates to workloads that were auto-stamped while it was on.
+
+        UPDATE (ValidateMetadataOnUpdate, webhook.go:120-169): the
+        annotation is immutable — changing the value or adding it after
+        creation is forbidden. One replace-semantics accommodation: the
+        reference relies on apiserver merge-patch to carry the stamped
+        annotation through user applies that never mention it; this
+        surface's applies are whole-object, so an absent annotation on
+        update is carried forward from `old` rather than treated as an
+        explicit removal."""
+        path = f"metadata.annotations[{constants.ANNOTATION_AUTO_SLICE}]"
+        value = pcs.metadata.annotations.get(constants.ANNOTATION_AUTO_SLICE)
+        if old is not None:
+            old_value = old.metadata.annotations.get(constants.ANNOTATION_AUTO_SLICE)
+            if value is None and old_value is not None:
+                pcs.metadata.annotations[constants.ANNOTATION_AUTO_SLICE] = old_value
+                return []
+            if value is not None and old_value is None:
+                return [
+                    ValidationError(
+                        path, "annotation cannot be added after creation (immutable)"
+                    )
+                ]
+            if value != old_value:
+                return [
+                    ValidationError(
+                        path,
+                        f"annotation is immutable (was {old_value!r}, got {value!r})",
+                    )
+                ]
+            return []
+        if value is None:
+            return []
+        errors = []
+        if value not in (constants.AUTO_SLICE_ENABLED, constants.AUTO_SLICE_DISABLED):
+            errors.append(
+                ValidationError(
+                    path,
+                    f"must be {constants.AUTO_SLICE_ENABLED!r} or "
+                    f"{constants.AUTO_SLICE_DISABLED!r}, got {value!r}",
+                )
+            )
+        elif value == constants.AUTO_SLICE_ENABLED and self.auto_slice_enabled is False:
+            errors.append(
+                ValidationError(
+                    path,
+                    "TPU slice injection requested but "
+                    "networkAcceleration.autoSliceEnabled is false",
+                )
+            )
+        return errors
 
     def admit_managed_mutation(self, actor: str, kind: str, name: str) -> None:
         self.authorizer.check(actor, kind, name)

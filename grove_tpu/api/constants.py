@@ -71,6 +71,17 @@ FINALIZER_PCSG = "grove.io/podcliquescalinggroup-protection"
 
 ANNOTATION_MNNVL = "grove.io/network-acceleration"  # analog: TPU slice acceleration
 ANNOTATION_ICI_DOMAIN = "grove.io/ici-domain"  # TPU-native: pin gang to ICI domain
+# Per-workload TPU-slice injection opt-in/out (the grove.io/auto-mnnvl
+# analog, mnnvl/helpers.go:29-34): defaulted to "enabled" at admission when
+# the feature is on and a clique requests the slice resource; users may
+# pre-set it to either value (webhook.go:33-66).
+ANNOTATION_AUTO_SLICE = "grove.io/auto-slice"
+AUTO_SLICE_ENABLED = "enabled"
+AUTO_SLICE_DISABLED = "disabled"
+# The ONE default for the TPU-slice device resource name (the GPU-request
+# analog, mnnvl/helpers.go hasGPURequirement): config, admission chain, and
+# the config-less CLI dry run must agree or they check different resources.
+DEFAULT_SLICE_RESOURCE = "google.com/tpu"
 # Capacity queue this workload's gangs draw quota from (the KAI Queue
 # analog, e2e/yaml/queues.yaml; scheduling.queues in the operator config).
 ANNOTATION_QUEUE = "grove.io/queue"

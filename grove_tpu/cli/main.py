@@ -371,10 +371,13 @@ def main(argv=None) -> int:
             import yaml as _yaml
 
             from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY, PodCliqueSet
+            from grove_tpu.api import constants as api_constants
             from grove_tpu.api.admission import AdmissionChain, AdmissionError
 
             topology = DEFAULT_CLUSTER_TOPOLOGY
             known_queues = None
+            auto_slice = None  # config unknown: skip the feature cross-check
+            slice_resource = api_constants.DEFAULT_SLICE_RESOURCE
             if args.config:
                 from grove_tpu.runtime.config import load_operator_config
 
@@ -383,11 +386,16 @@ def main(argv=None) -> int:
                 # The server rejects unknown queues; the dry run must too
                 # or validate would bless a file apply then bounces.
                 known_queues = frozenset(opcfg.scheduling.queues)
+                auto_slice = opcfg.network_acceleration.auto_slice_enabled
+                slice_resource = opcfg.network_acceleration.slice_resource_name
             try:
                 with open(args.filename) as f:
                     doc = _yaml.safe_load(f)
                 pcs = AdmissionChain(
-                    topology=topology, known_queues=known_queues
+                    topology=topology,
+                    known_queues=known_queues,
+                    auto_slice_enabled=auto_slice,
+                    slice_resource_name=slice_resource,
                 ).admit_podcliqueset(PodCliqueSet.from_dict(doc))
             except AdmissionError as e:
                 for err in e.errors:

@@ -742,6 +742,33 @@ class KubernetesWatchSource:
             self._record_error(f"ClusterTopology sync: {e}")
             return False
 
+    def sync_webhook_ca(self, ca_pem: bytes, app: str = "grove-tpu-operator") -> bool:
+        """Write the webhook serving cert into the Mutating/Validating
+        WebhookConfigurations' clientConfig.caBundle — the cert-controller
+        rotator's job in the reference (cert.go:66-93): deploy renders the
+        configs with an empty bundle, the running operator completes them so
+        the apiserver can verify the TLS it is told to call. Best-effort: a
+        cluster without the configs (webhook disabled at deploy) returns
+        False."""
+        bundle = base64.b64encode(ca_pem).decode()
+        ok = True
+        for kind in ("mutatingwebhookconfigurations", "validatingwebhookconfigurations"):
+            path = f"/apis/admissionregistration.k8s.io/v1/{kind}/{app}"
+            try:
+                cur = self._request("GET", path)
+                changed = False
+                for wh in cur.get("webhooks", []) or []:
+                    cc = wh.setdefault("clientConfig", {})
+                    if cc.get("caBundle") != bundle:
+                        cc["caBundle"] = bundle
+                        changed = True
+                if changed:
+                    self._request("PUT", path, cur)
+            except (KubeApiError, OSError, ValueError) as e:
+                self._record_error(f"webhook caBundle sync ({kind}): {e}")
+                ok = False
+        return ok
+
     def delete_workload(self, name: str) -> bool:
         """Delete the PodCliqueSet CR (an operator-API delete must also
         remove the CR, or the next relist re-emits ADDED and resurrects the

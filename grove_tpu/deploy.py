@@ -211,13 +211,25 @@ def render_manifests(
     ha_capable = (
         cfg.leader_election.enabled and cfg.cluster.source == "kubernetes"
     )
+    webhook_enabled = cfg.servers.webhook_port >= 0
     if replicas is None:
-        replicas = 2 if ha_capable else 1
+        replicas = 2 if ha_capable and not webhook_enabled else 1
     elif replicas > 1 and not ha_capable:
         raise ValueError(
             "replicas > 1 requires leaderElection.enabled AND cluster.source: "
             "kubernetes (apiserver-backed lease); the file lease cannot "
             "coordinate pods on separate filesystems"
+        )
+    if webhook_enabled and replicas > 1:
+        # Each replica self-signs its own webhook cert into its container
+        # filesystem, but caBundle can only hold one trust root and the
+        # webhook Service load-balances across pods — the apiserver would
+        # fail TLS on whichever pod lost the boot-time patch race. Until
+        # certs are Secret-shared, webhooks mean one replica.
+        raise ValueError(
+            "servers.webhookPort with replicas > 1 would intermittently fail "
+            "apiserver TLS verification (per-pod self-signed webhook certs, "
+            "one caBundle); run a single replica or disable the webhook"
         )
 
     if cfg.servers.bind_address.startswith("127.") or cfg.servers.bind_address in (
@@ -233,6 +245,7 @@ def render_manifests(
     for name, port, enabled in (
         ("health", cfg.servers.health_port, cfg.servers.health_port >= 0),
         ("metrics", cfg.servers.metrics_port, cfg.servers.metrics_port >= 0),
+        ("webhook", cfg.servers.webhook_port, cfg.servers.webhook_port >= 0),
         ("backend", cfg.backend.port, cfg.backend.enabled),
     ):
         if not enabled:
@@ -309,6 +322,28 @@ def render_manifests(
             raise ValueError(
                 "servers.advertiseUrl must be a plaintext http:// URL (the "
                 "injected grove-initc has no CA material for https)"
+            )
+
+    webhook_svc_dns = f"{APP}-webhook.{namespace}.svc"
+    if webhook_enabled:
+        if cfg.cluster.source != "kubernetes":
+            raise ValueError(
+                "servers.webhookPort requires cluster.source: kubernetes — "
+                "the running operator must patch the rendered webhook "
+                "configs' caBundle via the apiserver"
+            )
+        # NB: rendered webhook certs are always auto-generated — webhooks
+        # require cluster.source kubernetes (above), which in turn requires
+        # tlsMode disabled (below), so the manual-cert path cannot reach
+        # this renderer and webhookSans always governs the real cert.
+        if webhook_svc_dns not in cfg.servers.webhook_sans:
+            # The apiserver verifies the webhook serving cert against the
+            # Service DNS name; a cert without it fails every admission call
+            # (failurePolicy Fail => cluster-wide PCS write outage).
+            raise ValueError(
+                f"servers.webhookSans must include {webhook_svc_dns!r} so the "
+                "auto-generated webhook cert verifies against the rendered "
+                "Service"
             )
 
     docs: list[dict] = []
@@ -431,7 +466,25 @@ def render_manifests(
                     "resources": ["clustertopologies"],
                     "verbs": ["get", "create", "update"],
                 },
-            ],
+            ]
+            + (
+                [
+                    {
+                        # Boot-time caBundle patch (sync_webhook_ca): the
+                        # configs are cluster-scoped; scope the grant to
+                        # exactly our two objects.
+                        "apiGroups": ["admissionregistration.k8s.io"],
+                        "resources": [
+                            "mutatingwebhookconfigurations",
+                            "validatingwebhookconfigurations",
+                        ],
+                        "resourceNames": [APP],
+                        "verbs": ["get", "update"],
+                    }
+                ]
+                if webhook_enabled
+                else []
+            ),
         },
         {
             "apiVersion": "rbac.authorization.k8s.io/v1",
@@ -541,7 +594,84 @@ def render_manifests(
                 },
             }
         )
+    if webhook_enabled:
+        docs.extend(_render_webhook_objects(namespace))
     return docs
+
+
+def _render_webhook_objects(namespace: str) -> list[dict]:
+    """The inbound admission surface (webhook/register.go:34-62 analog): a
+    dedicated webhook Service on 443 plus Mutating/Validating
+    WebhookConfigurations for PodCliqueSet writes. caBundle is left empty;
+    the running operator completes it at boot (sync_webhook_ca — the
+    cert-controller rotator pattern, cert.go:66-93)."""
+
+    def _client_config(path: str) -> dict:
+        return {
+            "service": {
+                "name": f"{APP}-webhook",
+                "namespace": namespace,
+                "path": path,
+                "port": 443,
+            }
+        }
+
+    rules = [
+        {
+            "apiGroups": ["grove.io"],
+            "apiVersions": ["v1alpha1"],
+            "operations": ["CREATE", "UPDATE"],
+            "resources": ["podcliquesets"],
+            "scope": "Namespaced",
+        }
+    ]
+    common = {
+        "rules": rules,
+        "failurePolicy": "Fail",
+        "sideEffects": "None",
+        "admissionReviewVersions": ["v1"],
+        "matchPolicy": "Equivalent",
+        "timeoutSeconds": 10,
+    }
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": f"{APP}-webhook",
+                "namespace": namespace,
+                "labels": _labels(),
+            },
+            "spec": {
+                "selector": {"app.kubernetes.io/name": APP},
+                "ports": [{"name": "webhook", "port": 443, "targetPort": "webhook"}],
+            },
+        },
+        {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": APP, "labels": _labels()},
+            "webhooks": [
+                {
+                    "name": "defaulting.pcs.grove.io",
+                    "clientConfig": _client_config("/webhook/v1/default"),
+                    **common,
+                }
+            ],
+        },
+        {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": APP, "labels": _labels()},
+            "webhooks": [
+                {
+                    "name": "validation.pcs.grove.io",
+                    "clientConfig": _client_config("/webhook/v1/validate"),
+                    **common,
+                }
+            ],
+        },
+    ]
 
 
 def main(argv: list[str] | None = None) -> int:

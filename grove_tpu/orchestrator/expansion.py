@@ -139,7 +139,7 @@ def expand_podcliqueset(
     pclq_replica_overrides: dict[str, int] | None = None,
     rng: random.Random | None = None,
     auto_slice_enabled: bool = False,
-    slice_resource_name: str = "google.com/tpu",
+    slice_resource_name: str = constants.DEFAULT_SLICE_RESOURCE,
     initc_server_url: str = "",
 ) -> DesiredState:
     """Expand a defaulted PodCliqueSet into its full desired object set.
@@ -394,10 +394,16 @@ def _collect_hpas(out: DesiredState, pcs: PodCliqueSet) -> None:
 
 
 def slice_injection_active(pcs: PodCliqueSet, auto_slice_enabled: bool) -> bool:
-    """Config gate + per-PCS opt-out annotation (mnnvl/helpers.go:30-98)."""
+    """Config gate + per-PCS opt-out annotation (mnnvl/helpers.go:30-98).
+
+    The admission chain defaults grove.io/auto-slice to "enabled" on
+    qualifying workloads and rejects "enabled" when the feature is off
+    (api/admission.py), so at expansion time the gate is simply: feature on
+    and not explicitly opted out."""
     return (
         auto_slice_enabled
-        and pcs.metadata.annotations.get("grove.io/auto-slice") != "disabled"
+        and pcs.metadata.annotations.get(constants.ANNOTATION_AUTO_SLICE)
+        != constants.AUTO_SLICE_DISABLED
     )
 
 

@@ -35,6 +35,7 @@ def ensure_serving_certs(
     key_file: str = "",
     common_name: str = "grove-tpu-manager",
     days: int = 365,
+    san_dns: tuple[str, ...] = (),
 ) -> tuple[str, str]:
     """Return (cert_path, key_path) ready to serve, per the configured mode.
 
@@ -60,7 +61,29 @@ def ensure_serving_certs(
     os.chmod(out, 0o700)
     cert = out / "tls.crt"
     key = out / "tls.key"
-    if cert.is_file() and key.is_file() and _still_valid(cert, days):
+    # SANs are baked into the cert: if the requested set changed (e.g. a
+    # webhook Service DNS name was added to the config), the cached cert is
+    # stale even while time-valid — track the set in a sidecar marker.
+    san = "subjectAltName=" + ",".join(
+        ["DNS:localhost", "IP:127.0.0.1"] + [f"DNS:{d}" for d in san_dns]
+    )
+    san_marker = out / "san.txt"
+    if san_marker.is_file():
+        san_current = san_marker.read_text()
+    else:
+        # Pre-marker certs were all generated with the bare default SAN set:
+        # treat a missing marker as that set (and stamp it on reuse below) so
+        # upgrading does not churn a still-valid cert that pinned clients
+        # (initc agents, GroveClients) already trust.
+        san_current = "subjectAltName=DNS:localhost,IP:127.0.0.1"
+    if (
+        cert.is_file()
+        and key.is_file()
+        and _still_valid(cert, days)
+        and san_current == san
+    ):
+        if not san_marker.is_file():
+            san_marker.write_text(san)
         os.chmod(key, 0o600)
         return str(cert), str(key)
     try:
@@ -70,7 +93,7 @@ def ensure_serving_certs(
                 "-keyout", str(key), "-out", str(cert),
                 "-days", str(days),
                 "-subj", f"/CN={common_name}",
-                "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+                "-addext", san,
             ],
             capture_output=True,
             text=True,
@@ -79,6 +102,7 @@ def ensure_serving_certs(
         raise CertError(f"cannot run openssl: {e}") from e
     if proc.returncode != 0:
         raise CertError(f"self-signed cert generation failed: {proc.stderr.strip()}")
+    san_marker.write_text(san)
     os.chmod(key, 0o600)
     return str(cert), str(key)
 

@@ -21,6 +21,7 @@ from typing import Any, Optional
 
 import yaml
 
+from grove_tpu.api import constants as api_constants
 from grove_tpu.api.types import ClusterTopology, DEFAULT_CLUSTER_TOPOLOGY
 
 
@@ -54,6 +55,21 @@ class ServerConfig:
     tls_cert_dir: str = "/tmp/grove-tpu-certs"
     tls_cert_file: str = ""
     tls_key_file: str = ""
+    # Manual mode only: the ISSUING CA bundle for tlsCertFile. Required for
+    # the webhook caBundle patch when the manual cert is CA-issued — a leaf
+    # installed as a trust root verifies nothing. Unset = the cert file
+    # itself is the root (self-signed manual certs).
+    tls_ca_file: str = ""
+    # Inbound AdmissionReview webhook server (the controller-runtime webhook
+    # server analog, manager.go:90-121 / register.go:34-62). -1 = disabled,
+    # 0 = auto-assign (tests). ALWAYS HTTPS — the apiserver refuses plaintext
+    # webhooks — with certs independent of tlsMode (auto self-signed into
+    # tlsCertDir/webhook unless tlsMode is manual, which reuses its files).
+    webhook_port: int = -1
+    # Extra DNS SANs baked into the auto-generated webhook serving cert —
+    # must include the webhook Service DNS name for in-cluster use (the
+    # apiserver verifies the cert against clientConfig.service).
+    webhook_sans: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -92,7 +108,7 @@ class NetworkAccelerationConfig:
     """types.go:233-240 MNNVL analog: auto TPU-slice/ICI resource injection."""
 
     auto_slice_enabled: bool = False
-    slice_resource_name: str = "google.com/tpu"
+    slice_resource_name: str = api_constants.DEFAULT_SLICE_RESOURCE
 
 
 @dataclass
@@ -269,6 +285,9 @@ _CAMEL_FIELDS = {
     "tlsCertDir": "tls_cert_dir",
     "tlsCertFile": "tls_cert_file",
     "tlsKeyFile": "tls_key_file",
+    "tlsCaFile": "tls_ca_file",
+    "webhookPort": "webhook_port",
+    "webhookSans": "webhook_sans",
     "concurrentSyncs": "concurrent_syncs",
     "reconcileIntervalSeconds": "reconcile_interval_seconds",
     "exemptActors": "exempt_actors",
@@ -386,13 +405,32 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
         cfg.servers.tls_cert_file and cfg.servers.tls_key_file
     ):
         errors.append("servers.tlsCertFile/tlsKeyFile: required for tlsMode manual")
+    if cfg.servers.tls_ca_file:
+        import os as _os
+
+        if cfg.servers.tls_mode != "manual":
+            errors.append("servers.tlsCaFile: only meaningful with tlsMode manual")
+        elif not _os.path.isfile(cfg.servers.tls_ca_file):
+            errors.append(
+                f"servers.tlsCaFile: {cfg.servers.tls_ca_file!r} does not exist"
+            )
     for port_name, port in (
         ("servers.healthPort", cfg.servers.health_port),
         ("servers.metricsPort", cfg.servers.metrics_port),
+        ("servers.webhookPort", cfg.servers.webhook_port),
         ("backend.port", cfg.backend.port),
     ):
         if port < -1 or port > 65535:
             errors.append(f"{port_name}: {port} out of range")
+    if not isinstance(cfg.servers.webhook_sans, list):
+        # A bare YAML string would iterate char-by-char below AND turn the
+        # deploy renderer's `dns in sans` membership test into a substring
+        # match — two silent passes ending in cluster-wide TLS failure.
+        errors.append("servers.webhookSans: must be a list of DNS names")
+    else:
+        for i, san in enumerate(cfg.servers.webhook_sans):
+            if not isinstance(san, str) or not san:
+                errors.append(f"servers.webhookSans[{i}]: must be a non-empty DNS name")
     tas = cfg.topology_aware_scheduling
     seen_domains: set[str] = set()
     for i, lvl in enumerate(tas.levels):

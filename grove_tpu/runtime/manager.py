@@ -352,6 +352,83 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
         pass
 
 
+def _serve_admission_review(handler: "_ProbeHandler") -> None:
+    """Inbound AdmissionReview v1 endpoints — the apiserver-facing webhook
+    surface (webhook/register.go:34-62 analog). Served ONLY on the dedicated
+    webhook port: these are called BY the apiserver, which authenticates the
+    operator via the serving cert, not a bearer token — putting them on the
+    (possibly plaintext, token-guarded) API port would expose an
+    unauthenticated admission oracle to every workload pod."""
+    from grove_tpu.api.webhook import handle_mutate, handle_validate
+
+    length = int(handler.headers.get("Content-Length", "0"))
+    try:
+        review = json.loads(handler.rfile.read(length).decode())
+        if not isinstance(review, dict):
+            raise ValueError("AdmissionReview body must be a JSON object")
+    except (ValueError, TypeError) as e:
+        handler._respond(400, json.dumps({"errors": [str(e)]}), "application/json")
+        return
+    fn = handle_mutate if handler.path.endswith("default") else handle_validate
+    out = fn(review, handler.manager.admission)
+    handler._respond(200, json.dumps(out), "application/json")
+
+
+class _WebhookHandler(_ProbeHandler):
+    """The dedicated webhook server's handler: AdmissionReview POSTs plus a
+    bare /healthz — nothing else from the API surface leaks onto the
+    apiserver-facing port (the reference's webhook server is likewise
+    separate from metrics/health, manager.go:90-121)."""
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            self._respond(200, "ok")
+        else:
+            self._respond(404, "not found")
+
+    def do_POST(self):  # noqa: N802
+        if self.path in ("/webhook/v1/default", "/webhook/v1/validate"):
+            _serve_admission_review(self)
+        else:
+            self._respond(404, "not found")
+
+    def do_DELETE(self):  # noqa: N802
+        self._respond(404, "not found")
+
+
+def _require_self_signed(cert_file: str) -> None:
+    """Raise CertError when a manual webhook cert is CA-issued but no
+    tlsCaFile was given (issuer != subject means the leaf cannot serve as
+    its own trust root in caBundle). openssl-unavailable => skip the check
+    (same best-effort posture as cert generation)."""
+    import subprocess
+
+    from grove_tpu.runtime.certs import CertError
+
+    try:
+        out = subprocess.run(
+            ["openssl", "x509", "-noout", "-issuer", "-subject", "-in", cert_file],
+            capture_output=True,
+            text=True,
+        )
+    except OSError:
+        return
+    if out.returncode != 0:
+        return
+    fields = dict(
+        line.split("=", 1) for line in out.stdout.splitlines() if "=" in line
+    )
+    issuer = fields.get("issuer", "").strip()
+    subject = fields.get("subject", "").strip()
+    if issuer and subject and issuer != subject:
+        raise CertError(
+            "servers.tlsCertFile is CA-issued (issuer != subject) but "
+            "servers.tlsCaFile is unset: the webhook caBundle patch would "
+            "install an unverifiable leaf as trust root; set tlsCaFile to "
+            "the issuing CA bundle"
+        )
+
+
 def _parse_queue_quotas(queues: dict) -> dict:
     """scheduling.queues (quantity strings / -1) -> numeric quotas for the
     controller's admission filter (validated at config load)."""
@@ -410,7 +487,10 @@ class Manager:
         self._next_requeue: Optional[float] = None
         self.persistence = None  # wired by start() when enabled
         self.metrics_port: Optional[int] = None
+        self.webhook_port: Optional[int] = None
         self._tls_paths: Optional[tuple[str, str]] = None  # (cert, key) once ensured
+        self._webhook_tls_paths: Optional[tuple[str, str]] = None
+        self._webhook_ca_pending = False  # boot patch failed; retry in reconcile
         # /profilez state: per-step cumulative seconds + call counts.
         self._profile: dict[str, dict[str, float]] = {}
         # Watch driver (cluster integration path): attached via attach_watch;
@@ -438,6 +518,8 @@ class Manager:
                 exempt_actors=tuple(config.authorizer.exempt_actors),
             ),
             known_queues=frozenset(config.scheduling.queues),
+            auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
+            slice_resource_name=config.network_acceleration.slice_resource_name,
         )
 
         self._m_reconciles = self.metrics.counter(
@@ -698,6 +780,8 @@ class Manager:
             # Dedicated metrics bind (manager.go:94-96); same handler class,
             # so /metrics is the canonical path on this port.
             self.metrics_port = self._serve_http(cfg.servers.metrics_port)
+        if cfg.servers.webhook_port >= 0:
+            self.webhook_port = self._serve_webhook(cfg.servers.webhook_port)
         if cfg.backend.enabled:
             from grove_tpu.backend.service import create_server
 
@@ -774,6 +858,18 @@ class Manager:
             # get it; best-effort — a CRD-less cluster just logs.
             if not source.sync_cluster_topology(self.topology):
                 self.log.info("ClusterTopology CR sync unavailable")
+            if self.webhook_port is not None:
+                # Complete the webhook configs deploy rendered with an empty
+                # caBundle (the cert-controller rotator analog). Failure is
+                # NOT terminal here — reconcile_once retries until it lands
+                # (failurePolicy Fail means an unpatched config is a
+                # cluster-wide PCS write outage).
+                ca = self.webhook_ca_bundle()
+                self._webhook_ca_pending = ca is None or not source.sync_webhook_ca(ca)
+                if self._webhook_ca_pending:
+                    self.log.error(
+                        "webhook caBundle patch failed; retrying each reconcile"
+                    )
             driver = self.attach_watch(source, backend=backend_client)
             # Workload CRs from the apiserver (kubectl apply -> watch ->
             # admission -> store; SURVEY §3.2-3.3) — the same chain the
@@ -792,30 +888,19 @@ class Manager:
             backend_port=self.backend_port,
         )
 
-    def _serve_http(self, port: int) -> int:
+    def _bind_server(
+        self, port: int, handler_base: type, tls_paths: Optional[tuple[str, str]]
+    ) -> int:
+        """Bind + start one HTTP(S) server: the single copy of the
+        socket-wrap/bookkeeping logic both surfaces share."""
+        import ssl
+
         cfg = self.config.servers
-        ctx = None
-        if cfg.tls_mode != "disabled":
-            # Cert management (cert.go:46-98 analog): certs are ensured
-            # BEFORE the port binds — a CertError fails the boot without
-            # leaking a bound socket, and nothing ever serves plaintext.
-            import ssl
-
-            from grove_tpu.runtime.certs import ensure_serving_certs
-
-            if self._tls_paths is None:
-                self._tls_paths = ensure_serving_certs(
-                    cfg.tls_mode,
-                    cfg.tls_cert_dir,
-                    cert_file=cfg.tls_cert_file,
-                    key_file=cfg.tls_key_file,
-                )
-            cert, key = self._tls_paths
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(cert, key)
-        handler = type("Handler", (_ProbeHandler,), {"manager": self})
+        handler = type("Handler", (handler_base,), {"manager": self})
         server = http.server.ThreadingHTTPServer((cfg.bind_address, port), handler)
-        if ctx is not None:
+        if tls_paths is not None:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(*tls_paths)
             # Handshake lazily in the per-connection handler thread
             # (do_handshake_on_connect=False): a slow client must not park
             # the accept loop and starve /healthz for everyone else.
@@ -827,6 +912,82 @@ class Manager:
         t.start()
         self._threads.append(t)
         return server.server_address[1]
+
+    def _serve_http(self, port: int) -> int:
+        cfg = self.config.servers
+        if cfg.tls_mode != "disabled" and self._tls_paths is None:
+            # Cert management (cert.go:46-98 analog): certs are ensured
+            # BEFORE the port binds — a CertError fails the boot without
+            # leaking a bound socket, and nothing ever serves plaintext.
+            from grove_tpu.runtime.certs import ensure_serving_certs
+
+            self._tls_paths = ensure_serving_certs(
+                cfg.tls_mode,
+                cfg.tls_cert_dir,
+                cert_file=cfg.tls_cert_file,
+                key_file=cfg.tls_key_file,
+            )
+        tls = self._tls_paths if cfg.tls_mode != "disabled" else None
+        return self._bind_server(port, _ProbeHandler, tls)
+
+    def _serve_webhook(self, port: int) -> int:
+        """The dedicated AdmissionReview server. Always HTTPS — the
+        apiserver refuses plaintext webhooks — with certs independent of
+        the API surface's tlsMode: manual reuses its files, anything else
+        self-signs into tlsCertDir/webhook with the configured SANs (the
+        cert-controller rotator analog, cert.go:66-93)."""
+        import os as _os
+
+        from grove_tpu.runtime.certs import ensure_serving_certs
+
+        cfg = self.config.servers
+        if self._webhook_tls_paths is None:
+            if cfg.tls_mode == "manual":
+                self._webhook_tls_paths = ensure_serving_certs(
+                    "manual",
+                    cfg.tls_cert_dir,
+                    cert_file=cfg.tls_cert_file,
+                    key_file=cfg.tls_key_file,
+                )
+                if not cfg.tls_ca_file:
+                    # A CA-issued leaf without tlsCaFile would be patched
+                    # into caBundle as a trust root the apiserver cannot
+                    # chain — with failurePolicy Fail that is a silent
+                    # cluster-wide PCS write outage. Fail the boot instead.
+                    _require_self_signed(cfg.tls_cert_file)
+            else:
+                self._webhook_tls_paths = ensure_serving_certs(
+                    "auto",
+                    _os.path.join(cfg.tls_cert_dir, "webhook"),
+                    common_name="grove-tpu-webhook",
+                    san_dns=tuple(cfg.webhook_sans),
+                )
+        return self._bind_server(port, _WebhookHandler, self._webhook_tls_paths)
+
+    def webhook_ca_bundle(self) -> Optional[bytes]:
+        """PEM bundle apiserver clients should trust for the webhook server
+        — what the boot-time caBundle patch writes into the webhook configs.
+        Auto mode: the self-signed serving cert doubles as the CA. Manual
+        mode with a CA-issued cert: tlsCaFile names the issuing CA (a leaf
+        installed as trust root verifies nothing); without it the manual
+        cert is assumed self-signed."""
+        if self._webhook_tls_paths is None:
+            return None
+        cfg = self.config.servers
+        src = (
+            cfg.tls_ca_file
+            if cfg.tls_mode == "manual" and cfg.tls_ca_file
+            else self._webhook_tls_paths[0]
+        )
+        try:
+            with open(src, "rb") as f:
+                return f.read()
+        except OSError as e:
+            # Must not escape: start() and the reconcile retry both treat
+            # None as "still pending" — an uncaught raise here would kill
+            # the run loop instead.
+            self.log.error("webhook CA bundle unreadable", path=src, err=str(e))
+            return None
 
     def reconcile_once(self, now: Optional[float] = None) -> FlowOutcome:
         """One full reconcile pass through the flow runner (testable unit).
@@ -844,6 +1005,17 @@ class Manager:
             except Exception as e:  # noqa: BLE001
                 self._m_reconcile_errors.inc()
                 self.log.error("watch pump failed", err=str(e))
+        if self._webhook_ca_pending and self._kube_source is not None:
+            # The rendered webhook configs carry failurePolicy Fail: until
+            # the caBundle lands, every PCS write in the cluster bounces —
+            # so the boot-time patch retries here until it succeeds (the
+            # cert-controller rotator reconciles continuously; one-shot
+            # best-effort would leave a cluster-wide outage behind an info
+            # log).
+            ca = self.webhook_ca_bundle()
+            if ca is not None and self._kube_source.sync_webhook_ca(ca):
+                self._webhook_ca_pending = False
+                self.log.info("webhook caBundle patched")
         ctrl = self.controller
 
         def _timed(name, body):

@@ -80,6 +80,12 @@ class FixtureApiServer:
         self.created_pods: list[str] = []
         self.leases: dict[str, dict] = {}
         self.events: list[dict] = []  # mirrored corev1 Events, in order
+        # Cluster-scoped admissionregistration objects (deploy renders them;
+        # the operator patches caBundle at boot): kind-plural -> name -> obj.
+        self.webhookconfigs: dict[str, dict[str, dict]] = {
+            "mutatingwebhookconfigurations": {},
+            "validatingwebhookconfigurations": {},
+        }
 
         fixture = self
 
@@ -108,6 +114,16 @@ class FixtureApiServer:
                     name = parsed.path[len(fixture._ct_prefix):].lstrip("/")
                     with fixture._lock:
                         obj = fixture.clustertopologies.get(name)
+                    if obj is None:
+                        self._json(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._json(200, json.loads(json.dumps(obj)))
+                    return
+                wc = fixture._webhookconfig_at(parsed.path)
+                if wc is not None:
+                    plural, name = wc
+                    with fixture._lock:
+                        obj = fixture.webhookconfigs[plural].get(name)
                     if obj is None:
                         self._json(404, {"kind": "Status", "code": 404})
                     else:
@@ -245,6 +261,14 @@ class FixtureApiServer:
                 elif parsed.path.startswith(fixture._pcs_prefix + "/"):
                     code, doc = fixture._pcs_put(parsed.path, body)
                     self._json(code, doc)
+                elif (wc := fixture._webhookconfig_at(parsed.path)) is not None:
+                    plural, name = wc
+                    with fixture._lock:
+                        if name not in fixture.webhookconfigs[plural]:
+                            self._json(404, {"kind": "Status", "code": 404})
+                            return
+                        fixture.webhookconfigs[plural][name] = body
+                    self._json(200, json.loads(json.dumps(body)))
                 else:
                     self._json(404, {"kind": "Status", "code": 404})
 
@@ -307,6 +331,16 @@ class FixtureApiServer:
         self._fail_watch_code = code
 
     # ---- protocol internals ---------------------------------------------------------
+
+    def _webhookconfig_at(self, path: str):
+        """(plural, name) for admissionregistration object paths, else None."""
+        prefix = "/apis/admissionregistration.k8s.io/v1/"
+        if not path.startswith(prefix):
+            return None
+        parts = path[len(prefix):].split("/")
+        if len(parts) == 2 and parts[0] in self.webhookconfigs:
+            return parts[0], parts[1]
+        return None
 
     @property
     def _leases_prefix(self) -> str:

@@ -1324,3 +1324,35 @@ def test_user_volumes_and_tgp_roundtrip():
         {"name": "data", "mountPath": "/data"}
     ]
     assert manifest["spec"]["terminationGracePeriodSeconds"] == 0
+
+
+def test_sync_webhook_ca_patches_rendered_configs(api):
+    """Boot-time caBundle completion (the cert-controller rotator analog,
+    cert.go:66-93): deploy renders the webhook configs with no caBundle; the
+    operator PUTs the serving cert into every webhook entry of both
+    configurations. Idempotent: a second sync with the same cert writes
+    nothing new."""
+    import base64
+
+    from grove_tpu.deploy import _render_webhook_objects
+
+    for doc in _render_webhook_objects("grove-system"):
+        kind = doc["kind"].lower() + "s"
+        if kind in api.webhookconfigs:
+            api.webhookconfigs[kind][doc["metadata"]["name"]] = doc
+
+    src = _source(api)
+    ca = b"-----BEGIN CERTIFICATE-----\nabc\n-----END CERTIFICATE-----\n"
+    assert src.sync_webhook_ca(ca) is True
+    want = base64.b64encode(ca).decode()
+    for plural in ("mutatingwebhookconfigurations", "validatingwebhookconfigurations"):
+        obj = api.webhookconfigs[plural]["grove-tpu-operator"]
+        for wh in obj["webhooks"]:
+            assert wh["clientConfig"]["caBundle"] == want
+    assert src.sync_webhook_ca(ca) is True  # no-op second pass
+
+    # A cluster without the configs (webhook disabled at deploy): best-effort
+    # False, recorded as an error, nothing raised.
+    api.webhookconfigs["mutatingwebhookconfigurations"].clear()
+    api.webhookconfigs["validatingwebhookconfigurations"].clear()
+    assert src.sync_webhook_ca(ca) is False

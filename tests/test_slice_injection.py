@@ -102,3 +102,74 @@ def test_slice_injection_keeps_authored_constraints():
                 g_inj.topology_constraint.pack_constraint.required
                 == tc.pack_constraint.required
             )
+
+
+# --- Admission-side annotation webhook analog (mnnvl/webhook.go:33-169) ------
+
+
+def _chain(**kw):
+    from grove_tpu.api.admission import AdmissionChain
+
+    return AdmissionChain(**kw)
+
+
+def test_admission_defaults_auto_slice_annotation():
+    """MutateAutoMNNVL analog: feature on + slice requested => annotation
+    stamped "enabled"; a pre-set value (either way) is never overridden."""
+    from grove_tpu.api import constants
+
+    pcs = _chain(auto_slice_enabled=True).admit_podcliqueset(aggregated_pcs("agg"))
+    assert (
+        pcs.metadata.annotations[constants.ANNOTATION_AUTO_SLICE]
+        == constants.AUTO_SLICE_ENABLED
+    )
+
+    pre = aggregated_pcs("agg2")
+    pre.metadata.annotations[constants.ANNOTATION_AUTO_SLICE] = (
+        constants.AUTO_SLICE_DISABLED
+    )
+    pcs = _chain(auto_slice_enabled=True).admit_podcliqueset(pre)
+    assert (
+        pcs.metadata.annotations[constants.ANNOTATION_AUTO_SLICE]
+        == constants.AUTO_SLICE_DISABLED
+    )
+
+
+def test_admission_skips_annotation_without_slice_request():
+    from grove_tpu.api import constants
+
+    pcs = _chain(auto_slice_enabled=True).admit_podcliqueset(frontend_pcs("fe"))
+    assert constants.ANNOTATION_AUTO_SLICE not in pcs.metadata.annotations
+
+    pcs = _chain(auto_slice_enabled=False).admit_podcliqueset(aggregated_pcs("agg"))
+    assert constants.ANNOTATION_AUTO_SLICE not in pcs.metadata.annotations
+
+
+def test_admission_rejects_bad_auto_slice_value():
+    import pytest
+
+    from grove_tpu.api.admission import AdmissionError
+
+    pcs = aggregated_pcs("agg")
+    pcs.metadata.annotations["grove.io/auto-slice"] = "maybe"
+    with pytest.raises(AdmissionError, match="auto-slice"):
+        _chain(auto_slice_enabled=True).admit_podcliqueset(pcs)
+
+
+def test_admission_rejects_enabled_when_feature_off():
+    """Asking for slice injection with the feature globally off would
+    silently never inject — the webhook analog rejects it up front
+    (validateMNNVLFeatureEnabled)."""
+    import pytest
+
+    from grove_tpu.api.admission import AdmissionError
+
+    pcs = aggregated_pcs("agg")
+    pcs.metadata.annotations["grove.io/auto-slice"] = "enabled"
+    with pytest.raises(AdmissionError, match="autoSliceEnabled"):
+        _chain(auto_slice_enabled=False).admit_podcliqueset(pcs)
+
+    # Config-less dry run (auto_slice_enabled=None): value check only.
+    pcs2 = aggregated_pcs("agg")
+    pcs2.metadata.annotations["grove.io/auto-slice"] = "enabled"
+    _chain(auto_slice_enabled=None).admit_podcliqueset(pcs2)

@@ -1,0 +1,549 @@
+"""Inbound AdmissionReview v1 webhook surface (grove_tpu/api/webhook.py).
+
+Reference: the apiserver POSTs admission.k8s.io/v1 AdmissionReview to the
+defaulting webhook (webhook/admission/pcs/defaulting/handler.go) and the
+validating webhook (validation/handler.go), registered at
+internal/webhook/register.go:34-62. These tests pin:
+
+  - the defaulting JSON patch is correct (applying it yields a document the
+    typed defaulting pass has nothing left to do to) and targeted (no
+    whole-spec replace — unmodeled fields survive);
+  - the wire envelope (uid echo, base64 JSONPatch, allowed/denied status);
+  - the live manager serving both endpoints over HTTPS on the dedicated
+    webhook port, with the rest of the API absent from that port;
+  - deploy.py rendering the webhook Service + configurations with the
+    failure-mode guards (SAN must cover the Service DNS name).
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import json
+import ssl
+import urllib.request
+
+import pytest
+import yaml
+
+from grove_tpu.api.admission import AdmissionChain
+from grove_tpu.api.defaulting import default_podcliqueset
+from grove_tpu.api.types import PodCliqueSet
+from grove_tpu.api.webhook import default_patch_ops, handle_mutate, handle_validate
+
+
+def _load_doc(path="examples/simple1.yaml") -> dict:
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def _apply_patch(doc: dict, ops: list[dict]) -> dict:
+    """Minimal RFC-6902 add/replace applier (what the apiserver would do)."""
+    doc = copy.deepcopy(doc)
+    for op in ops:
+        assert op["op"] in ("add", "replace"), op
+        tokens = [
+            t.replace("~1", "/").replace("~0", "~")
+            for t in op["path"].lstrip("/").split("/")
+        ]
+        parent = doc
+        for t in tokens[:-1]:
+            parent = parent[int(t)] if isinstance(parent, list) else parent[t]
+        last = tokens[-1]
+        if isinstance(parent, list):
+            parent[int(last)] = op["value"]
+        else:
+            if op["op"] == "replace":
+                assert last in parent, f"replace on missing key {op['path']}"
+            parent[last] = op["value"]
+    return doc
+
+
+def test_default_patch_applies_to_fully_defaulted_doc():
+    doc = _load_doc()
+    chain = AdmissionChain()
+    ops = default_patch_ops(doc, chain)
+    assert ops, "simple1.yaml relies on defaulting; expected a patch"
+    patched = _apply_patch(doc, ops)
+    # Idempotence: the patched document needs no further defaulting.
+    assert default_patch_ops(patched, chain) == []
+    # And the typed view agrees with running defaulting directly.
+    typed = default_podcliqueset(PodCliqueSet.from_dict(copy.deepcopy(doc)))
+    via_patch = PodCliqueSet.from_dict(patched)
+    for got, want in zip(via_patch.spec.template.cliques, typed.spec.template.cliques):
+        assert got.spec.replicas == want.spec.replicas
+        assert got.spec.min_available == want.spec.min_available
+        assert got.spec.pod_spec.restart_policy == want.spec.pod_spec.restart_policy
+    assert (
+        via_patch.spec.template.termination_delay_seconds
+        == typed.spec.template.termination_delay_seconds
+    )
+
+
+def test_default_patch_preserves_unmodeled_fields():
+    """Targeted ops only: a field this build does not model must survive the
+    patch byte-for-byte (the reason we never replace whole subtrees)."""
+    doc = _load_doc()
+    doc["spec"]["template"]["cliques"][0]["spec"]["podSpec"]["schedulerName"] = "custom"
+    doc["spec"]["futureField"] = {"x": 1}
+    patched = _apply_patch(doc, default_patch_ops(doc, AdmissionChain()))
+    assert (
+        patched["spec"]["template"]["cliques"][0]["spec"]["podSpec"]["schedulerName"]
+        == "custom"
+    )
+    assert patched["spec"]["futureField"] == {"x": 1}
+
+
+def test_default_patch_stamps_auto_slice_annotation():
+    doc = _load_doc("examples/multi-node-aggregated.yaml")
+    chain = AdmissionChain(auto_slice_enabled=True)
+    patched = _apply_patch(doc, default_patch_ops(doc, chain))
+    assert patched["metadata"]["annotations"]["grove.io/auto-slice"] == "enabled"
+    # Feature off: no annotation op.
+    patched_off = _apply_patch(doc, default_patch_ops(doc, AdmissionChain()))
+    assert "grove.io/auto-slice" not in patched_off.get("metadata", {}).get(
+        "annotations", {}
+    )
+
+
+def _review(obj, operation="CREATE", old=None, uid="uid-1"):
+    req = {"uid": uid, "operation": operation, "object": obj}
+    if old is not None:
+        req["oldObject"] = old
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": req,
+    }
+
+
+def test_handle_mutate_wire_envelope():
+    out = handle_mutate(_review(_load_doc()), AdmissionChain())
+    assert out["apiVersion"] == "admission.k8s.io/v1"
+    resp = out["response"]
+    assert resp["uid"] == "uid-1" and resp["allowed"] is True
+    assert resp["patchType"] == "JSONPatch"
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    assert all(o["op"] in ("add", "replace") for o in ops)
+
+    # Fully defaulted object: no patch key at all.
+    patched = _apply_patch(_load_doc(), ops)
+    out2 = handle_mutate(_review(patched, uid="uid-2"), AdmissionChain())
+    assert out2["response"]["allowed"] is True
+    assert "patch" not in out2["response"]
+
+
+def test_handle_validate_allows_and_denies():
+    chain = AdmissionChain()
+    ok = handle_validate(_review(_load_doc()), chain)
+    assert ok["response"]["allowed"] is True
+
+    bad = _load_doc()
+    bad["spec"]["template"]["cliques"][0]["spec"]["startsAfter"] = ["frontend"]
+    out = handle_validate(_review(bad), chain)
+    assert out["response"]["allowed"] is False
+    assert out["response"]["status"]["message"]
+
+    # UPDATE immutability: oldObject drives the update-path checks.
+    old = _load_doc()
+    new = _load_doc()
+    new["spec"]["template"]["cliques"][0]["name"] = "renamed"
+    out = handle_validate(_review(new, operation="UPDATE", old=old), chain)
+    assert out["response"]["allowed"] is False
+
+    # DELETE reviews pass through.
+    out = handle_validate(_review(None, operation="DELETE"), chain)
+    assert out["response"]["allowed"] is True
+
+
+def test_handle_validate_malformed_object_denied():
+    out = handle_validate(_review({"spec": "not-a-map"}), AdmissionChain())
+    assert out["response"]["allowed"] is False
+    assert "malformed" in out["response"]["status"]["message"]
+
+
+# --- live manager webhook server --------------------------------------------
+
+
+@pytest.fixture
+def webhook_manager(tmp_path):
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {
+                "healthPort": 0,
+                "metricsPort": -1,
+                "webhookPort": 0,
+                "tlsCertDir": str(tmp_path / "certs"),
+            },
+            "backend": {"enabled": False},
+            "leaderElection": {"enabled": False},
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    yield m
+    m.stop()
+
+
+def _post_review(manager, path, review):
+    from grove_tpu.runtime.certs import pinned_client_context
+
+    ctx = pinned_client_context(manager._webhook_tls_paths[0])
+    req = urllib.request.Request(
+        f"https://127.0.0.1:{manager.webhook_port}{path}",
+        data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, context=ctx) as r:
+        return json.loads(r.read())
+
+
+def test_manager_serves_webhook_over_https(webhook_manager):
+    m = webhook_manager
+    assert m.webhook_port and m.webhook_port != m.health_port
+
+    out = _post_review(m, "/webhook/v1/default", _review(_load_doc()))
+    assert out["response"]["allowed"] is True and out["response"]["patch"]
+
+    bad = _load_doc()
+    bad["spec"]["template"]["cliques"][0]["spec"]["startsAfter"] = ["frontend"]
+    out = _post_review(m, "/webhook/v1/validate", _review(bad))
+    assert out["response"]["allowed"] is False
+
+
+def test_webhook_port_does_not_expose_api(webhook_manager):
+    """The apiserver-facing port must not carry the bearer-token API."""
+    from grove_tpu.runtime.certs import pinned_client_context
+
+    m = webhook_manager
+    ctx = pinned_client_context(m._webhook_tls_paths[0])
+    for path in ("/api/v1/podcliquesets", "/statusz", "/metrics"):
+        req = urllib.request.Request(
+            f"https://127.0.0.1:{m.webhook_port}{path}",
+            data=b"{}" if path.startswith("/api") else None,
+            method="POST" if path.startswith("/api") else "GET",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, context=ctx)
+        assert exc.value.code == 404
+
+    # Plain HTTP on the webhook port must fail (TLS only).
+    with pytest.raises(Exception):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{m.webhook_port}/healthz", timeout=3
+        )
+
+
+def test_webhook_cert_san_rotation(tmp_path):
+    """Changing the SAN set must regenerate the cached cert (a webhook moved
+    to a new Service DNS name would otherwise serve a stale cert until
+    expiry)."""
+    from grove_tpu.runtime.certs import ensure_serving_certs
+
+    d = str(tmp_path / "c")
+    cert1, _ = ensure_serving_certs("auto", d, san_dns=("a.ns.svc",))
+    with open(cert1, "rb") as f:
+        pem1 = f.read()
+    cert2, _ = ensure_serving_certs("auto", d, san_dns=("a.ns.svc",))
+    with open(cert2, "rb") as f:
+        assert f.read() == pem1  # unchanged set: cached
+    cert3, _ = ensure_serving_certs("auto", d, san_dns=("b.ns.svc",))
+    with open(cert3, "rb") as f:
+        assert f.read() != pem1  # changed set: regenerated
+
+
+# --- deploy rendering --------------------------------------------------------
+
+
+def _kube_cfg(extra_servers=None):
+    from grove_tpu.runtime.config import parse_operator_config
+
+    servers = {
+        "bindAddress": "0.0.0.0",
+        "healthPort": 2751,
+        "metricsPort": 2752,
+        "webhookPort": 9443,
+        "advertiseUrl": "http://grove-tpu-operator.grove-system.svc:2751",
+        "webhookSans": ["grove-tpu-operator-webhook.grove-system.svc"],
+    }
+    servers.update(extra_servers or {})
+    cfg, errors = parse_operator_config(
+        {
+            "servers": servers,
+            "cluster": {"source": "kubernetes"},
+            "backend": {"enabled": False},
+        }
+    )
+    assert not errors, errors
+    return cfg
+
+
+def test_deploy_renders_webhook_objects():
+    from grove_tpu.deploy import render_manifests
+
+    docs = render_manifests(_kube_cfg(), "x: y")
+    kinds = {}
+    for d in docs:
+        kinds.setdefault(d["kind"], []).append(d)
+    assert len(kinds["MutatingWebhookConfiguration"]) == 1
+    assert len(kinds["ValidatingWebhookConfiguration"]) == 1
+    mwc = kinds["MutatingWebhookConfiguration"][0]["webhooks"][0]
+    assert mwc["clientConfig"]["service"]["path"] == "/webhook/v1/default"
+    assert mwc["failurePolicy"] == "Fail"
+    assert mwc["admissionReviewVersions"] == ["v1"]
+    assert "caBundle" not in mwc["clientConfig"]  # completed at boot
+    svc_names = [
+        d["metadata"]["name"] for d in kinds["Service"]
+    ]
+    assert "grove-tpu-operator-webhook" in svc_names
+    # RBAC for the boot-time caBundle patch.
+    cr = [d for d in kinds["ClusterRole"]][0]
+    groups = [r for rule in cr["rules"] for r in rule["apiGroups"]]
+    assert "admissionregistration.k8s.io" in groups
+    # Container exposes the webhook port.
+    dep = kinds["Deployment"][0]
+    ports = dep["spec"]["template"]["spec"]["containers"][0]["ports"]
+    assert {"name": "webhook", "containerPort": 9443} in ports
+
+
+def test_deploy_rejects_webhook_without_service_san():
+    from grove_tpu.deploy import render_manifests
+
+    with pytest.raises(ValueError, match="webhookSans"):
+        render_manifests(_kube_cfg({"webhookSans": []}), "x: y")
+
+
+def test_deploy_rejects_webhook_without_kubernetes_source():
+    from grove_tpu.deploy import render_manifests
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"bindAddress": "0.0.0.0", "webhookPort": 9443},
+            "backend": {"enabled": False},
+        }
+    )
+    assert not errors, errors
+    with pytest.raises(ValueError, match="cluster.source"):
+        render_manifests(cfg, "x: y")
+
+
+def test_config_rejects_webhook_sans_string():
+    """A bare YAML string would iterate char-by-char through validation and
+    turn deploy's membership check into a substring match — per-character
+    DNS SANs in the cert, cluster-wide TLS failure. Must be a load error."""
+    from grove_tpu.runtime.config import parse_operator_config
+
+    _, errors = parse_operator_config(
+        {"servers": {"webhookSans": "a.ns.svc"}}
+    )
+    assert any("webhookSans" in e and "list" in e for e in errors)
+
+
+def test_webhook_cert_missing_marker_keeps_legacy_cert(tmp_path):
+    """Pre-marker deployments: a still-valid cert with the default SAN set
+    and no san.txt must be reused (pinned clients would otherwise break on
+    upgrade), and the marker stamped for next time."""
+    import pathlib
+
+    from grove_tpu.runtime.certs import ensure_serving_certs
+
+    d = str(tmp_path / "c")
+    cert1, _ = ensure_serving_certs("auto", d)
+    pathlib.Path(d, "san.txt").unlink()  # simulate a pre-marker cert dir
+    with open(cert1, "rb") as f:
+        pem1 = f.read()
+    cert2, _ = ensure_serving_certs("auto", d)
+    with open(cert2, "rb") as f:
+        assert f.read() == pem1  # reused, not churned
+    assert pathlib.Path(d, "san.txt").is_file()  # marker backfilled
+
+
+def test_ca_bundle_patch_retries_until_success(webhook_manager):
+    """failurePolicy Fail means an unpatched config is a cluster-wide PCS
+    write outage: a failed boot-time sync must keep retrying from the
+    reconcile loop until the apiserver takes the PUT."""
+
+    class FlakySource:
+        def __init__(self):
+            self.calls = 0
+
+        def sync_webhook_ca(self, ca):
+            self.calls += 1
+            return self.calls >= 3  # fail twice, then land
+
+    m = webhook_manager
+    src = FlakySource()
+    m._kube_source = src
+    m._webhook_ca_pending = True
+    try:
+        m.reconcile_once(now=1.0)
+        assert m._webhook_ca_pending and src.calls == 1
+        m.reconcile_once(now=2.0)
+        m.reconcile_once(now=3.0)
+        assert not m._webhook_ca_pending and src.calls == 3
+        m.reconcile_once(now=4.0)
+        assert src.calls == 3  # landed: no more writes
+    finally:
+        m._kube_source = None
+
+
+def test_auto_slice_annotation_immutable_on_update():
+    """ValidateMetadataOnUpdate parity (mnnvl/webhook.go:120-169): the
+    stamped annotation cannot be changed or added post-create; an absent
+    annotation on a whole-object re-apply is carried forward (the
+    merge-patch accommodation), and flipping the feature off must NOT brick
+    updates to workloads stamped while it was on."""
+    from grove_tpu.api.admission import AdmissionError
+    from grove_tpu.sim.workloads import aggregated_pcs
+
+    chain_on = AdmissionChain(auto_slice_enabled=True)
+    old = chain_on.admit_podcliqueset(aggregated_pcs("agg"))
+    assert old.metadata.annotations["grove.io/auto-slice"] == "enabled"
+
+    # Feature later disabled: replica-bump update still admits; the stamped
+    # annotation is carried forward from the live object.
+    chain_off = AdmissionChain(auto_slice_enabled=False)
+    new = aggregated_pcs("agg")
+    new.spec.replicas = 3
+    out = chain_off.admit_podcliqueset(new, old=old)
+    assert out.metadata.annotations["grove.io/auto-slice"] == "enabled"
+
+    # Explicit value change: immutable.
+    flipped = aggregated_pcs("agg")
+    flipped.metadata.annotations["grove.io/auto-slice"] = "disabled"
+    with pytest.raises(AdmissionError, match="immutable"):
+        chain_on.admit_podcliqueset(flipped, old=old)
+
+    # Adding it after creation: forbidden.
+    never = AdmissionChain().admit_podcliqueset(aggregated_pcs("agg2"))
+    added = aggregated_pcs("agg2")
+    added.metadata.annotations["grove.io/auto-slice"] = "disabled"
+    with pytest.raises(AdmissionError, match="added after creation"):
+        AdmissionChain(auto_slice_enabled=True).admit_podcliqueset(added, old=never)
+
+
+def test_mutate_webhook_stamps_only_on_create():
+    doc = _load_doc("examples/multi-node-aggregated.yaml")
+    chain = AdmissionChain(auto_slice_enabled=True)
+    out = handle_mutate(_review(doc, operation="UPDATE", old=doc), chain)
+    patch = out["response"].get("patch")
+    if patch:
+        ops = json.loads(base64.b64decode(patch))
+        assert not any("auto-slice" in o["path"] for o in ops)
+
+
+def test_deploy_rejects_webhook_with_multiple_replicas():
+    from grove_tpu.deploy import render_manifests
+
+    cfg = _kube_cfg()
+    cfg.leader_election.enabled = True
+    with pytest.raises(ValueError, match="webhookPort with replicas"):
+        render_manifests(cfg, "x: y", replicas=2)
+    # Default replicas with webhook on: 1, even when HA-capable.
+    docs = render_manifests(cfg, "x: y")
+    dep = [d for d in docs if d["kind"] == "Deployment"][0]
+    assert dep["spec"]["replicas"] == 1
+
+
+def test_mutate_webhook_carries_forward_annotation_on_update():
+    """A whole-object PUT that omits the immutable annotation must get it
+    re-stamped BY THE MUTATING webhook (the validating webhook cannot
+    persist anything): an explicit "disabled" opt-out must survive replaces
+    or injection would silently switch on."""
+    old = _load_doc("examples/multi-node-aggregated.yaml")
+    old.setdefault("metadata", {}).setdefault("annotations", {})[
+        "grove.io/auto-slice"
+    ] = "disabled"
+    new = _load_doc("examples/multi-node-aggregated.yaml")  # annotation omitted
+    chain = AdmissionChain(auto_slice_enabled=True)
+    out = handle_mutate(_review(new, operation="UPDATE", old=old), chain)
+    ops = json.loads(base64.b64decode(out["response"]["patch"]))
+    patched = _apply_patch(new, ops)
+    assert patched["metadata"]["annotations"]["grove.io/auto-slice"] == "disabled"
+
+
+def test_deploy_rejects_webhook_port_zero():
+    from grove_tpu.deploy import render_manifests
+
+    with pytest.raises(ValueError, match="port is 0"):
+        render_manifests(_kube_cfg({"webhookPort": 0}), "x: y")
+
+
+def test_config_validates_tls_ca_file():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    _, errors = parse_operator_config(
+        {"servers": {"tlsCaFile": "/no/such/ca.pem"}}
+    )
+    assert any("tlsCaFile" in e and "manual" in e for e in errors)
+    _, errors = parse_operator_config(
+        {
+            "servers": {
+                "tlsMode": "manual",
+                "tlsCertFile": "/x/c.pem",
+                "tlsKeyFile": "/x/k.pem",
+                "tlsCaFile": "/no/such/ca.pem",
+            }
+        }
+    )
+    assert any("tlsCaFile" in e and "does not exist" in e for e in errors)
+
+
+def test_ca_bundle_unreadable_returns_none(webhook_manager):
+    """A bad tlsCaFile path must degrade to pending-retry, not an uncaught
+    OSError that kills the run loop."""
+    m = webhook_manager
+    m.config.servers.tls_mode = "manual"
+    m.config.servers.tls_ca_file = "/no/such/ca.pem"
+    try:
+        assert m.webhook_ca_bundle() is None
+    finally:
+        m.config.servers.tls_mode = "disabled"
+        m.config.servers.tls_ca_file = ""
+
+
+def test_manual_webhook_cert_must_be_self_signed_or_have_ca(tmp_path):
+    """A CA-issued manual cert without tlsCaFile would be patched into
+    caBundle as an unverifiable trust root — boot must fail instead."""
+    import subprocess
+
+    from grove_tpu.runtime.certs import CertError
+    from grove_tpu.runtime.manager import _require_self_signed
+
+    d = tmp_path
+    # Self-signed: passes.
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(d / "ss.key"), "-out", str(d / "ss.crt"),
+         "-days", "2", "-subj", "/CN=ss"],
+        check=True, capture_output=True,
+    )
+    _require_self_signed(str(d / "ss.crt"))
+
+    # CA-issued leaf: fails.
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(d / "ca.key"), "-out", str(d / "ca.crt"),
+         "-days", "2", "-subj", "/CN=test-ca"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(d / "leaf.key"), "-out", str(d / "leaf.csr"),
+         "-subj", "/CN=leaf"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["openssl", "x509", "-req", "-in", str(d / "leaf.csr"),
+         "-CA", str(d / "ca.crt"), "-CAkey", str(d / "ca.key"),
+         "-CAcreateserial", "-out", str(d / "leaf.crt"), "-days", "2"],
+        check=True, capture_output=True,
+    )
+    with pytest.raises(CertError, match="tlsCaFile"):
+        _require_self_signed(str(d / "leaf.crt"))
