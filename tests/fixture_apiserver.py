@@ -86,6 +86,10 @@ class FixtureApiServer:
             "mutatingwebhookconfigurations": {},
             "validatingwebhookconfigurations": {},
         }
+        # Admission-phase routing: webhook Service name -> reachable https
+        # URL (no cluster DNS in the fixture). Empty = admission phase off.
+        self.webhook_service_urls: dict[str, str] = {}
+        self.admission_denials: list[str] = []  # messages of rejected writes
 
         fixture = self
 
@@ -503,9 +507,106 @@ class FixtureApiServer:
 
     # ---- PodCliqueSet CRs (test-facing: the kubectl-apply analog) ------------------
 
+    def _apply_json_patch(self, doc: dict, ops: list[dict]) -> dict:
+        """RFC-6902 add/replace applier (what a real apiserver runs on the
+        mutating webhook's patch)."""
+        doc = json.loads(json.dumps(doc))
+        for op in ops:
+            tokens = [
+                t.replace("~1", "/").replace("~0", "~")
+                for t in op["path"].lstrip("/").split("/")
+            ]
+            parent = doc
+            for t in tokens[:-1]:
+                parent = parent[int(t)] if isinstance(parent, list) else parent[t]
+            last = tokens[-1]
+            if isinstance(parent, list):
+                parent[int(last)] = op["value"]
+            else:
+                parent[last] = op["value"]
+        return doc
+
+    def _call_webhook(self, cfg_obj: dict, review: dict):
+        """POST the AdmissionReview to the config's clientConfig, resolving
+        the Service via webhook_service_urls and verifying TLS against the
+        config's OWN caBundle — exactly what a real apiserver does, so an
+        unpatched/stale bundle fails here the way it would in production.
+        Returns the response dict, or raises on transport failure."""
+        import base64 as _b64
+        import ssl as _ssl
+        import urllib.request as _rq
+
+        wh = cfg_obj["webhooks"][0]
+        cc = wh["clientConfig"]
+        svc = cc["service"]
+        base = self.webhook_service_urls[svc["name"]]
+        bundle = cc.get("caBundle")
+        if not bundle:
+            raise ConnectionError("caBundle empty (boot patch never landed)")
+        ctx = _ssl.create_default_context(cadata=_b64.b64decode(bundle).decode())
+        ctx.check_hostname = False  # no cluster DNS in the fixture
+        req = _rq.Request(
+            base + svc["path"],
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with _rq.urlopen(req, context=ctx, timeout=wh.get("timeoutSeconds", 10)) as r:
+            return json.loads(r.read())
+
+    def _admit_pcs(self, doc: dict, operation: str, old: dict | None):
+        """The apiserver admission phase: mutating webhook (patch applied),
+        then validating. Only runs when webhook configs are registered AND
+        the test mapped their Services to URLs (webhook_service_urls).
+        failurePolicy Fail: an unreachable webhook rejects the write.
+        Returns (doc, None) on admit, (None, message) on deny."""
+        import base64 as _b64
+
+        if not self.webhook_service_urls:
+            return doc, None
+        review_req = {
+            "uid": f"fixture-{self._rv}",
+            "operation": operation,
+            "object": doc,
+        }
+        if old is not None:
+            review_req["oldObject"] = old
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": review_req,
+        }
+        for plural in ("mutatingwebhookconfigurations", "validatingwebhookconfigurations"):
+            for cfg_obj in list(self.webhookconfigs[plural].values()):
+                try:
+                    out = self._call_webhook(cfg_obj, review)
+                except Exception as e:  # noqa: BLE001 — failurePolicy Fail
+                    if cfg_obj["webhooks"][0].get("failurePolicy") == "Ignore":
+                        continue
+                    return None, f"webhook call failed (failurePolicy Fail): {e}"
+                resp = out.get("response", {})
+                if not resp.get("allowed"):
+                    return None, resp.get("status", {}).get("message", "denied")
+                patch = resp.get("patch")
+                if patch and plural == "mutatingwebhookconfigurations":
+                    ops = json.loads(_b64.b64decode(patch))
+                    doc = self._apply_json_patch(doc, ops)
+                    review["request"]["object"] = doc
+        return doc, None
+
     def apply_pcs(self, doc: dict):
-        """kubectl apply: create or replace the CR, preserving status."""
+        """kubectl apply: create or replace the CR, preserving status. When
+        webhook configs are registered and routable (webhook_service_urls),
+        the write runs the apiserver admission phase first; denials are
+        recorded in `admission_denials` and the CR is not persisted."""
         name = doc["metadata"]["name"]
+        with self._lock:
+            existing = self.podcliquesets.get(name)
+        operation = "UPDATE" if existing is not None else "CREATE"
+        doc, denial = self._admit_pcs(doc, operation, existing)
+        if denial is not None:
+            self.admission_denials.append(denial)
+            return
         with self._lock:
             existing = self.podcliquesets.get(name)
             if existing is not None:

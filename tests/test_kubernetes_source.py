@@ -1356,3 +1356,95 @@ def test_sync_webhook_ca_patches_rendered_configs(api):
     api.webhookconfigs["mutatingwebhookconfigurations"].clear()
     api.webhookconfigs["validatingwebhookconfigurations"].clear()
     assert src.sync_webhook_ca(ca) is False
+
+
+def test_apiserver_webhook_admission_loop(api, tmp_path):
+    """The FULL inbound-webhook loop over the wire, apiserver's view:
+    deploy renders webhook configs (empty caBundle) -> operator boots, its
+    sync_webhook_ca patch completes them -> a kubectl apply at the
+    apiserver calls the MUTATING webhook (TLS verified against that very
+    caBundle), applies the returned defaulting patch, then the VALIDATING
+    webhook -> the stored CR is the defaulted object and flows through the
+    watch into the store; an invalid CR is denied AT WRITE TIME and never
+    persisted (the reference's admission path, SURVEY §3.2)."""
+    import yaml as _yaml
+
+    from grove_tpu.deploy import _render_webhook_objects
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    # deploy's rendered configs, seeded like kubectl apply of the manifests.
+    for doc in _render_webhook_objects("grove-system"):
+        plural = doc["kind"].lower() + "s"
+        if plural in api.webhookconfigs:
+            api.webhookconfigs[plural][doc["metadata"]["name"]] = doc
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {
+                "healthPort": -1,
+                "metricsPort": -1,
+                "webhookPort": 0,
+                "tlsCertDir": str(tmp_path / "certs"),
+            },
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        # Boot patch completed the rendered configs with the serving cert.
+        for plural in api.webhookconfigs:
+            for obj in api.webhookconfigs[plural].values():
+                assert obj["webhooks"][0]["clientConfig"]["caBundle"]
+        # Route the webhook Service to the live server; admission is now on.
+        api.webhook_service_urls["grove-tpu-operator-webhook"] = (
+            f"https://127.0.0.1:{m.webhook_port}"
+        )
+
+        with open("examples/simple1.yaml") as f:
+            doc = _yaml.safe_load(f)
+        # The first clique relies on defaulting (no explicit minAvailable).
+        assert "minAvailable" not in doc["spec"]["template"]["cliques"][0]["spec"]
+        api.apply_pcs(doc)
+        assert not api.admission_denials, api.admission_denials
+        stored = api.podcliquesets["simple1"]
+        # The apiserver persisted the MUTATED object: defaults present.
+        assert stored["spec"]["template"]["cliques"][0]["spec"]["minAvailable"] is not None
+        assert stored["spec"]["template"]["terminationDelay"] == "4h"
+
+        # The defaulted CR flows through the watch into the store.
+        deadline = time.monotonic() + 20.0
+        t = 0.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if "simple1" in m.cluster.podcliquesets:
+                break
+            time.sleep(0.05)
+        assert "simple1" in m.cluster.podcliquesets
+
+        # Invalid CR: denied at write time, never stored, never watched.
+        bad = _yaml.safe_load(open("examples/simple1.yaml"))
+        bad["metadata"]["name"] = "bad1"
+        bad["spec"]["template"]["cliques"][0]["spec"]["startsAfter"] = ["frontend"]
+        api.apply_pcs(bad)
+        assert api.admission_denials and "startsAfter" in api.admission_denials[0]
+        assert "bad1" not in api.podcliquesets
+
+        # failurePolicy Fail: with the webhook dead, writes are rejected.
+        api.webhook_service_urls["grove-tpu-operator-webhook"] = (
+            "https://127.0.0.1:1"  # nothing listens
+        )
+        doc2 = _yaml.safe_load(open("examples/simple1.yaml"))
+        doc2["metadata"]["name"] = "unreachable1"
+        api.apply_pcs(doc2)
+        assert "unreachable1" not in api.podcliquesets
+        assert any("failurePolicy Fail" in d for d in api.admission_denials)
+    finally:
+        m.stop()
