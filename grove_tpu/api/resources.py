@@ -71,8 +71,8 @@ class Role:
     pcs_name: str = ""
     rules: list[dict[str, Any]] = field(
         default_factory=lambda: [
-            {"resources": ["podcliques"], "verbs": ["get", "list"]},
-            {"resources": ["pods"], "verbs": ["get", "list"]},
+            {"apiGroup": "grove.io", "resources": ["podcliques"], "verbs": ["get", "list"]},
+            {"apiGroup": "", "resources": ["pods"], "verbs": ["get", "list"]},
         ]
     )
 

@@ -310,6 +310,7 @@ class KubernetesWatchSource:
         request_timeout_s: float = 10.0,
         watch_read_timeout_s: float = 30.0,
         watch_workloads: bool = True,
+        initc_kube_tokens: bool = False,
     ):
         if pod_label_selector is None:
             pod_label_selector = DEFAULT_POD_LABEL_SELECTOR
@@ -348,6 +349,15 @@ class KubernetesWatchSource:
         self._synced_children: dict[str, dict] = {}
         # SA-token Secrets mirrored (pods mount them): name -> manifest.
         self._synced_secrets: dict[str, dict] = {}
+        # cluster.initcMode kubernetes: token Secrets become REAL
+        # service-account-token Secrets (the control plane mints the token)
+        # and the per-PCS SA/Role/RoleBinding are mirrored too.
+        self.initc_kube_tokens = initc_kube_tokens
+        self._synced_rbac: dict[str, dict[str, dict]] = {
+            "serviceaccounts": {},
+            "roles": {},
+            "rolebindings": {},
+        }
         # Collections whose cluster-side members have been LISTed into the
         # cache (crash-orphan GC; _sync_collection).
         self._seeded_bases: set[str] = set()
@@ -456,21 +466,128 @@ class KubernetesWatchSource:
         path = f"/api/v1/namespaces/{ns}/secrets"
         desired = {}
         for sec in secrets:
-            desired[sec.name] = {
-                "apiVersion": "v1",
-                "kind": "Secret",
-                "metadata": {
-                    "name": sec.name,
-                    "namespace": self.ctx.namespace,
-                    "labels": {
-                        api_constants.LABEL_MANAGED_BY: api_constants.LABEL_MANAGED_BY_VALUE,
-                        api_constants.LABEL_PART_OF: getattr(sec, "pcs_name", ""),
-                    },
+            meta = {
+                "name": sec.name,
+                "namespace": self.ctx.namespace,
+                "labels": {
+                    api_constants.LABEL_MANAGED_BY: api_constants.LABEL_MANAGED_BY_VALUE,
+                    api_constants.LABEL_PART_OF: getattr(sec, "pcs_name", ""),
                 },
-                "type": "Opaque",
-                "stringData": {"token": sec.token},
             }
-        return self._sync_collection(path, desired, self._synced_secrets)
+            if self.initc_kube_tokens:
+                # initcMode kubernetes: the mounted token must be one the
+                # APISERVER honors — a legacy service-account-token Secret,
+                # whose `token` key the k8s control plane populates for the
+                # bound SA (the reference's satokensecret component does
+                # exactly this, components/satokensecret/).
+                meta["annotations"] = {
+                    "kubernetes.io/service-account.name": getattr(
+                        sec, "service_account_name", ""
+                    )
+                }
+                desired[sec.name] = {
+                    "apiVersion": "v1",
+                    "kind": "Secret",
+                    "metadata": meta,
+                    "type": "kubernetes.io/service-account-token",
+                }
+            else:
+                desired[sec.name] = {
+                    "apiVersion": "v1",
+                    "kind": "Secret",
+                    "metadata": meta,
+                    "type": "Opaque",
+                    "stringData": {"token": sec.token},
+                }
+        return self._sync_collection(
+            path, desired, self._synced_secrets, recreate_on_invalid=True
+        )
+
+    def sync_rbac(self, service_accounts: list, roles: list, bindings: list) -> bool:
+        """Mirror the per-PCS ServiceAccount/Role/RoleBinding so the
+        service-account-token Secret resolves to a credential the apiserver
+        accepts for listing gang pods (initcMode kubernetes; the reference's
+        serviceaccount/role/rolebinding components). No-op unless
+        initc_kube_tokens — operator-mode tokens never reach the apiserver."""
+        if not self.initc_kube_tokens:
+            return True
+        ns_raw = self.ctx.namespace
+        ns = urllib.parse.quote(ns_raw)
+
+        def _meta(obj) -> dict:
+            return {
+                "name": obj.name,
+                "namespace": ns_raw,
+                "labels": {
+                    api_constants.LABEL_MANAGED_BY: api_constants.LABEL_MANAGED_BY_VALUE,
+                    api_constants.LABEL_PART_OF: getattr(obj, "pcs_name", ""),
+                },
+            }
+
+        ok = self._sync_collection(
+            f"/api/v1/namespaces/{ns}/serviceaccounts",
+            {
+                sa.name: {
+                    "apiVersion": "v1",
+                    "kind": "ServiceAccount",
+                    "metadata": _meta(sa),
+                }
+                for sa in service_accounts
+            },
+            self._synced_rbac["serviceaccounts"],
+        )
+        rbac_base = f"/apis/rbac.authorization.k8s.io/v1/namespaces/{ns}"
+
+        def _k8s_rules(role) -> list:
+            # Store-level rules carry their apiGroup explicitly
+            # (api/resources.Role) — no name-based guessing here.
+            return [
+                {
+                    "apiGroups": [rule.get("apiGroup", "")],
+                    "resources": list(rule.get("resources", [])),
+                    "verbs": sorted(set(rule.get("verbs", [])) | {"watch"}),
+                }
+                for rule in role.rules
+            ]
+
+        ok = self._sync_collection(
+            f"{rbac_base}/roles",
+            {
+                role.name: {
+                    "apiVersion": "rbac.authorization.k8s.io/v1",
+                    "kind": "Role",
+                    "metadata": _meta(role),
+                    "rules": _k8s_rules(role),
+                }
+                for role in roles
+            },
+            self._synced_rbac["roles"],
+        ) and ok
+        ok = self._sync_collection(
+            f"{rbac_base}/rolebindings",
+            {
+                rb.name: {
+                    "apiVersion": "rbac.authorization.k8s.io/v1",
+                    "kind": "RoleBinding",
+                    "metadata": _meta(rb),
+                    "roleRef": {
+                        "apiGroup": "rbac.authorization.k8s.io",
+                        "kind": "Role",
+                        "name": rb.role_name,
+                    },
+                    "subjects": [
+                        {
+                            "kind": "ServiceAccount",
+                            "name": rb.service_account_name,
+                            "namespace": ns_raw,
+                        }
+                    ],
+                }
+                for rb in bindings
+            },
+            self._synced_rbac["rolebindings"],
+        ) and ok
+        return ok
 
     # ---- managed-object sync plumbing ----------------------------------------------
 
@@ -507,11 +624,17 @@ class KubernetesWatchSource:
     def _upsert_object(
         self, base: str, name: str, manifest: dict, known: bool,
         status_subresource: bool = False,
+        recreate_on_invalid: bool = False,
     ) -> bool:
         """Create-or-update with real apiserver semantics: updates are
         GET-then-PUT (resourceVersion threaded through), and when the CRD
         declares a status subresource the .status field — which the main
-        PUT/POST STRIPS — is written with a second PUT to /status."""
+        PUT/POST STRIPS — is written with a second PUT to /status.
+
+        `recreate_on_invalid`: a 422 on the update PUT means an immutable
+        field changed (e.g. a Secret's `type` when cluster.initcMode flips)
+        — delete + re-create instead of wedging on the same rejected PUT
+        forever."""
 
         def _put_main() -> None:
             try:
@@ -528,7 +651,13 @@ class KubernetesWatchSource:
             rv = (cur.get("metadata", {}) or {}).get("resourceVersion")
             if rv:
                 body["metadata"] = {**manifest["metadata"], "resourceVersion": rv}
-            self._request("PUT", f"{base}/{name}", body)
+            try:
+                self._request("PUT", f"{base}/{name}", body)
+            except KubeApiError as e:
+                if not (recreate_on_invalid and e.status == 422):
+                    raise
+                self._request("DELETE", f"{base}/{name}")
+                self._request("POST", base, manifest)
 
         try:
             if known:
@@ -552,6 +681,7 @@ class KubernetesWatchSource:
     def _sync_collection(
         self, base: str, desired: dict, cache: dict,
         status_subresource: bool = False,
+        recreate_on_invalid: bool = False,
     ) -> bool:
         """Reconcile one managed collection: seed once, upsert changed,
         delete stale. `cache` maps name -> last-pushed manifest (or the
@@ -582,7 +712,8 @@ class KubernetesWatchSource:
                 continue
             known = name in cache
             if self._upsert_object(
-                base, name, manifest, known, status_subresource
+                base, name, manifest, known, status_subresource,
+                recreate_on_invalid,
             ):
                 cache[name] = manifest
             else:

@@ -194,6 +194,15 @@ class WatchDriver:
             # Managed headless Services mirror to the real cluster (pod DNS
             # needs them); the source change-detects, so this is cheap.
             sync_services(list(self.cluster.services.values()))
+        sync_rbac = getattr(self.source, "sync_rbac", None)
+        if sync_rbac is not None:
+            # SA/Role/RoleBinding BEFORE the token Secret that binds to the
+            # SA (initcMode kubernetes; no-op in operator mode).
+            sync_rbac(
+                list(self.cluster.service_accounts.values()),
+                list(self.cluster.roles.values()),
+                list(self.cluster.role_bindings.values()),
+            )
         sync_secrets = getattr(self.source, "sync_secrets", None)
         if sync_secrets is not None:
             # SA-token Secrets BEFORE pods need their mounts.

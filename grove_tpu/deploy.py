@@ -290,14 +290,16 @@ def render_manifests(
     config_hash = hashlib.sha256(config_yaml.encode()).hexdigest()[:8]
     configmap_name = f"{APP}-config-{config_hash}"
 
-    if cfg.cluster.source == "kubernetes":
+    if cfg.cluster.source == "kubernetes" and cfg.cluster.initc_mode == "operator":
         # Remote pods run the injected initc against --server: the URL must
         # exist (else pods poll localhost in their own netns), the serving
         # port must actually be enabled, and the scheme must be one the
         # agent can speak (no CA distribution to workload pods yet, so the
         # advertised surface must be plaintext; terminate TLS in front if
         # needed). Each failure here would otherwise be silent gang pods
-        # gating until init timeout.
+        # gating until init timeout. initcMode kubernetes escapes ALL of
+        # this: the agent talks to the apiserver with the mirrored SA token
+        # and the operator URL never enters the pod.
         if cfg.servers.health_port < 0:
             raise ValueError(
                 "servers.healthPort must be enabled for cluster.source: "
@@ -414,6 +416,29 @@ def render_manifests(
                     "resources": ["events"],
                     "verbs": ["create"],
                 },
+            ]
+            + (
+                [
+                    {
+                        # initcMode kubernetes: the operator mirrors per-PCS
+                        # SA/Role/RoleBinding so the service-account-token
+                        # Secret resolves to a real apiserver credential
+                        # (sync_rbac). Escalation-safe: everything granted
+                        # is a subset of the operator's own permissions.
+                        "apiGroups": [""],
+                        "resources": ["serviceaccounts"],
+                        "verbs": ["get", "list", "create", "update", "delete"],
+                    },
+                    {
+                        "apiGroups": ["rbac.authorization.k8s.io"],
+                        "resources": ["roles", "rolebindings"],
+                        "verbs": ["get", "list", "create", "update", "delete"],
+                    },
+                ]
+                if cfg.cluster.initc_mode == "kubernetes"
+                else []
+            )
+            + [
                 {
                     "apiGroups": ["grove.io"],
                     # The CR watch + status write-back (status subresource);

@@ -35,6 +35,20 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--cafile", default="", help="CA bundle pinning an https manager's cert"
     )
+    parser.add_argument(
+        "--kube",
+        action="store_true",
+        help="gate on the kube-apiserver directly (the reference agent's "
+        "path, wait.go:111-164) instead of the operator HTTP API; --server "
+        "defaults to the in-cluster apiserver, --cafile to the mounted "
+        "cluster CA",
+    )
+    parser.add_argument(
+        "--namespace",
+        default="",
+        help="pod namespace for --kube (default: the in-cluster namespace "
+        "file, else 'default')",
+    )
     args = parser.parse_args(argv)
     token = args.token
     if args.token_file:
@@ -54,13 +68,49 @@ def main(argv: list[str] | None = None) -> int:
     if not reqs:
         return 0
 
+    if args.kube:
+        import os
+
+        from grove_tpu.initc.agent import (
+            IN_CLUSTER_SA_DIR,
+            in_cluster_server,
+            kube_fetch,
+        )
+
+        # --server set explicitly wins (tests point it at a fixture);
+        # otherwise the standard in-cluster env names the apiserver.
+        server = args.server if args.server != parser.get_default("server") else None
+        server = server or in_cluster_server()
+        if server is None:
+            print(
+                "grove-initc: --kube but no --server and no in-cluster env "
+                "(KUBERNETES_SERVICE_HOST)",
+                file=sys.stderr,
+            )
+            return 2
+        namespace = args.namespace
+        if not namespace:
+            try:
+                with open(f"{IN_CLUSTER_SA_DIR}/namespace") as f:
+                    namespace = f.read().strip()
+            except OSError:
+                namespace = "default"
+        cafile = args.cafile or None
+        if cafile is None and os.path.isfile(f"{IN_CLUSTER_SA_DIR}/ca.crt"):
+            cafile = f"{IN_CLUSTER_SA_DIR}/ca.crt"
+        fetch = kube_fetch(server, namespace, token=token or None, cafile=cafile)
+    else:
+        fetch = http_fetch(
+            args.server, token=token or None, cafile=args.cafile or None
+        )
+
     def log_poll(n: int) -> None:
         if n == 1 or n % 30 == 0:
             print(f"grove-initc: waiting on {len(reqs)} parent clique(s)", flush=True)
 
     try:
         ok = wait_until_ready(
-            http_fetch(args.server, token=token or None, cafile=args.cafile or None),
+            fetch,
             reqs,
             timeout_s=args.timeout,
             poll_interval_s=args.poll_interval,

@@ -138,6 +138,95 @@ def http_fetch(
     return fetch
 
 
+def kube_fetch(
+    server: str,
+    namespace: str,
+    token: str | None = None,
+    cafile: str | None = None,
+    timeout_s: float = 5.0,
+    rbac_grace_s: float = 60.0,
+) -> FetchFn:
+    """Count Ready gang pods straight from the kube-apiserver — the
+    reference agent's own path (`initc/internal/wait.go:111-164` informer;
+    polled LIST here): pods selected by the `grove.io/podclique` label,
+    ready = condition Ready=True and not terminating. Unlike http_fetch this
+    needs no operator URL at all — the only dependency is the apiserver the
+    pod already lives on, authenticated by the mounted per-PCS SA token
+    (satokensecret component)."""
+    import urllib.parse
+
+    ssl_ctx = None
+    if cafile is not None:
+        import ssl
+
+        # The cluster CA verifies the apiserver's own DNS SANs — full
+        # hostname verification, unlike the operator-cert pin.
+        ssl_ctx = ssl.create_default_context(cafile=cafile)
+    # 401/403 right after pod start is EXPECTED here: the operator mirrors
+    # the RoleBinding in the same push that creates the pod, and the
+    # apiserver's RBAC cache can lag by seconds. Unlike the operator-API
+    # path (where a rejected credential never heals), keep gating through a
+    # grace window and only fail fast when the rejection persists.
+    denied_since: list[float] = []
+
+    def fetch(fqn: str) -> tuple[int, bool]:
+        selector = urllib.parse.quote(f"grove.io/podclique={fqn}")
+        url = (
+            f"{server.rstrip('/')}/api/v1/namespaces/"
+            f"{urllib.parse.quote(namespace)}/pods?labelSelector={selector}"
+        )
+        req = urllib.request.Request(url)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s, context=ssl_ctx) as resp:
+                doc = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code in (401, 403):
+                now = time.monotonic()
+                if not denied_since:
+                    denied_since.append(now)
+                if now - denied_since[0] >= rbac_grace_s:
+                    raise PermissionError(
+                        f"apiserver rejected the SA token ({e.code}) listing "
+                        f"pods of {fqn} for {rbac_grace_s:.0f}s (RBAC grace "
+                        "exhausted)"
+                    ) from e
+            return 0, False
+        except (OSError, TimeoutError, ValueError):
+            return 0, False
+        denied_since.clear()
+        ready = 0
+        for pod in doc.get("items", []) or []:
+            if (pod.get("metadata", {}) or {}).get("deletionTimestamp"):
+                continue
+            conds = (pod.get("status", {}) or {}).get("conditions", []) or []
+            if any(c.get("type") == "Ready" and c.get("status") == "True" for c in conds):
+                ready += 1
+        # A clique with no pods yet lists as empty — that still gates
+        # (ready=0), matching the informer counting zero Ready pods.
+        return ready, True
+
+    return fetch
+
+
+# In-cluster defaults (the downward/projected mounts every pod carries).
+IN_CLUSTER_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def in_cluster_server() -> str | None:
+    """https URL of the apiserver from the standard in-cluster env."""
+    import os
+
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if not host:
+        return None
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"  # bare IPv6 literal must be bracketed in a URL
+    return f"https://{host}:{port}"
+
+
 def store_fetch(cluster) -> FetchFn:
     """In-process fetch over the store — the simulator's agent path uses the
     same wait/requirements code as the binary."""

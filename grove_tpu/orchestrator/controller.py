@@ -83,6 +83,9 @@ class GroveController:
     # servers.advertiseUrl: the injected initc agent's --server ("" = the
     # agent's localhost default; real clusters need the operator Service URL)
     initc_server_url: str = ""
+    # cluster.initcMode: operator (poll the operator API) | kubernetes
+    # (agent lists gang pods at the apiserver directly)
+    initc_mode: str = "operator"
     # Preemption flap guard: a gang whose rejection is NOT capacity-caused
     # (e.g. a required rack that can never fit it) must not evict fresh
     # victims every pass — one preemption attempt per contender per window.
@@ -156,6 +159,7 @@ class GroveController:
             auto_slice_enabled=self.auto_slice_enabled,
             slice_resource_name=self.slice_resource_name,
             initc_server_url=self.initc_server_url,
+            initc_mode=self.initc_mode,
         )
 
     def sync_workload(self, pcs: PodCliqueSet, now: float, desired=None) -> None:
@@ -295,6 +299,8 @@ class GroveController:
                     if clique.pod_gang_name in c.podgangs
                     else None
                 ),
+                initc_server_url=self.initc_server_url,
+                initc_mode=self.initc_mode,
             )
             # _build_pods makes spec.replicas pods indexed 0..n-1; keep only the
             # ones matching the free indices, re-pointing their index/hostname.

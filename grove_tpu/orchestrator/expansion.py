@@ -141,6 +141,7 @@ def expand_podcliqueset(
     auto_slice_enabled: bool = False,
     slice_resource_name: str = constants.DEFAULT_SLICE_RESOURCE,
     initc_server_url: str = "",
+    initc_mode: str = "operator",
 ) -> DesiredState:
     """Expand a defaulted PodCliqueSet into its full desired object set.
 
@@ -242,6 +243,7 @@ def expand_podcliqueset(
                 pcs, pclq, clique_tmpl, svc, i, gen_hash, rng,
                 tmpl_hash=tmpl_hashes[clique_tmpl.name],
                 initc_server_url=initc_server_url,
+                initc_mode=initc_mode,
             )
             group.pod_references = [NamespacedName(ns, p.name) for p in pods]
             out.pods.extend(pods)
@@ -313,6 +315,7 @@ def expand_podcliqueset(
                         pcsg_fqn=pcsg_fqn, pcsg_replica=j,
                         base_podgang_name=None if in_base else base_gang.name,
                         initc_server_url=initc_server_url,
+                        initc_mode=initc_mode,
                     )
                     group.pod_references = [NamespacedName(ns, p.name) for p in pods]
                     out.pods.extend(pods)
@@ -574,14 +577,24 @@ INITC_TOKEN_VOLUME = "grove-sa-token"
 
 
 def _inject_initc(
-    spec, args: list[str], pcs_name: str, server_url: str = ""
+    spec,
+    args: list[str],
+    pcs_name: str,
+    server_url: str = "",
+    initc_mode: str = "operator",
 ) -> None:
     """Inject the startup-ordering init container (initcontainer.go:51,98-126);
     its args are exactly what the agent binary consumes (python -m
     grove_tpu.initc). The SA-token distribution is DECLARED in the pod spec
     the way the reference declares it: a secret volume + mount the node
     runtime fulfills (satokensecret component + projected volume); the agent
-    reads the mounted file via --token-file."""
+    reads the mounted file via --token-file.
+
+    `initc_mode` kubernetes (cluster.initcMode): the agent gates on the
+    kube-apiserver directly (--kube, the reference's own informer path) —
+    no operator URL in the pod; the mounted secret then carries a REAL SA
+    token the apiserver honors (sync_rbac mirrors SA/Role/RoleBinding and a
+    service-account-token Secret)."""
     if any(c.name == INITC_CONTAINER_NAME for c in spec.init_containers):
         return
     secret_name = naming.initc_sa_token_secret_name(pcs_name)
@@ -589,16 +602,23 @@ def _inject_initc(
         spec.volumes.append(
             {"name": INITC_TOKEN_VOLUME, "secret": {"secretName": secret_name}}
         )
+    if initc_mode == "kubernetes":
+        # No explicit --namespace: the operator mirrors gang pods (and the
+        # per-PCS RBAC) into cluster.kubeNamespace, which the store-level
+        # PCS namespace need not match — the agent's in-cluster
+        # namespace-file fallback names the namespace the pod actually
+        # runs in, which is by construction where its gang lives.
+        mode_args = ["--kube"]
+    else:
+        # --server: the operator's advertised URL (servers.advertiseUrl);
+        # unset keeps the agent's localhost default (single-host runs).
+        mode_args = [f"--server={server_url}"] if server_url else []
     spec.init_containers.append(
         Container(
             name=INITC_CONTAINER_NAME,
             image="grove-initc",
             command=["python", "-m", "grove_tpu.initc"],
-            # --server: the operator's advertised URL (servers.advertiseUrl);
-            # unset keeps the agent's localhost default (single-host runs).
-            args=list(args)
-            + ([f"--server={server_url}"] if server_url else [])
-            + [f"--token-file={INITC_TOKEN_MOUNT}"],
+            args=list(args) + mode_args + [f"--token-file={INITC_TOKEN_MOUNT}"],
             volume_mounts=[
                 {"name": INITC_TOKEN_VOLUME, "mountPath": INITC_TOKEN_MOUNT_DIR}
             ],
@@ -620,6 +640,7 @@ def _build_pods(
     pcsg_replica: int | None = None,
     base_podgang_name: str | None = None,
     initc_server_url: str = "",
+    initc_mode: str = "operator",
 ) -> list[Pod]:
     """Build the pods of one clique (podclique/components/pod/pod.go:135-269)."""
     import copy
@@ -666,7 +687,8 @@ def _build_pods(
         spec.subdomain = headless_service
         if startup_args is not None:
             _inject_initc(
-                spec, startup_args, pcs.metadata.name, initc_server_url
+                spec, startup_args, pcs.metadata.name, initc_server_url,
+                initc_mode=initc_mode,
             )
         pods.append(
             Pod(

@@ -198,6 +198,12 @@ class ClusterConfig:
     # reconcile -> status write-back). Off = fleet mirroring only (workloads
     # arrive via the operator's own HTTP API).
     watch_workloads: bool = True
+    # How injected grove-initc agents read parent-clique readiness:
+    #   operator   — poll the operator HTTP API (servers.advertiseUrl)
+    #   kubernetes — list gang pods at the kube-apiserver directly with the
+    #                mirrored per-PCS SA token (the reference agent's path,
+    #                wait.go:111-164); no operator URL in the pod at all.
+    initc_mode: str = "operator"
     kwok_nodes: int = 8
     kwok_cpu_per_node: float = 32.0
     kwok_memory_per_node: float = 128 * 2**30
@@ -311,6 +317,7 @@ _CAMEL_FIELDS = {
     "kubeNamespace": "kube_namespace",
     "podLabelSelector": "pod_label_selector",
     "watchWorkloads": "watch_workloads",
+    "initcMode": "initc_mode",
     "kwokNodes": "kwok_nodes",
     "kwokCpuPerNode": "kwok_cpu_per_node",
     "kwokMemoryPerNode": "kwok_memory_per_node",
@@ -509,6 +516,15 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             if not isinstance(wv, (int, float)) or isinstance(wv, bool) or not _math.isfinite(float(wv)):
                 errors.append(f"solver.weights.{wk}: {wv!r} is not a finite number")
     cl = cfg.cluster
+    if cl.initc_mode not in ("operator", "kubernetes"):
+        errors.append(
+            f"cluster.initcMode: {cl.initc_mode!r} not in operator|kubernetes"
+        )
+    if cl.initc_mode == "kubernetes" and cl.source != "kubernetes":
+        errors.append(
+            "cluster.initcMode: kubernetes requires cluster.source: kubernetes "
+            "(the agent lists gang pods at the apiserver)"
+        )
     if cl.source not in ("none", "kwok", "kubernetes"):
         errors.append(
             f"cluster.source: {cl.source!r} not in none|kwok|kubernetes"

@@ -472,6 +472,7 @@ class Manager:
             auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
             slice_resource_name=config.network_acceleration.slice_resource_name,
             initc_server_url=config.servers.advertise_url,
+            initc_mode=config.cluster.initc_mode,
         )
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -850,6 +851,7 @@ class Manager:
                 pod_label_selector=cfg.cluster.pod_label_selector or None,
                 pod_manifest_for=_manifest,
                 watch_workloads=cfg.cluster.watch_workloads,
+                initc_kube_tokens=cfg.cluster.initc_mode == "kubernetes",
             )
             source.start()
             self._kube_source = source

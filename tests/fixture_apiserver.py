@@ -90,6 +90,12 @@ class FixtureApiServer:
         # URL (no cluster DNS in the fixture). Empty = admission phase off.
         self.webhook_service_urls: dict[str, str] = {}
         self.admission_denials: list[str] = []  # messages of rejected writes
+        # Mirrored per-PCS RBAC (initcMode kubernetes): plural -> name -> obj.
+        self.rbac_objects: dict[str, dict[str, dict]] = {
+            "serviceaccounts": {},
+            "roles": {},
+            "rolebindings": {},
+        }
 
         fixture = self
 
@@ -128,6 +134,23 @@ class FixtureApiServer:
                     plural, name = wc
                     with fixture._lock:
                         obj = fixture.webhookconfigs[plural].get(name)
+                    if obj is None:
+                        self._json(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._json(200, json.loads(json.dumps(obj)))
+                    return
+                rb = fixture._rbac_at(parsed.path)
+                if rb is not None:
+                    plural, name = rb
+                    with fixture._lock:
+                        if name is None:
+                            items = [
+                                o for o in fixture.rbac_objects[plural].values()
+                                if fixture._matches(o, qs.get("labelSelector", ""))
+                            ]
+                            self._json(200, {"kind": "List", "items": items})
+                            return
+                        obj = fixture.rbac_objects[plural].get(name)
                     if obj is None:
                         self._json(404, {"kind": "Status", "code": 404})
                     else:
@@ -273,6 +296,36 @@ class FixtureApiServer:
                             return
                         fixture.webhookconfigs[plural][name] = body
                     self._json(200, json.loads(json.dumps(body)))
+                elif (rb := fixture._rbac_at(parsed.path)) is not None and rb[1]:
+                    plural, name = rb
+                    with fixture._lock:
+                        if name not in fixture.rbac_objects[plural]:
+                            self._json(404, {"kind": "Status", "code": 404})
+                            return
+                        fixture.rbac_objects[plural][name] = body
+                    self._json(200, json.loads(json.dumps(body)))
+                elif parsed.path.startswith(
+                    f"/api/v1/namespaces/{fixture.namespace}/secrets/"
+                ):
+                    name = parsed.path.rsplit("/", 1)[1]
+                    body = fixture._mint_sa_token(body)
+                    with fixture._lock:
+                        cur = fixture.secrets.get(name)
+                        if cur is None:
+                            self._json(404, {"kind": "Status", "code": 404})
+                            return
+                        # Real apiserver semantics: a Secret's type is
+                        # immutable — mutating it is 422 Invalid.
+                        if cur.get("type", "Opaque") != body.get("type", "Opaque"):
+                            self._json(
+                                422,
+                                {"kind": "Status", "code": 422,
+                                 "reason": "Invalid",
+                                 "message": "Secret type is immutable"},
+                            )
+                            return
+                        fixture.secrets[name] = body
+                    self._json(200, json.loads(json.dumps(body)))
                 else:
                     self._json(404, {"kind": "Status", "code": 404})
 
@@ -335,6 +388,35 @@ class FixtureApiServer:
         self._fail_watch_code = code
 
     # ---- protocol internals ---------------------------------------------------------
+
+    def _rbac_at(self, path: str):
+        """(plural, name|None) for SA/Role/RoleBinding paths, else None."""
+        for plural, prefix in (
+            ("serviceaccounts", f"/api/v1/namespaces/{self.namespace}/serviceaccounts"),
+            ("roles", f"/apis/rbac.authorization.k8s.io/v1/namespaces/{self.namespace}/roles"),
+            ("rolebindings", f"/apis/rbac.authorization.k8s.io/v1/namespaces/{self.namespace}/rolebindings"),
+        ):
+            if path == prefix:
+                return plural, None
+            if path.startswith(prefix + "/"):
+                return plural, path[len(prefix) + 1:]
+        return None
+
+    @staticmethod
+    def _mint_sa_token(body: dict) -> dict:
+        """Control-plane stand-in: a kubernetes.io/service-account-token
+        Secret gets its token minted by the cluster, not the writer."""
+        if body.get("type") == "kubernetes.io/service-account-token":
+            import base64 as _b64
+
+            sa = (body.get("metadata", {}).get("annotations", {}) or {}).get(
+                "kubernetes.io/service-account.name", ""
+            )
+            body = dict(body)
+            body["data"] = {
+                "token": _b64.b64encode(f"sa-token-{sa}".encode()).decode()
+            }
+        return body
 
     def _webhookconfig_at(self, path: str):
         """(plural, name) for admissionregistration object paths, else None."""
@@ -640,8 +722,18 @@ class FixtureApiServer:
             return 200, json.loads(json.dumps(cur))
 
     def _post(self, path: str, body: dict):
+        rb = self._rbac_at(path)
+        if rb is not None and rb[1] is None:
+            plural = rb[0]
+            name = body["metadata"]["name"]
+            with self._lock:
+                if name in self.rbac_objects[plural]:
+                    return 409, {"kind": "Status", "code": 409}
+                self.rbac_objects[plural][name] = body
+            return 201, json.loads(json.dumps(body))
         if path == f"/api/v1/namespaces/{self.namespace}/secrets":
             name = body["metadata"]["name"]
+            body = self._mint_sa_token(body)
             with self._lock:
                 if name in self.secrets:
                     return 409, {"kind": "Status", "code": 409}
@@ -709,6 +801,13 @@ class FixtureApiServer:
         return 404, {"kind": "Status", "code": 404}
 
     def _delete(self, path: str):
+        rb = self._rbac_at(path)
+        if rb is not None and rb[1]:
+            plural, name = rb
+            with self._lock:
+                if self.rbac_objects[plural].pop(name, None) is None:
+                    return 404, {"kind": "Status", "code": 404}
+            return 200, {"kind": "Status", "code": 200}
         plural = self._child_plural_of(path)
         if plural is not None:
             name = path[len(self._child_prefix(plural)) + 1:]

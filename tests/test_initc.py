@@ -225,3 +225,328 @@ def test_initc_binary_bad_args():
         capture_output=True, text=True, timeout=30,
     )
     assert proc.returncode == 2
+
+
+# --- kubernetes-native mode (cluster.initcMode: kubernetes) ------------------
+
+
+def test_kube_fetch_counts_ready_gang_pods():
+    """kube_fetch lists pods at the apiserver by the grove.io/podclique
+    label (the reference agent's informer source, wait.go:111-164): ready =
+    condition Ready=True and not terminating; an unreachable apiserver
+    gates instead of crashing; 403 fails fast."""
+    import urllib.error
+
+    from tests.fixture_apiserver import FixtureApiServer
+
+    from grove_tpu.initc.agent import kube_fetch
+
+    api = FixtureApiServer()
+    try:
+        def pod(name, ready, deleting=False, clique="w-0-prefill"):
+            p = {
+                "metadata": {
+                    "name": name,
+                    "labels": {"grove.io/podclique": clique},
+                },
+                "status": {
+                    "phase": "Running",
+                    "conditions": [
+                        {"type": "Ready", "status": "True" if ready else "False"}
+                    ],
+                },
+            }
+            if deleting:
+                p["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+            return p
+
+        api.pods["p0"] = pod("p0", True)
+        api.pods["p1"] = pod("p1", True)
+        api.pods["p2"] = pod("p2", False)          # not Ready
+        api.pods["p3"] = pod("p3", True, True)     # terminating
+        api.pods["p4"] = pod("p4", True, clique="other")  # other clique
+
+        fetch = kube_fetch(api.url, "default")
+        assert fetch("w-0-prefill") == (2, True)
+        assert fetch("no-such-clique") == (0, True)  # empty list still gates
+    finally:
+        api.close()
+
+    # Apiserver down: keep gating, never crash.
+    assert fetch("w-0-prefill") == (0, False)
+
+
+def test_expansion_kube_mode_injects_kube_args():
+    """initcMode kubernetes: the injected agent carries --kube and the pod
+    namespace — NO operator URL enters the pod; the token mount stays (it
+    now resolves to a real SA token via the service-account-token Secret)."""
+    from grove_tpu.orchestrator.expansion import INITC_TOKEN_MOUNT
+
+    ds = expand_podcliqueset(
+        _inorder_pcs(), initc_mode="kubernetes",
+        initc_server_url="http://should-not-appear:1",
+    )
+    worker_pods = [p for p in ds.pods if "workers" in p.pclq_fqn]
+    assert worker_pods
+    for p in worker_pods:
+        initc = [c for c in p.spec.init_containers if c.name == INITC_CONTAINER_NAME]
+        # No explicit --namespace: the agent's in-cluster namespace file
+        # names where the pod (and thus its gang + RBAC) actually lives —
+        # the store-level PCS namespace need not match cluster.kubeNamespace.
+        assert initc[0].args == [
+            "--podcliques=ordered-0-leader:1",
+            "--kube",
+            f"--token-file={INITC_TOKEN_MOUNT}",
+        ]
+        assert not any("should-not-appear" in a for a in initc[0].args)
+
+
+def test_kube_mode_mirrors_rbac_and_sa_token_secret():
+    """initcMode kubernetes mirrors the per-PCS SA/Role/RoleBinding and a
+    service-account-token Secret whose token the CONTROL PLANE mints (the
+    satokensecret component analog) — the agent's apiserver credential."""
+    import base64
+
+    from tests.fixture_apiserver import FixtureApiServer
+
+    from grove_tpu.cluster.kubernetes import KubeContext, KubernetesWatchSource
+    from grove_tpu.orchestrator.expansion import expand_podcliqueset as _expand
+
+    api = FixtureApiServer()
+    try:
+        src = KubernetesWatchSource(
+            KubeContext(server=api.url, namespace="default"),
+            initc_kube_tokens=True,
+        )
+        ds = _expand(_inorder_pcs(), initc_mode="kubernetes")
+        sa, role, binding, secret = ds.rbac
+        assert src.sync_rbac([sa], [role], [binding]) is True
+        assert src.sync_secrets([secret]) is True
+
+        assert sa.name in api.rbac_objects["serviceaccounts"]
+        k8s_role = api.rbac_objects["roles"][role.name]
+        flat = [(r["apiGroups"], tuple(r["resources"])) for r in k8s_role["rules"]]
+        assert ([""], ("pods",)) in flat
+        assert (["grove.io"], ("podcliques",)) in flat
+        for rule in k8s_role["rules"]:
+            assert "watch" in rule["verbs"]
+        k8s_rb = api.rbac_objects["rolebindings"][binding.name]
+        assert k8s_rb["roleRef"]["name"] == role.name
+        assert k8s_rb["subjects"][0]["name"] == sa.name
+
+        sec = api.secrets[secret.name]
+        assert sec["type"] == "kubernetes.io/service-account-token"
+        assert "stringData" not in sec  # the cluster mints, not us
+        minted = base64.b64decode(sec["data"]["token"]).decode()
+        assert sa.name in minted
+
+        # Operator mode: no RBAC mirroring, opaque token secrets.
+        src2 = KubernetesWatchSource(
+            KubeContext(server=api.url, namespace="default"),
+        )
+        assert src2.sync_rbac([sa], [role], [binding]) is True  # no-op
+    finally:
+        api.close()
+
+
+def test_initc_kube_binary_gates_on_fixture_apiserver():
+    """The real agent binary in --kube mode against the wire-protocol
+    fixture: gates while the parent clique is short, exits 0 once enough
+    gang pods turn Ready — no operator anywhere in the loop."""
+    from tests.fixture_apiserver import FixtureApiServer
+
+    api = FixtureApiServer()
+    try:
+        def pod(name, ready):
+            return {
+                "metadata": {
+                    "name": name,
+                    "labels": {"grove.io/podclique": "w-0-leader"},
+                },
+                "status": {
+                    "conditions": [
+                        {"type": "Ready", "status": "True" if ready else "False"}
+                    ]
+                },
+            }
+
+        api.pods["l0"] = pod("l0", True)
+        api.pods["l1"] = pod("l1", False)
+
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "grove_tpu.initc",
+                "--podcliques=w-0-leader:2",
+                "--kube",
+                f"--server={api.url}",
+                "--namespace=default",
+                "--poll-interval=0.1",
+                "--timeout=30",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        time.sleep(1.0)
+        assert proc.poll() is None, proc.stdout.read()  # still gating
+        api.pods["l1"]["status"]["conditions"][0]["status"] = "True"
+        rc = proc.wait(timeout=30)
+        out = proc.stdout.read()
+        assert rc == 0, out
+        assert "all parent cliques ready" in out
+    finally:
+        api.close()
+
+
+def test_deploy_kube_initc_mode_skips_advertise_url():
+    """initcMode kubernetes removes the operator-URL-in-pod constraints:
+    deploy renders without advertiseUrl (and without the plaintext-TLS
+    restriction chain), and the operator Role gains the SA/Role/RoleBinding
+    mirror permissions."""
+    from grove_tpu.deploy import render_manifests
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"bindAddress": "0.0.0.0", "healthPort": 2751,
+                        "metricsPort": 2752},
+            "backend": {"enabled": False},
+            "cluster": {"source": "kubernetes", "initcMode": "kubernetes"},
+        }
+    )
+    assert not errors, errors
+    docs = render_manifests(cfg, "x: y")
+    role = next(
+        d for d in docs
+        if d["kind"] == "Role" and d["metadata"]["name"] == "grove-tpu-operator"
+    )
+    granted = [(tuple(r["apiGroups"]), tuple(r["resources"])) for r in role["rules"]]
+    assert (("",), ("serviceaccounts",)) in granted
+    assert ((("rbac.authorization.k8s.io",), ("roles", "rolebindings")) in granted)
+
+    # Operator mode still requires the advertiseUrl.
+    cfg2, errors2 = parse_operator_config(
+        {
+            "servers": {"bindAddress": "0.0.0.0", "healthPort": 2751,
+                        "metricsPort": 2752},
+            "backend": {"enabled": False},
+            "cluster": {"source": "kubernetes"},
+        }
+    )
+    assert not errors2
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="advertiseUrl"):
+        render_manifests(cfg2, "x: y")
+
+
+def test_controller_pod_build_threads_initc_mode():
+    """Regression: the controller's own pod-build path (_sync_clique_pods —
+    distinct from expansion) must thread initc_server_url AND initc_mode;
+    it silently dropped both, so real-cluster/replacement pods lost the
+    --kube (or --server) wiring the expansion path had."""
+    from grove_tpu.orchestrator.controller import GroveController
+    from grove_tpu.orchestrator.store import Cluster
+
+    for mode, want, not_want in (
+        ("kubernetes", "--kube", "--server="),
+        ("operator", "--server=http://op.example:2751", "--kube"),
+    ):
+        from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+
+        ctrl = GroveController(
+            cluster=Cluster(),
+            topology=DEFAULT_CLUSTER_TOPOLOGY,
+            initc_mode=mode,
+            initc_server_url="http://op.example:2751",
+        )
+        pcs = default_podcliqueset(
+            PodCliqueSet.from_dict(
+                yaml.safe_load(open("examples/explicit-startup-order.yaml"))
+            )
+        )
+        ctrl.cluster.podcliquesets[pcs.metadata.name] = pcs
+        ctrl.sync_workload(pcs, now=1.0)
+        gated = [
+            p for p in ctrl.cluster.pods.values() if p.spec.init_containers
+        ]
+        assert gated, "expected startsAfter pods with injected initc"
+        for p in gated:
+            args = p.spec.init_containers[0].args
+            assert any(want in a for a in args), (mode, args)
+            assert not any(not_want in a for a in args), (mode, args)
+
+
+def test_kube_fetch_rbac_grace_then_fail_fast():
+    """A 403 right after pod start is expected (RBAC propagation lag):
+    keep gating through the grace window, fail fast only when it persists."""
+    import http.server
+    import threading
+
+    import pytest as _pytest
+
+    from grove_tpu.initc.agent import kube_fetch
+
+    class Deny(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(403)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Deny)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        fetch = kube_fetch(url, "default", rbac_grace_s=0.3)
+        assert fetch("x") == (0, False)  # first denial: keep gating
+        time.sleep(0.35)
+        with pytest.raises(PermissionError, match="RBAC grace"):
+            fetch("x")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_secret_type_flip_recreates_instead_of_wedging():
+    """Flipping cluster.initcMode on a live cluster changes the mirrored
+    Secret's immutable type: the apiserver 422s the PUT; the mirror must
+    delete + re-create, not retry the rejected PUT forever."""
+    from tests.fixture_apiserver import FixtureApiServer
+
+    from grove_tpu.cluster.kubernetes import KubeContext, KubernetesWatchSource
+    from grove_tpu.orchestrator.expansion import expand_podcliqueset as _expand
+
+    api = FixtureApiServer()
+    try:
+        ds = _expand(_inorder_pcs())
+        secret = ds.rbac[3]
+        # Operator mode first: Opaque secret lands.
+        src1 = KubernetesWatchSource(
+            KubeContext(server=api.url, namespace="default")
+        )
+        assert src1.sync_secrets([secret]) is True
+        assert api.secrets[secret.name]["type"] == "Opaque"
+        # Mode flip (fresh source, as a restart would be): type changes.
+        src2 = KubernetesWatchSource(
+            KubeContext(server=api.url, namespace="default"),
+            initc_kube_tokens=True,
+        )
+        assert src2.sync_secrets([secret]) is True, src2.errors
+        assert (
+            api.secrets[secret.name]["type"]
+            == "kubernetes.io/service-account-token"
+        )
+    finally:
+        api.close()
+
+
+def test_in_cluster_server_brackets_ipv6(monkeypatch):
+    from grove_tpu.initc.agent import in_cluster_server
+
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "fd00:10:96::1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    assert in_cluster_server() == "https://[fd00:10:96::1]:443"
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.96.0.1")
+    assert in_cluster_server() == "https://10.96.0.1:443"
