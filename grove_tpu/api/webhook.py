@@ -205,6 +205,49 @@ def handle_mutate(body: dict, chain: AdmissionChain) -> dict:
     return _review_response(uid, True, patch=ops or None)
 
 
+def handle_authorize(
+    body: dict, chain: AdmissionChain, operator_users: frozenset
+) -> dict:
+    """Authorizer webhook endpoint (admission/pcs/authorization/handler.go:
+    60-80): deny any user other than the reconciler (and configured exempt
+    actors) mutating a grove-managed resource. The rendered configuration
+    pre-filters with an objectSelector on the managed-by label; this
+    handler re-checks the label so a mis-scoped configuration fails closed
+    for managed objects and open for everything else."""
+    from grove_tpu.api import constants
+
+    req = body.get("request") or {}
+    uid = str(req.get("uid", ""))
+    operation = str(req.get("operation", "")).upper()
+    if operation == "CONNECT":
+        # Always allowed for users with sufficient RBAC (handler.go:66-70).
+        return _review_response(uid, True)
+    username = str((req.get("userInfo") or {}).get("username", ""))
+    kind = str((req.get("kind") or {}).get("kind", ""))
+
+    def _managed(o) -> bool:
+        labels = ((o or {}).get("metadata", {}) or {}).get("labels", {}) or {}
+        return labels.get(constants.LABEL_MANAGED_BY) == constants.LABEL_MANAGED_BY_VALUE
+
+    obj = req.get("object") if isinstance(req.get("object"), dict) else None
+    old = req.get("oldObject") if isinstance(req.get("oldObject"), dict) else None
+    # Managed if EITHER side carries the label: an UPDATE that strips the
+    # managed-by label would otherwise walk straight past the check — the
+    # objectSelector fires on either side and so must we.
+    if not (_managed(obj) or _managed(old)):
+        return _review_response(uid, True)  # not grove-managed
+    if obj is None:
+        obj = old  # DELETE reviews carry only oldObject
+    if username in operator_users:
+        return _review_response(uid, True)
+    name = ((obj or {}).get("metadata", {}) or {}).get("name", "")
+    try:
+        chain.admit_managed_mutation(username, kind, name)
+    except PermissionError as e:
+        return _review_response(uid, False, message=str(e))
+    return _review_response(uid, True)
+
+
 def handle_validate(body: dict, chain: AdmissionChain) -> dict:
     """Validating webhook endpoint body → AdmissionReview response."""
     uid, operation, obj, old = _review_request(body)

@@ -620,11 +620,13 @@ def render_manifests(
             }
         )
     if webhook_enabled:
-        docs.extend(_render_webhook_objects(namespace))
+        docs.extend(
+            _render_webhook_objects(namespace, authorizer=cfg.authorizer.enabled)
+        )
     return docs
 
 
-def _render_webhook_objects(namespace: str) -> list[dict]:
+def _render_webhook_objects(namespace: str, authorizer: bool = False) -> list[dict]:
     """The inbound admission surface (webhook/register.go:34-62 analog): a
     dedicated webhook Service on 443 plus Mutating/Validating
     WebhookConfigurations for PodCliqueSet writes. caBundle is left empty;
@@ -694,7 +696,57 @@ def _render_webhook_objects(namespace: str) -> list[dict]:
                     "clientConfig": _client_config("/webhook/v1/validate"),
                     **common,
                 }
-            ],
+            ]
+            + (
+                [
+                    {
+                        # Authorizer webhook (authorization/handler.go:60-80):
+                        # only the operator (and exempt actors) may mutate
+                        # managed resources. objectSelector scopes the
+                        # apiserver's calls to grove-managed objects so an
+                        # operator outage cannot block unrelated writes.
+                        "name": "authorization.pcs.grove.io",
+                        "clientConfig": _client_config("/webhook/v1/authorize"),
+                        "rules": [
+                            {
+                                "apiGroups": ["grove.io"],
+                                "apiVersions": ["v1alpha1"],
+                                "operations": ["CREATE", "UPDATE", "DELETE"],
+                                # Status subresources listed explicitly:
+                                # webhooks do not fire for unlisted
+                                # subresources, and the operator-owned
+                                # status projections are a write surface.
+                                "resources": [
+                                    "podcliques",
+                                    "podcliques/status",
+                                    "podcliquescalinggroups",
+                                    "podcliquescalinggroups/status",
+                                ],
+                                "scope": "Namespaced",
+                            },
+                            {
+                                "apiGroups": [""],
+                                "apiVersions": ["v1"],
+                                "operations": ["UPDATE", "DELETE"],
+                                "resources": ["pods"],
+                                "scope": "Namespaced",
+                            },
+                        ],
+                        "objectSelector": {
+                            "matchLabels": {
+                                "app.kubernetes.io/managed-by": APP,
+                            }
+                        },
+                        "failurePolicy": "Fail",
+                        "sideEffects": "None",
+                        "admissionReviewVersions": ["v1"],
+                        "matchPolicy": "Equivalent",
+                        "timeoutSeconds": 10,
+                    }
+                ]
+                if authorizer
+                else []
+            ),
         },
     ]
 

@@ -359,7 +359,7 @@ def _serve_admission_review(handler: "_ProbeHandler") -> None:
     operator via the serving cert, not a bearer token — putting them on the
     (possibly plaintext, token-guarded) API port would expose an
     unauthenticated admission oracle to every workload pod."""
-    from grove_tpu.api.webhook import handle_mutate, handle_validate
+    from grove_tpu.api.webhook import handle_authorize, handle_mutate, handle_validate
 
     length = int(handler.headers.get("Content-Length", "0"))
     try:
@@ -369,8 +369,14 @@ def _serve_admission_review(handler: "_ProbeHandler") -> None:
     except (ValueError, TypeError) as e:
         handler._respond(400, json.dumps({"errors": [str(e)]}), "application/json")
         return
-    fn = handle_mutate if handler.path.endswith("default") else handle_validate
-    out = fn(review, handler.manager.admission)
+    if handler.path.endswith("authorize"):
+        out = handle_authorize(
+            review, handler.manager.admission, handler.manager.operator_users()
+        )
+    elif handler.path.endswith("default"):
+        out = handle_mutate(review, handler.manager.admission)
+    else:
+        out = handle_validate(review, handler.manager.admission)
     handler._respond(200, json.dumps(out), "application/json")
 
 
@@ -387,7 +393,11 @@ class _WebhookHandler(_ProbeHandler):
             self._respond(404, "not found")
 
     def do_POST(self):  # noqa: N802
-        if self.path in ("/webhook/v1/default", "/webhook/v1/validate"):
+        if self.path in (
+            "/webhook/v1/default",
+            "/webhook/v1/validate",
+            "/webhook/v1/authorize",
+        ):
             _serve_admission_review(self)
         else:
             self._respond(404, "not found")
@@ -492,6 +502,7 @@ class Manager:
         self._tls_paths: Optional[tuple[str, str]] = None  # (cert, key) once ensured
         self._webhook_tls_paths: Optional[tuple[str, str]] = None
         self._webhook_ca_pending = False  # boot patch failed; retry in reconcile
+        self._operator_users: Optional[frozenset] = None  # cached (static)
         # /profilez state: per-step cumulative seconds + call counts.
         self._profile: dict[str, dict[str, float]] = {}
         # Watch driver (cluster integration path): attached via attach_watch;
@@ -965,6 +976,30 @@ class Manager:
                     san_dns=tuple(cfg.webhook_sans),
                 )
         return self._bind_server(port, _WebhookHandler, self._webhook_tls_paths)
+
+    def operator_users(self) -> frozenset:
+        """Identities the authorizer webhook treats as the reconciler
+        (handler.go's reconcilerServiceAccountUserName): the in-process
+        actor name plus the operator's own in-cluster ServiceAccount
+        username (derived from the SA mount when running in a pod, else
+        the deploy renderer's default namespace). Static for the process:
+        computed once — this sits on the apiserver's failurePolicy-Fail
+        admission path."""
+        if self._operator_users is None:
+            ns = "grove-system"
+            try:
+                with open(
+                    "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+                ) as f:
+                    ns = f.read().strip() or ns
+            except OSError:
+                pass
+            from grove_tpu.api.admission import OPERATOR_ACTOR
+
+            self._operator_users = frozenset(
+                {OPERATOR_ACTOR, f"system:serviceaccount:{ns}:grove-tpu-operator"}
+            )
+        return self._operator_users
 
     def webhook_ca_bundle(self) -> Optional[bytes]:
         """PEM bundle apiserver clients should trust for the webhook server

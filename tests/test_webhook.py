@@ -547,3 +547,186 @@ def test_manual_webhook_cert_must_be_self_signed_or_have_ca(tmp_path):
     )
     with pytest.raises(CertError, match="tlsCaFile"):
         _require_self_signed(str(d / "leaf.crt"))
+
+
+# --- authorizer webhook (authorization/handler.go:60-80) ---------------------
+
+
+def _authz_review(kind, name, username, operation="UPDATE", managed=True, uid="u1"):
+    labels = (
+        {"app.kubernetes.io/managed-by": "grove-tpu-operator"} if managed else {}
+    )
+    obj = {"metadata": {"name": name, "labels": labels}}
+    req = {
+        "uid": uid,
+        "operation": operation,
+        "kind": {"group": "grove.io", "kind": kind},
+        "userInfo": {"username": username},
+    }
+    if operation == "DELETE":
+        req["oldObject"] = obj
+    else:
+        req["object"] = obj
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview", "request": req}
+
+
+def test_handle_authorize_blocks_non_operator_mutation():
+    from grove_tpu.api.admission import Authorizer
+    from grove_tpu.api.webhook import handle_authorize
+
+    chain = AdmissionChain(authorizer=Authorizer(enabled=True, exempt_actors=("ci-bot",)))
+    ops = frozenset({"system:serviceaccount:grove-system:grove-tpu-operator"})
+
+    # A user editing a managed PodClique: denied.
+    out = handle_authorize(
+        _authz_review("PodClique", "a-0-prefill", "alice"), chain, ops
+    )
+    assert out["response"]["allowed"] is False
+    assert "may not mutate" in out["response"]["status"]["message"]
+
+    # The operator's own SA: allowed.
+    out = handle_authorize(
+        _authz_review(
+            "PodClique", "a-0-prefill",
+            "system:serviceaccount:grove-system:grove-tpu-operator",
+        ),
+        chain, ops,
+    )
+    assert out["response"]["allowed"] is True
+
+    # Exempt actor: allowed.
+    out = handle_authorize(
+        _authz_review("Pod", "a-0-prefill-x1", "ci-bot"), chain, ops
+    )
+    assert out["response"]["allowed"] is True
+
+    # DELETE (only oldObject present): still denied for strangers.
+    out = handle_authorize(
+        _authz_review("Pod", "a-0-prefill-x1", "alice", operation="DELETE"),
+        chain, ops,
+    )
+    assert out["response"]["allowed"] is False
+
+    # Un-managed object (mis-scoped configuration): allowed.
+    out = handle_authorize(
+        _authz_review("Pod", "user-pod", "alice", managed=False), chain, ops
+    )
+    assert out["response"]["allowed"] is True
+
+    # CONNECT always allowed (handler.go:66-70).
+    out = handle_authorize(
+        _authz_review("Pod", "x", "alice", operation="CONNECT"), chain, ops
+    )
+    assert out["response"]["allowed"] is True
+
+    # Authorizer disabled in config: allow (webhook shouldn't be rendered,
+    # but the handler must not invent policy the config didn't ask for).
+    out = handle_authorize(
+        _authz_review("PodClique", "a-0-prefill", "alice"),
+        AdmissionChain(),
+        ops,
+    )
+    assert out["response"]["allowed"] is True
+
+
+def test_manager_serves_authorize_endpoint(tmp_path):
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {
+                "healthPort": 0,
+                "metricsPort": -1,
+                "webhookPort": 0,
+                "tlsCertDir": str(tmp_path / "certs"),
+            },
+            "backend": {"enabled": False},
+            "leaderElection": {"enabled": False},
+            "authorizer": {"enabled": True},
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        out = _post_review(
+            m, "/webhook/v1/authorize",
+            _authz_review("PodClique", "a-0-prefill", "alice"),
+        )
+        assert out["response"]["allowed"] is False
+        out = _post_review(
+            m, "/webhook/v1/authorize",
+            _authz_review("PodClique", "a-0-prefill", "system:grove-operator"),
+        )
+        assert out["response"]["allowed"] is True
+    finally:
+        m.stop()
+
+
+def test_deploy_renders_authorizer_webhook_only_when_enabled():
+    from grove_tpu.deploy import render_manifests
+    from grove_tpu.runtime.config import parse_operator_config
+
+    def _cfg(authz):
+        cfg, errors = parse_operator_config(
+            {
+                "servers": {
+                    "bindAddress": "0.0.0.0",
+                    "healthPort": 2751,
+                    "metricsPort": 2752,
+                    "webhookPort": 9443,
+                    "advertiseUrl": "http://grove-tpu-operator.grove-system.svc:2751",
+                    "webhookSans": ["grove-tpu-operator-webhook.grove-system.svc"],
+                },
+                "cluster": {"source": "kubernetes"},
+                "backend": {"enabled": False},
+                "authorizer": {"enabled": authz},
+            }
+        )
+        assert not errors, errors
+        return cfg
+
+    docs = render_manifests(_cfg(True), "x: y")
+    vwc = next(d for d in docs if d["kind"] == "ValidatingWebhookConfiguration")
+    names = [w["name"] for w in vwc["webhooks"]]
+    assert names == ["validation.pcs.grove.io", "authorization.pcs.grove.io"]
+    authz = vwc["webhooks"][1]
+    assert authz["clientConfig"]["service"]["path"] == "/webhook/v1/authorize"
+    assert authz["objectSelector"]["matchLabels"] == {
+        "app.kubernetes.io/managed-by": "grove-tpu-operator"
+    }
+    assert {r["resources"][0] for r in authz["rules"]} == {"podcliques", "pods"}
+
+    docs = render_manifests(_cfg(False), "x: y")
+    vwc = next(d for d in docs if d["kind"] == "ValidatingWebhookConfiguration")
+    assert [w["name"] for w in vwc["webhooks"]] == ["validation.pcs.grove.io"]
+
+
+def test_authorize_blocks_label_strip_update():
+    """Bypass regression: an UPDATE whose NEW object strips the managed-by
+    label must still be treated as managed (the old object carries it)."""
+    from grove_tpu.api.admission import Authorizer
+    from grove_tpu.api.webhook import handle_authorize
+
+    chain = AdmissionChain(authorizer=Authorizer(enabled=True))
+    review = _authz_review("PodClique", "a-0-prefill", "alice")
+    review["request"]["oldObject"] = review["request"]["object"]
+    review["request"]["object"] = {
+        "metadata": {"name": "a-0-prefill", "labels": {}}  # label stripped
+    }
+    out = handle_authorize(review, chain, frozenset())
+    assert out["response"]["allowed"] is False
+
+
+def test_authorizer_webhook_rules_cover_status_subresources():
+    from grove_tpu.deploy import _render_webhook_objects
+
+    vwc = next(
+        d for d in _render_webhook_objects("ns", authorizer=True)
+        if d["kind"] == "ValidatingWebhookConfiguration"
+    )
+    authz = vwc["webhooks"][1]
+    grove_rule = next(r for r in authz["rules"] if r["apiGroups"] == ["grove.io"])
+    assert "podcliques/status" in grove_rule["resources"]
+    assert "podcliquescalinggroups/status" in grove_rule["resources"]
