@@ -247,6 +247,20 @@ def main() -> int:
             print(f"[bench] platform fallback: {plat_err}", file=sys.stderr)
             _RESULT["error"] = f"platform fallback: {plat_err}"
 
+        # Persistent XLA compilation cache: the ~20-40s warm-up compiles are
+        # paid once per (code, shape-bucket, platform) and then load from
+        # disk — so the DRIVER's end-of-round run on a machine we benched
+        # on earlier skips straight to the drain. GROVE_BENCH_COMPILE_CACHE=0
+        # opts out (e.g. to measure cold compiles).
+        if os.environ.get("GROVE_BENCH_COMPILE_CACHE", "1") == "1":
+            from grove_tpu.utils.platform import enable_compilation_cache
+
+            enable_compilation_cache(
+                os.environ.get(
+                    "GROVE_BENCH_COMPILE_CACHE_DIR", "/tmp/grove-tpu-xla-cache"
+                )
+            )
+
         import jax
 
         _RESULT["platform"] = jax.devices()[0].platform

@@ -85,6 +85,9 @@ DEFAULT_SLICE_RESOURCE = "google.com/tpu"
 # Capacity queue this workload's gangs draw quota from (the KAI Queue
 # analog, e2e/yaml/queues.yaml; scheduling.queues in the operator config).
 ANNOTATION_QUEUE = "grove.io/queue"
+# Set "true" on a PodCliqueSet to bypass the authorizer's managed-resource
+# protection for its children (constants.go:43-45).
+ANNOTATION_DISABLE_PROTECTION = "grove.io/disable-managed-resource-protection"
 
 # Default PodCliqueSet name budget: pod names must fit the 63-char DNS label after
 # the operator appends `-<i>-[<pcsg>-<j>-]<pclq>-<5char suffix>`

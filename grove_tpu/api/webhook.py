@@ -206,16 +206,23 @@ def handle_mutate(body: dict, chain: AdmissionChain) -> dict:
 
 
 def handle_authorize(
-    body: dict, chain: AdmissionChain, operator_users: frozenset
+    body: dict,
+    chain: AdmissionChain,
+    operator_users: frozenset,
+    pcs_lookup=None,
 ) -> dict:
     """Authorizer webhook endpoint (admission/pcs/authorization/handler.go:
-    60-80): deny any user other than the reconciler (and configured exempt
-    actors) mutating a grove-managed resource. The rendered configuration
-    pre-filters with an objectSelector on the managed-by label; this
-    handler re-checks the label so a mis-scoped configuration fails closed
-    for managed objects and open for everything else."""
-    from grove_tpu.api import constants
-
+    60-135): deny any user other than the reconciler (and configured exempt
+    actors) mutating a grove-managed resource. Reference exceptions kept:
+    CONNECT is always allowed; Pod DELETE is allowed for everyone (the
+    kubelet's completion deletes and the GC's owner-reference cascade are
+    system identities no exempt list could enumerate, handler.go:121-124);
+    a parent PCS annotated grove.io/disable-managed-resource-protection:
+    "true" bypasses the check for its children (handler.go:89-93,
+    `pcs_lookup` resolves the parent by the part-of label). The rendered
+    configuration pre-filters with an objectSelector on the managed-by
+    label; this handler re-checks the label so a mis-scoped configuration
+    fails closed for managed objects and open for everything else."""
     req = body.get("request") or {}
     uid = str(req.get("uid", ""))
     operation = str(req.get("operation", "")).upper()
@@ -224,6 +231,8 @@ def handle_authorize(
         return _review_response(uid, True)
     username = str((req.get("userInfo") or {}).get("username", ""))
     kind = str((req.get("kind") or {}).get("kind", ""))
+    if kind == "Pod" and operation == "DELETE":
+        return _review_response(uid, True)
 
     def _managed(o) -> bool:
         labels = ((o or {}).get("metadata", {}) or {}).get("labels", {}) or {}
@@ -240,9 +249,17 @@ def handle_authorize(
         obj = old  # DELETE reviews carry only oldObject
     if username in operator_users:
         return _review_response(uid, True)
-    name = ((obj or {}).get("metadata", {}) or {}).get("name", "")
+    meta = (obj or {}).get("metadata", {}) or {}
+    if pcs_lookup is not None:
+        pcs_name = (meta.get("labels", {}) or {}).get(constants.LABEL_PART_OF, "")
+        parent = pcs_lookup(pcs_name) if pcs_name else None
+        if parent is not None and (
+            parent.metadata.annotations.get(constants.ANNOTATION_DISABLE_PROTECTION)
+            == "true"
+        ):
+            return _review_response(uid, True)
     try:
-        chain.admit_managed_mutation(username, kind, name)
+        chain.admit_managed_mutation(username, kind, meta.get("name", ""))
     except PermissionError as e:
         return _review_response(uid, False, message=str(e))
     return _review_response(uid, True)

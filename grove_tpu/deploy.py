@@ -700,11 +700,18 @@ def _render_webhook_objects(namespace: str, authorizer: bool = False) -> list[di
             + (
                 [
                     {
-                        # Authorizer webhook (authorization/handler.go:60-80):
+                        # Authorizer webhook (authorization/handler.go:60-135):
                         # only the operator (and exempt actors) may mutate
                         # managed resources. objectSelector scopes the
                         # apiserver's calls to grove-managed objects so an
                         # operator outage cannot block unrelated writes.
+                        # Pod DELETE is deliberately NOT registered: the
+                        # kubelet's completion deletes and the GC's
+                        # owner-reference cascade are system identities no
+                        # exempt list could enumerate (the handler also
+                        # allows them as defense in depth, handler.go:
+                        # 121-124).
+                        **common,
                         "name": "authorization.pcs.grove.io",
                         "clientConfig": _client_config("/webhook/v1/authorize"),
                         "rules": [
@@ -727,7 +734,7 @@ def _render_webhook_objects(namespace: str, authorizer: bool = False) -> list[di
                             {
                                 "apiGroups": [""],
                                 "apiVersions": ["v1"],
-                                "operations": ["UPDATE", "DELETE"],
+                                "operations": ["UPDATE"],
                                 "resources": ["pods"],
                                 "scope": "Namespaced",
                             },
@@ -737,11 +744,6 @@ def _render_webhook_objects(namespace: str, authorizer: bool = False) -> list[di
                                 "app.kubernetes.io/managed-by": APP,
                             }
                         },
-                        "failurePolicy": "Fail",
-                        "sideEffects": "None",
-                        "admissionReviewVersions": ["v1"],
-                        "matchPolicy": "Equivalent",
-                        "timeoutSeconds": 10,
                     }
                 ]
                 if authorizer

@@ -137,6 +137,9 @@ class SolverConfig:
     # (A `speculative` knob existed through round 3; the path was deleted
     # after losing to the sequential scan in every measured regime.)
     portfolio: int = 1
+    # Persistent XLA compilation cache dir ("" = off): solver warm-up
+    # compiles (~20-40s on TPU) are reused across operator restarts.
+    compilation_cache_dir: str = ""
     max_groups: Optional[int] = None
     max_sets: Optional[int] = None
     max_pods: Optional[int] = None
@@ -305,6 +308,7 @@ _CAMEL_FIELDS = {
     "maxSets": "max_sets",
     "maxPods": "max_pods",
     "padGangsTo": "pad_gangs_to",
+    "compilationCacheDir": "compilation_cache_dir",
     "maxWorkers": "max_workers",
     "snapshotIntervalSeconds": "snapshot_interval_seconds",
     "wTight": "w_tight",

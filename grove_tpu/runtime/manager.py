@@ -371,7 +371,12 @@ def _serve_admission_review(handler: "_ProbeHandler") -> None:
         return
     if handler.path.endswith("authorize"):
         out = handle_authorize(
-            review, handler.manager.admission, handler.manager.operator_users()
+            review,
+            handler.manager.admission,
+            handler.manager.operator_users(),
+            # Parent-PCS resolution for the disable-protection annotation
+            # bypass (handler.go:89-93) — the store is the PCS cache here.
+            pcs_lookup=handler.manager.cluster.podcliquesets.get,
         )
     elif handler.path.endswith("default"):
         out = handle_mutate(review, handler.manager.admission)
@@ -764,6 +769,13 @@ class Manager:
         if self._started:
             return
         cfg = self.config
+        if cfg.solver.compilation_cache_dir:
+            # Persistent XLA compilation cache: solver warm-up compiles are
+            # reused across operator restarts (jax-idiomatic; never fatal).
+            from grove_tpu.utils.platform import enable_compilation_cache
+
+            if not enable_compilation_cache(cfg.solver.compilation_cache_dir):
+                self.log.info("compilation cache unavailable")
         if cfg.leader_election.enabled:
             if cfg.cluster.source == "kubernetes":
                 # Apiserver-backed Lease: the only store EVERY replica of a

@@ -1448,3 +1448,74 @@ def test_apiserver_webhook_admission_loop(api, tmp_path):
         assert any("failurePolicy Fail" in d for d in api.admission_denials)
     finally:
         m.stop()
+
+
+def test_kube_initc_mode_end_to_end(api, tmp_path):
+    """cluster.initcMode kubernetes through the real operator + fixture
+    apiserver: per-PCS SA/Role/RoleBinding mirrored, the token Secret is a
+    cluster-minted service-account-token, created gang pods carry --kube
+    (and NO operator URL), and the startsAfter workload still schedules."""
+    import yaml as _yaml
+
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    for i in range(8):
+        api.add_node(
+            k8s_node(
+                f"n{i}", cpu="16", memory="64Gi", tpu="8",
+                labels={
+                    "topology.kubernetes.io/zone": "z0",
+                    "topology.kubernetes.io/block": "b0",
+                    "topology.kubernetes.io/rack": f"r{i % 2}",
+                },
+            )
+        )
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "initcMode": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        with open("examples/explicit-startup-order.yaml") as f:
+            api.apply_pcs(_yaml.safe_load(f))
+        deadline = time.monotonic() + 30.0
+        t = 0.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if api.pods and api.rbac_objects["serviceaccounts"]:
+                break
+            time.sleep(0.05)
+        assert api.pods, "gang pods never created at the apiserver"
+
+        # RBAC + token mirrored for the agent's apiserver credential.
+        assert api.rbac_objects["serviceaccounts"]
+        assert api.rbac_objects["roles"] and api.rbac_objects["rolebindings"]
+        sec = next(iter(api.secrets.values()))
+        assert sec["type"] == "kubernetes.io/service-account-token"
+        assert "data" in sec  # control plane minted the token
+
+        # Gated pods carry --kube, never an operator URL or --namespace
+        # (the in-cluster namespace file is authoritative).
+        gated = [
+            p for p in api.pods.values()
+            if p.get("spec", {}).get("initContainers")
+        ]
+        assert gated, "expected startsAfter pods with injected initc"
+        for p in gated:
+            args = p["spec"]["initContainers"][0]["args"]
+            assert "--kube" in args, args
+            assert not any(a.startswith("--server") for a in args), args
+            assert not any(a.startswith("--namespace") for a in args), args
+    finally:
+        m.stop()

@@ -85,3 +85,48 @@ def test_wait_for_accelerator_force_cpu_env(monkeypatch):
     platform, err = plat.wait_for_accelerator(wait_budget_s=100.0)
     assert (platform, err) == ("cpu", None)
     assert called == [True]
+
+
+def test_enable_compilation_cache_sets_jax_config(tmp_path):
+    """The persistent-cache knob must actually configure jax (and create
+    the dir); errors degrade to False, never raise."""
+    import jax
+
+    from grove_tpu.utils.platform import enable_compilation_cache
+
+    d = str(tmp_path / "xla-cache")
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        assert enable_compilation_cache(d) is True
+        assert jax.config.jax_compilation_cache_dir == d
+        assert jax.config.jax_enable_compilation_cache is True
+        import os
+
+        assert os.path.isdir(d)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_manager_wires_compilation_cache(tmp_path):
+    import jax
+
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    d = str(tmp_path / "cc")
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "solver": {"compilationCacheDir": d},
+        }
+    )
+    assert not errors, errors
+    before = jax.config.jax_compilation_cache_dir
+    m = Manager(cfg)
+    m.start()
+    try:
+        assert jax.config.jax_compilation_cache_dir == d
+    finally:
+        m.stop()
+        jax.config.update("jax_compilation_cache_dir", before)

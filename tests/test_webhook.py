@@ -600,9 +600,11 @@ def test_handle_authorize_blocks_non_operator_mutation():
     )
     assert out["response"]["allowed"] is True
 
-    # DELETE (only oldObject present): still denied for strangers.
+    # DELETE (only oldObject present): still denied for strangers — for
+    # CR kinds; Pod DELETE is the reference's universal exception, pinned
+    # in test_authorize_pod_delete_allowed_for_everyone.
     out = handle_authorize(
-        _authz_review("Pod", "a-0-prefill-x1", "alice", operation="DELETE"),
+        _authz_review("PodClique", "a-0-prefill", "alice", operation="DELETE"),
         chain, ops,
     )
     assert out["response"]["allowed"] is False
@@ -730,3 +732,62 @@ def test_authorizer_webhook_rules_cover_status_subresources():
     grove_rule = next(r for r in authz["rules"] if r["apiGroups"] == ["grove.io"])
     assert "podcliques/status" in grove_rule["resources"]
     assert "podcliquescalinggroups/status" in grove_rule["resources"]
+
+
+def test_authorize_pod_delete_allowed_for_everyone():
+    """Reference exception (handler.go:121-124): Pod DELETE is allowed for
+    all users — the kubelet's completion deletes and the GC's
+    owner-reference cascade are system identities no exempt list could
+    enumerate; the rendered rules don't even register pods DELETE."""
+    from grove_tpu.api.admission import Authorizer
+    from grove_tpu.api.webhook import handle_authorize
+    from grove_tpu.deploy import _render_webhook_objects
+
+    chain = AdmissionChain(authorizer=Authorizer(enabled=True))
+    out = handle_authorize(
+        _authz_review("Pod", "a-0-x-1", "system:node:n7", operation="DELETE"),
+        chain, frozenset(),
+    )
+    assert out["response"]["allowed"] is True
+    # But UPDATE of a managed pod by a stranger still denies.
+    out = handle_authorize(
+        _authz_review("Pod", "a-0-x-1", "system:node:n7"), chain, frozenset()
+    )
+    assert out["response"]["allowed"] is False
+
+    vwc = next(
+        d for d in _render_webhook_objects("ns", authorizer=True)
+        if d["kind"] == "ValidatingWebhookConfiguration"
+    )
+    pod_rule = next(
+        r for r in vwc["webhooks"][1]["rules"] if r["resources"] == ["pods"]
+    )
+    assert pod_rule["operations"] == ["UPDATE"]
+
+
+def test_authorize_disable_protection_annotation_bypasses():
+    """grove.io/disable-managed-resource-protection: "true" on the parent
+    PCS admits anyone (handler.go:89-93); resolved via pcs_lookup."""
+    from grove_tpu.api.admission import Authorizer
+    from grove_tpu.api.types import PodCliqueSet
+    from grove_tpu.api.webhook import handle_authorize
+
+    chain = AdmissionChain(authorizer=Authorizer(enabled=True))
+    pcs = PodCliqueSet.from_dict(
+        {"metadata": {"name": "a", "annotations":
+                      {"grove.io/disable-managed-resource-protection": "true"}},
+         "spec": {"template": {"cliques": []}}}
+    )
+    review = _authz_review("PodClique", "a-0-prefill", "alice")
+    review["request"]["object"]["metadata"]["labels"][
+        "app.kubernetes.io/part-of"
+    ] = "a"
+    out = handle_authorize(
+        review, chain, frozenset(), pcs_lookup={"a": pcs}.get
+    )
+    assert out["response"]["allowed"] is True
+    # Annotation absent (or PCS unknown): still denied.
+    out = handle_authorize(
+        review, chain, frozenset(), pcs_lookup={}.get
+    )
+    assert out["response"]["allowed"] is False
