@@ -911,6 +911,7 @@ class Manager:
             leader=self._is_leader,
             health_port=self.health_port,
             backend_port=self.backend_port,
+            webhook_port=self.webhook_port,
         )
 
     def _bind_server(

@@ -19,6 +19,7 @@ import signal
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -68,25 +69,23 @@ def _post(port: int, path: str, body: str, method: str = "POST") -> dict:
         return json.loads(r.read().decode())
 
 
-@pytest.fixture
-def operator_proc(tmp_path, request):
-    cfg = tmp_path / "config.yaml"
-    cfg.write_text(CONFIG)
+def _spawn_operator(cfg_path):
+    """Boot the binary and parse the structured `manager started` line (it
+    carries the auto-assigned ports; log.format=json makes it
+    machine-readable). Returns (proc, start_doc|None, captured_lines).
+    Stderr is read on a thread: a wedged subprocess that emits nothing must
+    fail at the deadline, not hang the session in readline()."""
+    import queue
+    import threading
+
     proc = subprocess.Popen(
-        [sys.executable, "-m", "grove_tpu.runtime", "--config", str(cfg)],
+        [sys.executable, "-m", "grove_tpu.runtime", "--config", str(cfg_path)],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
         cwd=REPO,
         env=ENV,
     )
-    # The structured log line `manager started` carries the auto-assigned
-    # health port (log.format=json makes it machine-readable). Read stderr on
-    # a thread: a wedged subprocess that emits nothing must fail the test at
-    # the deadline, not hang the session in readline().
-    import queue
-    import threading
-
     lines_q: queue.Queue = queue.Queue()
 
     def _reader():
@@ -94,7 +93,6 @@ def operator_proc(tmp_path, request):
             lines_q.put(line)
 
     threading.Thread(target=_reader, daemon=True).start()
-    port = None
     deadline = time.time() + 30
     lines = []
     while time.time() < deadline:
@@ -108,11 +106,19 @@ def operator_proc(tmp_path, request):
         except ValueError:
             continue
         if doc.get("msg") == "manager started":
-            port = doc["health_port"]
-            break
-    if port is None:
+            return proc, doc, lines
+    return proc, None, lines
+
+
+@pytest.fixture
+def operator_proc(tmp_path, request):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(CONFIG)
+    proc, start_doc, lines = _spawn_operator(cfg)
+    if start_doc is None:
         proc.kill()
         pytest.fail(f"operator did not start: {''.join(lines)}")
+    port = start_doc["health_port"]
     yield proc, port
     # Failure diagnostics BEFORE the kill: dump the live operator's whole
     # object state when the test body failed (debug_utils.go analog;
@@ -341,3 +347,66 @@ def test_diag_dump_produced_on_forced_failure(tmp_path):
     assert doc["nodes"], "dump carries the fleet"
     assert "statusz" in doc and "events" in doc
     assert "test_forced_failure_for_diag" in doc["test"]
+
+
+def test_operator_binary_serves_webhooks(tmp_path):
+    """Process tier for the inbound admission surface: the real binary with
+    servers.webhookPort serves AdmissionReview over HTTPS on its own port
+    (mutate patches, validate denies), and the API port carries none of it."""
+    import yaml as _yaml
+
+    cfg = tmp_path / "config.yaml"
+    doc = _yaml.safe_load(CONFIG)
+    doc["servers"]["webhookPort"] = 0
+    doc["servers"]["tlsCertDir"] = str(tmp_path / "certs")
+    cfg.write_text(_yaml.safe_dump(doc))
+
+    proc, start_doc, lines = _spawn_operator(cfg)
+    try:
+        assert start_doc, f"operator did not start: {''.join(lines)}"
+        health_port = start_doc["health_port"]
+        webhook_port = start_doc["webhook_port"]
+        assert webhook_port and webhook_port != health_port
+
+        from grove_tpu.runtime.certs import pinned_client_context
+
+        ctx = pinned_client_context(str(tmp_path / "certs" / "webhook" / "tls.crt"))
+        with open(REPO / "examples" / "simple1.yaml") as f:
+            pcs_doc = _yaml.safe_load(f)
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": "e2e-1", "operation": "CREATE", "object": pcs_doc},
+        }
+        req = urllib.request.Request(
+            f"https://127.0.0.1:{webhook_port}/webhook/v1/default",
+            data=json.dumps(review).encode(),
+            method="POST",
+        )
+        out = json.loads(urllib.request.urlopen(req, context=ctx, timeout=10).read())
+        assert out["response"]["allowed"] is True and out["response"]["patch"]
+
+        bad = _yaml.safe_load((REPO / "examples" / "simple1.yaml").read_text())
+        bad["spec"]["template"]["cliques"][0]["spec"]["startsAfter"] = ["frontend"]
+        review["request"]["object"] = bad
+        req = urllib.request.Request(
+            f"https://127.0.0.1:{webhook_port}/webhook/v1/validate",
+            data=json.dumps(review).encode(),
+            method="POST",
+        )
+        out = json.loads(urllib.request.urlopen(req, context=ctx, timeout=10).read())
+        assert out["response"]["allowed"] is False
+
+        # The plaintext API port must 404 the webhook paths.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{health_port}/webhook/v1/default",
+            data=json.dumps(review).encode(),
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 404
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
