@@ -341,6 +341,23 @@ class KubernetesWatchSource:
                     "PodCliqueSet", self._pcs_path, missing_backoff_s=30.0
                 )
             )
+            # Child CR projections are operator-owned, but their SCALE
+            # subresource is a public surface (reference: HPA ScaleTargetRef
+            # -> PCLQ/PCSG scale, components/hpa/hpa.go:249-259; kubectl
+            # scale pclq). Watching them turns external spec.replicas writes
+            # into scale events; echoes of our own projection PUTs compare
+            # equal at the driver and cost nothing.
+            for kind, plural in (
+                ("PodClique", "podcliques"),
+                ("PodCliqueScalingGroup", "podcliquescalinggroups"),
+            ):
+                self._watches.append(
+                    _ResourceWatch(
+                        kind,
+                        f"/apis/grove.io/v1alpha1/namespaces/{ns}/{plural}",
+                        missing_backoff_s=30.0,
+                    )
+                )
         # Wire-visible error log (last few), surfaced via statusz/tests.
         self.errors: list[str] = []
         # Managed Services mirrored to the cluster: name -> last manifest.
@@ -728,6 +745,20 @@ class KubernetesWatchSource:
                     continue
             del cache[name]
         return ok
+
+    def last_projected_replicas(self, name: str) -> Optional[int]:
+        """spec.replicas of the child-CR manifest THIS process last pushed
+        (None = never pushed / pre-existing from before a restart). The
+        child-scale sink uses it to tell external writes from echoes and
+        relist replays of our own projections — store state can't do that:
+        a pending override makes the store disagree with what's actually on
+        the wire."""
+        for plural in ("podcliques", "podcliquescalinggroups"):
+            manifest = self._synced_children.get(plural, {}).get(name)
+            if isinstance(manifest, dict) and "spec" in manifest:
+                reps = (manifest.get("spec") or {}).get("replicas")
+                return reps if isinstance(reps, int) else None
+        return None
 
     def sync_workload_children(self, podcliques: list, scaling_groups: list) -> bool:
         """Mirror the operator-owned PodClique / PodCliqueScalingGroup

@@ -68,6 +68,7 @@ class WatchDriver:
     # manager's admission-gated apply/delete path, NOT written raw into the
     # store — watch events never bypass the webhook-analog chain.
     workload_sink: Optional[object] = None  # callable(WatchEvent)
+    child_scale_sink: Optional[object] = None  # callable(WatchEvent, now)
     # pods we've told the source about (bind pushed), and known-deleted pods
     _pushed_bindings: set[str] = field(default_factory=set)
     # pods whose bind FAILED after the source may have already materialized
@@ -100,6 +101,14 @@ class WatchDriver:
                     # if the status itself hasn't changed since.
                     self._pushed_status.pop(ev.name, None)
                 self.workload_sink(ev, now)
+            elif (
+                ev.kind in ("PodClique", "PodCliqueScalingGroup")
+                and self.child_scale_sink is not None
+            ):
+                # External writes to the child CRs' scale subresource
+                # (kubectl scale pclq / a cluster HPA); echoes of our own
+                # projection PUTs no-op inside the sink.
+                self.child_scale_sink(ev, now)
         # Dirty-flag, not event-count, gates forwarding: a failed UpdateCluster
         # (sidecar briefly down) must retry on the NEXT pump even if no new
         # node events arrive in between.

@@ -100,10 +100,12 @@ def render_child_crds() -> list[dict]:
     """PodClique + PodCliqueScalingGroup CRDs: the operator-owned child
     objects are projected to the apiserver as CRs with live status
     (`kubectl get pclq,pcsg` — the reference materializes the same kinds).
-    Read-only projections: no scale subresource — the operator is the sole
-    writer of these CRs (an HPA writing spec.replicas here would silently
-    fight the projection; scale through the PodCliqueSet CR or the
-    operator's API instead)."""
+    Status is operator-owned, but spec.replicas via the SCALE subresource is
+    a public surface (reference: HPA ScaleTargetRef targets PCLQ/PCSG scale,
+    components/hpa/hpa.go:249-259): the operator watches these CRs and turns
+    external replica writes into the same scale path its own HPA step and
+    the CLI scale verb use — so `kubectl scale pclq/pcsg` and cluster HPAs
+    work."""
     preserve = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
     out = []
     for kind, plural, singular, short in (
@@ -144,7 +146,14 @@ def render_child_crds() -> list[dict]:
                                     },
                                 }
                             },
-                            "subresources": {"status": {}},
+                            "subresources": {
+                                "status": {},
+                                "scale": {
+                                    "specReplicasPath": ".spec.replicas",
+                                    "statusReplicasPath": ".status.replicas",
+                                    "labelSelectorPath": ".status.selector",
+                                },
+                            },
                         }
                     ],
                 },

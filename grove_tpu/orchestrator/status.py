@@ -59,14 +59,15 @@ def compute_podclique_status(
     cluster: Cluster, clique: PodClique, now: float, updating: bool = False
 ) -> None:
     """Recompute clique status + conditions in place."""
-    if clique.spec.scale_config is not None:
-        # Autoscaler selector (reference fills it only when scaling is
-        # configured, reconcilestatus.go:150-167).
-        clique.status.selector = _hpa_selector(
-            constants.LABEL_PODCLIQUE, clique.metadata.name, clique.pcs_name
-        )
-    else:
-        clique.status.selector = ""  # scaleConfig removed: no stale selector
+    # Autoscaler selector. The reference fills it only when scaling is
+    # configured (reconcilestatus.go:150-167); here it is ALWAYS populated —
+    # the child CRD's scale subresource names .status.selector as
+    # labelSelectorPath, and a cluster HPA targeting a non-auto-scaled
+    # clique would fail on an empty selector. The selector is a pure
+    # function of the clique's identity, so there is nothing to go stale.
+    clique.status.selector = _hpa_selector(
+        constants.LABEL_PODCLIQUE, clique.metadata.name, clique.pcs_name
+    )
     pods = [p for p in cluster.pods_of_clique(clique.metadata.name) if p.is_active]
     scheduled = sum(1 for p in pods if p.is_scheduled)
     ready = sum(1 for p in pods if p.ready)
@@ -126,18 +127,13 @@ def compute_pcsg_status(
     cluster: Cluster, pcsg: PodCliqueScalingGroup, now: float, updating: bool = False
 ) -> None:
     """Aggregate member-clique state per PCSG replica."""
-    owner = cluster.podcliquesets.get(pcsg.pcs_name)
-    if owner is not None and any(
-        cfg.name == pcsg.template_name and cfg.scale_config is not None
-        for cfg in owner.spec.template.pod_clique_scaling_group_configs
-    ):
-        # Autoscaler selector, only when scaling is configured (the
-        # reference's gate, podcliquescalinggroup/reconcilestatus.go:245).
-        pcsg.status.selector = _hpa_selector(
-            constants.LABEL_SCALING_GROUP, pcsg.metadata.name, pcsg.pcs_name
-        )
-    else:
-        pcsg.status.selector = ""
+    # Always populated (deviation from the reference's scaling-configured
+    # gate, podcliquescalinggroup/reconcilestatus.go:245, for the same
+    # reason as the clique selector above: the CRD's scale subresource
+    # names .status.selector, and it is a pure function of identity).
+    pcsg.status.selector = _hpa_selector(
+        constants.LABEL_SCALING_GROUP, pcsg.metadata.name, pcsg.pcs_name
+    )
     members = cluster.cliques_of_pcsg(pcsg.metadata.name)
     by_replica: dict[int, list[PodClique]] = defaultdict(list)
     for c in members:

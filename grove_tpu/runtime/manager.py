@@ -636,6 +636,53 @@ class Manager:
         self.admission.admit_managed_mutation(actor, kind, name)
         fn(self.cluster)
 
+    def _apply_child_scale_event(self, ev, now: float) -> None:
+        """PodClique/PCSG CR watch event -> the scale subresource path.
+
+        The child CRs are operator-owned projections, but their spec.replicas
+        is the reference's public scale surface (HPA ScaleTargetRef,
+        hpa.go:249-259; kubectl scale pclq): an external value becomes a
+        scale_target() call — the SAME path the in-process HPA and the CLI
+        scale verb use (ceilings included).
+
+        External-vs-echo is decided against what THIS process last PUSHED to
+        the apiserver (source.last_projected_replicas), not against store
+        state: a pending override makes the store disagree with the wire, so
+        a relist replaying our own stale projection would otherwise read as
+        an external write and revert a just-applied scale. A replica count
+        equal to our last push is indistinguishable from our own echo (the
+        inherent limit of a level-based watch) and is ignored; after an
+        operator restart nothing has been pushed yet, so a CR value
+        differing from the freshly-expanded spec is re-adopted — an external
+        scale survives the restart."""
+        if ev.type.value == "DELETED":
+            return  # our own GC, or an out-of-band delete the sync heals
+        spec = (ev.obj or {}).get("spec", {}) or {}
+        reps = spec.get("replicas")
+        if not isinstance(reps, int) or isinstance(reps, bool):
+            return
+        c = self.cluster
+        cur = c.podcliques.get(ev.name) or c.scaling_groups.get(ev.name)
+        if cur is None:
+            return  # projection of an object the store no longer owns
+        last = (
+            self._kube_source.last_projected_replicas(ev.name)
+            if self._kube_source is not None
+            else None
+        )
+        if last is not None:
+            if reps == last:
+                return  # our own write (live echo or relist replay)
+        elif cur.spec.replicas == reps:
+            return  # nothing pushed yet and the CR agrees with the store
+        if c.scale_overrides.get(ev.name) == reps:
+            return  # already requested; projection just hasn't caught up
+        try:
+            self.scale_target(ev.name, reps, actor="apiserver", now=now)
+        except (KeyError, ValueError) as e:
+            # Out-of-range external scale: surface, don't crash the pump.
+            c.record_event(now, ev.name, f"CR scale rejected: {e}")
+
     def _apply_workload_event(self, ev, now: float) -> None:
         """PodCliqueSet watch event -> admission-gated apply / cascade
         delete. Rejections surface as control-plane events (the CR stays in
@@ -900,6 +947,7 @@ class Manager:
             # admission -> store; SURVEY §3.2-3.3) — the same chain the
             # HTTP apply path runs, so watch events can't bypass admission.
             driver.workload_sink = self._apply_workload_event
+            driver.child_scale_sink = self._apply_child_scale_event
             self.log.info(
                 "kubernetes cluster attached",
                 server=ctx.server,

@@ -74,6 +74,8 @@ class FixtureApiServer:
             "nodes": [],
             "pods": [],
             "podcliquesets": [],
+            "podcliques": [],
+            "podcliquescalinggroups": [],
         }
         self._fail_watch_code: int | None = None
         self.binding_log: list[tuple[str, str]] = []  # (pod, node) in order
@@ -196,16 +198,28 @@ class FixtureApiServer:
                 if plural is not None:
                     rest = parsed.path[len(fixture._child_prefix(plural)):]
                     name = rest.lstrip("/")
+                    if not name:  # list/watch: generic machinery (rv + streams)
+                        if qs.get("watch") == "1":
+                            fixture._serve_watch(self, plural, qs)
+                        else:
+                            self._json(200, fixture._list_doc(plural, qs))
+                        return
+                    if name.endswith("/scale"):
+                        # kubectl-scale reads the scale subresource first.
+                        base = name[: -len("/scale")]
+                        with fixture._lock:
+                            obj = fixture.child_crs[plural].get(base)
+                        if obj is None:
+                            self._json(404, {"kind": "Status", "code": 404})
+                        else:
+                            self._json(200, {
+                                "kind": "Scale",
+                                "metadata": {"name": base},
+                                "spec": {"replicas": (obj.get("spec", {}) or {}).get("replicas", 0)},
+                                "status": {"replicas": (obj.get("status", {}) or {}).get("replicas", 0)},
+                            })
+                        return
                     with fixture._lock:
-                        if not name:  # list
-                            items = [
-                                o for o in fixture.child_crs[plural].values()
-                                if fixture._matches(
-                                    o, qs.get("labelSelector", "")
-                                )
-                            ]
-                            self._json(200, {"kind": "List", "items": items})
-                            return
                         obj = fixture.child_crs[plural].get(name)
                     if obj is None:
                         self._json(404, {"kind": "Status", "code": 404})
@@ -261,7 +275,23 @@ class FixtureApiServer:
                             return
                         if sub == "status":
                             cur["status"] = body.get("status", {})
+                            fixture._emit(plural, "MODIFIED", cur)
                             self._json(200, json.loads(json.dumps(cur)))
+                            return
+                        if sub == "scale":
+                            # kubectl-scale / HPA write surface: only
+                            # spec.replicas is taken from the Scale body.
+                            reps = (body.get("spec", {}) or {}).get("replicas")
+                            if not isinstance(reps, int):
+                                self._json(
+                                    422, {"kind": "Status", "code": 422}
+                                )
+                                return
+                            cur.setdefault("spec", {})["replicas"] = reps
+                            fixture._rv += 1
+                            cur["metadata"]["resourceVersion"] = str(fixture._rv)
+                            fixture._emit(plural, "MODIFIED", cur)
+                            self._json(200, json.loads(json.dumps(body)))
                             return
                         sent_rv = body.get("metadata", {}).get("resourceVersion")
                         if sent_rv != cur["metadata"].get("resourceVersion"):
@@ -276,6 +306,7 @@ class FixtureApiServer:
                         fixture._rv += 1
                         body["metadata"]["resourceVersion"] = str(fixture._rv)
                         fixture.child_crs[plural][name] = body
+                        fixture._emit(plural, "MODIFIED", body)
                     self._json(200, json.loads(json.dumps(body)))
                 elif parsed.path.startswith(fixture._ct_prefix + "/"):
                     name = parsed.path[len(fixture._ct_prefix) + 1:]
@@ -519,6 +550,8 @@ class FixtureApiServer:
             "nodes": self.nodes,
             "pods": self.pods,
             "podcliquesets": self.podcliquesets,
+            "podcliques": self.child_crs["podcliques"],
+            "podcliquescalinggroups": self.child_crs["podcliquescalinggroups"],
         }[resource]
 
     def _matches(self, obj: dict, selector: str) -> bool:
@@ -543,6 +576,8 @@ class FixtureApiServer:
             "nodes": "NodeList",
             "pods": "PodList",
             "podcliquesets": "PodCliqueSetList",
+            "podcliques": "PodCliqueList",
+            "podcliquescalinggroups": "PodCliqueScalingGroupList",
         }[resource]
         return {
             "apiVersion": "v1",
@@ -759,6 +794,7 @@ class FixtureApiServer:
                 self._rv += 1
                 body.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
                 self.child_crs[plural][name] = body
+                self._emit(plural, "ADDED", body)
             return 201, json.loads(json.dumps(body))
         svc_prefix = f"/api/v1/namespaces/{self.namespace}/services"
         if path == svc_prefix:
@@ -812,8 +848,10 @@ class FixtureApiServer:
         if plural is not None:
             name = path[len(self._child_prefix(plural)) + 1:]
             with self._lock:
-                if self.child_crs[plural].pop(name, None) is None:
+                obj = self.child_crs[plural].pop(name, None)
+                if obj is None:
                     return 404, {"kind": "Status", "code": 404}
+                self._emit(plural, "DELETED", obj)
             return 200, {"kind": "Status", "code": 200}
         sec_prefix = f"/api/v1/namespaces/{self.namespace}/secrets/"
         if path.startswith(sec_prefix):

@@ -1519,3 +1519,227 @@ def test_kube_initc_mode_end_to_end(api, tmp_path):
             assert not any(a.startswith("--namespace") for a in args), args
     finally:
         m.stop()
+
+
+def test_kubectl_scale_child_cr_drives_operator(api, tmp_path):
+    """The child CRs' scale subresource is a live write surface (reference:
+    HPA ScaleTargetRef -> PCLQ/PCSG scale, hpa.go:249-259): a kubectl-scale
+    PUT at the apiserver flows through the child-CR watch into the SAME
+    scale path the in-process HPA uses, pods follow, and the projection
+    converges to the new replica count. Echoes of the operator's own
+    projection writes must not re-trigger scaling."""
+    import json
+    import urllib.request as _rq
+
+    import yaml as _yaml
+
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    for i in range(10):
+        api.add_node(
+            k8s_node(
+                f"n{i}", cpu="8", memory="32Gi",
+                labels={
+                    "topology.kubernetes.io/zone": "z0",
+                    "topology.kubernetes.io/block": "b0",
+                    "topology.kubernetes.io/rack": f"r{i % 2}",
+                },
+            )
+        )
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        with open("examples/simple1.yaml") as f:
+            api.apply_pcs(_yaml.safe_load(f))
+        deadline = time.monotonic() + 30.0
+        t = 0.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if "simple1-0-frontend" in api.child_crs["podcliques"]:
+                break
+            time.sleep(0.05)
+        assert "simple1-0-frontend" in api.child_crs["podcliques"]
+        frontend_pods = lambda: [  # noqa: E731
+            p for p in m.cluster.pods.values()
+            if p.pclq_fqn == "simple1-0-frontend" and p.is_active
+        ]
+        assert len(frontend_pods()) == 3  # spec default
+
+        # kubectl scale pclq simple1-0-frontend --replicas=5 (HPA max is 5).
+        scale_url = (
+            f"{api.url}/apis/grove.io/v1alpha1/namespaces/default/"
+            "podcliques/simple1-0-frontend/scale"
+        )
+        req = _rq.Request(
+            scale_url,
+            data=json.dumps({"spec": {"replicas": 5}}).encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if (
+                len(frontend_pods()) == 5
+                and api.child_crs["podcliques"]["simple1-0-frontend"]["spec"][
+                    "replicas"
+                ]
+                == 5
+            ):
+                break
+            time.sleep(0.05)
+        assert len(frontend_pods()) == 5, "scale never materialized"
+        assert m.cluster.scale_overrides.get("simple1-0-frontend") == 5
+
+        # Echo guard: keep reconciling; the projection's own writes must not
+        # flap the override or spawn scale events.
+        events_before = len(m.cluster.events)
+        for _ in range(5):
+            t += 1.0
+            m.reconcile_once(now=t)
+            time.sleep(0.02)
+        scale_events = [
+            e for e in m.cluster.events[events_before:] if "scaled" in e[2]
+        ]
+        assert not scale_events, scale_events
+
+        # Out-of-range external scale (HPA ceiling 5): rejected with an
+        # event, not applied, loop stays alive.
+        req = _rq.Request(
+            scale_url,
+            data=json.dumps({"spec": {"replicas": 50}}).encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if any("CR scale rejected" in e[2] for e in m.cluster.events):
+                break
+            time.sleep(0.05)
+        assert any("CR scale rejected" in e[2] for e in m.cluster.events)
+        assert m.cluster.scale_overrides.get("simple1-0-frontend") == 5
+    finally:
+        m.stop()
+
+
+def test_child_scale_relist_replay_does_not_revert(api, tmp_path):
+    """Race regression: a watch relist replaying the operator's OWN stale
+    projection (spec.replicas from before an in-process scale) must not be
+    misread as an external write — the sink compares against what this
+    process last PUSHED, not against store state."""
+    import json
+
+    import yaml as _yaml
+
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    for i in range(10):
+        api.add_node(k8s_node(f"n{i}", cpu="8", memory="32Gi"))
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        with open("examples/simple1.yaml") as f:
+            api.apply_pcs(_yaml.safe_load(f))
+        t = 0.0
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if "simple1-0-frontend" in api.child_crs["podcliques"]:
+                break
+            time.sleep(0.05)
+
+        # The race window: the projection PUT cannot land (apiserver blip)
+        # while an in-process scale (the HPA/CLI path) raises replicas to 5
+        # — the apiserver (and our last-pushed cache) still say 3.
+        src = m._kube_source
+        real_sync = src.sync_workload_children
+        src.sync_workload_children = lambda *a, **k: False
+        m.scale_target("simple1-0-frontend", 5, actor="user", now=t)
+        for _ in range(5):
+            t += 1.0
+            m.reconcile_once(now=t)
+            time.sleep(0.02)
+        assert m.cluster.scale_overrides["simple1-0-frontend"] == 5
+
+        # The same blip makes the watch relist, replaying our own STALE
+        # projection (replicas=3). Store says 5, but the sink must
+        # recognize 3 as what WE last pushed — not an external write.
+        stale = json.loads(
+            json.dumps(api.child_crs["podcliques"]["simple1-0-frontend"])
+        )
+        assert stale["spec"]["replicas"] == 3  # apiserver never saw the 5
+        api._emit("podcliques", "ADDED", stale)
+        for _ in range(5):
+            t += 1.0
+            m.reconcile_once(now=t)
+            time.sleep(0.02)
+        # The stale replay must NOT revert the scale...
+        assert m.cluster.scale_overrides["simple1-0-frontend"] == 5
+
+        # Sync recovers; the projection converges to 5.
+        src.sync_workload_children = real_sync
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if (
+                api.child_crs["podcliques"]["simple1-0-frontend"]["spec"][
+                    "replicas"
+                ]
+                == 5
+            ):
+                break
+            time.sleep(0.02)
+
+        # ...but a genuinely external write (differs from our last push)
+        # still lands.
+        ext = json.loads(
+            json.dumps(api.child_crs["podcliques"]["simple1-0-frontend"])
+        )
+        ext["spec"]["replicas"] = 4
+        api.child_crs["podcliques"]["simple1-0-frontend"]["spec"]["replicas"] = 4
+        api._emit("podcliques", "MODIFIED", ext)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if m.cluster.scale_overrides.get("simple1-0-frontend") == 4:
+                break
+            time.sleep(0.02)
+        assert m.cluster.scale_overrides["simple1-0-frontend"] == 4
+    finally:
+        m.stop()

@@ -198,7 +198,10 @@ def test_hpa_selector_populated_for_scaled_targets(simple1):
         assert pod.labels.get(k) == v, f"selector clause {clause} unmatched"
 
     router = c.podcliques["simple1-0-router"]
-    assert router.status.selector == ""  # no scaleConfig: no selector
+    # Selector is populated even without scaleConfig: the child CRD's scale
+    # subresource names .status.selector, and a cluster HPA targeting a
+    # non-auto-scaled clique needs it (pure function of identity).
+    assert "grove.io/podclique=simple1-0-router" in router.status.selector
 
     pcsg = c.scaling_groups["simple1-0-workers"]
     sel = pcsg.status.selector
