@@ -108,6 +108,15 @@ class ClusterTopology:
             levels=[*self.levels, TopologyLevel(TopologyDomain.HOST, "kubernetes.io/hostname")],
         )
 
+    def levels_doc(self) -> list[dict]:
+        """The wire shape of the effective hierarchy (host level included,
+        broadest first) — the ONE rendering both the synced ClusterTopology
+        CR and /statusz (CLI `get topology`) use."""
+        return [
+            {"domain": lvl.domain.value, "nodeLabelKey": lvl.node_label_key}
+            for lvl in self.with_host_level().sorted_levels()
+        ]
+
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ClusterTopology":
         spec = d.get("spec", d)

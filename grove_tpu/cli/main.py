@@ -3,7 +3,7 @@
 Talks to a running manager (`python -m grove_tpu.runtime`) over its object
 API via the typed client. Commands:
 
-  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas   table listing
+  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology   table listing
   get <kind> <name>                             full object as JSON
   describe <kind> <name>                        human detail + object events
   apply -f <file.yaml>                          admit a PodCliqueSet
@@ -49,6 +49,10 @@ KIND_ALIASES = {
     "hpas": "hpas",
     "queue": "queues",
     "queues": "queues",
+    "ct": "topology",
+    "topology": "topology",
+    "clustertopology": "topology",
+    "clustertopologies": "topology",
 }
 
 
@@ -115,6 +119,14 @@ def _get_table(client: GroveClient, kind: str) -> str:
             cap = ",".join(f"{k}={v:g}" for k, v in sorted(obj.capacity.items()))
             rows.append([name, "yes" if obj.schedulable else "no", cap])
         return _table(rows, ["NAME", "SCHEDULABLE", "CAPACITY"])
+    if kind == "topology":
+        # kubectl get clustertopology analog: the effective level hierarchy
+        # (config TAS levels + auto host level) from /statusz.
+        rows = [
+            [lvl.get("domain", "?"), lvl.get("nodeLabelKey", "?")]
+            for lvl in client.statusz().get("topology", [])
+        ]
+        return _table(rows, ["DOMAIN", "NODELABELKEY"])
     if kind == "queues":
         rows = []
         for qname, doc in sorted(client.statusz().get("queues", {}).items()):

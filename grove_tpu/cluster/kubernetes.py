@@ -746,6 +746,15 @@ class KubernetesWatchSource:
             del cache[name]
         return ok
 
+    def invalidate_child_projection(self, name: str) -> None:
+        """Drop the sync cache entry for one child CR so the next push
+        re-PUTs it even though the DESIRED manifest hasn't changed — the
+        heal for an external write the operator rejected (the wire changed
+        behind the cache's back; without this the CR would show the
+        rejected value forever)."""
+        for plural in ("podcliques", "podcliquescalinggroups"):
+            self._synced_children.get(plural, {}).pop(name, None)
+
     def last_projected_replicas(self, name: str) -> Optional[int]:
         """spec.replicas of the child-CR manifest THIS process last pushed
         (None = never pushed / pre-existing from before a restart). The
@@ -877,10 +886,7 @@ class KubernetesWatchSource:
         cluster without the CRD returns False and the operator runs on its
         in-memory topology."""
         path = "/apis/grove.io/v1alpha1/clustertopologies/grove-topology"
-        levels = [
-            {"domain": lvl.domain.value, "nodeLabelKey": lvl.node_label_key}
-            for lvl in topology.with_host_level().sorted_levels()
-        ]
+        levels = topology.levels_doc()
         body = {
             "apiVersion": "grove.io/v1alpha1",
             "kind": "ClusterTopology",

@@ -508,6 +508,9 @@ class Manager:
         self._webhook_tls_paths: Optional[tuple[str, str]] = None
         self._webhook_ca_pending = False  # boot patch failed; retry in reconcile
         self._operator_users: Optional[frozenset] = None  # cached (static)
+        # Child-CR scale values already rejected (ceilings): name -> value.
+        # Guards against per-replay event spam until the healing PUT lands.
+        self._rejected_child_scales: dict[str, int] = {}
         # /profilez state: per-step cumulative seconds + call counts.
         self._profile: dict[str, dict[str, float]] = {}
         # Watch driver (cluster integration path): attached via attach_watch;
@@ -677,11 +680,21 @@ class Manager:
             return  # nothing pushed yet and the CR agrees with the store
         if c.scale_overrides.get(ev.name) == reps:
             return  # already requested; projection just hasn't caught up
+        if self._rejected_child_scales.get(ev.name) == reps:
+            return  # already rejected this exact value; no event spam
         try:
             self.scale_target(ev.name, reps, actor="apiserver", now=now)
+            self._rejected_child_scales.pop(ev.name, None)
         except (KeyError, ValueError) as e:
-            # Out-of-range external scale: surface, don't crash the pump.
+            # Out-of-range external scale: surface once, don't crash the
+            # pump — and heal the wire: invalidate the projection cache so
+            # the next sync re-PUTs the effective manifest (the external
+            # write changed the CR behind the cache's back; without this
+            # kubectl would show the rejected value forever).
+            self._rejected_child_scales[ev.name] = reps
             c.record_event(now, ev.name, f"CR scale rejected: {e}")
+            if self._kube_source is not None:
+                self._kube_source.invalidate_child_projection(ev.name)
 
     def _apply_workload_event(self, ev, now: float) -> None:
         """PodCliqueSet watch event -> admission-gated apply / cascade
@@ -786,6 +799,11 @@ class Manager:
         return {
             "build": build_info(),
             "queues": queues,
+            # The effective ClusterTopology (config TAS levels + auto host
+            # level) — what `grove-tpu get topology` renders (kubectl get
+            # clustertopology analog; the kubernetes source also syncs it
+            # as a CR at boot).
+            "topology": self.topology.levels_doc(),
             "leader": self._is_leader,
             "backend_port": self.backend_port,
             "objects": {

@@ -213,3 +213,36 @@ def test_clique_listing_scoped_to_token_pcs(simple1, simple1_variant):
         assert ei.value.status == 404
     finally:
         m.stop()
+
+
+def test_cli_get_topology_table():
+    """kubectl get clustertopology analog: the effective hierarchy (config
+    TAS levels + auto host level) as a table, on both client surfaces."""
+    from grove_tpu.cli.main import _get_table
+    from grove_tpu.client.typed import FakeGroveClient
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "topologyAwareScheduling": {
+                "levels": [
+                    {"domain": "zone", "nodeLabelKey": "topology.kubernetes.io/zone"},
+                    {"domain": "rack", "nodeLabelKey": "topology.kubernetes.io/rack"},
+                ]
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        out = _get_table(FakeGroveClient(m), "topology")
+        lines = out.splitlines()
+        assert lines[0].split() == ["DOMAIN", "NODELABELKEY"]
+        domains = [ln.split()[0] for ln in lines[1:]]
+        assert domains == ["zone", "rack", "host"]  # auto host level appended
+    finally:
+        m.stop()

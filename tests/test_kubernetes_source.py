@@ -1639,6 +1639,30 @@ def test_kubectl_scale_child_cr_drives_operator(api, tmp_path):
             time.sleep(0.05)
         assert any("CR scale rejected" in e[2] for e in m.cluster.events)
         assert m.cluster.scale_overrides.get("simple1-0-frontend") == 5
+
+        # The wire HEALS: the projection re-PUTs the effective manifest, so
+        # kubectl does not show the rejected 50 forever — and replays of the
+        # rejected value do not spam events (one rejection recorded).
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if (
+                api.child_crs["podcliques"]["simple1-0-frontend"]["spec"][
+                    "replicas"
+                ]
+                == 5
+            ):
+                break
+            time.sleep(0.05)
+        assert (
+            api.child_crs["podcliques"]["simple1-0-frontend"]["spec"]["replicas"]
+            == 5
+        ), "projection never healed the rejected CR value"
+        rejections = [
+            e for e in m.cluster.events if "CR scale rejected" in e[2]
+        ]
+        assert len(rejections) == 1, rejections
     finally:
         m.stop()
 
