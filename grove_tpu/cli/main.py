@@ -144,7 +144,14 @@ def _get_table(client: GroveClient, kind: str) -> str:
     raise AssertionError(kind)
 
 
-_DESCRIBE_KINDS = ("podcliquesets", "podgangs", "pods", "nodes")
+_DESCRIBE_KINDS = (
+    "podcliquesets",
+    "podcliques",
+    "podcliquescalinggroups",
+    "podgangs",
+    "pods",
+    "nodes",
+)
 
 
 def _fmt_conditions(conditions) -> list[str]:
@@ -184,6 +191,38 @@ def _describe(client: GroveClient, kind: str, name: str) -> str:
         if st.last_errors:
             lines.append("LastErrors:")
             lines += [f"  {e}" for e in st.last_errors]
+    elif kind in ("podcliques", "podcliquescalinggroups"):
+        # LIST-only collections on the API (by-name GET is the initc
+        # readiness endpoint); describe reads the bulk listing.
+        full = (
+            client.list_podcliques_full()
+            if kind == "podcliques"
+            else client.list_scaling_groups_full()
+        )
+        obj = full.get(name)
+        if obj is None:
+            raise GroveApiError(404, [f"{kind[:-1]} {name!r} not found"])
+        st = obj.status
+        lines += [f"Name:      {name}"]
+        if kind == "podcliques":
+            lines += [
+                f"Role:      {obj.spec.role_name}",
+                f"Replicas:  {obj.spec.replicas} desired, {st.ready_replicas} ready, "
+                f"{st.scheduled_replicas} scheduled, {st.schedule_gated_replicas} gated",
+                f"MinAvail:  {obj.min_available}",
+            ]
+        else:
+            lines += [
+                f"Replicas:  {obj.spec.replicas} desired, {st.available_replicas} "
+                f"available, {st.scheduled_replicas} scheduled",
+                f"MinAvail:  {obj.spec.min_available}",
+                f"Members:   {', '.join(obj.spec.clique_names)}",
+            ]
+        if st.selector:
+            lines.append(f"Selector:  {st.selector}")
+        if st.conditions:
+            lines.append("Conditions:")
+            lines += _fmt_conditions(st.conditions)
     elif kind == "podgangs":
         obj = client.get_podgang(name)
         st = obj.status
@@ -342,7 +381,10 @@ def main(argv=None) -> int:
         elif args.cmd == "describe":
             kind = KIND_ALIASES.get(args.kind)
             if kind not in _DESCRIBE_KINDS:
-                print("describe supports: pcs, podgangs, pods, nodes", file=sys.stderr)
+                print(
+                    "describe supports: pcs, pclq, pcsg, podgangs, pods, nodes",
+                    file=sys.stderr,
+                )
                 return 2
             print(_describe(client, kind, args.name))
         elif args.cmd == "apply":

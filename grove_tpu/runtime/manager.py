@@ -675,7 +675,13 @@ class Manager:
         )
         if last is not None:
             if reps == last:
-                return  # our own write (live echo or relist replay)
+                # Our own write (live echo or relist replay). Seeing the CR
+                # back at the pushed value also proves a heal PUT landed —
+                # clear the rejected-value guard so a SECOND genuine write
+                # of the same out-of-range value records and heals again
+                # instead of being silently ignored forever.
+                self._rejected_child_scales.pop(ev.name, None)
+                return
         elif cur.spec.replicas == reps:
             return  # nothing pushed yet and the CR agrees with the store
         if c.scale_overrides.get(ev.name) == reps:

@@ -78,6 +78,10 @@ class FixtureApiServer:
             "podcliquescalinggroups": [],
         }
         self._fail_watch_code: int | None = None
+        # Watch replay log (apiserver rv semantics): resource -> [(rv, ev)].
+        self._event_log: dict[str, list] = {}
+        # Highest tag dropped from each resource's log (compaction floor).
+        self._log_compacted: dict[str, int] = {}
         self.binding_log: list[tuple[str, str]] = []  # (pod, node) in order
         self.created_pods: list[str] = []
         self.leases: dict[str, dict] = {}
@@ -588,8 +592,27 @@ class FixtureApiServer:
 
     def _emit(self, resource: str, etype: str, obj: dict):
         self._rv += 1
+        obj = json.loads(json.dumps(obj))
+        # Stamp the event's rv into the object (apiserver behavior): the
+        # client's resume-rv advances with consumed events, so a reconnect
+        # replays only what it actually missed.
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        ev = {"type": etype, "object": obj}
+        # Event log per resource: a real apiserver REPLAYS events newer than
+        # the watch request's resourceVersion — without this, an event fired
+        # between a client's reconnects (or before its first watch request
+        # lands) is silently lost, which is exactly the gap rv-resume exists
+        # to close. Bounded like etcd's compaction window.
+        log = self._event_log.setdefault(resource, [])
+        log.append((self._rv, ev))
+        if len(log) > 2000:
+            # Track the highest compacted tag: a resume below it gets 410
+            # Gone (the signal that makes etcd's bounded window safe — the
+            # client relists instead of silently missing events).
+            self._log_compacted[resource] = log[len(log) - 2001][0]
+            del log[:-2000]
         for q in self._watchers[resource]:
-            q.put({"type": etype, "object": json.loads(json.dumps(obj))})
+            q.put(ev)
 
     def _serve_watch(self, handler, resource: str, qs: dict):
         if self._fail_watch_code is not None:
@@ -598,7 +621,38 @@ class FixtureApiServer:
             return
         selector = qs.get("labelSelector", "")
         q: queue.Queue = queue.Queue()
+        # Param ABSENT = "start at now" (no replay); PRESENT — including
+        # "0", the rv of a LIST taken before any event — = "replay
+        # everything newer than this". Conflating the two loses events
+        # emitted between an early LIST and the watch request landing.
+        raw_rv = qs.get("resourceVersion")
+        try:
+            since_rv = int(raw_rv) if raw_rv not in (None, "") else None
+        except ValueError:
+            since_rv = None
         with self._lock:
+            if (
+                since_rv is not None
+                and since_rv < self._log_compacted.get(resource, 0)
+            ):
+                handler._json(
+                    410,
+                    {"kind": "Status", "code": 410,
+                     "message": "resourceVersion too old"},
+                )
+                return
+            # Replay-snapshot and registration are ONE atomic step: an event
+            # emitted between them would otherwise be in neither the replay
+            # nor the queue.
+            replay = (
+                [
+                    ev
+                    for tag, ev in self._event_log.get(resource, [])
+                    if tag > since_rv
+                ]
+                if since_rv is not None
+                else []
+            )
             self._watchers[resource].append(q)
         try:
             handler.send_response(200)
@@ -608,6 +662,10 @@ class FixtureApiServer:
             # chunked behavior, minus the framing the fixture doesn't need.
             handler.send_header("Connection", "close")
             handler.end_headers()
+            for ev in replay:
+                if self._matches(ev["object"], selector):
+                    handler.wfile.write(json.dumps(ev).encode() + b"\n")
+            handler.wfile.flush()
             while True:
                 ev = q.get()
                 if ev is None:  # server closing

@@ -246,3 +246,42 @@ def test_cli_get_topology_table():
         assert domains == ["zone", "rack", "host"]  # auto host level appended
     finally:
         m.stop()
+
+
+def test_cli_describe_clique_and_pcsg():
+    """describe pclq/pcsg (LIST-only collections: describe reads the bulk
+    listing): role/replica rollups, selector, conditions, scoped events."""
+    import yaml
+
+    from grove_tpu.api.types import PodCliqueSet
+    from grove_tpu.cli.main import _describe
+    from grove_tpu.client.typed import FakeGroveClient, GroveApiError
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        with open("examples/simple1.yaml") as f:
+            m.apply_podcliqueset(PodCliqueSet.from_dict(yaml.safe_load(f)))
+        m.reconcile_once(now=1.0)
+        c = FakeGroveClient(m)
+        out = _describe(c, "podcliques", "simple1-0-frontend")
+        assert "Role:      frontend" in out
+        assert "grove.io/podclique=simple1-0-frontend" in out
+        assert "Conditions:" in out
+        out = _describe(c, "podcliquescalinggroups", "simple1-0-workers")
+        assert "Members:   prefill, decode" in out
+        import pytest as _pytest
+
+        with _pytest.raises(GroveApiError, match="not found"):
+            _describe(c, "podcliques", "no-such-clique")
+    finally:
+        m.stop()

@@ -13,6 +13,7 @@ the wire end to end.
 from __future__ import annotations
 
 import base64
+import json
 import time
 
 import pytest
@@ -1663,6 +1664,39 @@ def test_kubectl_scale_child_cr_drives_operator(api, tmp_path):
             e for e in m.cluster.events if "CR scale rejected" in e[2]
         ]
         assert len(rejections) == 1, rejections
+
+        # A SECOND genuine write of the same out-of-range value (after the
+        # heal landed and its echo cleared the guard) must record and heal
+        # again — not be silently ignored forever.
+        req = _rq.Request(
+            scale_url,
+            data=json.dumps({"spec": {"replicas": 50}}).encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            rejections = [
+                e for e in m.cluster.events if "CR scale rejected" in e[2]
+            ]
+            if (
+                len(rejections) == 2
+                and api.child_crs["podcliques"]["simple1-0-frontend"]["spec"][
+                    "replicas"
+                ]
+                == 5
+            ):
+                break
+            time.sleep(0.05)
+        assert len(rejections) == 2, rejections
+        assert (
+            api.child_crs["podcliques"]["simple1-0-frontend"]["spec"]["replicas"]
+            == 5
+        ), "second rejection never healed"
     finally:
         m.stop()
 
@@ -1767,3 +1801,52 @@ def test_child_scale_relist_replay_does_not_revert(api, tmp_path):
         assert m.cluster.scale_overrides["simple1-0-frontend"] == 4
     finally:
         m.stop()
+
+
+def test_fixture_watch_replays_since_rv(api):
+    """Fixture fidelity pins (the apiserver semantics the source's rv-resume
+    depends on): a watch with resourceVersion replays newer events —
+    including from rv 0, the rv of a LIST taken before any event — while a
+    watch WITHOUT the param starts at now; a resume below the compaction
+    floor gets 410 Gone (the client relists on it)."""
+    import http.client
+
+    api.add_node(k8s_node("n0"))
+    api.add_node(k8s_node("n1"))
+
+    def read_watch_lines(query, n, timeout=5.0):
+        host, port = api.url.replace("http://", "").split(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+        conn.request("GET", f"/api/v1/nodes?watch=1&{query}")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            conn.close()
+            return resp.status, []
+        lines = []
+        try:
+            for _ in range(n):
+                line = resp.readline()
+                if not line:
+                    break
+                lines.append(json.loads(line))
+        except TimeoutError:
+            pass
+        conn.close()
+        return 200, lines
+
+    # rv=0 (LIST before any event existed): BOTH adds replay.
+    status, lines = read_watch_lines("resourceVersion=0", 2)
+    assert status == 200
+    assert [l["object"]["metadata"]["name"] for l in lines] == ["n0", "n1"]
+
+    # rv after the first event: only the second replays.
+    first_rv = int(lines[0]["object"]["metadata"]["resourceVersion"])
+    status, lines = read_watch_lines(f"resourceVersion={first_rv}", 1)
+    assert status == 200
+    assert [l["object"]["metadata"]["name"] for l in lines] == ["n1"]
+
+    # Below the compaction floor: 410 Gone, the relist signal.
+    api._log_compacted["nodes"] = 100
+    status, _ = read_watch_lines("resourceVersion=1", 1)
+    assert status == 410
+    api._log_compacted["nodes"] = 0
