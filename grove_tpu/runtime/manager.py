@@ -7,12 +7,12 @@ probes, leader election, the store + reconcile loop (with flow.go requeue
 semantics), optional control-plane persistence, and optionally hosts the
 scheduler-backend gRPC sidecar in-process.
 
-Differences from the reference, by design: there is no kube-apiserver —
-the store is fed by the simulator, the watch driver
-(grove_tpu/cluster/watch.py), or backend RPCs; webhook TLS/cert rotation is
-replaced by the admission pipeline being invoked in-process at object
-apply time (grove_tpu/api/validation.py), so cert management has no analog
-surface.
+The store is fed by the simulator, the watch driver
+(grove_tpu/cluster/watch.py — KWOK fake or a live kube-apiserver), or
+backend RPCs. Admission runs in-process at every apply path AND as inbound
+AdmissionReview webhooks on a dedicated HTTPS port (servers.webhookPort;
+api/webhook.py) whose caBundle the manager patches into the rendered
+webhook configurations at boot — the cert-controller rotator analog.
 """
 
 from __future__ import annotations
