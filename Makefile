@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-go-shim bench bench-cpu dryrun check clean
+.PHONY: test test-all test-e2e test-conformance test-go-shim bench bench-cpu dryrun api-docs check clean
 
 test:            ## unit + scenario suites (CPU-forced via tests/conftest.py)
 	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_process.py
@@ -32,9 +32,13 @@ bench-cpu:       ## benchmark with the TPU-relay probe skipped
 dryrun:          ## multi-chip sharding compile+run on 8 virtual devices
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
 
-check:           ## import + compile sanity across the package
+api-docs:        ## regenerate docs/api.md from the dataclasses
+	$(PY) scripts/gen_api_docs.py --write
+
+check:           ## import + compile sanity + generated-docs freshness
 	$(PY) -m compileall -q grove_tpu tests bench.py __graft_entry__.py
 	$(PY) -c "import grove_tpu, grove_tpu.cli, grove_tpu.client, grove_tpu.deploy"
+	$(PY) scripts/gen_api_docs.py --check
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

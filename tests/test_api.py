@@ -398,3 +398,26 @@ def test_topology_domains_qualified_by_parent():
     snap = build_snapshot(nodes, topo)
     li = snap.level_index(TopologyDomain.RACK)
     assert snap.node_domain_id[li, 0] != snap.node_domain_id[li, 1]
+
+
+def test_generated_api_docs_current():
+    """docs/api.md is GENERATED (scripts/gen_api_docs.py, the make api-docs
+    analog); `make check` fails when it drifts from the dataclasses — pin
+    that here so the default suite catches staleness too."""
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "gen_api_docs.py"), "--check"],
+        capture_output=True,
+        text=True,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr
+    text = (repo / "docs" / "api.md").read_text()
+    # Spot checks: a workload field, a config knob, and the IR.
+    assert "`min_available`" in text
+    assert "`webhook_port`" in text
+    assert "### PodGang" in text
