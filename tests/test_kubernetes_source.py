@@ -1337,7 +1337,9 @@ def test_sync_webhook_ca_patches_rendered_configs(api):
 
     from grove_tpu.deploy import _render_webhook_objects
 
-    for doc in _render_webhook_objects("grove-system"):
+    # authorizer=True: the validating configuration carries TWO webhook
+    # entries — the patch must land in every entry, not just the first.
+    for doc in _render_webhook_objects("grove-system", authorizer=True):
         kind = doc["kind"].lower() + "s"
         if kind in api.webhookconfigs:
             api.webhookconfigs[kind][doc["metadata"]["name"]] = doc
@@ -1350,6 +1352,14 @@ def test_sync_webhook_ca_patches_rendered_configs(api):
         obj = api.webhookconfigs[plural]["grove-tpu-operator"]
         for wh in obj["webhooks"]:
             assert wh["clientConfig"]["caBundle"] == want
+    assert (
+        len(
+            api.webhookconfigs["validatingwebhookconfigurations"][
+                "grove-tpu-operator"
+            ]["webhooks"]
+        )
+        == 2
+    )
     assert src.sync_webhook_ca(ca) is True  # no-op second pass
 
     # A cluster without the configs (webhook disabled at deploy): best-effort
