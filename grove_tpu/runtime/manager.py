@@ -605,7 +605,18 @@ class Manager:
         ValueError for a bad count."""
         c = self.cluster
         if target in c.podcliques:
-            spec_replicas = c.podcliques[target].spec.replicas
+            pclq = c.podcliques[target]
+            if pclq.pcsg_name:
+                # Members scale WITH their group (the reference forbids
+                # individual autoscaling for them, validation/podcliqueset.
+                # go:240-246; expansion takes member replicas from the
+                # template). Accepting this would silently do nothing and
+                # leave an externally-scaled CR diverged forever.
+                raise ValueError(
+                    f"{target} is a scaling-group member; scale the "
+                    f"PodCliqueScalingGroup {pclq.pcsg_name} instead"
+                )
+            spec_replicas = pclq.spec.replicas
         elif target in c.scaling_groups:
             spec_replicas = c.scaling_groups[target].spec.replicas
         else:

@@ -1860,3 +1860,79 @@ def test_fixture_watch_replays_since_rv(api):
     status, _ = read_watch_lines("resourceVersion=1", 1)
     assert status == 410
     api._log_compacted["nodes"] = 0
+
+
+def test_scale_rejects_pcsg_member_clique(api, tmp_path):
+    """Members scale WITH their group (reference: individual autoscaling
+    forbidden for scaling-group members, validation/podcliqueset.go:
+    240-246; expansion takes member replicas from the template). An
+    accepted-but-ineffective scale would leave an externally-scaled member
+    CR silently diverged — so scale_target rejects members outright, and
+    the external-CR path records the rejection and heals the CR."""
+    import urllib.request as _rq
+
+    import yaml as _yaml
+
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    for i in range(10):
+        api.add_node(k8s_node(f"n{i}", cpu="8", memory="32Gi"))
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "cluster": {
+                "source": "kubernetes",
+                "kubeconfig": _write_kubeconfig(tmp_path, api.url),
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        with open("examples/simple1.yaml") as f:
+            api.apply_pcs(_yaml.safe_load(f))
+        member = "simple1-0-workers-0-prefill"
+        t = 0.0
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if member in api.child_crs["podcliques"]:
+                break
+            time.sleep(0.05)
+        assert member in api.child_crs["podcliques"]
+
+        # Direct path (HTTP scale verb / HPA would hit the same check).
+        with pytest.raises(ValueError, match="scaling-group member"):
+            m.scale_target(member, 5, actor="user", now=t)
+
+        # External CR scale: rejected with an event, CR heals to template
+        # replicas instead of showing the diverged value forever.
+        orig = api.child_crs["podcliques"][member]["spec"]["replicas"]
+        req = _rq.Request(
+            f"{api.url}/apis/grove.io/v1alpha1/namespaces/default/"
+            f"podcliques/{member}/scale",
+            data=json.dumps({"spec": {"replicas": 7}}).encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            t += 1.0
+            m.reconcile_once(now=t)
+            if (
+                any("CR scale rejected" in e[2] for e in m.cluster.events)
+                and api.child_crs["podcliques"][member]["spec"]["replicas"]
+                == orig
+            ):
+                break
+            time.sleep(0.05)
+        assert any("scaling-group member" in e[2] for e in m.cluster.events)
+        assert api.child_crs["podcliques"][member]["spec"]["replicas"] == orig
+    finally:
+        m.stop()
