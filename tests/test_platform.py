@@ -107,6 +107,44 @@ def test_enable_compilation_cache_sets_jax_config(tmp_path):
         jax.config.update("jax_compilation_cache_dir", before)
 
 
+def test_latest_committed_tpu_artifact_picks_newest_headline(tmp_path, monkeypatch):
+    """The evidence chain (evidence/bench_tpu_*.json): the CPU-fallback bench
+    embeds the NEWEST committed on-chip artifact at headline scale (1x) —
+    skipping scale-envelope points, off-chip runs, and unparseable files."""
+    import json
+
+    import bench
+
+    ev = tmp_path / "evidence"
+    ev.mkdir()
+
+    def art(name, **fields):
+        (ev / name).write_text(json.dumps(fields))
+
+    art("bench_tpu_20260730T010000Z_aaa_s1.0.json",
+        platform="tpu", value=0.70, scale=1.0)
+    art("bench_tpu_20260731T020000Z_bbb_s4.0.json",
+        platform="tpu", value=2.1, scale=4.0)  # scale point, not headline
+    art("bench_tpu_20260731T030000Z_ccc_s1.0.json",
+        platform="cpu", value=0.88, scale=1.0)  # off-chip, must be skipped
+    (ev / "bench_tpu_20260731T040000Z_ddd_s1.0.json").write_text("{broken")
+    art("bench_tpu_20260731T013000Z_eee_s1.0.json",
+        platform="tpu", value=0.41, scale=1.0)  # the newest valid headline
+
+    monkeypatch.setattr(bench, "_EVIDENCE_DIR", ev)
+    got = bench._latest_committed_tpu_artifact()
+    assert got is not None
+    assert got["value"] == 0.41
+    assert got["artifact"] == "bench_tpu_20260731T013000Z_eee_s1.0.json"
+
+
+def test_latest_committed_tpu_artifact_none_when_empty(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "_EVIDENCE_DIR", tmp_path / "missing")
+    assert bench._latest_committed_tpu_artifact() is None
+
+
 def test_manager_wires_compilation_cache(tmp_path):
     import jax
 
