@@ -55,6 +55,7 @@ from grove_tpu.api.types import (
 from grove_tpu.backend.proto import scheduler_backend_pb2 as pb
 from grove_tpu.solver.core import decode_assignments, solve
 from grove_tpu.solver.encode import encode_gangs, pack_set_count
+from grove_tpu.solver.escalation import EscalationDamper, escalation_fingerprint
 from grove_tpu.solver.planner import (
     build_pending_subgang,
     build_spread_avoid,
@@ -179,6 +180,9 @@ class TPUSchedulerBackend:
         # One solve at a time (capacity accounting is sequential); control
         # RPCs use _lock only.
         self._solve_lock = threading.Lock()
+        # Futile-escalation damper (see _solve_unlocked; definition shared
+        # with the controller in solver/escalation.py).
+        self._escalation_damper = EscalationDamper()
         self._topology = ClusterTopology(name="backend", levels=[])
         self._nodes: dict[str, Node] = {}
         self._gangs: dict[str, PodGang] = {}
@@ -548,11 +552,27 @@ class TPUSchedulerBackend:
         # solver.portfolio > 1: the sidecar's Solve explores P weight
         # variants and keeps the winner (multi-chip quality path; the
         # variants shard over the device mesh when one exists).
+        # portfolioEscalation: a rejecting base solve retries once under P
+        # variants — dampened by the same futile-fingerprint discipline as
+        # the controller (a saturated steady state must not pay P-variant
+        # cost every Solve when nothing changed).
+        esc = self._solver_config.portfolio_escalation
+        esc_fp = None
+        if esc > self._solver_config.portfolio:
+            esc_fp = escalation_fingerprint(
+                work["fingerprints"].items(),
+                ((p.name, p.node_name) for p in work["bound_pods"]),
+                work["nodes"],
+            )
+            esc = self._escalation_damper.effective_width(
+                "solve", esc_fp, self._solver_config.portfolio, esc
+            )
         result = solve(
             snapshot,
             batch,
             params=self._solver_params,
             portfolio=self._solver_config.portfolio,
+            escalate_portfolio=esc,
         )
         bindings = decode_assignments(result, decode, snapshot)
 
@@ -560,6 +580,15 @@ class TPUSchedulerBackend:
 
         ok = dict(zip(decode.gang_names, np.asarray(result.ok)))
         scores = dict(zip(decode.gang_names, np.asarray(result.placement_score)))
+        valid = dict(zip(decode.gang_names, np.asarray(batch.gang_valid)))
+        any_valid_rejected = any(
+            valid.get(n, False) and not ok.get(n, False) for n in decode.gang_names
+        )
+        if esc_fp is not None:
+            self._escalation_damper.record(
+                "solve", esc_fp, esc > self._solver_config.portfolio,
+                any_valid_rejected,
+            )
         return bindings, ok, scores
 
     def _commit(self, work: dict, bindings, ok, scores) -> pb.SolveResponse:

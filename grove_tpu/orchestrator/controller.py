@@ -52,6 +52,7 @@ from grove_tpu.orchestrator.status import (
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.solver.core import SolverParams, decode_assignments, solve
 from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.solver.escalation import EscalationDamper, escalation_fingerprint
 from grove_tpu.solver.planner import (
     build_pending_subgang,
     build_spread_avoid,
@@ -77,6 +78,11 @@ class GroveController:
     # portfolio width: >1 solves each wave under P weight variants, winner
     # kept (solver.portfolio; parallel/portfolio.py)
     portfolio: int = 1
+    # rejection escalation (solver.portfolioEscalation): a portfolio=1 solve
+    # that rejects valid gangs is retried once under P variants — packing
+    # artifacts get fixed through the DEFAULT serving path, uncontended
+    # passes pay nothing
+    portfolio_escalation: int = 4
     # MNNVL-analog TPU-slice injection (networkAcceleration config section)
     auto_slice_enabled: bool = False
     slice_resource_name: str = "google.com/tpu"
@@ -110,6 +116,12 @@ class GroveController:
     # First-admissions of the current pass (floors wave), so the extras wave
     # can't double-count them (see solve_pending).
     _admitted_this_pass: set = field(default_factory=set)
+    # Futile-escalation damper, keyed per wave kind (floors/extras): while
+    # the solver-input state matches the last pass whose ESCALATED solve
+    # still rejected valid gangs, re-escalating is a guaranteed no-op, so a
+    # saturated steady state pays base-solve cost per reconcile. Definition
+    # shared with the backend sidecar (solver/escalation.py).
+    _escalation_damper: EscalationDamper = field(default_factory=EscalationDamper)
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -577,8 +589,23 @@ class GroveController:
             reuse_nodes_by_gang=reuse_nodes,
             spread_avoid_by_gang=spread_avoid,
         )
+        esc = self.portfolio_escalation
+        esc_fp = None
+        if esc > self.portfolio:
+            esc_fp = escalation_fingerprint(
+                (g.name for g in sub_gangs),
+                ((p.name, p.node_name) for p in bound_pods),
+                c.nodes.values(),
+            )
+            esc = self._escalation_damper.effective_width(
+                floors_only, esc_fp, self.portfolio, esc
+            )
         result = solve(
-            snapshot, batch, self.solver_params, portfolio=self.portfolio
+            snapshot,
+            batch,
+            self.solver_params,
+            portfolio=self.portfolio,
+            escalate_portfolio=esc,
         )
         bindings = decode_assignments(result, decode, snapshot)
 
@@ -587,6 +614,15 @@ class GroveController:
 
         ok_by_name = dict(zip(decode.gang_names, np.asarray(result.ok)))
         scores = dict(zip(decode.gang_names, np.asarray(result.placement_score)))
+        valid_by_name = dict(zip(decode.gang_names, np.asarray(batch.gang_valid)))
+        any_valid_rejected = any(
+            valid_by_name.get(n, False) and not ok_by_name.get(n, False)
+            for n in decode.gang_names
+        )
+        if esc_fp is not None:
+            self._escalation_damper.record(
+                floors_only, esc_fp, esc > self.portfolio, any_valid_rejected
+            )
         for gang_name, pod_bindings in bindings.items():
             gang = c.podgangs[gang_name]
             for pod_name, node_name in pod_bindings.items():
@@ -618,7 +654,6 @@ class GroveController:
         # Preemption considers FLOOR rejections only — a gang denied best-effort
         # extras has its guarantee met and must not evict anyone.
         if floors_only:
-            valid_by_name = dict(zip(decode.gang_names, np.asarray(batch.gang_valid)))
             rejected = [
                 g
                 for g in sub_gangs

@@ -1,0 +1,250 @@
+"""Hierarchical capacity queues — the KAI/run.ai Queue analog.
+
+The reference deploys KAI queues as CRs with a parent tree and per-resource
+envelopes (`operator/e2e/yaml/queues.yaml:22-30`: `spec.parentQueue`,
+`spec.resources.<res>.{quota,limit,overQuotaWeight}`; installed by
+`operator/e2e/setup/kai_scheduler.go:90`). This module rebuilds those
+semantics for the TPU control plane — a pure-Python admission calculus the
+controller consults before a gang reaches the solver (no CRs, no scheduler
+plugins: the tree lives in operator config).
+
+Semantics (the KAI model, restated as rules):
+
+- **quota** — the queue's deserved share, -1 = unlimited. Usage is
+  HIERARCHICAL: a queue's usage includes every descendant's.
+- **limit** — hard cap on (subtree) usage, -1 = none. Never exceedable.
+- **overQuotaWeight** — 0 makes quota hard for that resource; > 0 lets the
+  queue borrow beyond quota (up to limit) out of its parent's headroom,
+  and orders contending borrowers in a pass (higher weight granted first).
+- A ROOT queue can never exceed a set quota — there is no parent to borrow
+  from. (This is also exactly the legacy flat-map behavior: flat queues are
+  parentless, so their quotas stay hard and existing configs keep meaning
+  what they meant.)
+- **Reclaim** — a demand that fits its own queue's quota but is blocked
+  because siblings' over-quota borrowing consumed the ancestor's headroom
+  is entitled to evict those borrowers (in-quota beats borrowed). The tree
+  names the victims; the controller performs the eviction with the same
+  machinery as priority preemption.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+_EPS = 1e-9
+
+
+@dataclass(frozen=True)
+class QueueResource:
+    """One resource envelope: quota (deserved), limit (cap), weight."""
+
+    quota: float = -1.0
+    limit: float = -1.0
+    over_quota_weight: float = 1.0
+
+
+@dataclass
+class QueueSpec:
+    name: str
+    parent: str | None = None
+    resources: dict[str, QueueResource] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Verdict:
+    """Outcome of try_charge. `admitted` with `borrowed` distinguishes an
+    in-quota grant from an over-quota one (grant ordering); a block carries
+    the level it happened at and whether the contender was in-quota there
+    (reclaim eligibility)."""
+
+    admitted: bool
+    borrowed: bool = False
+    blocked_at: str | None = None
+    blocked_reason: str = ""  # "limit" | "quota" | "root-quota"
+    reclaim_eligible: bool = False
+
+
+class QueueTree:
+    """Validated queue forest + the admission calculus over a usage map.
+
+    The usage map (`{queue: {res: used}}`, hierarchical — build it with
+    `hierarchical_usage`) is owned by the caller and mutated by `charge`;
+    the tree itself is immutable after construction.
+    """
+
+    def __init__(self, specs: dict[str, QueueSpec]):
+        self.specs = dict(specs)
+        for name, spec in self.specs.items():
+            if spec.parent is not None and spec.parent not in self.specs:
+                raise ValueError(
+                    f"queue {name!r}: parentQueue {spec.parent!r} does not exist"
+                )
+        # Cycle check + ancestor chains (self first, root last).
+        self._chain: dict[str, list[str]] = {}
+        for name in self.specs:
+            chain, seen = [], set()
+            cur: str | None = name
+            while cur is not None:
+                if cur in seen:
+                    raise ValueError(f"queue {name!r}: parentQueue cycle at {cur!r}")
+                seen.add(cur)
+                chain.append(cur)
+                cur = self.specs[cur].parent
+            self._chain[name] = chain
+        self._children: dict[str, list[str]] = {n: [] for n in self.specs}
+        for name, spec in self.specs.items():
+            if spec.parent is not None:
+                self._children[spec.parent].append(name)
+
+    @classmethod
+    def from_flat(cls, flat: dict[str, dict[str, float]]) -> "QueueTree":
+        """Legacy `{queue: {res: quota}}` map -> parentless hard-quota trees
+        (roots can't borrow, so the old hard-quota behavior is preserved)."""
+        return cls(
+            {
+                name: QueueSpec(
+                    name=name,
+                    resources={
+                        res: QueueResource(quota=float(q)) for res, q in rmap.items()
+                    },
+                )
+                for name, rmap in flat.items()
+            }
+        )
+
+    def ancestors(self, name: str) -> list[str]:
+        """name, parent, ..., root."""
+        return self._chain[name]
+
+    def subtree(self, name: str) -> set[str]:
+        out, stack = set(), [name]
+        while stack:
+            cur = stack.pop()
+            out.add(cur)
+            stack.extend(self._children[cur])
+        return out
+
+    def hierarchical_usage(
+        self, leaf_usage: dict[str, dict[str, float]]
+    ) -> dict[str, dict[str, float]]:
+        """Per-queue usage where every queue includes its descendants.
+        `leaf_usage` charges each gang to the queue it was submitted to
+        (controller.queue_usage); unknown queue names are ignored."""
+        out: dict[str, dict[str, float]] = {n: {} for n in self.specs}
+        for qname, res in leaf_usage.items():
+            if qname not in self.specs:
+                continue
+            for anc in self._chain[qname]:
+                acc = out[anc]
+                for rname, qty in res.items():
+                    acc[rname] = acc.get(rname, 0.0) + qty
+        return out
+
+    def _res(self, qname: str, rname: str) -> QueueResource:
+        # A resource the spec doesn't envelope is unconstrained at that level.
+        return self.specs[qname].resources.get(rname, QueueResource())
+
+    def borrow_weight(self, qname: str, demand: dict[str, float]) -> float:
+        """Grant-ordering weight for an over-quota demand: the most
+        conservative (minimum) overQuotaWeight across demanded resources."""
+        if not demand:
+            return 0.0
+        return min(self._res(qname, r).over_quota_weight for r in demand)
+
+    def try_charge(
+        self,
+        usage: dict[str, dict[str, float]],
+        qname: str,
+        demand: dict[str, float],
+        commit: bool = True,
+    ) -> Verdict:
+        """Can `demand` land in `qname` given hierarchical `usage`?
+
+        Walks the ancestor chain: every level's limit must hold; a level
+        pushed past a set quota needs that level's weight > 0 for every
+        over-quota resource AND a parent to borrow from. On admission (and
+        commit=True) the demand is charged to the whole chain.
+        """
+        if qname not in self.specs:
+            # Unknown queue: admission (api/admission.py) should have
+            # rejected it; fail open here so a stale annotation cannot
+            # wedge scheduling behind a KeyError.
+            return Verdict(admitted=True)
+        borrowed = False
+        in_quota_at_self = True
+        for level, anc in enumerate(self._chain[qname]):
+            used = usage.get(anc, {})
+            for rname, qty in demand.items():
+                new = used.get(rname, 0.0) + qty
+                env = self._res(anc, rname)
+                if env.limit != -1 and new > env.limit + _EPS:
+                    return Verdict(
+                        admitted=False,
+                        blocked_at=anc,
+                        blocked_reason="limit",
+                        reclaim_eligible=False,
+                    )
+                if env.quota != -1 and new > env.quota + _EPS:
+                    if level == 0:
+                        in_quota_at_self = False
+                    is_root = self.specs[anc].parent is None
+                    if is_root:
+                        return Verdict(
+                            admitted=False,
+                            blocked_at=anc,
+                            blocked_reason="root-quota",
+                            # In-quota at its own level but squeezed out of
+                            # the root headroom by borrowers -> may reclaim.
+                            reclaim_eligible=in_quota_at_self and level > 0,
+                        )
+                    if env.over_quota_weight <= 0.0:
+                        return Verdict(
+                            admitted=False,
+                            blocked_at=anc,
+                            blocked_reason="quota",
+                            reclaim_eligible=in_quota_at_self and level > 0,
+                        )
+                    borrowed = True
+        if commit:
+            self.charge(usage, qname, demand)
+        return Verdict(admitted=True, borrowed=borrowed)
+
+    def charge(
+        self, usage: dict[str, dict[str, float]], qname: str, demand: dict[str, float]
+    ) -> None:
+        for anc in self._chain.get(qname, ()):
+            acc = usage.setdefault(anc, {})
+            for rname, qty in demand.items():
+                acc[rname] = acc.get(rname, 0.0) + qty
+
+    def over_quota_queues(
+        self, usage: dict[str, dict[str, float]], under: str
+    ) -> set[str]:
+        """Queues in `under`'s subtree whose own usage exceeds their own set
+        quota on any resource — the reclaim victim pool (borrowers)."""
+        out = set()
+        for name in self.subtree(under):
+            used = usage.get(name, {})
+            for rname, qty in used.items():
+                env = self._res(name, rname)
+                if env.quota != -1 and qty > env.quota + _EPS:
+                    out.add(name)
+                    break
+        return out
+
+    def describe(self) -> dict[str, dict]:
+        """Static tree shape for observability (statusz/CLI)."""
+        return {
+            name: {
+                "parent": spec.parent,
+                "quota": {r: e.quota for r, e in spec.resources.items()},
+                "limit": {r: e.limit for r, e in spec.resources.items()},
+                "overQuotaWeight": {
+                    r: e.over_quota_weight for r, e in spec.resources.items()
+                },
+            }
+            for name, spec in self.specs.items()
+        }
+
+    def depth(self, name: str) -> int:
+        return len(self._chain[name]) - 1

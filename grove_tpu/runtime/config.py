@@ -137,6 +137,15 @@ class SolverConfig:
     # (A `speculative` knob existed through round 3; the path was deleted
     # after losing to the sequential scan in every measured regime.)
     portfolio: int = 1
+    # Rejection escalation: when a solve at `portfolio` width leaves valid
+    # gangs rejected and this value is LARGER, re-solve that batch once at
+    # this width and keep the winner (bounded, once per solve; the seeded
+    # population is prefix-stable, so the wider winner can only admit
+    # more). <= portfolio disables. Defaults ON so the default serving
+    # path fixes packing-artifact rejections without paying the portfolio
+    # cost on uncontended solves; the serving paths damp it to base cost
+    # in an unchanged saturated steady state.
+    portfolio_escalation: int = 4
     # Persistent XLA compilation cache dir ("" = off): solver warm-up
     # compiles (~20-40s on TPU) are reused across operator restarts.
     compilation_cache_dir: str = ""
@@ -309,6 +318,7 @@ _CAMEL_FIELDS = {
     "maxPods": "max_pods",
     "padGangsTo": "pad_gangs_to",
     "compilationCacheDir": "compilation_cache_dir",
+    "portfolioEscalation": "portfolio_escalation",
     "maxWorkers": "max_workers",
     "snapshotIntervalSeconds": "snapshot_interval_seconds",
     "wTight": "w_tight",
@@ -499,6 +509,9 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
     pf = cfg.solver.portfolio
     if not isinstance(pf, int) or isinstance(pf, bool) or pf < 1:
         errors.append("solver.portfolio: must be an int >= 1")
+    pe = cfg.solver.portfolio_escalation
+    if not isinstance(pe, int) or isinstance(pe, bool) or pe < 1:
+        errors.append("solver.portfolioEscalation: must be an int >= 1 (1 = off)")
     if not isinstance(cfg.solver.weights, dict):
         errors.append("solver.weights: must be a mapping of weight -> number")
     elif cfg.solver.weights:

@@ -484,6 +484,7 @@ class Manager:
             max_pods=config.solver.max_pods,
             pad_gangs_to=config.solver.pad_gangs_to,
             portfolio=config.solver.portfolio,
+            portfolio_escalation=config.solver.portfolio_escalation,
             auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
             slice_resource_name=config.network_acceleration.slice_resource_name,
             initc_server_url=config.servers.advertise_url,

@@ -540,6 +540,36 @@ def test_solver_portfolio_knob_wiring(tmp_path):
     assert errors
 
 
+def test_solver_portfolio_escalation_knob_wiring(tmp_path):
+    """solver.portfolioEscalation (default ON at 4) flows to the controller;
+    1 disables; validation rejects non-widths."""
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+        }
+    )
+    assert not errors, errors
+    assert cfg.solver.portfolio_escalation == 4  # the default-path fix is on
+    assert Manager(cfg).controller.portfolio_escalation == 4
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "solver": {"portfolioEscalation": 1},
+        }
+    )
+    assert not errors, errors
+    assert Manager(cfg).controller.portfolio_escalation == 1
+
+    for bad in (0, -2, True, "four"):
+        _, errors = parse_operator_config({"solver": {"portfolioEscalation": bad}})
+        assert any("solver.portfolioEscalation" in e for e in errors), bad
+
+
 def test_portfolio_controller_schedules_workload(simple1):
     """A portfolio-configured controller still runs the full reconcile
     cascade (the serving path exercises parallel/portfolio.py, not just the

@@ -75,6 +75,188 @@ def test_solver_never_loses_to_greedy_uncontended():
     assert len(bindings) >= gstats.admitted
 
 
+def test_escalation_fixes_binpack_trap_at_default_portfolio():
+    """solver.portfolioEscalation (round-4 verdict weak #6): portfolio=1
+    plus escalation admits the full trap backlog in ONE solve call; the
+    same call without escalation strands gangs (control — the trap is real)."""
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+    from grove_tpu.sim.workloads import binpack_trap_backlog, binpack_trap_cluster
+
+    topo = DEFAULT_CLUSTER_TOPOLOGY
+    gangs, pods = _expand_all(binpack_trap_backlog(), topo)
+    snapshot = build_snapshot(binpack_trap_cluster(), topo)
+    batch, decode = encode_gangs(gangs, pods, snapshot)
+    base = len(decode_assignments(solve(snapshot, batch), decode, snapshot))
+    assert base < len(gangs), "trap must bite the base solver"
+    esc = len(
+        decode_assignments(
+            solve(snapshot, batch, escalate_portfolio=4), decode, snapshot
+        )
+    )
+    assert esc == len(gangs), f"escalation admitted {esc}/{len(gangs)}"
+
+
+def test_escalation_skipped_when_nothing_rejected(monkeypatch):
+    """Bounded-cost contract: a solve that admits every valid gang must not
+    touch the portfolio path at all — escalation is free when uncontended."""
+    import grove_tpu.parallel.portfolio as pf
+    from grove_tpu.sim.workloads import synthetic_backlog, synthetic_cluster
+
+    def _boom(*a, **k):
+        raise AssertionError("escalated on an uncontended solve")
+
+    monkeypatch.setattr(pf, "portfolio_solve", _boom)
+    topo = bench_topology()
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=2, racks_per_block=4)
+    gangs, pods = _expand_all(synthetic_backlog(4, 3, 3), topo)
+    snapshot = build_snapshot(nodes, topo)
+    batch, decode = encode_gangs(gangs, pods, snapshot)
+    result = solve(snapshot, batch, escalate_portfolio=4)
+    assert len(decode_assignments(result, decode, snapshot)) == len(gangs)
+
+
+def test_controller_default_path_escalates_binpack_trap():
+    """The DEFAULT serving path (GroveController with portfolio=1 and the
+    default portfolioEscalation) admits 12/12 on the bin-packing trap; the
+    identical controller with escalation disabled strands gangs. This is the
+    round-4 verdict's done-criterion: the trap fixed without opting in to
+    solver.portfolio."""
+    from scenario_harness import Scenario
+
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+    from grove_tpu.sim.workloads import binpack_trap_backlog, binpack_trap_cluster
+
+    def run(escalation: int) -> int:
+        s = Scenario(
+            0,
+            topology=DEFAULT_CLUSTER_TOPOLOGY,
+            nodes=binpack_trap_cluster(),
+            priority_classes={"fast": 100},
+        )
+        s.controller.portfolio_escalation = escalation
+        for pcs in binpack_trap_backlog():
+            # The trap fires when the smalls SOLVE first (arrival order in
+            # the drain; here the controller's priority sort stands in for
+            # it — name order alone would put the bigs first and dodge it).
+            if "small" in pcs.metadata.name:
+                pcs.spec.template.priority_class_name = "fast"
+            s.deploy(pcs)
+        s.settle(20)
+        return len({p.podgang_name for p in s.scheduled()})
+
+    assert run(1) < 12, "trap must bite the escalation-off controller"
+    assert run(4) == 12
+
+
+def _infeasible_pcs(name: str = "too-big"):
+    """One valid gang no node can ever hold (100 cpu vs 7-cpu nodes)."""
+    from grove_tpu.api import PodCliqueSet, default_podcliqueset
+
+    doc = {
+        "apiVersion": "grove.io/v1alpha1",
+        "kind": "PodCliqueSet",
+        "metadata": {"name": name},
+        "spec": {
+            "replicas": 1,
+            "template": {
+                "cliques": [
+                    {
+                        "name": "w",
+                        "spec": {
+                            "roleName": "w",
+                            "replicas": 1,
+                            "podSpec": {
+                                "containers": [
+                                    {
+                                        "name": "w",
+                                        "image": "registry.local/w:latest",
+                                        "resources": {"requests": {"cpu": "100"}},
+                                    }
+                                ]
+                            },
+                        },
+                    }
+                ],
+            },
+        },
+    }
+    return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+
+def _spy_portfolio_widths(monkeypatch) -> list[int]:
+    """Record the width of every portfolio_solve call, still running it."""
+    import grove_tpu.parallel.portfolio as pf
+
+    calls: list[int] = []
+    real = pf.portfolio_solve
+
+    def spy(*a, **k):
+        calls.append(k["portfolio"] if "portfolio" in k else a[6])
+        return real(*a, **k)
+
+    monkeypatch.setattr(pf, "portfolio_solve", spy)
+    return calls
+
+
+def test_escalation_damper_bounds_steady_state_cost(monkeypatch):
+    """A genuinely-unschedulable gang triggers ONE escalated solve, not one
+    per reconcile: while nothing changes, the futile fingerprint damps
+    re-escalation back to base-solve cost. New arrivals re-arm it."""
+    from scenario_harness import Scenario
+
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+    from grove_tpu.sim.workloads import binpack_trap_cluster
+
+    calls = _spy_portfolio_widths(monkeypatch)
+    s = Scenario(0, topology=DEFAULT_CLUSTER_TOPOLOGY, nodes=binpack_trap_cluster())
+    s.deploy(_infeasible_pcs())
+    s.settle(10)  # many reconcile passes over unchanged state
+    assert calls == [4], f"expected one escalated solve, saw widths {calls}"
+    # A new arrival changes the pending set -> escalation re-arms.
+    s.deploy(_infeasible_pcs("too-big-2"))
+    s.settle(10)
+    assert len(calls) >= 2, "escalation must re-arm when state changes"
+    assert len(calls) <= 4, f"damper must re-damp after re-arming: {calls}"
+
+
+def test_escalation_rearms_on_in_place_capacity_change(monkeypatch):
+    """The damper fingerprint covers node CAPACITY, not just names and the
+    schedulable bit: an in-place capacity change (UpdateCluster analog)
+    must re-arm escalation even though no node appeared, vanished, bound,
+    or cordoned (review finding: names-only fingerprints never re-fire)."""
+    from scenario_harness import Scenario
+
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+    from grove_tpu.sim.workloads import binpack_trap_cluster
+
+    calls = _spy_portfolio_widths(monkeypatch)
+    s = Scenario(0, topology=DEFAULT_CLUSTER_TOPOLOGY, nodes=binpack_trap_cluster())
+    s.deploy(_infeasible_pcs())
+    s.settle(10)
+    assert calls == [4], f"damper must arm first: {calls}"
+    next(iter(s.cluster.nodes.values())).capacity["cpu"] = 50.0  # still short
+    s.settle(10)
+    assert calls == [4, 4], f"capacity change must re-arm once: {calls}"
+
+
+def test_escalation_applies_above_portfolio_width(monkeypatch):
+    """portfolio > 1 composes with a LARGER escalation width: the rejecting
+    P-wide solve is retried once at the escalation width."""
+    from scenario_harness import Scenario
+
+    from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY
+    from grove_tpu.sim.workloads import binpack_trap_cluster
+
+    calls = _spy_portfolio_widths(monkeypatch)
+    s = Scenario(0, topology=DEFAULT_CLUSTER_TOPOLOGY, nodes=binpack_trap_cluster())
+    s.controller.portfolio = 2
+    s.controller.portfolio_escalation = 4
+    s.deploy(_infeasible_pcs())
+    s.settle(10)
+    assert calls[:2] == [2, 4], f"expected P=2 then escalated 4, saw {calls}"
+    assert calls.count(4) == 1, f"escalation must damp at width 2 after: {calls}"
+
+
 def test_portfolio_matches_sequential_admission_under_contention():
     """On the trap-block cluster the portfolio solve holds the sequential
     scan's 32-gang capacity ceiling at 48 offered (slot-0 elitism makes
