@@ -128,15 +128,43 @@ def _get_table(client: GroveClient, kind: str) -> str:
         ]
         return _table(rows, ["DOMAIN", "NODELABELKEY"])
     if kind == "queues":
+        docs = client.statusz().get("queues", {})
+
+        def tree_path(name: str) -> tuple:
+            # Root-first ancestry: sorting by it lists parents before their
+            # children (depth bounded defensively — the server validates
+            # acyclicity).
+            out: list[str] = []
+            cur: str | None = name
+            for _ in range(len(docs) + 1):
+                if cur is None:
+                    break
+                out.append(cur)
+                cur = docs.get(cur, {}).get("parent")
+            return tuple(reversed(out))
+
         rows = []
-        for qname, doc in sorted(client.statusz().get("queues", {}).items()):
+        for qname in sorted(docs, key=tree_path):
+            doc = docs[qname]
             quota = ",".join(
                 f"{r}={'unlimited' if q == -1 else q}"
-                for r, q in sorted(doc["quota"].items())
+                for r, q in sorted(doc.get("quota", {}).items())
+            )
+            limit = ",".join(
+                f"{r}={'none' if v == -1 else v}"
+                for r, v in sorted(doc.get("limit", {}).items())
             )
             used = ",".join(f"{r}={v:g}" for r, v in sorted(doc["used"].items()))
-            rows.append([qname, quota or "-", used or "-"])
-        return _table(rows, ["NAME", "QUOTA", "USED"])
+            rows.append(
+                [
+                    "  " * int(doc.get("depth", 0)) + qname,
+                    doc.get("parent") or "-",
+                    quota or "-",
+                    limit or "-",
+                    used or "-",
+                ]
+            )
+        return _table(rows, ["NAME", "PARENT", "QUOTA", "LIMIT", "USED"])
     if kind == "services":
         return _table([[n] for n in client.list_services()], ["NAME"])
     if kind == "hpas":

@@ -49,6 +49,7 @@ from grove_tpu.orchestrator.status import (
     pcsg_breached_since,
     sync_pcsg_rolling_progress,
 )
+from grove_tpu.orchestrator.queues import QueueTree
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.solver.core import SolverParams, decode_assignments, solve
 from grove_tpu.solver.encode import encode_gangs
@@ -100,15 +101,20 @@ class GroveController:
     # set by the floors wave when some gang has gated pods beyond its floor;
     # gates the extras wave (see solve_pending)
     _extras_candidates: bool = False
-    # Capacity queues (scheduling.queues; KAI Queue analog): name ->
-    # {resource: quota-or--1}; gangs opt in via the grove.io/queue
-    # annotation (expansion stamps PodGang.queue).
-    queues: dict = field(default_factory=dict)
+    # Capacity queues (scheduling.queues; hierarchical KAI Queue analog,
+    # orchestrator/queues.py): a QueueTree, or a legacy flat
+    # {name: {resource: quota-or--1}} map (normalized by the queue_tree
+    # property); gangs opt in via the grove.io/queue annotation (expansion
+    # stamps PodGang.queue).
+    queues: object = field(default_factory=dict)
     # Event dedupe for quota-blocked gangs (one event per block episode).
     _quota_blocked: set = field(default_factory=set)
-    # Floors wave's post-grant remaining quota, consumed by the extras wave
-    # (see solve_pending) — saves a full pod scan per pass.
-    _queue_remaining_carry: dict | None = None
+    # Floors wave's post-grant hierarchical usage map, consumed by the
+    # extras wave (see solve_pending) — saves a full pod scan per pass.
+    _queue_usage_carry: dict | None = None
+    # Reclaim flap guard (same discipline as _preempted_for_at): one
+    # reclaim attempt per in-quota contender per cooldown window.
+    _reclaimed_for_at: dict = field(default_factory=dict)
     # PlacementScores of gangs first-admitted in the LAST solve_pending pass
     # (GREP-244 metrics direction) — the manager drains this into the
     # grove_placement_score histogram each reconcile.
@@ -371,12 +377,12 @@ class GroveController:
         # (rolling updates churn gang names; same discipline as
         # _preempted_for_at): a recreated namesake must event again.
         self._quota_blocked &= set(self.cluster.podgangs)
-        # One queue-usage scan per pass: the floors wave computes remaining
-        # quota from live usage and leaves its post-grant remainder here for
-        # the extras wave (a floor grant the SOLVER then rejected makes the
-        # extras view conservative for one pass — extras are best-effort
-        # and the next pass recomputes from real bindings).
-        self._queue_remaining_carry = None
+        # One queue-usage scan per pass: the floors wave builds the
+        # hierarchical usage map from live usage and leaves its post-grant
+        # state here for the extras wave (a floor grant the SOLVER then
+        # rejected makes the extras view conservative for one pass — extras
+        # are best-effort and the next pass recomputes from real bindings).
+        self._queue_usage_carry = None
         admitted = self._solve_wave(now, floors_only=True)
         if self._extras_candidates:
             self._solve_wave(now, floors_only=False)
@@ -401,26 +407,20 @@ class GroveController:
             pending, lambda g: self.priority_classes.get(g.spec.priority_class_name, 0)
         )
 
-        # Queue quotas (the KAI Queue analog): remaining headroom per queue
-        # from the CURRENT bound usage; each gang's encode-set demand draws
-        # it down in priority order below. Only built when queues exist.
-        queue_remaining: dict[str, dict[str, float | None]] = {}
-        if self.queues:
-            if not floors_only and self._queue_remaining_carry is not None:
-                queue_remaining = self._queue_remaining_carry
+        # Capacity queues (the hierarchical KAI Queue analog,
+        # orchestrator/queues.py): the pass works against a HIERARCHICAL
+        # usage map — every queue's usage includes its descendants' — seeded
+        # from bound usage; each grant charges the whole ancestor chain.
+        # The floors wave builds it and leaves the charged map for the
+        # extras wave.
+        qtree = self.queue_tree
+        qusage: dict | None = None
+        if qtree is not None:
+            if not floors_only and self._queue_usage_carry is not None:
+                qusage = self._queue_usage_carry
             else:
-                usage = self.queue_usage()
-                for qname, res in self.queues.items():
-                    used = usage.get(qname, {})
-                    queue_remaining[qname] = {
-                        rname: (
-                            None
-                            if quota == -1
-                            else float(quota) - used.get(rname, 0.0)
-                        )
-                        for rname, quota in res.items()
-                    }
-                self._queue_remaining_carry = queue_remaining
+                qusage = qtree.hierarchical_usage(self.queue_usage())
+                self._queue_usage_carry = qusage
 
         # Partial gangs: encode only gated pods; floors shrink by bound pods
         # (shared discipline: solver/planner.py). Bound pods' node NAMES are
@@ -429,6 +429,13 @@ class GroveController:
         # bound pods occupy.
         sub_gangs: list[PodGang] = []
         bound_node_names: dict[str, dict[str, list[str]]] = {}
+        # Quota-grant staging: in-quota demands grant inline (in priority
+        # order); over-quota demands wait in `borrowers` and retry with
+        # borrowing afterward, overQuotaWeight-descending — deserved demand
+        # of this pass beats borrowed, and heavier borrowers beat lighter.
+        granted: list[tuple[int, PodGang, PodGang, dict]] = []
+        borrowers: list[tuple[int, PodGang, PodGang, dict, dict]] = []
+        order = 0
         for gang in pending:
             unbound_refs: dict[str, list[NamespacedName]] = {}
             bound_counts: dict[str, int] = {}
@@ -469,12 +476,10 @@ class GroveController:
             sub = build_pending_subgang(gang, unbound_refs, bound_counts)
             if sub is None:
                 continue
-            rem = queue_remaining.get(gang.queue) if gang.queue else None
-            if rem is not None:
-                # Hard quota: this wave's encode-set demand must fit the
-                # queue's remaining headroom or the gang waits (no solver
-                # cost; re-offered next pass as usage frees). Granted in
-                # priority order — `pending` is already sorted.
+            if qtree is not None and gang.queue and gang.queue in qtree.specs:
+                # This wave's encode-set demand must fit the queue tree
+                # (quota/limit along the ancestor chain) or the gang waits —
+                # no solver cost; re-offered next pass as usage frees.
                 demand: dict[str, float] = {}
                 for refs in unbound_refs.values():
                     for ref in refs:
@@ -483,26 +488,48 @@ class GroveController:
                             continue
                         for res, qty in pod.spec.total_requests().items():
                             demand[res] = demand.get(res, 0.0) + qty
-                if all(
-                    lim is None or demand.get(rname, 0.0) <= lim + 1e-9
-                    for rname, lim in rem.items()
-                ):
-                    for rname, lim in rem.items():
-                        if lim is not None:
-                            rem[rname] = lim - demand.get(rname, 0.0)
+                if qtree.try_charge(
+                    qusage, gang.queue, demand, allow_borrow=False
+                ).admitted:
                     self._quota_blocked.discard(gang.name)
+                    granted.append((order, gang, sub, per_group_nodes))
                 else:
-                    if gang.name not in self._quota_blocked:
-                        self._quota_blocked.add(gang.name)
-                        c.record_event(
-                            now,
-                            gang.name,
-                            f"gang waiting on queue {gang.queue!r} quota",
-                        )
+                    borrowers.append((order, gang, sub, per_group_nodes, demand))
+            else:
+                granted.append((order, gang, sub, per_group_nodes))
+            order += 1
+        reclaim_candidates: list[tuple[PodGang, dict, object]] = []
+        if borrowers:
+            borrowers.sort(
+                key=lambda b: (-qtree.borrow_weight(b[1].queue, b[4]), b[0])
+            )
+            for order_i, gang, sub, pgn, demand in borrowers:
+                verdict = qtree.try_charge(qusage, gang.queue, demand)
+                if verdict.admitted:
+                    self._quota_blocked.discard(gang.name)
+                    granted.append((order_i, gang, sub, pgn))
                     continue
+                if gang.name not in self._quota_blocked:
+                    self._quota_blocked.add(gang.name)
+                    c.record_event(
+                        now,
+                        gang.name,
+                        f"gang waiting on queue {gang.queue!r} quota "
+                        f"({verdict.blocked_reason} at {verdict.blocked_at!r})",
+                    )
+                if verdict.reclaim_eligible:
+                    reclaim_candidates.append((gang, demand, verdict))
+        # Solver batch order must stay the priority order (scaled gangs
+        # behind their base, etc.) — re-sort grants by arrival index.
+        for _, gang, sub, pgn in sorted(granted, key=lambda g: g[0]):
             sub_gangs.append(sub)
-            if per_group_nodes:
-                bound_node_names[gang.name] = per_group_nodes
+            if pgn:
+                bound_node_names[gang.name] = pgn
+        if reclaim_candidates and floors_only:
+            # In-quota demand squeezed out by siblings' borrowing reclaims
+            # the borrowed capacity (KAI reclaim) — floors only: best-effort
+            # extras never evict anyone.
+            self._reclaim_for_quota(reclaim_candidates, now)
         if not sub_gangs:
             return 0
 
@@ -665,6 +692,23 @@ class GroveController:
                 self._preempt_for_rejected(rejected, now)
         return admitted
 
+    @property
+    def queue_tree(self) -> QueueTree | None:
+        """The QueueTree for `queues` — accepts an already-built tree or the
+        legacy flat {name: {res: quota}} float map (normalized once and
+        cached per distinct mapping object)."""
+        q = self.queues
+        if not q:
+            return None
+        if isinstance(q, QueueTree):
+            return q
+        cached = getattr(self, "_queue_tree_cache", None)
+        if cached is not None and cached[0] is q:
+            return cached[1]
+        tree = QueueTree.from_flat(q)
+        self._queue_tree_cache = (q, tree)
+        return tree
+
     def queue_usage(self) -> dict[str, dict[str, float]]:
         """Bound-and-active resource usage per capacity queue — the number
         the quota filter subtracts and the observability surfaces report
@@ -770,6 +814,115 @@ class GroveController:
                 )
             c.record_event(
                 now, gang.name, f"gang preempted by {contender.name} ({len(pods)} pods)"
+            )
+        return True
+
+    def _reclaim_for_quota(
+        self, candidates: list[tuple[PodGang, dict, object]], now: float
+    ) -> bool:
+        """In-quota demand beats over-quota borrowers (the KAI reclaim
+        rule): evict enough borrower gangs under the blocking ancestor that
+        the highest-priority in-quota contender's demand fits its deserved
+        share. One reclaim per pass with the preemption cooldown, so the
+        cascade stays observable; the contender re-solves next pass against
+        the freed capacity."""
+        c = self.cluster
+        qtree = self.queue_tree
+        for name in [n for n in self._reclaimed_for_at if n not in c.podgangs]:
+            del self._reclaimed_for_at[name]
+        chosen_cand = None
+        for gang, demand, verdict in sorted(
+            candidates, key=lambda t: -self._priority_of(t[0])
+        ):
+            last = self._reclaimed_for_at.get(gang.name)
+            if last is None or now - last >= self.preemption_cooldown_seconds:
+                chosen_cand = (gang, demand, verdict)
+                break
+        if chosen_cand is None:
+            return False
+        gang, demand, verdict = chosen_cand
+        blocked_at = verdict.blocked_at
+        # Live (not pass-charged) usage: reclaim evicts BOUND gangs, so the
+        # arithmetic must be over committed bindings only.
+        live = qtree.hierarchical_usage(self.queue_usage())
+        # Over-quota is a queue-level (rolled-up) property, but gangs are
+        # charged to the queue they were SUBMITTED to — which may be a
+        # descendant of the over-quota level (e.g. borrowers in sub-a push
+        # team-a past quota). The victim pool is therefore the union of the
+        # over-quota queues' SUBTREES: every gang in an over-quota family is
+        # running on borrowed share.
+        contender_chain = set(qtree.ancestors(gang.queue))
+        victim_queues: set[str] = set()
+        for oq in qtree.over_quota_queues(live, blocked_at) - contender_chain:
+            victim_queues |= qtree.subtree(oq)
+        victim_queues -= contender_chain
+        if not victim_queues:
+            return False
+        # How much must free AT THE BLOCKING LEVEL for the contender to fit
+        # inside that level's quota.
+        used = live.get(blocked_at, {})
+        needed: dict[str, float] = {}
+        for rname, qty in demand.items():
+            env = qtree.envelope(blocked_at, rname)
+            if env.quota != -1:
+                over = used.get(rname, 0.0) + qty - env.quota
+                if over > 1e-9:
+                    needed[rname] = over
+        if not needed:
+            return False
+        victims = []
+        for other in c.podgangs.values():
+            if other.queue in victim_queues and other.name != gang.name:
+                pods = [
+                    p
+                    for p in c.pods_of_gang(other.name)
+                    if p.is_active and p.is_scheduled
+                ]
+                if pods:
+                    victims.append((other, pods))
+        # Lightest borrowers go first (overQuotaWeight ascending), then
+        # lowest priority, then smallest blast radius.
+        victims.sort(
+            key=lambda gp: (
+                qtree.borrow_weight(gp[0].queue, needed),
+                self._priority_of(gp[0]),
+                len(gp[1]),
+            )
+        )
+        released = {r: 0.0 for r in needed}
+        chosen: list[tuple[PodGang, list[Pod]]] = []
+        for other, pods in victims:
+            chosen.append((other, pods))
+            for p in pods:
+                for res, qty in p.spec.total_requests().items():
+                    if res in released:
+                        released[res] += qty
+            if all(released[r] >= needed[r] - 1e-9 for r in needed):
+                break
+        else:
+            return False  # even evicting every borrower cannot free enough
+        from grove_tpu.api.types import Condition, set_condition
+
+        self._reclaimed_for_at[gang.name] = now
+        for other, pods in chosen:
+            other.status.conditions = set_condition(
+                other.status.conditions,
+                Condition(
+                    type=constants.PODGANG_CONDITION_DISRUPTION_TARGET,
+                    status="True",
+                    reason="Reclaimed",
+                    message=(
+                        f"over-quota usage reclaimed by in-quota gang {gang.name}"
+                    ),
+                ),
+                now,
+            )
+            for p in pods:
+                self._release_pod(p, now, reason=f"reclaimed by {gang.name}")
+            c.record_event(
+                now,
+                other.name,
+                f"gang reclaimed by in-quota {gang.name} ({len(pods)} pods)",
             )
         return True
 

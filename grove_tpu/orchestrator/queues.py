@@ -140,9 +140,12 @@ class QueueTree:
                     acc[rname] = acc.get(rname, 0.0) + qty
         return out
 
-    def _res(self, qname: str, rname: str) -> QueueResource:
-        # A resource the spec doesn't envelope is unconstrained at that level.
+    def envelope(self, qname: str, rname: str) -> QueueResource:
+        """The (quota, limit, weight) envelope for one resource at one
+        level. A resource the spec doesn't mention is unconstrained."""
         return self.specs[qname].resources.get(rname, QueueResource())
+
+    _res = envelope  # internal alias
 
     def borrow_weight(self, qname: str, demand: dict[str, float]) -> float:
         """Grant-ordering weight for an over-quota demand: the most
@@ -157,6 +160,7 @@ class QueueTree:
         qname: str,
         demand: dict[str, float],
         commit: bool = True,
+        allow_borrow: bool = True,
     ) -> Verdict:
         """Can `demand` land in `qname` given hierarchical `usage`?
 
@@ -164,6 +168,11 @@ class QueueTree:
         pushed past a set quota needs that level's weight > 0 for every
         over-quota resource AND a parent to borrow from. On admission (and
         commit=True) the demand is charged to the whole chain.
+
+        `allow_borrow=False` treats EVERY set quota as hard — the serving
+        pass uses it to classify: in-quota demands grant first (deserved
+        before borrowed), over-quota candidates retry with borrowing in
+        weight order afterward.
         """
         if qname not in self.specs:
             # Unknown queue: admission (api/admission.py) should have
@@ -188,20 +197,16 @@ class QueueTree:
                     if level == 0:
                         in_quota_at_self = False
                     is_root = self.specs[anc].parent is None
-                    if is_root:
+                    if is_root or env.over_quota_weight <= 0.0 or not allow_borrow:
                         return Verdict(
                             admitted=False,
                             blocked_at=anc,
-                            blocked_reason="root-quota",
+                            blocked_reason="root-quota" if is_root else "quota",
                             # In-quota at its own level but squeezed out of
-                            # the root headroom by borrowers -> may reclaim.
-                            reclaim_eligible=in_quota_at_self and level > 0,
-                        )
-                    if env.over_quota_weight <= 0.0:
-                        return Verdict(
-                            admitted=False,
-                            blocked_at=anc,
-                            blocked_reason="quota",
+                            # an ancestor's headroom by borrowers -> may
+                            # reclaim. (Meaningless in allow_borrow=False
+                            # classification calls; callers consult it only
+                            # on the borrowing retry.)
                             reclaim_eligible=in_quota_at_self and level > 0,
                         )
                     borrowed = True
@@ -248,3 +253,102 @@ class QueueTree:
 
     def depth(self, name: str) -> int:
         return len(self._chain[name]) - 1
+
+
+def _parse_qty(value, ctx: str) -> float:
+    """quota/limit value: -1 (unlimited) or a k8s quantity."""
+    from grove_tpu.api.quantity import parse_quantity
+
+    if value == -1:
+        return -1.0
+    try:
+        out = float(parse_quantity(value))
+        if out < 0:
+            raise ValueError("negative")
+        return out
+    except (ValueError, TypeError):
+        raise ValueError(f"{ctx}: {value!r} is not a quantity or -1") from None
+
+
+def parse_queue_config(
+    queues: dict, errors: list[str] | None = None
+) -> QueueTree | None:
+    """`scheduling.queues` -> QueueTree. Both config shapes, per queue:
+
+    - legacy flat `{resource: quota}` — a parentless hard-quota queue
+      (exactly the pre-hierarchy behavior);
+    - structured `{parentQueue: name?, resources: {res: {quota, limit,
+      overQuotaWeight}}}` — the KAI Queue CR shape
+      (e2e/yaml/queues.yaml:22-30).
+
+    With `errors` (config validation), every problem is appended — one
+    message per bad queue, `scheduling.queues.<q>...`-prefixed — and None
+    is returned if any; without it (the manager booting validated config)
+    the first problem raises ValueError.
+    """
+    if not queues:
+        return None
+    collected: list[str] = [] if errors is None else errors
+    specs: dict[str, QueueSpec] = {}
+    for qname, doc in queues.items():
+        try:
+            specs[qname] = _parse_one_queue(qname, doc)
+        except ValueError as e:
+            if errors is None:
+                raise
+            collected.append(str(e))
+    if errors is not None and collected:
+        return None
+    try:
+        return QueueTree(specs)
+    except ValueError as e:
+        msg = f"scheduling.queues: {e}"
+        if errors is None:
+            raise ValueError(msg) from None
+        collected.append(msg)
+        return None
+
+
+def _parse_one_queue(qname: str, doc) -> QueueSpec:
+    """One queue entry (either shape) -> QueueSpec; ValueError on the first
+    problem with a `scheduling.queues.<q>...`-prefixed message."""
+    ctx = f"scheduling.queues.{qname}"
+    if not isinstance(doc, dict):
+        raise ValueError(f"{ctx}: must map resource -> quota")
+    if not ("resources" in doc or "parentQueue" in doc):
+        # Legacy flat shape: {resource: quota}, parentless (hard quota).
+        return QueueSpec(
+            qname,
+            None,
+            {
+                rname: QueueResource(quota=_parse_qty(q, f"{ctx}.{rname}"))
+                for rname, q in doc.items()
+            },
+        )
+    unknown = set(doc) - {"resources", "parentQueue"}
+    if unknown:
+        raise ValueError(f"{ctx}: unknown fields {sorted(unknown)}")
+    parent = doc.get("parentQueue")
+    if parent is not None and not isinstance(parent, str):
+        raise ValueError(f"{ctx}.parentQueue: must be a queue name")
+    resources: dict[str, QueueResource] = {}
+    for rname, env in (doc.get("resources") or {}).items():
+        rctx = f"{ctx}.resources.{rname}"
+        if not isinstance(env, dict):
+            raise ValueError(f"{rctx}: must map {{quota, limit, overQuotaWeight}}")
+        bad = set(env) - {"quota", "limit", "overQuotaWeight"}
+        if bad:
+            raise ValueError(f"{rctx}: unknown fields {sorted(bad)}")
+        quota = _parse_qty(env.get("quota", -1), f"{rctx}.quota")
+        limit = _parse_qty(env.get("limit", -1), f"{rctx}.limit")
+        weight = env.get("overQuotaWeight", 1)
+        if (
+            not isinstance(weight, (int, float))
+            or isinstance(weight, bool)
+            or weight < 0
+        ):
+            raise ValueError(f"{rctx}.overQuotaWeight: must be a number >= 0")
+        if quota != -1 and limit != -1 and limit < quota:
+            raise ValueError(f"{rctx}: limit {limit:g} is below quota {quota:g}")
+        resources[rname] = QueueResource(quota, limit, float(weight))
+    return QueueSpec(qname, parent, resources)

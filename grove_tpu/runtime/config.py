@@ -487,25 +487,13 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
     if not isinstance(cfg.scheduling.queues, dict):
         errors.append("scheduling.queues: must be a mapping of name -> quotas")
     else:
-        from grove_tpu.api.quantity import parse_quantity as _pq
+        # Both queue shapes (legacy flat quotas and hierarchical
+        # parentQueue/resources trees) validate through the one parser the
+        # manager boots from — shape, quantities, weights, parent
+        # existence, and cycles (orchestrator/queues.py).
+        from grove_tpu.orchestrator.queues import parse_queue_config
 
-        for qname, res in cfg.scheduling.queues.items():
-            if not isinstance(res, dict):
-                errors.append(
-                    f"scheduling.queues.{qname}: must map resource -> quota"
-                )
-                continue
-            for rname, quota in res.items():
-                if quota == -1:
-                    continue  # unlimited (the KAI -1 convention)
-                try:
-                    if _pq(quota) < 0:
-                        raise ValueError("negative")
-                except (ValueError, TypeError):
-                    errors.append(
-                        f"scheduling.queues.{qname}.{rname}: {quota!r} is "
-                        "not a quantity or -1"
-                    )
+        parse_queue_config(cfg.scheduling.queues, errors)
     pf = cfg.solver.portfolio
     if not isinstance(pf, int) or isinstance(pf, bool) or pf < 1:
         errors.append("solver.portfolio: must be an int >= 1")

@@ -28,6 +28,7 @@ from grove_tpu.api import constants
 from grove_tpu.api.admission import AdmissionChain, Authorizer
 from grove_tpu.api.types import PodCliqueSet
 from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.queues import parse_queue_config
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.runtime.config import OperatorConfiguration
 from grove_tpu.runtime.flow import (
@@ -444,20 +445,6 @@ def _require_self_signed(cert_file: str) -> None:
         )
 
 
-def _parse_queue_quotas(queues: dict) -> dict:
-    """scheduling.queues (quantity strings / -1) -> numeric quotas for the
-    controller's admission filter (validated at config load)."""
-    from grove_tpu.api.quantity import parse_quantity
-
-    return {
-        qname: {
-            rname: (-1 if quota == -1 else parse_quantity(quota))
-            for rname, quota in res.items()
-        }
-        for qname, res in queues.items()
-    }
-
-
 class Manager:
     """Boots and runs the control plane from one OperatorConfiguration."""
 
@@ -477,7 +464,7 @@ class Manager:
             topology=self.topology,
             solver_params=config.solver.solver_params(),
             priority_classes=dict(config.scheduling.priority_classes),
-            queues=_parse_queue_quotas(config.scheduling.queues),
+            queues=parse_queue_config(config.scheduling.queues) or {},
             tas_enabled=config.topology_aware_scheduling.enabled,
             max_groups=config.solver.max_groups,
             max_sets=config.solver.max_sets,
@@ -795,7 +782,8 @@ class Manager:
         from grove_tpu.version import build_info
 
         queues = {}
-        if self.controller.queues:
+        qtree = self.controller.queue_tree
+        if qtree is not None:
             # HTTP thread vs reconcile thread: queue_usage iterates the pod
             # dict, so retry the rare mid-iteration resize (same discipline
             # as the object-API bulk reads).
@@ -807,12 +795,16 @@ class Manager:
                     continue
             else:
                 usage = {}
+            husage = qtree.hierarchical_usage(usage)
+            desc = qtree.describe()
             queues = {
                 qname: {
-                    "quota": dict(res),
-                    "used": dict(usage.get(qname, {})),
+                    **doc,
+                    "depth": qtree.depth(qname),
+                    # Hierarchical: a parent's `used` includes descendants.
+                    "used": dict(husage.get(qname, {})),
                 }
-                for qname, res in self.controller.queues.items()
+                for qname, doc in desc.items()
             }
         return {
             "build": build_info(),
@@ -1274,19 +1266,22 @@ class Manager:
             # solve_pending (which resets the list) must not re-observe.
             ctrl.last_admission_scores = []
         self._next_requeue = outcome.requeue_after_seconds
-        if self.controller.queues:
+        qtree = self.controller.queue_tree
+        if qtree is not None:
             # Per-queue usage gauges (GREP-244 metrics direction): refreshed
-            # per pass so /metrics mirrors the quota filter's view. Every
-            # series ever emitted is re-set each pass (zero when usage is
-            # gone) — gauges are persistent, so skip-when-absent would
-            # freeze a drained queue at its last nonzero value forever.
-            usage = self.controller.queue_usage()
-            for qname, res in self.controller.queues.items():
-                keys = set(res) | set(usage.get(qname, {}))
+            # per pass so /metrics mirrors the quota filter's view — every
+            # tree level, usage hierarchical (a parent includes its
+            # descendants). Every series ever emitted is re-set each pass
+            # (zero when usage is gone) — gauges are persistent, so
+            # skip-when-absent would freeze a drained queue at its last
+            # nonzero value forever.
+            husage = qtree.hierarchical_usage(self.controller.queue_usage())
+            for qname, spec in qtree.specs.items():
+                keys = set(spec.resources) | set(husage.get(qname, {}))
                 self._queue_metric_keys.setdefault(qname, set()).update(keys)
                 for rname in self._queue_metric_keys[qname]:
                     self._m_queue_used.set(
-                        usage.get(qname, {}).get(rname, 0.0),
+                        husage.get(qname, {}).get(rname, 0.0),
                         queue=qname,
                         resource=rname,
                     )

@@ -257,6 +257,357 @@ def test_queue_gauge_zeroes_when_usage_drains(simple1):
     assert m._m_queue_used.value(queue="team-a", resource="cpu") == 0.0
 
 
+# --- hierarchical queues (parentQueue/quota/limit/overQuotaWeight) ----------------
+# Reference shape: operator/e2e/yaml/queues.yaml:22-30 (KAI Queue CRs).
+
+
+def test_queue_tree_construction_validation():
+    from grove_tpu.orchestrator.queues import parse_queue_config
+
+    with pytest.raises(ValueError, match="does not exist"):
+        parse_queue_config({"a": {"parentQueue": "nope", "resources": {}}})
+    with pytest.raises(ValueError, match="cycle"):
+        parse_queue_config(
+            {
+                "a": {"parentQueue": "b", "resources": {}},
+                "b": {"parentQueue": "a", "resources": {}},
+            }
+        )
+    with pytest.raises(ValueError, match="limit.*below quota"):
+        parse_queue_config(
+            {"a": {"resources": {"cpu": {"quota": "10", "limit": "5"}}}}
+        )
+    with pytest.raises(ValueError, match="overQuotaWeight"):
+        parse_queue_config(
+            {"a": {"resources": {"cpu": {"overQuotaWeight": -1}}}}
+        )
+    with pytest.raises(ValueError, match="unknown fields"):
+        parse_queue_config({"a": {"resources": {}, "reclaim": True}})
+    # Both shapes validate through parse_operator_config too.
+    _, errors = parse_operator_config(
+        {
+            "scheduling": {
+                "queues": {
+                    "org": {"resources": {"cpu": {"quota": "10"}}},
+                    "team": {
+                        "parentQueue": "org",
+                        "resources": {
+                            "cpu": {"quota": "4", "limit": "8", "overQuotaWeight": 2}
+                        },
+                    },
+                }
+            }
+        }
+    )
+    assert not errors, errors
+    _, errors = parse_operator_config(
+        {"scheduling": {"queues": {"team": {"parentQueue": 7, "resources": {}}}}}
+    )
+    assert any("parentQueue" in e for e in errors)
+
+
+def test_queue_tree_charge_semantics():
+    """The admission calculus: in-quota, borrowing within parent headroom,
+    hard limit, root quota, weight-0 hard quota, hierarchical usage."""
+    from grove_tpu.orchestrator.queues import parse_queue_config
+
+    tree = parse_queue_config(
+        {
+            "org": {"resources": {"cpu": {"quota": "10"}}},
+            "a": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "4", "limit": "9"}},
+            },
+            "b": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "4", "overQuotaWeight": 0}},
+            },
+        }
+    )
+    usage = tree.hierarchical_usage({"a": {"cpu": 3.0}, "b": {"cpu": 1.0}})
+    assert usage["org"]["cpu"] == 4.0  # parent includes both children
+
+    # In-quota grant charges the whole chain.
+    v = tree.try_charge(usage, "a", {"cpu": 1.0})
+    assert v.admitted and not v.borrowed
+    assert usage["a"]["cpu"] == 4.0 and usage["org"]["cpu"] == 5.0
+
+    # Over quota but within parent headroom -> borrow.
+    v = tree.try_charge(usage, "a", {"cpu": 3.0})
+    assert v.admitted and v.borrowed
+    assert usage["a"]["cpu"] == 7.0 and usage["org"]["cpu"] == 8.0
+
+    # The queue's own limit is hard even with parent headroom left.
+    v = tree.try_charge(usage, "a", {"cpu": 2.5})
+    assert not v.admitted and v.blocked_reason == "limit" and v.blocked_at == "a"
+
+    # weight 0 -> quota is hard for that queue.
+    v = tree.try_charge(usage, "b", {"cpu": 3.5})
+    assert not v.admitted and v.blocked_reason == "quota" and v.blocked_at == "b"
+
+    # Root quota can never be borrowed past; an in-quota child squeezed out
+    # by the sibling's borrowing is reclaim-eligible.
+    v = tree.try_charge(usage, "b", {"cpu": 2.5})
+    assert not v.admitted and v.blocked_reason == "root-quota"
+    assert v.blocked_at == "org" and v.reclaim_eligible
+
+    # allow_borrow=False classifies: the same demand that borrows above is
+    # rejected when borrowing is off.
+    v = tree.try_charge(usage, "a", {"cpu": 1.5}, allow_borrow=False)
+    assert not v.admitted
+
+
+def test_queue_validation_accumulates_all_errors():
+    """Several bad entries -> several messages in one validation run (the
+    operator fixes everything at once, not one fix-and-rerun per entry)."""
+    _, errors = parse_operator_config(
+        {
+            "scheduling": {
+                "queues": {
+                    "a": {"cpu": "ten"},
+                    "b": "nope",
+                    "c": {"resources": {"cpu": {"quota": "10", "limit": "5"}}},
+                }
+            }
+        }
+    )
+    assert any("a.cpu" in e for e in errors)
+    assert any("queues.b" in e for e in errors)
+    assert any("limit" in e and "below quota" in e for e in errors)
+
+
+def test_reclaim_reaches_borrowers_in_descendant_queues(simple1, simple1_variant):
+    """Over-quota is a rolled-up property but gangs are charged to the
+    queue they were SUBMITTED to: borrowers submitted to a CHILD of the
+    over-quota level must still be reclaimable (review finding: exact-name
+    victim matching made deep borrowers invisible and starved the in-quota
+    arrival forever)."""
+    m = _mgr(
+        {
+            "org": {"resources": {"cpu": {"quota": "0.13"}}},
+            "team-a": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "0.01"}},
+            },
+            # No envelope of its own: usage rolls up into team-a, which is
+            # where over-quota is detected.
+            "sub-a": {"parentQueue": "team-a", "resources": {}},
+            "team-b": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "0.13"}},
+            },
+        }
+    )
+    a = copy.deepcopy(simple1)
+    a.metadata.annotations[constants.ANNOTATION_QUEUE] = "sub-a"
+    m.apply_podcliqueset(a)
+    m.reconcile_once(now=1.0)
+    assert [
+        p
+        for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("simple1-") and p.is_scheduled
+    ], "deep borrower admits while headroom is free"
+
+    b = copy.deepcopy(simple1_variant)
+    b.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-b"
+    m.apply_podcliqueset(b)
+    for t in range(2, 8):
+        m.reconcile_once(now=float(t))
+    bound_b = [
+        p
+        for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("variant1-") and p.is_scheduled
+    ]
+    bound_a = [
+        p
+        for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("simple1-") and p.is_scheduled
+    ]
+    assert len(bound_b) == 13, "in-quota arrival reclaims the deep borrower"
+    assert not bound_a
+
+
+def test_hierarchy_borrowing_admits_over_quota_within_parent(simple1):
+    """A child over ITS quota still admits while the parent has headroom
+    (overQuotaWeight > 0); the identical config with weight 0 blocks —
+    quota becomes hard."""
+
+    def run(weight: int):
+        m = _mgr(
+            {
+                "org": {"resources": {"cpu": {"quota": "0.2"}}},
+                "team-a": {
+                    "parentQueue": "org",
+                    "resources": {
+                        "cpu": {"quota": "0.05", "overQuotaWeight": weight}
+                    },
+                },
+            }
+        )
+        a = copy.deepcopy(simple1)
+        a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+        m.apply_podcliqueset(a)  # base floor demand: 0.13 cpu > 0.05 quota
+        for t in range(1, 5):
+            m.reconcile_once(now=float(t))
+        return [p for p in m.cluster.pods.values() if p.is_scheduled]
+
+    assert len(run(1)) == 13, "borrowing within parent headroom must admit"
+    assert not run(0), "overQuotaWeight 0 makes the quota hard"
+
+
+def test_hierarchy_limit_caps_borrowing(simple1):
+    """`limit` is the hard ceiling on borrowing: parent headroom exists but
+    the child's limit is below the demand."""
+    m = _mgr(
+        {
+            "org": {"resources": {"cpu": {"quota": "1"}}},
+            "team-a": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "0.05", "limit": "0.10"}},
+            },
+        }
+    )
+    a = copy.deepcopy(simple1)
+    a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+    m.apply_podcliqueset(a)
+    for t in range(1, 5):
+        m.reconcile_once(now=float(t))
+    assert not [p for p in m.cluster.pods.values() if p.is_scheduled]
+    assert any(
+        "queue 'team-a' quota (limit" in msg for _, _, msg in m.cluster.events
+    )
+
+
+def test_in_quota_arrival_reclaims_over_quota_borrower(simple1, simple1_variant):
+    """KAI reclaim: a borrower fills the parent's headroom; an IN-quota
+    arrival in a sibling queue evicts it (DisruptionTarget/Reclaimed) and
+    takes its deserved share; the borrower waits thereafter."""
+    m = _mgr(
+        {
+            "org": {"resources": {"cpu": {"quota": "0.13"}}},
+            "borrower": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "0.01"}},
+            },
+            "deserved": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "0.13"}},
+            },
+        }
+    )
+    a = copy.deepcopy(simple1)
+    a.metadata.annotations[constants.ANNOTATION_QUEUE] = "borrower"
+    m.apply_podcliqueset(a)
+    m.reconcile_once(now=1.0)
+    bound_a = [
+        p
+        for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("simple1-") and p.is_scheduled
+    ]
+    assert len(bound_a) == 13, "borrower admits while headroom is free"
+
+    b = copy.deepcopy(simple1_variant)
+    b.metadata.annotations[constants.ANNOTATION_QUEUE] = "deserved"
+    m.apply_podcliqueset(b)
+    for t in range(2, 8):
+        m.reconcile_once(now=float(t))
+    bound_b = [
+        p
+        for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("variant1-") and p.is_scheduled
+    ]
+    bound_a = [
+        p
+        for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("simple1-") and p.is_scheduled
+    ]
+    assert len(bound_b) == 13, "in-quota arrival takes its deserved share"
+    assert not bound_a, "the borrower was reclaimed and now waits"
+    assert any("reclaimed by in-quota" in msg for _, _, msg in m.cluster.events)
+    from grove_tpu.api import constants as k
+
+    reclaimed = [
+        g
+        for g in m.cluster.podgangs.values()
+        if any(
+            c.type == k.PODGANG_CONDITION_DISRUPTION_TARGET
+            and c.reason == "Reclaimed"
+            for c in g.status.conditions
+        )
+    ]
+    assert reclaimed, "victim gang carries the Reclaimed DisruptionTarget"
+
+
+def test_statusz_and_cli_render_queue_hierarchy(simple1, capsys):
+    """/statusz carries parent/depth/limit/weight with HIERARCHICAL usage
+    (parent includes child); `get queues` indents children under parents."""
+    import json
+    import urllib.request
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": 0, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "scheduling": {
+                "queues": {
+                    "org": {"resources": {"cpu": {"quota": "10"}}},
+                    "team-a": {
+                        "parentQueue": "org",
+                        "resources": {
+                            "cpu": {"quota": "4", "limit": "8", "overQuotaWeight": 2}
+                        },
+                    },
+                }
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    from grove_tpu.state import Node
+
+    for i in range(4):
+        m.cluster.nodes[f"n{i}"] = Node(
+            name=f"n{i}",
+            capacity={"cpu": 64.0, "memory": 256 * 2**30},
+            labels={
+                "topology.kubernetes.io/zone": "z0",
+                "topology.kubernetes.io/block": "b0",
+                "topology.kubernetes.io/rack": f"r{i % 2}",
+            },
+        )
+    m.start()
+    try:
+        a = copy.deepcopy(simple1)
+        a.metadata.annotations[constants.ANNOTATION_QUEUE] = "team-a"
+        m.apply_podcliqueset(a)
+        m.reconcile_once(now=1.0)
+        base = f"http://127.0.0.1:{m.health_port}"
+        st = json.loads(urllib.request.urlopen(f"{base}/statusz").read())
+        org, team = st["queues"]["org"], st["queues"]["team-a"]
+        assert org["parent"] is None and org["depth"] == 0
+        assert team["parent"] == "org" and team["depth"] == 1
+        assert team["limit"] == {"cpu": 8.0}
+        assert team["overQuotaWeight"] == {"cpu": 2.0}
+        assert abs(team["used"]["cpu"] - 0.13) < 1e-6
+        assert abs(org["used"]["cpu"] - 0.13) < 1e-6, "usage rolls up"
+
+        from grove_tpu.cli.main import main as cli_main
+
+        rc = cli_main(
+            ["--server", f"http://127.0.0.1:{m.health_port}", "get", "queues"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        org_i = next(i for i, ln in enumerate(lines) if ln.startswith("org"))
+        team_i = next(i for i, ln in enumerate(lines) if "team-a" in ln)
+        assert team_i > org_i, "children list under their parent"
+        assert lines[team_i].startswith("  team-a"), "children indent"
+        assert "org" in lines[team_i].split()[1], "PARENT column filled"
+    finally:
+        m.stop()
+
+
 def test_cli_get_queues_table(simple1, capsys):
     """`grove-tpu get queues` renders quota/usage from statusz."""
     cfg, errors = parse_operator_config(
