@@ -2,10 +2,23 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-go-shim bench bench-cpu dryrun api-docs check clean
+.PHONY: test test-all test-e2e test-conformance test-go-shim bench bench-cpu dryrun api-docs check clean ci
 
+# The green-bar contract for a cold checkout: check + default suite +
+# process e2e + wire conformance + the Go shim when a toolchain exists.
+# .github/workflows/ci.yaml runs this same set as parallel jobs.
+ci:              ## green-bar contract (serial form of .github/workflows/ci.yaml)
+	$(MAKE) check
+	$(MAKE) test
+	$(MAKE) test-e2e
+	$(MAKE) test-conformance
+	$(MAKE) test-go-shim
+
+# Conformance is ignored here because it has its own tier (and CI job) —
+# it shells out to protoc, which plain unit-test environments may lack.
 test:            ## unit + scenario suites (CPU-forced via tests/conftest.py)
-	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_process.py
+	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_process.py \
+		--ignore=tests/test_backend_conformance.py
 
 test-all:        ## everything incl. soak/churn tiers and process e2e
 	$(PY) -m pytest tests/ -q -m ""
