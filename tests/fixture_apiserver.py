@@ -29,6 +29,7 @@ import http.server
 import json
 import queue
 import threading
+import time
 import urllib.parse
 
 
@@ -78,6 +79,9 @@ class FixtureApiServer:
             "podcliquescalinggroups": [],
         }
         self._fail_watch_code: int | None = None
+        # Watch replay window size (etcd compaction analog); tests shrink it
+        # to force 410s / prove bookmark-based resume cheaply.
+        self.compact_window = 2000
         # Watch replay log (apiserver rv semantics): resource -> [(rv, ev)].
         self._event_log: dict[str, list] = {}
         # Highest tag dropped from each resource's log (compaction floor).
@@ -605,12 +609,12 @@ class FixtureApiServer:
         # to close. Bounded like etcd's compaction window.
         log = self._event_log.setdefault(resource, [])
         log.append((self._rv, ev))
-        if len(log) > 2000:
+        if len(log) > self.compact_window:
             # Track the highest compacted tag: a resume below it gets 410
             # Gone (the signal that makes etcd's bounded window safe — the
             # client relists instead of silently missing events).
-            self._log_compacted[resource] = log[len(log) - 2001][0]
-            del log[:-2000]
+            self._log_compacted[resource] = log[len(log) - self.compact_window - 1][0]
+            del log[: -self.compact_window]
         for q in self._watchers[resource]:
             q.put(ev)
 
@@ -620,6 +624,18 @@ class FixtureApiServer:
             handler._json(code, {"kind": "Status", "code": code})
             return
         selector = qs.get("labelSelector", "")
+        # timeoutSeconds: the apiserver closes the stream at the client's
+        # requested budget; with allowWatchBookmarks it sends a BOOKMARK at
+        # the CURRENT rv right before closing, so a resume after heavy
+        # selector-filtered churn starts fresh instead of 410ing into a
+        # relist (k8s API concepts, "Watch bookmarks").
+        bookmarks = qs.get("allowWatchBookmarks") in ("true", "1")
+        try:
+            timeout_s = (
+                float(qs["timeoutSeconds"]) if qs.get("timeoutSeconds") else None
+            )
+        except ValueError:
+            timeout_s = None
         q: queue.Queue = queue.Queue()
         # Param ABSENT = "start at now" (no replay); PRESENT — including
         # "0", the rv of a LIST taken before any event — = "replay
@@ -666,8 +682,55 @@ class FixtureApiServer:
                 if self._matches(ev["object"], selector):
                     handler.wfile.write(json.dumps(ev).encode() + b"\n")
             handler.wfile.flush()
+            deadline = (
+                time.monotonic() + timeout_s if timeout_s is not None else None
+            )
             while True:
-                ev = q.get()
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        if bookmarks:
+                            # rv-then-drain, in that order: _emit runs under
+                            # the fixture lock, so after reading rv_now every
+                            # event tagged <= rv_now is already in q — drain
+                            # and deliver them BEFORE the bookmark, or the
+                            # bookmark's rv would cover events the client
+                            # never received (review finding: a permanently
+                            # lost event, the exact guarantee bookmarks
+                            # exist to give). Drained events tagged > rv_now
+                            # are withheld: the resume replays them.
+                            with self._lock:
+                                rv_now = self._rv
+                            while True:
+                                try:
+                                    dev = q.get_nowait()
+                                except queue.Empty:
+                                    break
+                                if dev is None:
+                                    return
+                                tag = int(
+                                    dev["object"]["metadata"]["resourceVersion"]
+                                )
+                                if tag <= rv_now and self._matches(
+                                    dev["object"], selector
+                                ):
+                                    handler.wfile.write(
+                                        json.dumps(dev).encode() + b"\n"
+                                    )
+                            bm = {
+                                "type": "BOOKMARK",
+                                "object": {
+                                    "metadata": {"resourceVersion": str(rv_now)}
+                                },
+                            }
+                            handler.wfile.write(json.dumps(bm).encode() + b"\n")
+                            handler.wfile.flush()
+                        return  # timeoutSeconds reached: clean stream end
+                try:
+                    ev = q.get(timeout=remaining)
+                except queue.Empty:
+                    continue  # hit the deadline branch above
                 if ev is None:  # server closing
                     return
                 if not self._matches(ev["object"], selector):

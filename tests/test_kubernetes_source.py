@@ -766,6 +766,78 @@ def test_store_only_workload_does_not_hammer_apiserver(api, tmp_path, simple1):
         m.stop()
 
 
+def test_fixture_watch_sends_bookmark_at_timeout(api):
+    """Fixture fidelity (docs/FIXTURE_FIDELITY.md row 6): with
+    allowWatchBookmarks the stream ends with a BOOKMARK carrying the
+    CURRENT rv at timeoutSeconds; without the param it just closes."""
+    import urllib.request
+
+    api.add_node(k8s_node("n0", cpu="1", memory="1Gi"))
+
+    def stream(params: str) -> list[dict]:
+        url = f"{api.url}/api/v1/nodes?watch=1&resourceVersion=0&{params}"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return [json.loads(ln) for ln in r.read().splitlines() if ln.strip()]
+
+    lines = stream("allowWatchBookmarks=true&timeoutSeconds=1")
+    assert lines and lines[-1]["type"] == "BOOKMARK"
+    assert int(lines[-1]["object"]["metadata"]["resourceVersion"]) >= 1
+    assert all(ln["type"] != "BOOKMARK" for ln in lines[:-1])
+    lines = stream("timeoutSeconds=1")
+    assert all(ln["type"] != "BOOKMARK" for ln in lines)
+
+
+def test_bookmark_resume_survives_filtered_churn_compaction(api):
+    """The failure bookmarks exist for (k8s API concepts, 'Watch
+    bookmarks'): churn a labelSelector filters OUT advances the cluster rv
+    invisibly to the client, so after compaction a resume from the client's
+    last DELIVERED rv would 410 into a relist. The timeout BOOKMARK hands
+    the client a fresh rv — resume crosses the compaction gap without a
+    single relist."""
+    from grove_tpu.api import constants as k
+
+    api.compact_window = 10  # tiny etcd window: 30 noise events compact past it
+    managed = {k.LABEL_MANAGED_BY: k.LABEL_MANAGED_BY_VALUE}
+
+    def mk_pod(name: str, labels: dict) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "labels": labels},
+            "spec": {},
+            "status": {},
+        }
+
+    api.pods["m0"] = mk_pod("m0", managed)
+    src = KubernetesWatchSource(
+        KubeContext(server=api.url, namespace="default"),
+        watch_read_timeout_s=1.0,  # short streams: quick bookmark cycles
+    )
+    src.start()
+    try:
+        _poll_until(
+            src, lambda evs: any(e.kind == "Pod" and e.name == "m0" for e in evs)
+        )
+        # Invisible churn: 30 unmanaged-pod events the selector filters out.
+        for i in range(30):
+            noise = mk_pod(f"noise-{i}", {})
+            api.pods[noise["metadata"]["name"]] = noise
+            api._emit("pods", "ADDED", noise)
+        # Two stream cycles: the first timeout's bookmark carries the
+        # post-churn rv; the resume after it crosses the compacted window.
+        time.sleep(2.5)
+        api.pods["m1"] = mk_pod("m1", managed)
+        api._emit("pods", "ADDED", api.pods["m1"])
+        _poll_until(
+            src, lambda evs: any(e.kind == "Pod" and e.name == "m1" for e in evs)
+        )
+        assert not src.errors, (
+            f"bookmark resume must not relist/410: {src.errors}"
+        )
+    finally:
+        src.stop()
+
+
 def test_watch_survives_repeated_stream_drops(api, tmp_path, simple1):
     """Chaos tier: the informer loop must converge through repeated watch
     failures (410 relists mid-reconcile) without losing node/pod state —
