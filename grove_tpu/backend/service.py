@@ -495,9 +495,16 @@ class TPUSchedulerBackend:
 
     def _solve_unlocked(self, work: dict):
         """No lock held: snapshot build, bucketed encode, device solve, decode."""
+        from grove_tpu.solver.encode import next_pow2
+
         pending = work["pending"]
+        # Node axis pow2-bucketed like every encode axis: cluster growth
+        # inside a bucket reuses the compiled solver (no XLA recompile).
         snapshot = build_snapshot(
-            work["nodes"], work["topology"], bound_pods=work["bound_pods"]
+            work["nodes"],
+            work["topology"],
+            bound_pods=work["bound_pods"],
+            pad_nodes_to=next_pow2(len(work["nodes"])),
         )
         bound_idx = {
             gname: {

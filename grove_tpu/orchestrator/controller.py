@@ -52,8 +52,8 @@ from grove_tpu.orchestrator.status import (
 from grove_tpu.orchestrator.queues import QueueTree
 from grove_tpu.orchestrator.store import Cluster
 from grove_tpu.solver.core import SolverParams, decode_assignments, solve
-from grove_tpu.solver.encode import encode_gangs
-from grove_tpu.solver.escalation import EscalationDamper, escalation_fingerprint
+from grove_tpu.solver.encode import encode_gangs, next_pow2
+from grove_tpu.solver.escalation import EscalationDamper, node_state_digest
 from grove_tpu.solver.planner import (
     build_pending_subgang,
     build_spread_avoid,
@@ -115,6 +115,9 @@ class GroveController:
     # Reclaim flap guard (same discipline as _preempted_for_at): one
     # reclaim attempt per in-quota contender per cooldown window.
     _reclaimed_for_at: dict = field(default_factory=dict)
+    # Solve-skip memo, per wave kind: (input fingerprint, retry_at) of the
+    # last no-effect pass — see the wave_fp block in _solve_wave.
+    _solve_skip_memo: dict = field(default_factory=dict)
     # PlacementScores of gangs first-admitted in the LAST solve_pending pass
     # (GREP-244 metrics direction) — the manager drains this into the
     # grove_placement_score histogram each reconcile.
@@ -534,8 +537,66 @@ class GroveController:
             return 0
 
         bound_pods = [p for p in c.pods.values() if p.is_scheduled and p.is_active]
+        # Solve-skip damper: the batched solve is deterministic in its
+        # inputs, so a pass whose input state matches the last pass that
+        # admitted NOTHING and bound NOTHING will reproduce that outcome
+        # exactly — skip the snapshot/encode/solve entirely. This is the
+        # controller's steady-state saturation cost going to ~zero (and the
+        # scenario suites' wall-clock with it). `retry_at` re-runs the pass
+        # when a rejected contender's preemption cooldown expires — the one
+        # time-driven effect a skipped solve would otherwise never retry.
+        # The fingerprint covers everything the encode reads: ordered
+        # pending subgangs (refs + template hashes + floors + queue +
+        # priority), base-scheduled set, placements, full node state. It is
+        # shared with the escalation damper. Placements are digested over
+        # ALL pods holding a node_name — not just active ones — because the
+        # reuse/spread seeds read inactive (Failed) pods' nodes too; a GC
+        # of those pods changes solver inputs and must break the match.
+        wave_fp = (
+            tuple(
+                (
+                    sub.name,
+                    getattr(sub, "queue", ""),
+                    sub.spec.priority_class_name,
+                    tuple(
+                        (
+                            grp.name,
+                            grp.min_replicas,
+                            tuple(
+                                (
+                                    r.name,
+                                    getattr(
+                                        c.pods.get(r.name), "pod_template_hash", ""
+                                    ),
+                                )
+                                for r in grp.pod_references
+                            ),
+                        )
+                        for grp in sub.spec.pod_groups
+                    ),
+                )
+                for sub in sub_gangs
+            ),
+            frozenset(scheduled_names),
+            frozenset(
+                (p.name, p.node_name, p.is_active)
+                for p in c.pods.values()
+                if p.node_name is not None
+            ),
+            node_state_digest(c.nodes.values()),
+        )
+        memo = self._solve_skip_memo.get(floors_only)
+        if memo is not None and memo[0] == wave_fp and now < memo[1]:
+            return 0
+        # Node axis bucketed to the next power of two (phantom rows are
+        # unschedulable zero-capacity): node add/remove inside a bucket
+        # reuses the compiled solver instead of forcing an XLA recompile —
+        # the static-shape discipline every other solve axis already follows.
         snapshot = build_snapshot(
-            list(c.nodes.values()), self.topology, bound_pods=bound_pods
+            list(c.nodes.values()),
+            self.topology,
+            bound_pods=bound_pods,
+            pad_nodes_to=next_pow2(len(c.nodes)),
         )
         # ReuseReservationRef (podgang.go:65-71): a gang replacing another is
         # biased toward the old gang's nodes via the solver's w_reuse seed.
@@ -619,11 +680,7 @@ class GroveController:
         esc = self.portfolio_escalation
         esc_fp = None
         if esc > self.portfolio:
-            esc_fp = escalation_fingerprint(
-                (g.name for g in sub_gangs),
-                ((p.name, p.node_name) for p in bound_pods),
-                c.nodes.values(),
-            )
+            esc_fp = wave_fp  # same inputs govern both dampers
             esc = self._escalation_damper.effective_width(
                 floors_only, esc_fp, self.portfolio, esc
             )
@@ -650,6 +707,27 @@ class GroveController:
             self._escalation_damper.record(
                 floors_only, esc_fp, esc > self.portfolio, any_valid_rejected
             )
+        # Arm the solve-skip memo only for no-effect passes (nothing bound,
+        # nothing newly admitted). retry_at: the earliest in-cooldown
+        # preemption expiry among valid rejected contenders — past it the
+        # pass must re-run so preemption can retry; contenders NOT in
+        # cooldown already attempted (deterministically) this pass.
+        if not any(bindings.values()):
+            retry_at = math.inf
+            if floors_only and any_valid_rejected:
+                expiries = [
+                    t + self.preemption_cooldown_seconds
+                    for n in decode.gang_names
+                    if valid_by_name.get(n, False)
+                    and not ok_by_name.get(n, False)
+                    and (t := self._preempted_for_at.get(n)) is not None
+                    and now - t < self.preemption_cooldown_seconds
+                ]
+                if expiries:
+                    retry_at = min(expiries)
+            self._solve_skip_memo[floors_only] = (wave_fp, retry_at)
+        else:
+            self._solve_skip_memo.pop(floors_only, None)
         for gang_name, pod_bindings in bindings.items():
             gang = c.podgangs[gang_name]
             for pod_name, node_name in pod_bindings.items():

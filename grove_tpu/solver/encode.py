@@ -293,12 +293,10 @@ def encode_gangs(
     selector_rows: dict[tuple, np.ndarray] = {}
     toleration_rows: dict[tuple, np.ndarray] = {}
     # Nodes carrying scheduling-blocking taints; empty on the common
-    # untainted cluster, keeping the mask tensor unmaterialized.
-    tainted_idx = [
-        i
-        for i, taints in enumerate(snapshot.node_taints)
-        if any(t.get("effect") in _BLOCKING_EFFECTS for t in taints)
-    ]
+    # untainted cluster, keeping the mask tensor unmaterialized. Memoized
+    # on the snapshot: per-wave rescans were the dominant node-linear term
+    # in the drain's host encode (8x-scale profile).
+    tainted_idx = snapshot.tainted_node_indices(_BLOCKING_EFFECTS)
     # Normalize per resource before summing — raw units are incomparable
     # (cpu cores ~1 vs memory bytes ~1e10 vs TPU chips ~4).
     cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)

@@ -19,6 +19,23 @@ from __future__ import annotations
 from typing import Hashable, Iterable
 
 
+def node_state_digest(nodes: Iterable) -> frozenset:
+    """Hashable digest of every node field the solver reads — the
+    schedulable bit, capacity, labels, and taints are all mutable in place
+    (cordon, UpdateCluster) without changing the node-name set, so a
+    names-only digest would miss real state changes."""
+    return frozenset(
+        (
+            n.name,
+            n.schedulable,
+            tuple(sorted(n.capacity.items())),
+            tuple(sorted(n.labels.items())),
+            tuple(sorted(repr(sorted(t.items())) for t in n.taints)),
+        )
+        for n in nodes
+    )
+
+
 def escalation_fingerprint(
     pending_keys: Iterable[Hashable],
     bound_pairs: Iterable[Hashable],
@@ -28,23 +45,12 @@ def escalation_fingerprint(
 
     `pending_keys` identifies the pending gang set (names or spec
     fingerprints), `bound_pairs` the committed placements (pod, node), and
-    `nodes` the Node objects — digested with schedulable bit, capacity,
-    labels, and taints, all of which are mutable in place (cordon,
-    UpdateCluster) without changing the node-name set.
+    `nodes` the Node objects (see node_state_digest).
     """
     return (
         frozenset(pending_keys),
         frozenset(bound_pairs),
-        frozenset(
-            (
-                n.name,
-                n.schedulable,
-                tuple(sorted(n.capacity.items())),
-                tuple(sorted(n.labels.items())),
-                tuple(sorted(repr(sorted(t.items())) for t in n.taints)),
-            )
-            for n in nodes
-        ),
+        node_state_digest(nodes),
     )
 
 

@@ -63,10 +63,31 @@ class ClusterSnapshot:
     node_labels: list[dict] = field(default_factory=list)
     # Raw node taints, same layout; empty for untainted/padded rows.
     node_taints: list[list] = field(default_factory=list)
+    # Memo for tainted_node_indices, keyed by the effects tuple (a single
+    # unkeyed slot would silently serve one caller's effects set to
+    # another). The snapshot is immutable for its lifetime, so one O(N)
+    # taint scan per effects set serves every encode against it — at bench
+    # scale the per-wave rescan was the dominant node-linear term in host
+    # encode (round-5 profile: 1.2s of a 4.8s 8x encode).
+    _tainted_idx: Optional[dict] = None
 
     @property
     def n_nodes(self) -> int:
         return len(self.node_names)
+
+    def tainted_node_indices(self, blocking_effects) -> list[int]:
+        """Indices of nodes carrying scheduling-blocking taints; memoized
+        per effects set (empty on the common untainted cluster)."""
+        key = tuple(sorted(blocking_effects))
+        if self._tainted_idx is None:
+            self._tainted_idx = {}
+        if key not in self._tainted_idx:
+            self._tainted_idx[key] = [
+                i
+                for i, taints in enumerate(self.node_taints)
+                if any(t.get("effect") in blocking_effects for t in taints)
+            ]
+        return self._tainted_idx[key]
 
     @property
     def free(self) -> np.ndarray:

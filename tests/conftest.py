@@ -21,9 +21,22 @@ sys.path.insert(0, str(REPO_ROOT))
 
 # XLA reads XLA_FLAGS at first CPU-client creation, which happens strictly
 # after this module is imported — pytest loads conftest before any test module.
-from grove_tpu.utils.platform import force_virtual_cpu_devices  # noqa: E402
+from grove_tpu.utils.platform import (  # noqa: E402
+    enable_compilation_cache,
+    force_virtual_cpu_devices,
+)
 
 force_virtual_cpu_devices(8)
+# Persistent XLA compilation cache: solver compiles are the dominant suite
+# cost (a single cold solve+escalation pair is ~10s of XLA on CPU), and
+# shapes recur heavily across tests AND across runs. Keyed by HLO+config,
+# so staleness is impossible — worst case is a miss. Override the location
+# with GROVE_TEST_XLA_CACHE (empty string disables).
+_cache_dir = __import__("os").environ.get(
+    "GROVE_TEST_XLA_CACHE", "/tmp/grove-tpu-test-xla-cache"
+)
+if _cache_dir:
+    enable_compilation_cache(_cache_dir)
 
 import pytest  # noqa: E402
 import yaml  # noqa: E402
