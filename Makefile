@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-go-shim bench bench-cpu dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim bench bench-cpu dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -12,6 +12,7 @@ ci:              ## green-bar contract (serial form of .github/workflows/ci.yaml
 	$(MAKE) test
 	$(MAKE) test-e2e
 	$(MAKE) test-conformance
+	$(MAKE) test-cpp-shim
 	$(MAKE) test-go-shim
 
 # Conformance is ignored here because it has its own tier (and CI job) —
@@ -28,6 +29,9 @@ test-e2e:        ## process-level e2e tier only (binary + CLI over HTTP)
 
 test-conformance: ## GREP-375 wire conformance vs the live sidecar (protoc-built client)
 	$(PY) -m pytest tests/test_backend_conformance.py -q
+
+test-cpp-shim:   ## compiled C++ client vs the live sidecar (g++ + protoc + libprotobuf)
+	$(PY) -m pytest tests/test_cpp_conformance.py -q
 
 test-go-shim:    ## `go test` the GREP-375 shim (needs a Go toolchain; absent in this image)
 	@if command -v go >/dev/null 2>&1; then \

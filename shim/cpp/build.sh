@@ -1,0 +1,15 @@
+#!/bin/sh
+# Build the C++ GREP-375 conformance client: generate C++ protobuf from the
+# SAME pinned proto the sidecar serves, then compile against libprotobuf.
+# No gRPC library needed — the client hand-rolls minimal HTTP/2 framing
+# (see conformance_client.cc). Usage: build.sh [outdir] (default: ./build).
+set -e
+cd "$(dirname "$0")"
+OUT="${1:-build}"
+mkdir -p "$OUT"
+protoc --proto_path=../../grove_tpu/backend/proto \
+  --cpp_out="$OUT" scheduler_backend.proto
+c++ -std=c++17 -O1 -I"$OUT" \
+  conformance_client.cc "$OUT/scheduler_backend.pb.cc" \
+  -lprotobuf -pthread -o "$OUT/conformance_client"
+echo "built $OUT/conformance_client"

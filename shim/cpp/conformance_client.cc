@@ -1,0 +1,332 @@
+// GREP-375 wire-conformance client, C++ edition.
+//
+// Purpose: a COMPILED native artifact at the scheduler-backend boundary.
+// The Go shim (shim/go) implements the reference's Go interface but no Go
+// toolchain exists in this image, so nothing compiled proves the boundary
+// is language-neutral. This client is that proof: generated C++ protobuf
+// (protoc --cpp_out, libprotobuf is in the image) plus a hand-rolled
+// minimal gRPC-over-HTTP/2 cleartext layer (no gRPC C++ library here
+// either), driving the live Python sidecar end to end:
+//
+//   Init -> UpdateCluster -> SyncPodGang -> Solve -> verify bindings.
+//
+// HTTP/2 scope (deliberately minimal, spec-legal):
+//  - client preface + SETTINGS exchange (we ACK theirs, they ACK ours)
+//  - one RPC at a time on odd stream ids over one connection
+//  - request headers sent as HPACK "literal, never indexed, new name"
+//    (0x10) with raw (non-huffman) strings — any decoder accepts this
+//  - response header blocks are SKIPPED, not decoded: the test asserts on
+//    the protobuf CONTENT of the DATA frames, so no HPACK decoder (static
+//    + dynamic tables + huffman) is needed; we advertise
+//    SETTINGS_HEADER_TABLE_SIZE=0 so skipping is stateless-safe
+//  - PING is ACKed, WINDOW_UPDATE ignored (messages are tiny),
+//    RST_STREAM/GOAWAY are fatal
+//
+// Build: shim/cpp/build.sh (protoc --cpp_out + g++ -lprotobuf).
+// Driven by tests/test_cpp_conformance.py against the live sidecar.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "scheduler_backend.pb.h"
+
+namespace pb = grove_tpu::backend::v1;
+
+namespace {
+
+struct Frame {
+  uint8_t type = 0;
+  uint8_t flags = 0;
+  uint32_t stream = 0;
+  std::string payload;
+};
+
+constexpr uint8_t kData = 0x0, kHeaders = 0x1, kRstStream = 0x3,
+                  kSettings = 0x4, kPing = 0x6, kGoAway = 0x7,
+                  kWindowUpdate = 0x8, kContinuation = 0x9;
+constexpr uint8_t kEndStream = 0x1, kAck = 0x1;
+
+class H2Conn {
+ public:
+  explicit H2Conn(int port) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("connect");
+    WriteAll("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n");
+    // Our SETTINGS: HEADER_TABLE_SIZE=0 (we never decode header blocks, so
+    // forbid the server's encoder from building dynamic-table state we
+    // would have to track).
+    std::string settings;
+    PutU16(settings, 0x1);  // SETTINGS_HEADER_TABLE_SIZE
+    PutU32(settings, 0);
+    SendFrame(kSettings, 0, 0, settings);
+  }
+  ~H2Conn() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  // One unary gRPC call; returns the concatenated response DATA payload
+  // (gRPC length-prefixed messages), completed at trailers (END_STREAM).
+  std::string Call(const std::string& path, const std::string& body) {
+    const uint32_t stream = next_stream_;
+    next_stream_ += 2;
+    SendFrame(kHeaders, 0x4 /*END_HEADERS*/, stream, HeaderBlock(path));
+    std::string framed;
+    framed.push_back('\0');  // uncompressed
+    PutU32(framed, static_cast<uint32_t>(body.size()));
+    framed += body;
+    SendFrame(kData, kEndStream, stream, framed);
+
+    std::string data;
+    bool headers_seen = false;
+    while (true) {
+      Frame f = ReadFrame();
+      switch (f.type) {
+        case kSettings:
+          if (!(f.flags & kAck)) SendFrame(kSettings, kAck, 0, "");
+          break;
+        case kPing:
+          if (!(f.flags & kAck)) SendFrame(kPing, kAck, 0, f.payload);
+          break;
+        case kWindowUpdate:
+          break;
+        case kHeaders:
+        case kContinuation:
+          if (f.stream == stream) {
+            // First HEADERS = response headers; a later HEADERS with
+            // END_STREAM = trailers (grpc-status). Content is asserted on
+            // the protobuf payload, so the blocks themselves are skipped.
+            if (f.flags & kEndStream) {
+              if (!headers_seen && data.empty())
+                throw std::runtime_error("trailers-only response (grpc error)");
+              return data;
+            }
+            headers_seen = true;
+          }
+          break;
+        case kData:
+          if (f.stream == stream) {
+            data += f.payload;
+            if (f.flags & kEndStream) return data;
+          }
+          break;
+        case kRstStream:
+          throw std::runtime_error("RST_STREAM from server");
+        case kGoAway:
+          throw std::runtime_error("GOAWAY from server");
+        default:
+          break;  // unknown frame types are ignorable per spec
+      }
+    }
+  }
+
+ private:
+  static void PutU16(std::string& out, uint16_t v) {
+    out.push_back(static_cast<char>(v >> 8));
+    out.push_back(static_cast<char>(v & 0xff));
+  }
+  static void PutU32(std::string& out, uint32_t v) {
+    out.push_back(static_cast<char>(v >> 24));
+    out.push_back(static_cast<char>((v >> 16) & 0xff));
+    out.push_back(static_cast<char>((v >> 8) & 0xff));
+    out.push_back(static_cast<char>(v & 0xff));
+  }
+  // HPACK integer with 7-bit prefix, then raw (huffman bit clear) string.
+  static void PutHpackStr(std::string& out, const std::string& s) {
+    if (s.size() < 127) {
+      out.push_back(static_cast<char>(s.size()));
+    } else {
+      out.push_back(0x7f);
+      size_t rest = s.size() - 127;
+      while (rest >= 128) {
+        out.push_back(static_cast<char>((rest & 0x7f) | 0x80));
+        rest >>= 7;
+      }
+      out.push_back(static_cast<char>(rest));
+    }
+    out += s;
+  }
+  static std::string HeaderBlock(const std::string& path) {
+    std::string b;
+    auto lit = [&b](const std::string& name, const std::string& value) {
+      b.push_back(0x10);  // literal header field, never indexed, new name
+      PutHpackStr(b, name);
+      PutHpackStr(b, value);
+    };
+    lit(":method", "POST");  // pseudo-headers first (RFC 7540 §8.1.2.1)
+    lit(":scheme", "http");
+    lit(":path", path);
+    lit(":authority", "localhost");
+    lit("te", "trailers");
+    lit("content-type", "application/grpc");
+    return b;
+  }
+
+  void SendFrame(uint8_t type, uint8_t flags, uint32_t stream,
+                 const std::string& payload) {
+    std::string hdr;
+    hdr.push_back(static_cast<char>((payload.size() >> 16) & 0xff));
+    hdr.push_back(static_cast<char>((payload.size() >> 8) & 0xff));
+    hdr.push_back(static_cast<char>(payload.size() & 0xff));
+    hdr.push_back(static_cast<char>(type));
+    hdr.push_back(static_cast<char>(flags));
+    PutU32(hdr, stream & 0x7fffffff);
+    WriteAll(hdr + payload);
+  }
+
+  Frame ReadFrame() {
+    std::string hdr = ReadN(9);
+    Frame f;
+    const uint32_t len = (static_cast<uint8_t>(hdr[0]) << 16) |
+                         (static_cast<uint8_t>(hdr[1]) << 8) |
+                         static_cast<uint8_t>(hdr[2]);
+    f.type = static_cast<uint8_t>(hdr[3]);
+    f.flags = static_cast<uint8_t>(hdr[4]);
+    f.stream = ((static_cast<uint8_t>(hdr[5]) << 24) |
+                (static_cast<uint8_t>(hdr[6]) << 16) |
+                (static_cast<uint8_t>(hdr[7]) << 8) |
+                static_cast<uint8_t>(hdr[8])) &
+               0x7fffffff;
+    f.payload = ReadN(len);
+    return f;
+  }
+
+  void WriteAll(const std::string& buf) {
+    size_t off = 0;
+    while (off < buf.size()) {
+      ssize_t n = write(fd_, buf.data() + off, buf.size() - off);
+      if (n <= 0) throw std::runtime_error("write");
+      off += static_cast<size_t>(n);
+    }
+  }
+  std::string ReadN(size_t n) {
+    std::string out(n, '\0');
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = read(fd_, out.data() + off, n - off);
+      if (r <= 0) throw std::runtime_error("read/eof");
+      off += static_cast<size_t>(r);
+    }
+    return out;
+  }
+
+  int fd_ = -1;
+  uint32_t next_stream_ = 1;
+};
+
+// Strip gRPC length-prefix framing; exactly one message expected.
+std::string UnframeOne(const std::string& data) {
+  if (data.size() < 5) throw std::runtime_error("short grpc frame");
+  if (data[0] != 0) throw std::runtime_error("compressed response unexpected");
+  const uint32_t len = (static_cast<uint8_t>(data[1]) << 24) |
+                       (static_cast<uint8_t>(data[2]) << 16) |
+                       (static_cast<uint8_t>(data[3]) << 8) |
+                       static_cast<uint8_t>(data[4]);
+  if (data.size() < 5 + len) throw std::runtime_error("truncated grpc frame");
+  return data.substr(5, len);
+}
+
+template <typename Resp, typename Req>
+Resp Unary(H2Conn& conn, const std::string& method, const Req& req) {
+  const std::string path =
+      "/grove_tpu.backend.v1.SchedulerBackend/" + method;
+  std::string body;
+  if (!req.SerializeToString(&body))
+    throw std::runtime_error("serialize " + method);
+  Resp resp;
+  if (!resp.ParseFromString(UnframeOne(conn.Call(path, body))))
+    throw std::runtime_error("parse " + method + " response");
+  return resp;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::cerr << "usage: conformance_client <sidecar-port>\n";
+    return 2;
+  }
+  try {
+    H2Conn conn(std::stoi(argv[1]));
+
+    pb::InitRequest init;
+    for (const auto& [domain, key] :
+         {std::pair<std::string, std::string>{"zone",
+                                              "topology.kubernetes.io/zone"},
+          {"rack", "topology.kubernetes.io/rack"}}) {
+      auto* lvl = init.add_topology();
+      lvl->set_domain(domain);
+      lvl->set_node_label_key(key);
+    }
+    auto init_resp = Unary<pb::InitResponse>(conn, "Init", init);
+    std::cout << "INIT name=" << init_resp.name() << "\n";
+
+    pb::UpdateClusterRequest upd;
+    upd.set_full_replace(true);
+    for (int i = 0; i < 4; i++) {
+      auto* n = upd.add_nodes();
+      n->set_name("cpp-n" + std::to_string(i));
+      n->set_schedulable(true);
+      auto* cap = n->add_capacity();
+      cap->set_name("cpu");
+      cap->set_value(8.0);
+      (*n->mutable_labels())["topology.kubernetes.io/zone"] = "z0";
+      (*n->mutable_labels())["topology.kubernetes.io/rack"] =
+          "r" + std::to_string(i % 2);
+    }
+    auto upd_resp = Unary<pb::UpdateClusterResponse>(conn, "UpdateCluster", upd);
+    std::cout << "UPDATE nodes=" << upd_resp.node_count() << "\n";
+
+    pb::SyncPodGangRequest sync;
+    auto* gang = sync.mutable_pod_gang();
+    gang->set_name("cpp-gang-0");
+    gang->set_namespace_("default");
+    auto* grp = gang->add_pod_groups();
+    grp->set_name("workers");
+    grp->set_min_replicas(3);
+    for (int i = 0; i < 3; i++) {
+      auto* ref = grp->add_pod_references();
+      ref->set_namespace_("default");
+      ref->set_name("cpp-pod-" + std::to_string(i));
+    }
+    auto* req = grp->add_per_pod_requests();
+    req->set_name("cpu");
+    req->set_value(2.0);
+    gang->mutable_pack_constraint()->set_required_key(
+        "topology.kubernetes.io/rack");
+    Unary<pb::SyncPodGangResponse>(conn, "SyncPodGang", sync);
+    std::cout << "SYNC ok\n";
+
+    auto solve =
+        Unary<pb::SolveResponse>(conn, "Solve", pb::SolveRequest());
+    for (const auto& g : solve.gangs()) {
+      std::cout << "GANG " << g.name() << " admitted=" << g.admitted()
+                << " score=" << g.placement_score() << " bindings=";
+      bool first = true;
+      for (const auto& b : g.bindings()) {
+        if (!first) std::cout << ",";
+        first = false;
+        std::cout << b.pod_name() << ":" << b.node_name();
+      }
+      std::cout << "\n";
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    std::cerr << "ERROR: " << e.what() << "\n";
+    return 1;
+  }
+}
