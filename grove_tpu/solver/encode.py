@@ -281,12 +281,17 @@ def encode_gangs(
         depends_on=np.full((g_count,), -1, dtype=np.int32),
         global_index=np.full((g_count,), -1, dtype=np.int32),
         depends_global=np.full((g_count,), -1, dtype=np.int32),
-        reuse_nodes=np.zeros((g_count, snapshot.capacity.shape[0]), dtype=bool),
+        # reuse_nodes stays None unless some gang carries a reuse seed —
+        # like group_node_ok/spread_*, the dense [G, N] tensor (and its
+        # host->device transfer per wave: ~wave_size x nodes bools) only
+        # materializes when the feature is in play; solve_batch zero-fills
+        # on device for None (core._reuse_of).
     )
     decode = GangDecodeInfo(gang_names=[], pod_names=[], group_names=[])
     gang_index = {g.name: i for i, g in enumerate(gangs)}
     scheduled_gangs = scheduled_gangs or set()
     selector_masks: np.ndarray | None = None  # bool [G, MG, N], lazy
+    reuse_arr: np.ndarray | None = None  # bool [G, N], lazy
     # One O(N) label scan per UNIQUE selector / toleration set, not per
     # group — gang families share templates, and this runs on the per-Solve
     # encode hot path.
@@ -311,8 +316,12 @@ def encode_gangs(
         group_names: list[str] = []
         batch.gang_valid[gi] = sets_resolvable[gi]
         for node_idx in (reuse_nodes_by_gang or {}).get(gang.name, []):
-            if 0 <= node_idx < batch.reuse_nodes.shape[1]:
-                batch.reuse_nodes[gi, node_idx] = True
+            if 0 <= node_idx < snapshot.capacity.shape[0]:
+                if reuse_arr is None:
+                    reuse_arr = np.zeros(
+                        (g_count, snapshot.capacity.shape[0]), dtype=bool
+                    )
+                reuse_arr[gi, node_idx] = True
         if global_index_of is not None:
             batch.global_index[gi] = global_index_of.get(gang.name, -1)
         if gang.base_podgang_name is not None:
@@ -434,6 +443,8 @@ def encode_gangs(
 
     if selector_masks is not None:
         batch = batch._replace(group_node_ok=selector_masks)
+    if reuse_arr is not None:
+        batch = batch._replace(reuse_nodes=reuse_arr)
 
     # Replica spread: base gangs whose spec carries a resolvable spread_key
     # get a level, a family root (first base sibling of the same PCS in this
