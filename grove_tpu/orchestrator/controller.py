@@ -552,31 +552,9 @@ class GroveController:
         # ALL pods holding a node_name — not just active ones — because the
         # reuse/spread seeds read inactive (Failed) pods' nodes too; a GC
         # of those pods changes solver inputs and must break the match.
+        sub_digests = [self._sub_digest(sub) for sub in sub_gangs]
         wave_fp = (
-            tuple(
-                (
-                    sub.name,
-                    getattr(sub, "queue", ""),
-                    sub.spec.priority_class_name,
-                    tuple(
-                        (
-                            grp.name,
-                            grp.min_replicas,
-                            tuple(
-                                (
-                                    r.name,
-                                    getattr(
-                                        c.pods.get(r.name), "pod_template_hash", ""
-                                    ),
-                                )
-                                for r in grp.pod_references
-                            ),
-                        )
-                        for grp in sub.spec.pod_groups
-                    ),
-                )
-                for sub in sub_gangs
-            ),
+            tuple(sub_digests),
             frozenset(scheduled_names),
             frozenset(
                 (p.name, p.node_name, p.is_active)
@@ -586,8 +564,59 @@ class GroveController:
             node_state_digest(c.nodes.values()),
         )
         memo = self._solve_skip_memo.get(floors_only)
-        if memo is not None and memo[0] == wave_fp and now < memo[1]:
-            return 0
+        carried: set | None = None
+        carried_rejected: list[PodGang] = []
+        if memo is not None and now < memo[1]:
+            if memo[0] == wave_fp:
+                return 0
+            if memo[0][1:] == wave_fp[1:] and set(memo[0][0]) <= set(
+                wave_fp[0]
+            ):
+                # Incremental arrivals-only solve: placements, scheduled
+                # set, and node state all match the memoized no-effect pass
+                # and its pending gangs are a SUBSET of this pass's — the
+                # carried gangs are provably still rejected (placement
+                # feasibility is monotone in free capacity, which has not
+                # grown), so only the new arrivals need encoding and
+                # solving. A changed-by-arrival pass costs O(delta), not
+                # O(pending). Any admission by the delta binds pods, which
+                # changes the placement digest and forces the next pass to
+                # run full.
+                carried = set(memo[0][0])
+        if carried is not None:
+            kept = [i for i, d in enumerate(sub_digests) if d not in carried]
+            if not kept:
+                # Pure reorder of still-rejected gangs: same no-op outcome.
+                # Refresh the memo so the next unchanged pass takes the
+                # O(1) exact-match skip instead of re-deriving the subset.
+                self._solve_skip_memo[floors_only] = (
+                    wave_fp, memo[1], memo[2],
+                )
+                return 0
+            # A delta scaled gang needs its BASE at an earlier batch index
+            # to encode as valid-rejected (encode's dependency rule) — a
+            # carried base rides along and deterministically re-rejects.
+            idx_of = {sub.name: i for i, sub in enumerate(sub_gangs)}
+            keep_set = set(kept)
+            for i in list(kept):
+                base = sub_gangs[i].base_podgang_name
+                if base is not None and base in idx_of:
+                    keep_set.add(idx_of[base])
+            kept = sorted(keep_set)
+            # Preemption must see the FULL contender field: carried gangs
+            # that were valid-rejected in the memoized pass (recorded
+            # there) still outrank or contend with delta rejections.
+            kept_idx = set(kept)
+            carried_rejected = [
+                sub_gangs[i]
+                for i in range(len(sub_gangs))
+                if i not in kept_idx and sub_gangs[i].name in memo[2]
+            ]
+            sub_gangs = [sub_gangs[i] for i in kept]
+            kept_names = {sub.name for sub in sub_gangs}
+            bound_node_names = {
+                k: v for k, v in bound_node_names.items() if k in kept_names
+            }
         # Node axis bucketed to the next power of two (phantom rows are
         # unschedulable zero-capacity): node add/remove inside a bucket
         # reuses the compiled solver instead of forcing an XLA recompile —
@@ -711,21 +740,32 @@ class GroveController:
         # nothing newly admitted). retry_at: the earliest in-cooldown
         # preemption expiry among valid rejected contenders — past it the
         # pass must re-run so preemption can retry; contenders NOT in
-        # cooldown already attempted (deterministically) this pass.
+        # cooldown already attempted (deterministically) this pass. An
+        # incremental (delta) pass stores the UNION fingerprint but must
+        # carry the smaller of its own and the inherited retry_at — the
+        # carried gangs' pending preemption retries survive the delta.
         if not any(bindings.values()):
+            valid_rejected = frozenset(
+                n
+                for n in decode.gang_names
+                if valid_by_name.get(n, False) and not ok_by_name.get(n, False)
+            )
             retry_at = math.inf
-            if floors_only and any_valid_rejected:
+            if floors_only and valid_rejected:
                 expiries = [
                     t + self.preemption_cooldown_seconds
-                    for n in decode.gang_names
-                    if valid_by_name.get(n, False)
-                    and not ok_by_name.get(n, False)
-                    and (t := self._preempted_for_at.get(n)) is not None
+                    for n in valid_rejected
+                    if (t := self._preempted_for_at.get(n)) is not None
                     and now - t < self.preemption_cooldown_seconds
                 ]
                 if expiries:
                     retry_at = min(expiries)
-            self._solve_skip_memo[floors_only] = (wave_fp, retry_at)
+            if carried is not None and memo is not None:
+                retry_at = min(retry_at, memo[1])
+                valid_rejected = valid_rejected | memo[2]
+            self._solve_skip_memo[floors_only] = (
+                wave_fp, retry_at, valid_rejected,
+            )
         else:
             self._solve_skip_memo.pop(floors_only, None)
         for gang_name, pod_bindings in bindings.items():
@@ -766,9 +806,59 @@ class GroveController:
                 and valid_by_name.get(g.name, False)  # gated/unresolvable can't preempt
                 and g.name in c.podgangs
             ]
+            # Incremental pass: carried valid-rejected gangs stay in the
+            # contender field — a full pass would pick the highest-priority
+            # contender across ALL pending, and the delta must not let a
+            # lower-priority arrival preempt in its place.
+            rejected.extend(
+                g for g in carried_rejected if g.name in c.podgangs
+            )
             if rejected:
                 self._preempt_for_rejected(rejected, now)
         return admitted
+
+    def _sub_digest(self, sub: PodGang) -> tuple:
+        """Hashable digest of ONE pending subgang — everything encode reads
+        from it: identity, queue, priority, dependency/seed references,
+        topology constraints at all three levels, and per-group refs with
+        their pod template hashes (spec drift of a pod recreated under the
+        same name must break the match)."""
+
+        def pc(obj) -> tuple:
+            tc = getattr(obj, "topology_constraint", None)
+            p = getattr(tc, "pack_constraint", None) if tc else None
+            return (p.required, p.preferred) if p else (None, None)
+
+        c = self.cluster
+        return (
+            sub.name,
+            getattr(sub, "queue", ""),
+            sub.spec.priority_class_name,
+            sub.base_podgang_name,
+            getattr(sub.spec.reuse_reservation_ref, "name", None),
+            sub.spec.spread_key,
+            (sub.pcs_name, sub.pcs_replica_index),
+            pc(sub.spec),
+            tuple(
+                (gc.name, tuple(gc.pod_group_names), pc(gc))
+                for gc in sub.spec.topology_constraint_group_configs
+            ),
+            tuple(
+                (
+                    grp.name,
+                    grp.min_replicas,
+                    pc(grp),
+                    tuple(
+                        (
+                            r.name,
+                            getattr(c.pods.get(r.name), "pod_template_hash", ""),
+                        )
+                        for r in grp.pod_references
+                    ),
+                )
+                for grp in sub.spec.pod_groups
+            ),
+        )
 
     @property
     def queue_tree(self) -> QueueTree | None:

@@ -1,0 +1,197 @@
+"""Incremental arrivals-only solve (controller solve-skip damper extension).
+
+When a pass's placements/scheduled/node state all match the memoized
+no-effect pass and its pending set is a superset of the memo's, the carried
+gangs are provably still rejected (placement feasibility is monotone in
+free capacity), so the controller encodes and solves ONLY the new
+arrivals. These tests pin: the delta really is the delta, the outcomes
+match full solves exactly, spec drift breaks the match, and pure
+no-change passes stay fully skipped.
+"""
+
+from __future__ import annotations
+
+import pytest
+from scenario_harness import Scenario
+
+from grove_tpu.api import DEFAULT_CLUSTER_TOPOLOGY, PodCliqueSet, default_podcliqueset
+from grove_tpu.sim.workloads import binpack_trap_cluster
+
+
+def _pcs(name: str, cpu: str, replicas: int = 1) -> PodCliqueSet:
+    doc = {
+        "apiVersion": "grove.io/v1alpha1",
+        "kind": "PodCliqueSet",
+        "metadata": {"name": name},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "cliques": [
+                    {
+                        "name": "w",
+                        "spec": {
+                            "roleName": "w",
+                            "replicas": 1,
+                            "podSpec": {
+                                "containers": [
+                                    {
+                                        "name": "w",
+                                        "image": "registry.local/w:latest",
+                                        "resources": {"requests": {"cpu": cpu}},
+                                    }
+                                ]
+                            },
+                        },
+                    }
+                ],
+            },
+        },
+    }
+    return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+
+def _spy_encoded_gangs(monkeypatch) -> list[list[str]]:
+    """Record the gang names of every controller encode (batch composition)."""
+    import grove_tpu.orchestrator.controller as ctrl_mod
+
+    calls: list[list[str]] = []
+    real = ctrl_mod.encode_gangs
+
+    def spy(gangs, *a, **k):
+        calls.append([g.name for g in gangs])
+        return real(gangs, *a, **k)
+
+    monkeypatch.setattr(ctrl_mod, "encode_gangs", spy)
+    return calls
+
+
+@pytest.fixture
+def starved():
+    """6 x 7cpu nodes; a 100-cpu request can never fit."""
+    return Scenario(
+        0, topology=DEFAULT_CLUSTER_TOPOLOGY, nodes=binpack_trap_cluster()
+    )
+
+
+def test_arrival_solves_only_the_delta(starved, monkeypatch):
+    calls = _spy_encoded_gangs(monkeypatch)
+    s = starved
+    s.deploy(_pcs("big-a", "100"))  # unschedulable: rejected, memo arms
+    s.settle(5)
+    assert any("big-a-0" in c for c in calls)
+    calls.clear()
+    s.deploy(_pcs("big-b", "100"))  # arrival over unchanged state
+    s.settle(5)
+    delta_calls = [c for c in calls if c]
+    assert delta_calls, "the arrival must be solved"
+    assert all(
+        c == ["big-b-0"] for c in delta_calls
+    ), f"carried gang must not re-encode: {delta_calls}"
+
+
+def test_no_change_passes_stay_fully_skipped(starved, monkeypatch):
+    calls = _spy_encoded_gangs(monkeypatch)
+    s = starved
+    s.deploy(_pcs("big-a", "100"))
+    s.settle(5)
+    n_after_arm = len(calls)
+    s.settle(10)  # nothing changes
+    assert len(calls) == n_after_arm, "unchanged state must not re-encode"
+
+
+def test_incremental_outcomes_match_full_solves(monkeypatch):
+    """Staggered arrivals through the damped controller land EXACTLY the
+    same placements as a controller forced to full-solve every pass."""
+
+    def run(force_full: bool):
+        s = Scenario(
+            0, topology=DEFAULT_CLUSTER_TOPOLOGY, nodes=binpack_trap_cluster()
+        )
+        arrivals = {
+            1.0: _pcs("big-a", "100"),  # never fits
+            4.0: _pcs("small-a", "3"),  # fits
+            8.0: _pcs("small-b", "4"),  # fits
+            12.0: _pcs("big-b", "100"),  # never fits
+            16.0: _pcs("small-c", "5"),  # fits
+        }
+        for t in [x / 2 for x in range(2, 50)]:
+            if t in arrivals:
+                s.deploy(arrivals[t])
+            if force_full:
+                s.controller._solve_skip_memo.clear()
+            s.sim.step(0.5)
+        return {
+            (p.name, p.node_name)
+            for p in s.cluster.pods.values()
+            if p.is_scheduled
+        }, {
+            g.name: g.status.phase.value
+            for g in s.cluster.podgangs.values()
+        }
+
+    placements_inc, phases_inc = run(force_full=False)
+    placements_full, phases_full = run(force_full=True)
+    assert placements_inc == placements_full
+    assert phases_inc == phases_full
+    assert {n.rsplit("-", 1)[0] for n, _ in placements_inc} == {
+        "small-a-0-w", "small-b-0-w", "small-c-0-w"
+    }, "every feasible arrival landed, both bigs stayed pending"
+
+
+def test_delta_pass_preserves_preemption_contender_order(monkeypatch):
+    """A delta arrival must not preempt in place of a carried
+    higher-priority contender (review finding): the full-pass rule gives
+    the single per-pass preemption attempt to the HIGHEST-priority valid
+    rejected gang — here a hopeless one — so nothing gets evicted, and the
+    incremental pass must reproduce exactly that."""
+    s = Scenario(
+        0,
+        topology=DEFAULT_CLUSTER_TOPOLOGY,
+        nodes=binpack_trap_cluster(),
+        priority_classes={"hi": 50, "lo": 10},
+    )
+    # Fill the cluster with priority-0 victims (6 x 7cpu pods).
+    for i in range(6):
+        s.deploy(_pcs(f"victim-{i}", "7"))
+    s.settle(5)
+    assert len(s.scheduled()) == 6, "victims fill the cluster"
+
+    hi = _pcs("hopeless-hi", "100")  # unfittable even evicting everything
+    hi.spec.template.priority_class_name = "hi"
+    s.deploy(hi)
+    s.settle(5)  # rejected; memo arms with it as the valid-rejected record
+
+    lo = _pcs("evictor-lo", "7")  # would fit if it could evict one victim
+    lo.spec.template.priority_class_name = "lo"
+    s.deploy(lo)
+    s.settle(10)
+    # Full-pass semantics: the hi gang owns the (failing) preemption
+    # attempt every pass, so NO victim is ever evicted for the lo gang.
+    assert len(s.scheduled()) == 6, "no victim may be evicted"
+    assert not s.scheduled("evictor-lo"), "lo arrival stays pending"
+    assert not any(
+        "preempted by" in msg for _, _, msg in s.cluster.events
+    ), "no preemption event may fire"
+
+
+def test_spec_drift_breaks_the_match(starved, monkeypatch):
+    """A gang recreated with a CHANGED topology constraint but identical
+    refs must re-solve — the digest covers constraints, not just refs
+    (review-era gap: template hashes alone missed gang-level drift)."""
+    calls = _spy_encoded_gangs(monkeypatch)
+    s = starved
+    pcs = _pcs("big-a", "100")
+    s.deploy(pcs)
+    s.settle(5)
+    calls.clear()
+    s.settle(3)
+    assert not calls, "memo armed"
+    # In-place constraint change on the SAME workload (same pods/refs).
+    from grove_tpu.api.types import TopologyConstraint
+
+    pcs.spec.template.topology_constraint = TopologyConstraint.from_dict(
+        {"packDomain": "rack"}
+    )
+    s.deploy(pcs)
+    s.settle(3)
+    assert calls, "constraint drift must force a re-solve"
