@@ -115,9 +115,17 @@ class GroveController:
     # Reclaim flap guard (same discipline as _preempted_for_at): one
     # reclaim attempt per in-quota contender per cooldown window.
     _reclaimed_for_at: dict = field(default_factory=dict)
-    # Solve-skip memo, per wave kind: (input fingerprint, retry_at) of the
-    # last no-effect pass — see the wave_fp block in _solve_wave.
+    # Solve-skip memo, per wave kind: (input fingerprint, retry_at,
+    # valid-rejected names) of the last no-effect pass — see the wave_fp
+    # block in _solve_wave.
     _solve_skip_memo: dict = field(default_factory=dict)
+    # Observability: how each solve wave resolved — "full" (complete
+    # encode+solve), "delta" (incremental arrivals-only), "skipped"
+    # (fingerprint match, no work). The manager exports these as
+    # grove_solve_passes_total{kind=...}.
+    solve_pass_counts: dict = field(
+        default_factory=lambda: {"full": 0, "delta": 0, "skipped": 0}
+    )
     # PlacementScores of gangs first-admitted in the LAST solve_pending pass
     # (GREP-244 metrics direction) — the manager drains this into the
     # grove_placement_score histogram each reconcile.
@@ -568,6 +576,7 @@ class GroveController:
         carried_rejected: list[PodGang] = []
         if memo is not None and now < memo[1]:
             if memo[0] == wave_fp:
+                self.solve_pass_counts["skipped"] += 1
                 return 0
             if memo[0][1:] == wave_fp[1:] and set(memo[0][0]) <= set(
                 wave_fp[0]
@@ -592,6 +601,7 @@ class GroveController:
                 self._solve_skip_memo[floors_only] = (
                     wave_fp, memo[1], memo[2],
                 )
+                self.solve_pass_counts["skipped"] += 1
                 return 0
             # A delta scaled gang needs its BASE at an earlier batch index
             # to encode as valid-rejected (encode's dependency rule) — a
@@ -617,6 +627,7 @@ class GroveController:
             bound_node_names = {
                 k: v for k, v in bound_node_names.items() if k in kept_names
             }
+        self.solve_pass_counts["delta" if carried is not None else "full"] += 1
         # Node axis bucketed to the next power of two (phantom rows are
         # unschedulable zero-capacity): node add/remove inside a bucket
         # reuses the compiled solver instead of forcing an XLA recompile —

@@ -548,6 +548,16 @@ class Manager:
         self._m_queue_used = self.metrics.gauge(
             "grove_queue_used", "Bound resource usage per capacity queue"
         )
+        # Solve-wave dispositions (controller.solve_pass_counts): how often
+        # the damper turned a reconcile into a skip or an arrivals-only
+        # delta instead of a full encode+solve. A real Counter (rate()
+        # works, OpenMetrics _total convention holds); the refresh incs
+        # the delta against the last exported snapshot.
+        self._m_solve_passes = self.metrics.counter(
+            "grove_solve_passes_total",
+            "Solve waves by disposition (full | delta | skipped)",
+        )
+        self._solve_passes_exported = {"full": 0, "delta": 0, "skipped": 0}
         # GREP-244 "TAS metrics" direction: PlacementScore distribution of
         # admitted gangs (scheduler podgang.go:176-178; 1.0 = optimal).
         # Buckets cover the score's [0,1] range, dense near the top where
@@ -809,6 +819,8 @@ class Manager:
         return {
             "build": build_info(),
             "queues": queues,
+            # Damper effectiveness: solve waves by disposition.
+            "solvePasses": dict(self.controller.solve_pass_counts),
             # The effective ClusterTopology (config TAS levels + auto host
             # level) — what `grove-tpu get topology` renders (kubectl get
             # clustertopology analog; the kubernetes source also syncs it
@@ -1266,6 +1278,11 @@ class Manager:
             # solve_pending (which resets the list) must not re-observe.
             ctrl.last_admission_scores = []
         self._next_requeue = outcome.requeue_after_seconds
+        for kind, count in self.controller.solve_pass_counts.items():
+            delta = count - self._solve_passes_exported[kind]
+            if delta > 0:
+                self._m_solve_passes.inc(float(delta), kind=kind)
+                self._solve_passes_exported[kind] = count
         qtree = self.controller.queue_tree
         if qtree is not None:
             # Per-queue usage gauges (GREP-244 metrics direction): refreshed

@@ -174,6 +174,33 @@ def test_delta_pass_preserves_preemption_contender_order(monkeypatch):
     ), "no preemption event may fire"
 
 
+def test_pass_dispositions_surface_on_metrics_and_statusz():
+    """grove_solve_passes_total{kind=...} + /statusz solvePasses: the
+    damper's work is observable (full at arrival, skipped in steady
+    state, delta on the second arrival)."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {"servers": {"healthPort": -1, "metricsPort": -1}, "backend": {"enabled": False}}
+    )
+    assert not errors
+    m = Manager(cfg)
+    for node in binpack_trap_cluster():
+        m.cluster.nodes[node.name] = node
+    m.apply_podcliqueset(_pcs("big-a", "100"))
+    for t in range(1, 6):
+        m.reconcile_once(now=float(t))
+    counts = m.controller.solve_pass_counts
+    assert counts["full"] >= 1 and counts["skipped"] >= 1, counts
+    m.apply_podcliqueset(_pcs("big-b", "100"))
+    for t in range(6, 9):
+        m.reconcile_once(now=float(t))
+    assert counts["delta"] >= 1, counts
+    assert m._m_solve_passes.value(kind="skipped") == float(counts["skipped"])
+    assert m.statusz()["solvePasses"] == counts
+
+
 def test_spec_drift_breaks_the_match(starved, monkeypatch):
     """A gang recreated with a CHANGED topology constraint but identical
     refs must re-solve — the digest covers constraints, not just refs
