@@ -61,6 +61,7 @@ from grove_tpu.solver.planner import (
     build_spread_avoid,
     sort_pending,
 )
+from grove_tpu.solver.warm import WarmPath, gang_row_digest
 from grove_tpu.state.cluster import Node, build_snapshot
 
 SERVICE_NAME = "grove_tpu.backend.v1.SchedulerBackend"
@@ -176,6 +177,20 @@ class TPUSchedulerBackend:
         self._m_pods_bound = reg.counter(
             "grove_backend_pods_bound_total", "pod bindings committed"
         )
+        # Warm-path observability: AOT executable-cache traffic + per-gang
+        # encode-row reuse (solver/warm.py).
+        self._m_exec_hits = reg.counter(
+            "grove_backend_exec_cache_hits_total",
+            "solver executable cache hits (no XLA work)",
+        )
+        self._m_exec_misses = reg.counter(
+            "grove_backend_exec_cache_misses_total",
+            "solver executable cache misses (paid a lowering)",
+        )
+        self._m_encode_reuse = reg.counter(
+            "grove_backend_encode_reuse_hits_total",
+            "gang encode rows reused from the previous Solve",
+        )
         self._lock = threading.Lock()
         # One solve at a time (capacity accounting is sequential); control
         # RPCs use _lock only.
@@ -183,6 +198,9 @@ class TPUSchedulerBackend:
         # Futile-escalation damper (see _solve_unlocked; definition shared
         # with the controller in solver/escalation.py).
         self._escalation_damper = EscalationDamper()
+        # Warm path (solver/warm.py): AOT executables, device-resident node
+        # tensors across Solve RPCs, per-gang encode-row reuse.
+        self._warm = WarmPath()
         self._topology = ClusterTopology(name="backend", levels=[])
         self._nodes: dict[str, Node] = {}
         self._gangs: dict[str, PodGang] = {}
@@ -543,6 +561,17 @@ class TPUSchedulerBackend:
             pad_to = cfg.pad_gangs_to * max(1, -(-len(pending) // cfg.pad_gangs_to))
         else:
             pad_to = self._bucket(len(pending), None)
+        # Incremental encode reuse: gangs whose spec digest + snapshot epoch
+        # match the previous Solve copy their dense rows instead of re-
+        # walking the proto-derived spec (solver/warm.py; keyed on spec
+        # hash, not object identity — _collect_pending rebuilds sub-gang
+        # objects every RPC).
+        epoch = snapshot.encode_epoch()
+        row_keys = [
+            (gang_row_digest(sub, work["pods_by_name"]), epoch) for sub in pending
+        ]
+        h0 = self._warm.encode_rows.hits
+        x0 = (self._warm.executables.hits, self._warm.executables.misses)
         batch, decode = encode_gangs(
             pending,
             work["pods_by_name"],
@@ -555,6 +584,8 @@ class TPUSchedulerBackend:
             bound_nodes_by_group=bound_idx,
             reuse_nodes_by_gang=reuse_by_gang,
             spread_avoid_by_gang=spread_by_gang,
+            row_cache=self._warm.encode_rows,
+            row_keys=row_keys,
         )
         # solver.portfolio > 1: the sidecar's Solve explores P weight
         # variants and keeps the winner (multi-chip quality path; the
@@ -580,8 +611,12 @@ class TPUSchedulerBackend:
             params=self._solver_params,
             portfolio=self._solver_config.portfolio,
             escalate_portfolio=esc,
+            warm=self._warm,
         )
         bindings = decode_assignments(result, decode, snapshot)
+        self._m_encode_reuse.inc(self._warm.encode_rows.hits - h0)
+        self._m_exec_hits.inc(self._warm.executables.hits - x0[0])
+        self._m_exec_misses.inc(self._warm.executables.misses - x0[1])
 
         import numpy as np
 

@@ -53,6 +53,7 @@ KIND_ALIASES = {
     "topology": "topology",
     "clustertopology": "topology",
     "clustertopologies": "topology",
+    "solver": "solver",
 }
 
 
@@ -165,6 +166,20 @@ def _get_table(client: GroveClient, kind: str) -> str:
                 ]
             )
         return _table(rows, ["NAME", "PARENT", "QUOTA", "LIMIT", "USED"])
+    if kind == "solver":
+        # Solver health at a glance: pass dispositions (damper
+        # effectiveness) + warm-path cache traffic from /statusz.
+        st = client.statusz()
+        passes = st.get("solvePasses", {})
+        rows = [
+            ["solvePasses." + k, passes.get(k, 0)]
+            for k in ("full", "delta", "skipped")
+        ]
+        rows += [
+            ["warmPath." + k, v]
+            for k, v in sorted(st.get("warmPath", {}).items())
+        ]
+        return _table(rows, ["METRIC", "VALUE"])
     if kind == "services":
         return _table([[n] for n in client.list_services()], ["NAME"])
     if kind == "hpas":

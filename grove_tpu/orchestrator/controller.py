@@ -59,6 +59,7 @@ from grove_tpu.solver.planner import (
     build_spread_avoid,
     sort_pending,
 )
+from grove_tpu.solver.warm import WarmPath
 from grove_tpu.state.cluster import build_snapshot
 
 
@@ -139,6 +140,11 @@ class GroveController:
     # saturated steady state pays base-solve cost per reconcile. Definition
     # shared with the backend sidecar (solver/escalation.py).
     _escalation_damper: EscalationDamper = field(default_factory=EscalationDamper)
+    # Warm-path caches (solver/warm.py): AOT solver executables (observable
+    # lowering counters + startup prewarm), device-resident node tensors
+    # across ticks, and per-gang encode-row reuse. The manager surfaces
+    # warm.stats() on /statusz and wires the shape-history path for prewarm.
+    warm: WarmPath = field(default_factory=WarmPath)
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -623,6 +629,7 @@ class GroveController:
                 if i not in kept_idx and sub_gangs[i].name in memo[2]
             ]
             sub_gangs = [sub_gangs[i] for i in kept]
+            sub_digests = [sub_digests[i] for i in kept]
             kept_names = {sub.name for sub in sub_gangs}
             bound_node_names = {
                 k: v for k, v in bound_node_names.items() if k in kept_names
@@ -704,6 +711,14 @@ class GroveController:
             pad_to = self.pad_gangs_to * max(
                 1, -(-len(sub_gangs) // self.pad_gangs_to)
             )
+        # Incremental encode reuse (solver/warm.py): each sub-gang's dense
+        # rows are dirty-tracked by (spec digest, snapshot epoch) — a tick
+        # that re-solves an unchanged pending set against a changed cluster
+        # (capacity freed, node added) copies rows instead of re-walking
+        # specs in Python. The sub digests are already computed for the
+        # solve-skip fingerprint; the epoch is memoized on the snapshot.
+        epoch = snapshot.encode_epoch()
+        row_keys = [(d, epoch) for d in sub_digests]
         batch, decode = encode_gangs(
             sub_gangs,
             pods_by_name,
@@ -716,6 +731,8 @@ class GroveController:
             bound_nodes_by_group=bound_nodes,
             reuse_nodes_by_gang=reuse_nodes,
             spread_avoid_by_gang=spread_avoid,
+            row_cache=self.warm.encode_rows,
+            row_keys=row_keys,
         )
         esc = self.portfolio_escalation
         esc_fp = None
@@ -730,6 +747,10 @@ class GroveController:
             self.solver_params,
             portfolio=self.portfolio,
             escalate_portfolio=esc,
+            # AOT executable cache + device-resident node tensors: a tick
+            # whose shapes recur never re-lowers, and unchanged capacity/
+            # topology/free tensors skip the per-tick host->device upload.
+            warm=self.warm,
         )
         bindings = decode_assignments(result, decode, snapshot)
 

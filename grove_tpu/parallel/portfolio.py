@@ -37,6 +37,12 @@ from grove_tpu.solver.encode import GangBatch
 
 _N_WEIGHTS = len(SolverParams._fields)
 
+# Warm path: the population is deterministic in (p, base, spread, seed), so
+# the per-solve RNG draw + device upload memoize away. The escalation path
+# (solver.portfolioEscalation) otherwise re-derives the identical stack on
+# EVERY escalated solve — measurable host time inside the serving loop.
+_POPULATION_CACHE: dict[tuple, SolverParams] = {}
+
 
 def params_population(p: int, base: SolverParams = SolverParams(), spread: float = 0.6,
                       seed: int = 0) -> SolverParams:
@@ -52,8 +58,19 @@ def params_population(p: int, base: SolverParams = SolverParams(), spread: float
     batch; slot 0 is always the exact base, so the portfolio's admitted
     count can never fall below the base solver's.
 
-    Deterministic for a given seed so portfolio solves are reproducible.
+    Deterministic for a given seed so portfolio solves are reproducible —
+    which also makes the stack memoizable: repeat calls with scalar bases
+    (every serving path) return the SAME device arrays instead of paying the
+    RNG draw + host->device upload per solve.
     """
+    try:
+        key = (p, tuple(float(x) for x in base), spread, seed)
+    except (TypeError, ValueError):
+        key = None  # non-scalar base (already-stacked weights): no memo
+    if key is not None:
+        cached = _POPULATION_CACHE.get(key)
+        if cached is not None:
+            return cached
     rng = np.random.default_rng(seed)
     factors = np.exp(rng.normal(0.0, spread, size=(p, _N_WEIGHTS))).astype(np.float32)
     factors[0, :] = 1.0  # slot 0 is always the unperturbed base
@@ -61,7 +78,12 @@ def params_population(p: int, base: SolverParams = SolverParams(), spread: float
     stack = factors * base_vec[None, :]
     tight_i = SolverParams._fields.index("w_tight")
     stack[1::2, tight_i] *= -1.0  # odd slots: worst-fit members
-    return SolverParams(*(jnp.asarray(stack[:, i]) for i in range(_N_WEIGHTS)))
+    result = SolverParams(*(jnp.asarray(stack[:, i]) for i in range(_N_WEIGHTS)))
+    if key is not None:
+        if len(_POPULATION_CACHE) > 64:
+            _POPULATION_CACHE.clear()  # tiny key space in practice; bound anyway
+        _POPULATION_CACHE[key] = result
+    return result
 
 
 def _mutation_factors(p: int, spread: float = 0.35, seed: int = 7) -> np.ndarray:

@@ -488,6 +488,7 @@ class Manager:
         self.backend_port: Optional[int] = None
         self.health_port: Optional[int] = None
         self._started = False
+        self._prewarm_thread: Optional[threading.Thread] = None
         self._next_requeue: Optional[float] = None
         self.persistence = None  # wired by start() when enabled
         self.metrics_port: Optional[int] = None
@@ -821,6 +822,11 @@ class Manager:
             "queues": queues,
             # Damper effectiveness: solve waves by disposition.
             "solvePasses": dict(self.controller.solve_pass_counts),
+            # Warm-path caches (solver/warm.py): AOT executable hits/misses/
+            # lowerings + prewarm count, device-resident tensor reuse, and
+            # per-gang encode-row reuse — the measurable side of the
+            # compile-amortization discipline.
+            "warmPath": self.controller.warm.stats(),
             # The effective ClusterTopology (config TAS levels + auto host
             # level) — what `grove-tpu get topology` renders (kubectl get
             # clustertopology analog; the kubernetes source also syncs it
@@ -863,6 +869,26 @@ class Manager:
 
             if not enable_compilation_cache(cfg.solver.compilation_cache_dir):
                 self.log.info("compilation cache unavailable")
+        # Warm-path startup: record solver shape buckets to the history file
+        # and prewarm the top-K historical ones on a background thread, so
+        # the first solve_pending after a restart never blocks on XLA (the
+        # persistent compile cache above makes those prewarm compiles disk
+        # loads after the first boot on a machine).
+        if cfg.solver.shape_history_path:
+            self.controller.warm.executables.history_path = (
+                cfg.solver.shape_history_path
+            )
+        if cfg.solver.prewarm_top_k > 0:
+            # Non-daemon + stop-event-aware (a daemon thread killed inside an
+            # XLA compile at interpreter exit aborts the process); stop()
+            # joins it, waiting out at most one in-flight compile.
+            self._prewarm_thread = self.controller.warm.executables.start_prewarm_thread(
+                cfg.solver.prewarm_top_k, stop=self._stop
+            )
+            if self._prewarm_thread is not None:
+                self.log.info(
+                    "solver prewarm started", top_k=cfg.solver.prewarm_top_k
+                )
         if cfg.leader_election.enabled:
             if cfg.cluster.source == "kubernetes":
                 # Apiserver-backed Lease: the only store EVERY replica of a
@@ -1339,6 +1365,9 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        if getattr(self, "_prewarm_thread", None) is not None:
+            self._prewarm_thread.join()
+            self._prewarm_thread = None
         if self._kube_source is not None:
             self._kube_source.stop()
             self._kube_source = None

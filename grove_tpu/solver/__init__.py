@@ -9,4 +9,10 @@ from grove_tpu.solver.core import (  # noqa: F401
 )
 from grove_tpu.solver.encode import GangBatch, GangDecodeInfo, encode_gangs  # noqa: F401
 from grove_tpu.solver.drain import DrainStats, drain_backlog, plan_waves  # noqa: F401
+from grove_tpu.solver.warm import (  # noqa: F401
+    EncodeRowCache,
+    ExecutableCache,
+    SnapshotDeviceCache,
+    WarmPath,
+)
 from grove_tpu.solver.greedy import GreedyStats, greedy_drain, greedy_place_gang  # noqa: F401

@@ -533,8 +533,7 @@ def _place_gang(
     return free_out, used_out, assigned, gang_ok, placement_score
 
 
-@partial(jax.jit, static_argnames=("coarse_dmax",))
-def solve_batch(
+def solve_batch_impl(
     free0: jax.Array,  # f32 [N, R]
     capacity: jax.Array,  # f32 [N, R]
     schedulable: jax.Array,  # bool [N]
@@ -548,7 +547,12 @@ def solve_batch(
 
     `coarse_dmax` enables the scatter-free matmul aggregation path (see
     _coarse_onehot_stack) — pass int(snapshot.num_domains[:-1].max()); the
-    solve() wrapper does. None falls back to segment-sum (fine on CPU)."""
+    solve() wrapper does. None falls back to segment-sum (fine on CPU).
+
+    This is the UNJITTED implementation — `solve_batch` below is the default
+    jitted entry; solver/warm.py re-jits it for the AOT executable cache
+    (with and without wave-carry donation) so the warm path and the default
+    path trace the one function."""
     n = free0.shape[0]
     g = batch.gang_valid.shape[0]
     cap_scale = jnp.maximum(capacity.max(axis=0), 1e-9)  # [R]
@@ -639,6 +643,9 @@ def solve_batch(
     )
 
 
+solve_batch = partial(jax.jit, static_argnames=("coarse_dmax",))(solve_batch_impl)
+
+
 def coarse_dmax_of(snapshot) -> int | None:
     """Static bound on domains per non-host level, selecting the aggregation
     strategy for the backend the solve will run on:
@@ -668,12 +675,23 @@ def solve(
     ok_global: jax.Array | None = None,
     portfolio: int = 1,
     escalate_portfolio: int = 1,
+    warm=None,  # solver.warm.WarmPath: AOT executables + device-resident state
+    donate: bool = False,
 ) -> SolveResult:
     """Convenience wrapper: snapshot (numpy) -> device -> solve_batch.
 
     `free`/`schedulable` override the snapshot's (wave chaining: pass the
     previous result's free_after); `ok_global` threads the cross-wave verdict
     bitmap (see solve_batch).
+
+    `warm` (a solver.warm.WarmPath) routes the single-variant solve through
+    the AOT executable cache (observable hit/miss/lowering counters, prewarm)
+    and keeps the snapshot's node tensors device-resident across calls via
+    content-digest memoization — the per-tick serving paths pass their own.
+    `donate=True` additionally donates the free/ok_global wave carry (only
+    safe when the caller forfeits those buffers — the drain's chaining loop);
+    never combined with cached `free` buffers (solve() only donates when the
+    caller passed an explicit `free` override it owns).
 
     `portfolio` > 1 solves the batch under P score-weight variants (base +
     polarity-diverse perturbations, parallel/portfolio.py) and keeps the
@@ -699,10 +717,18 @@ def solve(
     per-round re-placement multiplier grew the gap with G. See git history
     for scripts/sweep_speculative.py.)
     """
-    free0 = jnp.asarray(snapshot.free if free is None else free)
-    capacity = jnp.asarray(snapshot.capacity)
-    sched = jnp.asarray(snapshot.schedulable if schedulable is None else schedulable)
-    node_domain_id = jnp.asarray(snapshot.node_domain_id)
+    if warm is not None:
+        # Device-resident node state: uploads memoized by content digest, so
+        # an unchanged capacity/topology/free tensor re-uses its device
+        # buffer across ticks instead of paying a fresh host->device copy.
+        free0, capacity, sched, node_domain_id = warm.device.snapshot_arrays(
+            snapshot, free=free, schedulable=schedulable
+        )
+    else:
+        free0 = jnp.asarray(snapshot.free if free is None else free)
+        capacity = jnp.asarray(snapshot.capacity)
+        sched = jnp.asarray(snapshot.schedulable if schedulable is None else schedulable)
+        node_domain_id = jnp.asarray(snapshot.node_domain_id)
     jbatch = GangBatch(*(None if x is None else jnp.asarray(x) for x in batch))
     cdmax = coarse_dmax_of(snapshot)
 
@@ -716,6 +742,13 @@ def solve(
 
     if portfolio > 1:
         result = _psolve(portfolio)
+    elif warm is not None:
+        # Donation only when the caller owns the carry: a cached `free`
+        # buffer (free is None -> device-cache owned) must survive the call.
+        result = warm.executables.solve(
+            free0, capacity, sched, node_domain_id, jbatch, params, ok_global,
+            coarse_dmax=cdmax, donate=bool(donate and free is not None),
+        )
     else:
         result = solve_batch(
             free0, capacity, sched, node_domain_id, jbatch, params, ok_global,

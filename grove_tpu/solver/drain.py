@@ -53,6 +53,15 @@ class DrainStats:
     admitted: int = 0
     pods_bound: int = 0
     scores: list = field(default_factory=list)  # per admitted gang
+    # Warm-path counters, as deltas attributable to THIS drain (the caches
+    # are shared process-wide — solver/warm.py): executable-cache traffic,
+    # actual XLA lowerings paid, and per-gang encode-row reuse.
+    exec_cache_hits: int = 0
+    exec_cache_misses: int = 0
+    lowerings: int = 0
+    encode_reuse_hits: int = 0
+    encode_reuse_misses: int = 0
+    donated: bool = False  # wave carry donated (free/ok_global in-place)
 
 
 def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int]]:
@@ -63,12 +72,24 @@ def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int
     priority gets the class containing the top-priority gang solved first,
     shrinking the cross-class inversion window the drain trades for
     throughput (strict global priority still needs the per-tick drivers);
-    test_plan_waves_class_order_follows_input_order pins this."""
+    test_plan_waves_class_order_follows_input_order pins this.
+
+    Gang-axis pad policy: full waves pad to max(32, next_pow2(wave_size)) —
+    the >=32 floor keeps recurring mid-size waves on one executable. A wave
+    that covers the REST of its class (the single-wave class, or a trailing
+    remainder) clamps to next_pow2(len) UNLESS the floored pad would equal
+    the class's full-wave pad (then keeping the floor reuses the already-
+    compiled executable instead of manufacturing a new smaller shape). A
+    3-gang class therefore pads to 4, not 32 — the 32-slot executable it
+    would otherwise compile is a shape the class never shares with anything
+    (executables are keyed per (mg, ms, mp) class, so cross-class pad
+    sharing does not exist)."""
 
     def _padded_shape(g):
         mg_g, ms_g, mp_g = gang_shape(g)
         return (mg_g, max(ms_g, 1), next_pow2(mp_g))
 
+    full_pad = max(32, next_pow2(wave_size))
     waves: list[tuple[list, tuple, int]] = []
     for rank in (0, 1):
         classes: dict[tuple, list] = {}
@@ -76,9 +97,16 @@ def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int
             if (g.base_podgang_name is not None) == bool(rank):
                 classes.setdefault(_padded_shape(g), []).append(g)
         for shape, members in classes.items():
+            n_full = len(members) // wave_size
             for i in range(0, len(members), wave_size):
                 wave = members[i : i + wave_size]
-                waves.append((wave, shape, max(32, next_pow2(len(wave)))))
+                pad = max(32, next_pow2(len(wave)))
+                if len(wave) < wave_size and (n_full == 0 or pad != full_pad):
+                    # Remainder wave whose floored pad is a new executable
+                    # shape anyway (no full wave of this class to share
+                    # with) — clamp to the remainder's own pow2.
+                    pad = next_pow2(len(wave))
+                waves.append((wave, shape, pad))
     return waves
 
 
@@ -91,6 +119,8 @@ def drain_backlog(
     params: SolverParams | None = None,
     portfolio: int = 1,
     warm: bool = True,
+    warm_path=None,  # solver.warm.WarmPath; None = the process-shared one
+    donate: bool | None = None,  # None = auto (on for accelerators, off CPU)
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
 
@@ -103,12 +133,26 @@ def drain_backlog(
     pipelined throughput.
     All-or-nothing per gang; scaled gangs wait for their base's verdict
     on-device.
+
+    Warm path: single-variant (portfolio=1) solves route through the AOT
+    executable cache (`warm_path`, shared process-wide by default — a second
+    drain over the same shape buckets pays ZERO XLA), the `warm` pre-pass
+    compiles (never executes) each unique (shape, pad) program, encode rows
+    reuse across drains via the per-gang row cache, and the free/ok_global
+    wave carry is donated (`donate`) so chaining is an in-place device
+    update rather than a copy per wave.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from grove_tpu.solver import warm as warm_mod
+
     params = params or SolverParams()
+    wp = warm_path if warm_path is not None else warm_mod.default_warm_path()
+    if donate is None:
+        donate = warm_mod.donation_default()
+    use_exec_cache = portfolio == 1
     if portfolio > 1:
         # Per-wave portfolio: every wave solved under P weight variants, the
         # winner's free_after/ok chained forward (solver.portfolio knob; the
@@ -132,9 +176,12 @@ def drain_backlog(
 
     else:
         solver = solve_batch
-    stats = DrainStats(gangs=len(gangs))
+    stats = DrainStats(gangs=len(gangs), donated=bool(donate and use_exec_cache))
     if not gangs:
         return {}, stats
+    # Warm-path counters are process-shared; report this drain's deltas.
+    exec0 = (wp.executables.hits, wp.executables.misses, wp.executables.lowerings)
+    rows0 = (wp.encode_rows.hits, wp.encode_rows.misses)
 
     waves = plan_waves(gangs, wave_size)
     stats.waves = len(waves)
@@ -144,9 +191,15 @@ def drain_backlog(
     schedulable = jnp.asarray(snapshot.schedulable)
     node_domain_id = jnp.asarray(snapshot.node_domain_id)
     dmax = coarse_dmax_of(snapshot)
+    epoch = snapshot.encode_epoch()
 
-    def encode_wave(ws):
+    def encode_wave(ws, reuse_rows: bool = True):
         wave, (mg_c, ms_c, mp_c), pad = ws
+        row_keys = None
+        if reuse_rows:
+            row_keys = [
+                (warm_mod.gang_row_digest(g, pods_by_name), epoch) for g in wave
+            ]
         return encode_gangs(
             wave,
             pods_by_name,
@@ -156,6 +209,8 @@ def drain_backlog(
             max_pods=mp_c,
             pad_gangs_to=pad,
             global_index_of=gidx,
+            row_cache=wp.encode_rows if reuse_rows else None,
+            row_keys=row_keys,
         )
 
     if warm:
@@ -166,23 +221,40 @@ def drain_backlog(
             if ws[1:] in warmed:
                 continue
             warmed.add(ws[1:])
-            warm_batch, _ = encode_wave(ws)
-            last = solver(
-                jnp.asarray(snapshot.free),
-                capacity,
-                schedulable,
-                node_domain_id,
-                warm_batch,
-                params,
-                jnp.zeros((len(gangs),), dtype=bool),
-                coarse_dmax=dmax,
-            )
-            jax.block_until_ready(last.ok)
+            # Warm-up encodes bypass the row cache so the TIMED encode below
+            # stays an honest measurement (the warm drain of a repeated
+            # backlog still hits: the timed encodes populate the cache).
+            warm_batch, _ = encode_wave(ws, reuse_rows=False)
+            if use_exec_cache:
+                # AOT: lower+compile only — no execution, no device chaining.
+                wp.executables.ensure_compiled(
+                    jnp.asarray(snapshot.free),
+                    capacity,
+                    schedulable,
+                    node_domain_id,
+                    warm_batch,
+                    params,
+                    jnp.zeros((len(gangs),), dtype=bool),
+                    coarse_dmax=dmax,
+                    donate=donate,
+                )
+            else:
+                last = solver(
+                    jnp.asarray(snapshot.free),
+                    capacity,
+                    schedulable,
+                    node_domain_id,
+                    warm_batch,
+                    params,
+                    jnp.zeros((len(gangs),), dtype=bool),
+                    coarse_dmax=dmax,
+                )
+                jax.block_until_ready(last.ok)
         stats.compile_s = time.perf_counter() - t0
         # Prime the device->host path OUTSIDE both the compile and the timed
         # drain regions (first d2h in a process pays a ~0.5s relay setup that
         # has nothing to do with either).
-        np.asarray(last.ok)
+        np.asarray(last.ok if last is not None else jnp.zeros((1,), dtype=bool))
 
     t0 = time.perf_counter()
     free_arr = jnp.asarray(snapshot.free)
@@ -195,10 +267,21 @@ def drain_backlog(
         batch, decode = encode_wave(ws)
         stats.encode_s += time.perf_counter() - te
         ts = time.perf_counter()
-        result = solver(
-            free_arr, capacity, schedulable, node_domain_id, batch, params, ok_g,
-            coarse_dmax=dmax,
-        )
+        if use_exec_cache:
+            # Donated wave carry: free_arr/ok_g are forfeited to the solve
+            # and immediately rebound to the result — the capacity update is
+            # an in-place device buffer, never a host round trip. The stale
+            # host free (snapshot.free) is recomputed on access and never
+            # consulted again inside this chain.
+            result = wp.executables.solve(
+                free_arr, capacity, schedulable, node_domain_id, batch,
+                params, ok_g, coarse_dmax=dmax, donate=donate,
+            )
+        else:
+            result = solver(
+                free_arr, capacity, schedulable, node_domain_id, batch, params,
+                ok_g, coarse_dmax=dmax,
+            )
         stats.dispatch_s += time.perf_counter() - ts
         free_arr = result.free_after
         ok_g = result.ok_global
@@ -221,4 +304,9 @@ def drain_backlog(
             stats.admitted += 1
             stats.pods_bound += len(pod_bindings)
     stats.total_s = time.perf_counter() - t0
+    stats.exec_cache_hits = wp.executables.hits - exec0[0]
+    stats.exec_cache_misses = wp.executables.misses - exec0[1]
+    stats.lowerings = wp.executables.lowerings - exec0[2]
+    stats.encode_reuse_hits = wp.encode_rows.hits - rows0[0]
+    stats.encode_reuse_misses = wp.encode_rows.misses - rows0[1]
     return bindings, stats

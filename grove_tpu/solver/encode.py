@@ -173,6 +173,73 @@ def gang_shape(gang: PodGang) -> tuple[int, int, int]:
     return (len(gang.spec.pod_groups), pack_set_count(gang), gang.total_pods())
 
 
+# GangBatch fields that depend only on one gang's spec (+ snapshot epoch +
+# bound-node pins) — exactly the rows the encode-row cache may reuse.
+# Everything else (depends_on, global_index, depends_global, base-gang
+# gating, reuse/spread seeds) depends on batch composition and is always
+# recomputed.
+_ROW_FIELDS = (
+    "group_req",
+    "group_total",
+    "group_required",
+    "group_valid",
+    "set_member",
+    "set_req_level",
+    "set_pref_level",
+    "set_valid",
+    "set_pinned",
+    "pod_group",
+    "pod_rank",
+    "group_order",
+)
+
+
+def _encode_cross_batch_fields(
+    batch: GangBatch,
+    gi: int,
+    gang: PodGang,
+    gang_index: dict[str, int],
+    scheduled_gangs: set[str],
+    global_index_of: dict[str, int] | None,
+) -> None:
+    """Batch-positional fields: global table slot + the base-gang gate.
+    Runs for cached AND freshly-encoded gangs — a cached gang's base may sit
+    at a different batch index (or in a different wave) this time."""
+    if global_index_of is not None:
+        batch.global_index[gi] = global_index_of.get(gang.name, -1)
+    if gang.base_podgang_name is not None:
+        base_idx = gang_index.get(gang.base_podgang_name, -1)
+        if 0 <= base_idx < gi:
+            batch.depends_on[gi] = base_idx
+        elif (
+            global_index_of is not None
+            and gang.base_podgang_name in global_index_of
+        ):
+            # Base solved in an earlier wave: resolve the verdict on-device
+            # via the solver's ok_global bitmap (pipelined chaining).
+            batch.depends_global[gi] = global_index_of[gang.base_podgang_name]
+        elif gang.base_podgang_name not in scheduled_gangs:
+            # Base gang missing and not yet scheduled: gate this gang out.
+            batch.gang_valid[gi] = False
+
+
+def _seed_reuse_row(
+    reuse_arr: np.ndarray | None,
+    gi: int,
+    gang: PodGang,
+    reuse_nodes_by_gang: dict[str, list[int]] | None,
+    snapshot: ClusterSnapshot,
+    g_count: int,
+) -> np.ndarray | None:
+    """ReuseReservationRef seed row; lazily materializes the [G, N] tensor."""
+    for node_idx in (reuse_nodes_by_gang or {}).get(gang.name, []):
+        if 0 <= node_idx < snapshot.capacity.shape[0]:
+            if reuse_arr is None:
+                reuse_arr = np.zeros((g_count, snapshot.capacity.shape[0]), dtype=bool)
+            reuse_arr[gi, node_idx] = True
+    return reuse_arr
+
+
 def encode_gangs(
     gangs: list[PodGang],
     pods_by_name: dict[str, Pod],
@@ -187,6 +254,8 @@ def encode_gangs(
     global_index_of: dict[str, int] | None = None,
     reuse_nodes_by_gang: dict[str, list[int]] | None = None,
     spread_avoid_by_gang: dict[str, list[int]] | None = None,
+    row_cache=None,  # solver.warm.EncodeRowCache (duck-typed)
+    row_keys: list | None = None,  # per-gang spec digests incl. snapshot epoch
 ) -> tuple[GangBatch, GangDecodeInfo]:
     """Flatten gang CRs into the padded batch + decode info.
 
@@ -203,6 +272,16 @@ def encode_gangs(
     `reuse_nodes_by_gang`: gang name -> snapshot node indices its previous
     incarnation occupied (ReuseReservationRef, podgang.go:65-71); seeds the
     solver's w_reuse locality bonus toward the old placement.
+
+    `row_cache`/`row_keys`: incremental encode reuse (solver/warm.py). Each
+    gang's dense rows are dirty-tracked under (row_keys[gi], resource axis,
+    bound-node signature) at the effective bucket dims: a gang whose key
+    matches a previous encode skips the Python spec walk and copies its rows
+    from the cache. The caller's row key MUST include a snapshot epoch
+    (ClusterSnapshot.encode_epoch()) — selector/toleration rows and pack-set
+    pins read node labels/taints/domains. Cross-batch fields (depends_on,
+    global_index, depends_global, base-gang gating, reuse/spread seeds) are
+    always recomputed; they depend on batch composition, not the gang spec.
 
     `global_index_of`: gang name -> slot in a caller-defined global gang table
     (pipelined-wave chaining). When set, each gang's `global_index` is filled,
@@ -258,11 +337,45 @@ def encode_gangs(
         return raw, not unresolved_required
 
     mg = max_groups or max((len(g.spec.pod_groups) for g in gangs), default=1) or 1
-    sets_and_ok = [_sets_of(g) for g in gangs]
-    all_sets = [s for s, _ in sets_and_ok]
-    sets_resolvable = [ok for _, ok in sets_and_ok]
-    ms = max_sets or max((len(s) for s in all_sets), default=1) or 1
     mp = max_pods or max((g.total_pods() for g in gangs), default=1) or 1
+    # Encode-row reuse: resolve cache entries BEFORE the spec walk so hits
+    # can skip _sets_of entirely (the stored n_sets feeds the ms default).
+    bound_map = bound_nodes_by_group or {}
+    row_entries: list = [None] * len(gangs)
+    row_full_keys: list = [None] * len(gangs)
+    if row_cache is not None and row_keys is not None:
+        if len(row_keys) != len(gangs):
+            raise ValueError("row_keys length must match gangs")
+        for gi, gang in enumerate(gangs):
+            bound_sig = tuple(
+                sorted(
+                    (grp, tuple(idxs))
+                    for grp, idxs in bound_map.get(gang.name, {}).items()
+                )
+            )
+            row_full_keys[gi] = (row_keys[gi], r, bound_sig)
+            row_entries[gi] = row_cache.peek(row_full_keys[gi])
+    sets_and_ok = [
+        None if row_entries[gi] is not None else _sets_of(g)
+        for gi, g in enumerate(gangs)
+    ]
+    ms = max_sets or max(
+        (
+            row_entries[gi]["n_sets"]
+            if row_entries[gi] is not None
+            else len(sets_and_ok[gi][0])
+            for gi in range(len(gangs))
+        ),
+        default=1,
+    ) or 1
+    # Demote hits whose bucket dims drifted — the stored rows are shaped by
+    # the bucket they were encoded under.
+    for gi in range(len(gangs)):
+        if row_entries[gi] is not None and row_entries[gi]["dims"] != (mg, ms, mp):
+            row_entries[gi] = None
+            sets_and_ok[gi] = _sets_of(gangs[gi])
+    all_sets = [None if s is None else s[0] for s in sets_and_ok]
+    sets_resolvable = [None if s is None else s[1] for s in sets_and_ok]
 
     batch = GangBatch(
         group_req=np.zeros((g_count, mg, r), dtype=np.float32),
@@ -307,6 +420,39 @@ def encode_gangs(
     cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
 
     for gi, gang in enumerate(gangs):
+        entry = row_entries[gi]
+        if entry is not None:
+            # Encode-row cache hit: the spec (and the snapshot epoch baked
+            # into the key) is unchanged since the rows were built — copy
+            # them in and skip the Python spec walk.
+            row_cache.hits += 1
+            decode.gang_names.append(gang.name)
+            decode.pod_names.append(list(entry["pod_names"]))
+            decode.group_names.append(list(entry["group_names"]))
+            batch.gang_valid[gi] = entry["resolvable"]
+            for fname in _ROW_FIELDS:
+                getattr(batch, fname)[gi] = entry[fname]
+            if entry["sel_rows"]:
+                if selector_masks is None:
+                    selector_masks = np.ones(
+                        (g_count, mg, snapshot.capacity.shape[0]), dtype=bool
+                    )
+                for k, sel_row in entry["sel_rows"].items():
+                    selector_masks[gi, k] = sel_row
+            _encode_cross_batch_fields(
+                batch,
+                gi,
+                gang,
+                gang_index,
+                scheduled_gangs,
+                global_index_of,
+            )
+            reuse_arr = _seed_reuse_row(
+                reuse_arr, gi, gang, reuse_nodes_by_gang, snapshot, g_count
+            )
+            continue
+        if row_cache is not None and row_full_keys[gi] is not None:
+            row_cache.misses += 1
         if len(gang.spec.pod_groups) > mg:
             raise ValueError(f"gang {gang.name}: {len(gang.spec.pod_groups)} groups > bucket {mg}")
         if gang.total_pods() > mp:
@@ -314,30 +460,14 @@ def encode_gangs(
         decode.gang_names.append(gang.name)
         pod_names: list[str] = []
         group_names: list[str] = []
+        miss_sel_rows: dict[int, np.ndarray] = {}
         batch.gang_valid[gi] = sets_resolvable[gi]
-        for node_idx in (reuse_nodes_by_gang or {}).get(gang.name, []):
-            if 0 <= node_idx < snapshot.capacity.shape[0]:
-                if reuse_arr is None:
-                    reuse_arr = np.zeros(
-                        (g_count, snapshot.capacity.shape[0]), dtype=bool
-                    )
-                reuse_arr[gi, node_idx] = True
-        if global_index_of is not None:
-            batch.global_index[gi] = global_index_of.get(gang.name, -1)
-        if gang.base_podgang_name is not None:
-            base_idx = gang_index.get(gang.base_podgang_name, -1)
-            if 0 <= base_idx < gi:
-                batch.depends_on[gi] = base_idx
-            elif (
-                global_index_of is not None
-                and gang.base_podgang_name in global_index_of
-            ):
-                # Base solved in an earlier wave: resolve the verdict on-device
-                # via the solver's ok_global bitmap (pipelined chaining).
-                batch.depends_global[gi] = global_index_of[gang.base_podgang_name]
-            elif gang.base_podgang_name not in scheduled_gangs:
-                # Base gang missing and not yet scheduled: gate this gang out.
-                batch.gang_valid[gi] = False
+        reuse_arr = _seed_reuse_row(
+            reuse_arr, gi, gang, reuse_nodes_by_gang, snapshot, g_count
+        )
+        _encode_cross_batch_fields(
+            batch, gi, gang, gang_index, scheduled_gangs, global_index_of
+        )
         slot = 0
         for k, grp in enumerate(gang.spec.pod_groups):
             group_names.append(grp.name)
@@ -397,6 +527,7 @@ def encode_gangs(
                             toleration_rows[tkey] = tol_row
                         row = row & tol_row
                     selector_masks[gi, k] = row
+                    miss_sel_rows[k] = row
             for rank, ref in enumerate(refs):
                 batch.pod_group[gi, slot] = k
                 batch.pod_rank[gi, slot] = rank
@@ -406,7 +537,7 @@ def encode_gangs(
             raise ValueError(
                 f"gang {gang.name}: {len(all_sets[gi])} pack-sets > bucket {ms}"
             )
-        gang_bound = (bound_nodes_by_group or {}).get(gang.name, {})
+        gang_bound = bound_map.get(gang.name, {})
         req_constrained: set[int] = set()
         for si, (members, req_l, pref_l, pin_names) in enumerate(all_sets[gi]):
             batch.set_valid[gi, si] = True
@@ -440,6 +571,19 @@ def encode_gangs(
         pod_names += [""] * (mp - len(pod_names))
         decode.pod_names.append(pod_names)
         decode.group_names.append(group_names)
+        if row_cache is not None and row_full_keys[gi] is not None:
+            rows = {
+                fname: getattr(batch, fname)[gi].copy() for fname in _ROW_FIELDS
+            }
+            rows.update(
+                dims=(mg, ms, mp),
+                n_sets=len(all_sets[gi]),
+                resolvable=bool(sets_resolvable[gi]),
+                pod_names=list(pod_names),
+                group_names=list(group_names),
+                sel_rows=miss_sel_rows,
+            )
+            row_cache.put(row_full_keys[gi], rows)
 
     if selector_masks is not None:
         batch = batch._replace(group_node_ok=selector_masks)

@@ -70,6 +70,8 @@ class ClusterSnapshot:
     # scale the per-wave rescan was the dominant node-linear term in host
     # encode (round-5 profile: 1.2s of a 4.8s 8x encode).
     _tainted_idx: Optional[dict] = None
+    # Memo for encode_epoch (same immutability argument).
+    _encode_epoch: Optional[tuple] = None
 
     @property
     def n_nodes(self) -> int:
@@ -88,6 +90,28 @@ class ClusterSnapshot:
                 if any(t.get("effect") in blocking_effects for t in taints)
             ]
         return self._tainted_idx[key]
+
+    def encode_epoch(self) -> tuple:
+        """Hashable digest of every snapshot input the dense ENCODE reads:
+        resource axis, capacity (cap_scale for group ordering), the domain
+        map (pack-set pins), node labels (selector rows), and node taints
+        (toleration rows). The per-gang encode-row cache (solver/warm.py)
+        keys on this so rows can never be reused against a snapshot they
+        were not built for. Memoized — the snapshot is immutable."""
+        if self._encode_epoch is None:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=16)
+            h.update(repr(self.resource_names).encode())
+            h.update(np.ascontiguousarray(self.capacity).tobytes())
+            h.update(np.ascontiguousarray(self.node_domain_id).tobytes())
+            for labels in self.node_labels:
+                h.update(repr(sorted(labels.items())).encode())
+            for taints in self.node_taints:
+                if taints:
+                    h.update(repr(taints).encode())
+            self._encode_epoch = (self.capacity.shape, h.hexdigest())
+        return self._encode_epoch
 
     @property
     def free(self) -> np.ndarray:

@@ -94,12 +94,39 @@ def test_plan_waves_rank_ordering():
     from grove_tpu.solver.encode import next_pow2
 
     for wave, _, pad in waves:
-        assert pad == max(32, next_pow2(len(wave)))
+        # Pad policy: full waves keep the >=32 floor; a remainder wave that
+        # cannot share its class's full-wave executable clamps to its own
+        # pow2 (see plan_waves docstring).
+        assert pad in (max(32, next_pow2(len(wave))), next_pow2(len(wave)))
+        assert pad >= len(wave)
         is_scaled_wave = wave[0].base_podgang_name is not None
         if is_scaled_wave:
             saw_scaled = True
         else:
             assert not saw_scaled, "base wave after a scaled wave"
+
+
+def test_plan_waves_pad_clamps_small_classes():
+    """A shape class that only ever holds a few gangs must not pad its gang
+    axis to the 32 floor — that manufactures a bigger executable shape the
+    class never shares with anything (executables are keyed per (mg, ms, mp)
+    class). A trailing remainder that CAN share its class's full-wave
+    executable keeps the floor instead."""
+    from grove_tpu.solver.encode import next_pow2
+
+    gangs, _, _ = _setup(n_disagg=0, n_agg=0, n_frontend=3)
+    waves = plan_waves(gangs, wave_size=256)
+    assert len(waves) == 1
+    wave, _, pad = waves[0]
+    assert pad == next_pow2(len(wave)) < 32
+
+    # Class of wave_size+remainder where the floored remainder pad equals the
+    # full-wave pad: the remainder rides the already-compiled executable.
+    gangs8, _, _ = _setup(n_disagg=0, n_agg=0, n_frontend=11)
+    frontend = [g for g in gangs8 if g.base_podgang_name is None]
+    waves8 = plan_waves(frontend, wave_size=8)
+    pads = [pad for _, _, pad in waves8]
+    assert pads == [32, 32], pads  # full wave of 8 -> 32; trailing 3 shares it
 
 
 def test_plan_waves_class_order_follows_input_order():
@@ -113,6 +140,56 @@ def test_plan_waves_class_order_follows_input_order():
     waves_b = plan_waves(list(reversed(frontend_first)), wave_size=64)
     assert waves_a[0][0][0].name == frontend_first[0].name
     assert waves_b[0][0][0].name != frontend_first[0].name
+
+
+def test_drain_donated_carry_matches_undonated():
+    """Donation safety: chaining >= 3 waves through the donated device-
+    resident free/ok_global carry must bind exactly what the undonated path
+    binds — the updated capacity is an in-place carry, and no stage ever
+    reads the stale host copy of free (capacity accounting from the donated
+    run's bindings must match the snapshot exactly)."""
+    from grove_tpu.solver.warm import WarmPath
+    from grove_tpu.state.cluster import pod_request_vector
+
+    gangs, pods, snap = _setup(n_disagg=8, n_agg=8, n_frontend=8, racks=1)
+    b_plain, s_plain = drain_backlog(
+        gangs, pods, snap, wave_size=8, donate=False, warm_path=WarmPath()
+    )
+    b_don, s_don = drain_backlog(
+        gangs, pods, snap, wave_size=8, donate=True, warm_path=WarmPath()
+    )
+    assert s_don.waves >= 3
+    assert s_don.donated
+    assert b_don == b_plain
+    assert s_don.admitted == s_plain.admitted
+    # First-principles capacity accounting over the donated run: the carry
+    # chained through donated buffers must never oversubscribe a node.
+    used: dict[str, float] = {}
+    for gb in b_don.values():
+        for pod_name, node_name in gb.items():
+            req = pod_request_vector(pods[pod_name], snap.resource_names)
+            used[node_name] = used.get(node_name, 0.0) + float(req[0])
+    for node_name, cpu in used.items():
+        assert cpu <= snap.capacity[snap.node_index(node_name), 0] + 1e-5
+
+
+def test_drain_second_run_is_warm():
+    """A second drain over the same backlog through one WarmPath pays ZERO
+    XLA lowerings (every wave is an executable-cache hit) and reuses every
+    gang's dense encode rows — the bench's cold/warm pair rides this."""
+    from grove_tpu.solver.warm import WarmPath
+
+    gangs, pods, snap = _setup()
+    wp = WarmPath()
+    b1, s1 = drain_backlog(gangs, pods, snap, wave_size=8, warm_path=wp)
+    assert s1.lowerings > 0  # cold: shapes actually compiled
+    b2, s2 = drain_backlog(gangs, pods, snap, wave_size=8, warm_path=wp)
+    assert b2 == b1
+    assert s2.lowerings == 0
+    assert s2.exec_cache_misses == 0
+    assert s2.exec_cache_hits >= s2.waves
+    assert s2.encode_reuse_hits >= len(gangs)
+    assert s2.compile_s < s1.compile_s or s1.compile_s == 0
 
 
 def test_drain_portfolio_beats_binpack_trap(simple1):

@@ -1,0 +1,283 @@
+"""Warm-path solver layer (solver/warm.py): AOT executable cache keying,
+prewarm-from-history, device-resident snapshot state, per-gang encode-row
+reuse, and the per-tick drivers' zero-recompile steady state."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from scenario_harness import Scenario, e2e_nodes, e2e_topology
+
+from grove_tpu.api import PodCliqueSet, default_podcliqueset
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.solver import encode_gangs
+from grove_tpu.solver.core import SolverParams, solve_batch
+from grove_tpu.solver.warm import (
+    EncodeRowCache,
+    ExecutableCache,
+    SnapshotDeviceCache,
+    WarmPath,
+    gang_row_digest,
+)
+from grove_tpu.state import build_snapshot
+
+
+def _setup(simple1: PodCliqueSet, pad_nodes_to: int | None = None):
+    topo = e2e_topology()
+    nodes = e2e_nodes(8, mem=64 * 2**30)
+    for n in nodes:
+        n.capacity["cpu"] = 16.0
+    ds = expand_podcliqueset(simple1, topo)
+    snap = build_snapshot(nodes, topo, pad_nodes_to=pad_nodes_to)
+    pods = {p.name: p for p in ds.pods}
+    return ds.podgangs, pods, snap
+
+
+def _solve_args(gangs, pods, snap):
+    batch, decode = encode_gangs(gangs, pods, snap)
+    return (
+        snap.free,
+        snap.capacity,
+        snap.schedulable,
+        snap.node_domain_id,
+        batch,
+        SolverParams(),
+        None,
+    ), decode
+
+
+# ---- executable cache keying (ISSUE-1 satellite) ------------------------------
+
+
+def test_executable_cache_keying_and_no_relower(simple1):
+    """Two snapshots with different NODE PADS must not alias to one
+    executable, a different coarse_dmax must not alias either, and a second
+    solve of the same key must not re-lower (pinned via the cache's
+    lowering counter)."""
+    cache = ExecutableCache()
+    gangs, pods, snap8 = _setup(simple1, pad_nodes_to=8)
+    _, _, snap16 = _setup(simple1, pad_nodes_to=16)
+    args8, decode = _solve_args(gangs, pods, snap8)
+    args16, _ = _solve_args(gangs, pods, snap16)
+
+    r8 = cache.solve(*args8)
+    assert cache.lowerings == 1 and cache.misses == 1
+    r16 = cache.solve(*args16)
+    assert cache.lowerings == 2, "node-pad change must compile a new executable"
+
+    # Same key again: served from cache, no new lowering.
+    r8b = cache.solve(*args8)
+    assert cache.lowerings == 2 and cache.hits == 1
+
+    # Different static coarse_dmax: a distinct executable.
+    cache.solve(*args8, coarse_dmax=4)
+    assert cache.lowerings == 3, "coarse_dmax change must compile a new executable"
+
+    # The cached executable computes exactly what the default jit path does.
+    ref = solve_batch(*args8)
+    np.testing.assert_array_equal(np.asarray(r8.ok), np.asarray(ref.ok))
+    np.testing.assert_array_equal(np.asarray(r8.assigned), np.asarray(ref.assigned))
+    np.testing.assert_array_equal(np.asarray(r8b.ok), np.asarray(r8.ok))
+    assert np.asarray(r16.ok).shape == np.asarray(r8.ok).shape
+
+
+def test_executable_cache_donate_is_a_distinct_key(simple1):
+    """The donated executable consumes its carry buffers — it must never be
+    served for an undonated call (and vice versa)."""
+    cache = ExecutableCache()
+    gangs, pods, snap = _setup(simple1, pad_nodes_to=8)
+    args, _ = _solve_args(gangs, pods, snap)
+    cache.solve(*args, donate=False)
+    cache.solve(*args, donate=True)
+    assert cache.lowerings == 2
+
+
+def test_prewarm_from_history(tmp_path, simple1):
+    """A fresh cache prewarms the recorded shape buckets from the history
+    file WITHOUT concrete data, and the first real solve is then a hit."""
+    history = str(tmp_path / "solve-shapes.json")
+    gangs, pods, snap = _setup(simple1, pad_nodes_to=8)
+    args, _ = _solve_args(gangs, pods, snap)
+
+    recorder = ExecutableCache(history_path=history)
+    recorder.solve(*args)
+    assert recorder.lowerings == 1
+
+    fresh = ExecutableCache(history_path=history)
+    compiled = fresh.prewarm_from_history(top_k=4)
+    assert compiled >= 1 and fresh.prewarmed == compiled
+    lowerings_after_prewarm = fresh.lowerings
+    result = fresh.solve(*args)
+    assert fresh.lowerings == lowerings_after_prewarm, (
+        "prewarmed shape must serve the first solve without re-lowering"
+    )
+    assert fresh.hits == 1
+    np.testing.assert_array_equal(
+        np.asarray(result.ok), np.asarray(solve_batch(*args).ok)
+    )
+
+
+def test_prewarm_thread_noop_without_history(tmp_path):
+    cache = ExecutableCache(history_path=str(tmp_path / "missing.json"))
+    assert cache.start_prewarm_thread(4) is None
+    assert ExecutableCache().start_prewarm_thread(4) is None  # no path at all
+
+
+# ---- device-resident snapshot state ------------------------------------------
+
+
+def test_device_cache_reuses_uploads_across_rebuilt_snapshots(simple1):
+    """Per-tick drivers rebuild numpy snapshots every pass; unchanged
+    content must reuse the SAME device buffers (digest-keyed), not pay a
+    fresh host->device upload."""
+    dc = SnapshotDeviceCache()
+    gangs, pods, snap_a = _setup(simple1, pad_nodes_to=8)
+    _, _, snap_b = _setup(simple1, pad_nodes_to=8)  # rebuilt, same content
+    f1, c1, s1, n1 = dc.snapshot_arrays(snap_a)
+    misses_cold = dc.misses
+    f2, c2, s2, n2 = dc.snapshot_arrays(snap_b)
+    assert c2 is c1 and n2 is n1 and s2 is s1 and f2 is f1
+    assert dc.misses == misses_cold and dc.hits >= 4
+    # Changed content (a node loses capacity) must re-upload, not alias.
+    snap_c = snap_b
+    snap_c.capacity[0, 0] -= 1.0
+    snap_c._encode_epoch = None  # content edit: drop memo (test-only mutation)
+    _, c3, _, _ = dc.snapshot_arrays(snap_c)
+    assert c3 is not c1
+
+
+# ---- per-gang encode-row reuse -----------------------------------------------
+
+
+def test_encode_row_cache_roundtrip_identical_batch(simple1):
+    """A second encode of the same gangs against the same snapshot epoch
+    must be all hits and produce a byte-identical batch + decode info."""
+    gangs, pods, snap = _setup(simple1)
+    rows = EncodeRowCache()
+    epoch = snap.encode_epoch()
+    keys = [(gang_row_digest(g, pods), epoch) for g in gangs]
+    b1, d1 = encode_gangs(gangs, pods, snap, row_cache=rows, row_keys=keys)
+    assert rows.misses == len(gangs) and rows.hits == 0
+    b2, d2 = encode_gangs(gangs, pods, snap, row_cache=rows, row_keys=keys)
+    assert rows.hits == len(gangs)
+    for fname in b1._fields:
+        a, b = getattr(b1, fname), getattr(b2, fname)
+        if a is None:
+            assert b is None, fname
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=fname)
+    assert d1.gang_names == d2.gang_names
+    assert d1.pod_names == d2.pod_names
+    assert d1.group_names == d2.group_names
+
+
+def test_encode_row_cache_epoch_change_misses(simple1):
+    """Rows key on (spec hash, snapshot epoch): a new epoch (labels/taints/
+    capacity changed) must re-encode, not reuse stale rows."""
+    gangs, pods, snap = _setup(simple1)
+    rows = EncodeRowCache()
+    epoch = snap.encode_epoch()
+    keys = [(gang_row_digest(g, pods), epoch) for g in gangs]
+    encode_gangs(gangs, pods, snap, row_cache=rows, row_keys=keys)
+    stale_keys = [(k[0], ("other-epoch",)) for k in keys]
+    encode_gangs(gangs, pods, snap, row_cache=rows, row_keys=stale_keys)
+    assert rows.hits == 0 and rows.misses == 2 * len(gangs)
+
+
+def test_gang_row_digest_tracks_spec_not_identity(simple1):
+    """The digest is a SPEC hash: a rebuilt equal gang matches, a floor
+    change does not."""
+    gangs, pods, _ = _setup(simple1)
+    gangs2, pods2, _ = _setup(simple1)  # fresh expansion, equal specs
+    assert gang_row_digest(gangs[0], pods) == gang_row_digest(gangs2[0], pods2)
+    gangs2[0].spec.pod_groups[0].min_replicas += 1
+    assert gang_row_digest(gangs[0], pods) != gang_row_digest(gangs2[0], pods2)
+
+
+# ---- per-tick drivers: the zero-recompile steady state (tier-1) ---------------
+
+
+def _one_clique_pcs(name: str, replicas: int = 1) -> PodCliqueSet:
+    doc = {
+        "apiVersion": "grove.io/v1alpha1",
+        "kind": "PodCliqueSet",
+        "metadata": {"name": name},
+        "spec": {
+            "replicas": 1,
+            "template": {
+                "cliques": [
+                    {
+                        "name": "w",
+                        "spec": {
+                            "roleName": "w",
+                            "replicas": replicas,
+                            "minAvailable": replicas,
+                            "podSpec": {
+                                "containers": [
+                                    {
+                                        "name": "w",
+                                        "image": "registry.local/w:v1",
+                                        "resources": {
+                                            "requests": {"memory": "80Mi"}
+                                        },
+                                    }
+                                ]
+                            },
+                        },
+                    }
+                ],
+            },
+        },
+    }
+    return default_podcliqueset(PodCliqueSet.from_dict(doc))
+
+
+def test_second_identical_solve_tick_zero_new_compilations():
+    """CI pin for the warm path on CPU: after the first solve_pending
+    compiles its shape bucket, (a) an unchanged tick is skipped outright and
+    (b) a SECOND solve of the same shape (an identical workload arriving)
+    rides the executable cache — zero new XLA lowerings either way."""
+    s = Scenario(4)
+    s.deploy(_one_clique_pcs("alpha"))
+    s.settle(5)
+    assert s.until_scheduled(1, "alpha")
+    cache = s.controller.warm.executables
+    lowerings_cold = cache.lowerings
+    assert lowerings_cold > 0
+
+    # (a) nothing changed: the skip damper short-circuits the pass entirely.
+    skipped_before = s.controller.solve_pass_counts["skipped"]
+    s.settle(5)
+    assert cache.lowerings == lowerings_cold
+
+    # (b) an identical workload = the same solve shape: executable-cache hit.
+    hits_before = cache.hits
+    s.deploy(_one_clique_pcs("beta"))
+    s.settle(5)
+    assert s.until_scheduled(1, "beta")
+    assert cache.lowerings == lowerings_cold, (
+        "identical solve shape must not re-lower"
+    )
+    assert cache.hits > hits_before
+    assert s.controller.solve_pass_counts["skipped"] >= skipped_before
+
+
+def test_unchanged_pending_set_reuses_encode_rows_across_ticks():
+    """ISSUE-1 acceptance: a tick that re-solves an UNCHANGED pending set
+    (the cluster changed — here a node uncordons — but no gang spec did)
+    reuses the gangs' dense encode rows from the previous tick
+    (hit counter > 0) instead of re-running encode on the whole set."""
+    s = Scenario(2)
+    s.cordon_n(1)
+    s.deploy(_one_clique_pcs("gamma", replicas=2))  # needs both nodes
+    s.settle(5)
+    assert not s.scheduled("gamma")  # rejected while cordoned; stays pending
+    rows = s.controller.warm.encode_rows
+    assert rows.misses > 0
+    hits_before = rows.hits
+    s.uncordon_n(1)  # schedulable flips; specs (and encode rows) unchanged
+    s.settle(5)
+    assert s.until_scheduled(2, "gamma")
+    assert rows.hits > hits_before, (
+        "unchanged pending gangs must reuse their encode rows"
+    )
