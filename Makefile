@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim bench bench-cpu dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim bench bench-cpu bench-defrag bench-defrag-cpu dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -45,6 +45,12 @@ bench:           ## north-star benchmark (one JSON line; TPU if healthy)
 
 bench-cpu:       ## benchmark with the TPU-relay probe skipped
 	GROVE_FORCE_CPU=1 $(PY) bench.py
+
+bench-defrag:    ## defrag scenario: fragmented fleet -> plan+execute -> recovery
+	GROVE_BENCH_SCENARIO=defrag $(PY) bench.py
+
+bench-defrag-cpu: ## defrag scenario with the TPU-relay probe skipped
+	GROVE_BENCH_SCENARIO=defrag GROVE_FORCE_CPU=1 $(PY) bench.py
 
 dryrun:          ## multi-chip sharding compile+run on 8 virtual devices
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

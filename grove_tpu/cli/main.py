@@ -3,7 +3,7 @@
 Talks to a running manager (`python -m grove_tpu.runtime`) over its object
 API via the typed client. Commands:
 
-  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology   table listing
+  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag   table listing
   get <kind> <name>                             full object as JSON
   describe <kind> <name>                        human detail + object events
   apply -f <file.yaml>                          admit a PodCliqueSet
@@ -54,6 +54,7 @@ KIND_ALIASES = {
     "clustertopology": "topology",
     "clustertopologies": "topology",
     "solver": "solver",
+    "defrag": "defrag",
 }
 
 
@@ -179,6 +180,37 @@ def _get_table(client: GroveClient, kind: str) -> str:
             ["warmPath." + k, v]
             for k, v in sorted(st.get("warmPath", {}).items())
         ]
+        return _table(rows, ["METRIC", "VALUE"])
+    if kind == "defrag":
+        # Defrag loop at a glance: score vs threshold, in-flight migrations,
+        # per-level stranded fractions, and the monotonic counters — all
+        # from /statusz (the same doc the manager's metrics are cut from).
+        doc = client.statusz().get("defrag", {})
+        last = doc.get("last", {})
+        counts = doc.get("counts", {})
+        rows = [
+            ["enabled", "yes" if doc.get("enabled") else "no"],
+            ["score", f"{last.get('score', 0.0):.4f}" if last else "-"],
+            ["threshold", doc.get("threshold", "-")],
+            ["migrating", ",".join(doc.get("migrating", [])) or "-"],
+        ]
+        for entry in last.get("report", {}).get("levels", []):
+            rows.append(
+                [
+                    f"stranded.{entry.get('level')}.{entry.get('resource')}",
+                    f"{entry.get('stranded', 0.0):.4f}",
+                ]
+            )
+        plan = last.get("plan")
+        if plan:
+            rows += [
+                ["lastPlan.moves", plan.get("moves", 0)],
+                ["lastPlan.podsMigrated", plan.get("podsMigrated", 0)],
+                ["lastPlan.capacityRecovered", plan.get("capacityRecovered", 0)],
+                ["lastPlan.efficiency", plan.get("efficiency", 0)],
+                ["lastPlan.solveSeconds", plan.get("planSolveSeconds", 0)],
+            ]
+        rows += [[f"counts.{k}", v] for k, v in sorted(counts.items())]
         return _table(rows, ["METRIC", "VALUE"])
     if kind == "services":
         return _table([[n] for n in client.list_services()], ["NAME"])

@@ -145,6 +145,46 @@ class GroveController:
     # across ticks, and per-gang encode-row reuse. The manager surfaces
     # warm.stats() on /statusz and wires the shape-history path for prewarm.
     warm: WarmPath = field(default_factory=WarmPath)
+    # Defragmentation & rebalance loop (solver/defrag.py; config section
+    # `defrag`): when the fragmentation score crosses the threshold, the
+    # batched migration planner re-places movable gangs (cluster minus their
+    # own usage, through the SAME warm path as serving solves) and this
+    # controller executes the winning plan's moves under a disruption budget
+    # — at most `defrag_max_concurrent` gangs migrating at once, one
+    # migration per gang per cooldown window, make-before-break: a gang's
+    # target capacity is verified free while its old placement still holds,
+    # then the whole gang rebinds atomically (gang semantics preserved).
+    defrag_enabled: bool = False
+    defrag_threshold: float = 0.5
+    defrag_interval_seconds: float = 30.0
+    defrag_max_concurrent: int = 1
+    defrag_cooldown_seconds: float = 300.0
+    defrag_max_moves: int = 8
+    defrag_min_efficiency: float = 0.0
+    # Gangs mid-migration (name -> start time); a migration completes when
+    # every pod of the gang is scheduled and Ready again. This set IS the
+    # disruption budget's denominator.
+    _defrag_migrating: dict = field(default_factory=dict)
+    # Per-gang cooldown stamps (name -> last migration start).
+    _defrag_migrated_at: dict = field(default_factory=dict)
+    # Next scheduled defrag evaluation (None = immediately when enabled).
+    _defrag_next_at: float | None = None
+    # Last tick's summary (score, report, plan) — /statusz + CLI surface.
+    defrag_last: dict = field(default_factory=dict)
+    # Monotonic counters the manager exports as metrics.
+    defrag_counts: dict = field(
+        default_factory=lambda: {
+            "ticks": 0,
+            "plans": 0,
+            "migrations": 0,
+            "migrations_completed": 0,
+            "pods_migrated": 0,
+            "capacity_recovered": 0.0,
+            "skipped_budget": 0,
+            "skipped_below_threshold": 0,
+            "moves_deferred": 0,
+        }
+    )
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -155,6 +195,7 @@ class GroveController:
         self.solve_pending(now)
         self.update_statuses(now)
         self.gang_termination(now)
+        self.maybe_defrag(now)
 
     # --- workload sync (PCS controller analog) -----------------------------------
 
@@ -1368,6 +1409,218 @@ class GroveController:
             if desired != current:
                 c.scale_overrides[fqn] = desired
                 c.record_event(now, fqn, f"HPA scaled {current} -> {desired}")
+
+    # --- defragmentation & rebalance (solver/defrag.py execution side) -----------
+
+    def maybe_defrag(self, now: float) -> dict | None:
+        """Run one defrag evaluation when enabled and the interval elapsed.
+        Called from reconcile() and from the manager's flow step — the
+        interval gate makes double wiring harmless."""
+        if not self.defrag_enabled:
+            return None
+        if self._defrag_next_at is not None and now < self._defrag_next_at:
+            return None
+        self._defrag_next_at = now + self.defrag_interval_seconds
+        return self.defrag_tick(now)
+
+    def defrag_movable(self, now: float) -> list[PodGang]:
+        """Gangs eligible for migration: fully placed AND settled (every
+        active pod scheduled and Ready — a gang mid-startup is not moved
+        under it), outside the per-gang cooldown, and not already migrating.
+        Ordered cheapest-disruption first: lowest priority, then fewest
+        pods, then name — the same priority machinery preemption uses, so
+        defrag never moves a high-priority gang to spare a low-priority one."""
+        c = self.cluster
+        movable: list[PodGang] = []
+        for gang in c.podgangs.values():
+            if gang.name in self._defrag_migrating:
+                continue
+            last = self._defrag_migrated_at.get(gang.name)
+            if last is not None and now - last < self.defrag_cooldown_seconds:
+                continue
+            pods = [p for p in c.pods_of_gang(gang.name) if p.is_active]
+            if not pods or not all(p.is_scheduled and p.ready for p in pods):
+                continue
+            movable.append(gang)
+        movable.sort(
+            key=lambda g: (
+                self._priority_of(g),
+                len(c.pods_of_gang(g.name)),
+                g.name,
+            )
+        )
+        return movable
+
+    def defrag_tick(self, now: float) -> dict | None:
+        """One defrag cycle: score → (maybe) plan → execute under budget.
+
+        Make-before-break: each move's target capacity is verified against
+        the CURRENT free state — before the gang's old placement releases
+        anything — and the whole gang then rebinds atomically. Moves whose
+        targets are still occupied (they need an earlier move's freed
+        capacity) retry within the tick after other moves land, and
+        anything left defers to the next cycle, which replans against the
+        then-current cluster."""
+        from grove_tpu.solver.defrag import fragmentation_report, plan_migrations
+
+        c = self.cluster
+        counts = self.defrag_counts
+        counts["ticks"] += 1
+        # Completion sweep: a migration is done when the gang is whole again.
+        for name in list(self._defrag_migrating):
+            gang = c.podgangs.get(name)
+            if gang is None:
+                del self._defrag_migrating[name]
+                continue
+            pods = [p for p in c.pods_of_gang(name) if p.is_active]
+            if pods and all(p.is_scheduled and p.ready for p in pods):
+                del self._defrag_migrating[name]
+                counts["migrations_completed"] += 1
+        for name in [n for n in self._defrag_migrated_at if n not in c.podgangs]:
+            del self._defrag_migrated_at[name]
+        if not c.nodes:
+            return None
+        nodes = list(c.nodes.values())
+        bound = [p for p in c.pods.values() if p.is_scheduled and p.is_active]
+        snapshot = build_snapshot(
+            nodes,
+            self.topology,
+            bound_pods=bound,
+            pad_nodes_to=next_pow2(len(c.nodes)),
+        )
+        report = fragmentation_report(snapshot)
+        summary: dict = {
+            "at": now,
+            "score": report.score,
+            "threshold": self.defrag_threshold,
+            "migrating": len(self._defrag_migrating),
+            "report": report.to_doc(),
+        }
+        self.defrag_last = summary
+        if report.score < self.defrag_threshold:
+            counts["skipped_below_threshold"] += 1
+            return summary
+        budget = self.defrag_max_concurrent - len(self._defrag_migrating)
+        if budget <= 0:
+            counts["skipped_budget"] += 1
+            summary["deferred"] = "disruption budget exhausted"
+            return summary
+        movable = self.defrag_movable(now)
+        if not movable:
+            summary["deferred"] = "no movable gangs"
+            return summary
+        plan = plan_migrations(
+            nodes,
+            self.topology,
+            movable,
+            dict(c.pods),
+            params=self.solver_params,
+            warm=self.warm,
+            max_moves=self.defrag_max_moves,
+            min_efficiency=self.defrag_min_efficiency,
+        )
+        if plan is None:
+            summary["deferred"] = "no improving plan"
+            return summary
+        counts["plans"] += 1
+        counts["capacity_recovered"] += plan.capacity_recovered
+        summary["plan"] = plan.to_doc()
+        executed = 0
+        moves = list(plan.moves)
+        progress = True
+        while moves and budget > 0 and progress:
+            progress = False
+            remaining = []
+            for mv in moves:
+                if budget <= 0:
+                    remaining.append(mv)
+                    continue
+                if self._execute_move(mv, snapshot, now):
+                    budget -= 1
+                    executed += 1
+                    progress = True
+                else:
+                    remaining.append(mv)
+            moves = remaining
+        counts["moves_deferred"] += len(moves)
+        summary["migrationsStarted"] = executed
+        summary["migrationsDeferred"] = len(moves)
+        summary["migrating"] = len(self._defrag_migrating)
+        return summary
+
+    def _execute_move(self, mv, snapshot, now: float) -> bool:
+        """Atomically rebind one gang to its planned nodes; False when the
+        move cannot run yet (capacity not free, gang changed under the plan).
+
+        The reservation IS the capacity check: every target node must fit
+        the incoming pods out of free capacity measured while the gang's old
+        placement still holds (make-before-break) — `snapshot.allocated` is
+        updated in place as moves land, so later moves inside one tick see
+        earlier moves' releases."""
+        import numpy as np
+
+        from grove_tpu.state.cluster import pod_request_vector
+
+        c = self.cluster
+        gang = c.podgangs.get(mv.gang)
+        if gang is None:
+            return False
+        pods = {p.name: p for p in c.pods_of_gang(mv.gang) if p.is_active}
+        demand: dict[int, np.ndarray] = {}
+        for pod_name, target in mv.bindings.items():
+            pod = pods.get(pod_name)
+            if pod is None or not pod.is_scheduled:
+                return False  # gang churned since planning; replan next cycle
+            if target not in snapshot.node_index_map:
+                return False
+            ti = snapshot.node_index(target)
+            req = pod_request_vector(pod, snapshot.resource_names)
+            demand[ti] = demand.get(ti, 0) + req
+        free = snapshot.capacity - snapshot.allocated
+        for ti, need in demand.items():
+            if not snapshot.schedulable[ti] or (free[ti] + 1e-6 < need).any():
+                return False  # target not free yet: defer (make-before-break)
+        # Cutover: the whole gang rebinds in one step. Pods restart on their
+        # new hosts (PENDING, not Ready) and flow through the normal startup
+        # lifecycle; the gang reads as migrating until it is whole again.
+        moved = 0
+        for pod_name, target in mv.bindings.items():
+            pod = pods[pod_name]
+            req = pod_request_vector(pod, snapshot.resource_names)
+            old = pod.node_name
+            if old in snapshot.node_index_map:
+                snapshot.allocated[snapshot.node_index(old)] -= req
+            snapshot.allocated[snapshot.node_index(target)] += req
+            pod.node_name = target
+            pod.ready = False
+            pod.phase = PodPhase.PENDING
+            pod.started_at = None
+            moved += 1
+        np.maximum(snapshot.allocated, 0.0, out=snapshot.allocated)
+        self._defrag_migrating[mv.gang] = now
+        self._defrag_migrated_at[mv.gang] = now
+        self.defrag_counts["migrations"] += 1
+        self.defrag_counts["pods_migrated"] += moved
+        c.record_event(
+            now,
+            mv.gang,
+            f"gang migrated by defrag ({moved} pods rebound, "
+            f"make-before-break)",
+        )
+        return True
+
+    def defrag_status(self) -> dict:
+        """JSON-able defrag state for /statusz and `grove-tpu get defrag`."""
+        return {
+            "enabled": self.defrag_enabled,
+            "threshold": self.defrag_threshold,
+            "intervalSeconds": self.defrag_interval_seconds,
+            "maxConcurrentMigrations": self.defrag_max_concurrent,
+            "gangCooldownSeconds": self.defrag_cooldown_seconds,
+            "migrating": sorted(self._defrag_migrating),
+            "counts": dict(self.defrag_counts),
+            "last": dict(self.defrag_last),
+        }
 
 
 def _merge_pod_groups(existing, desired):

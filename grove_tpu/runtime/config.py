@@ -183,6 +183,33 @@ class SolverConfig:
 
 
 @dataclass
+class DefragConfig:
+    """Defragmentation & rebalance loop (solver/defrag.py + the controller's
+    defrag_tick): periodic fragmentation scoring over the cluster snapshot;
+    when the score crosses `threshold`, the batched migration planner
+    re-places movable gangs (through the same warm-path AOT executable
+    cache as serving solves) and the controller executes the winning plan
+    under a disruption budget with make-before-break ordering."""
+
+    enabled: bool = False
+    # Fragmentation score in [0, 1] at which planning kicks in (1 - best
+    # domain free / ideal consolidated free, worst over levels+resources).
+    threshold: float = 0.5
+    # Evaluation cadence of the background loop.
+    interval_seconds: float = 30.0
+    # Disruption budget: max gangs migrating (rebound, not yet Ready again)
+    # at any instant. Plan moves beyond it defer to later cycles.
+    max_concurrent_migrations: int = 1
+    # A migrated gang is immune to re-migration for this long.
+    gang_cooldown_seconds: float = 300.0
+    # Cap on gangs re-placed per plan (candidate prefix ladder top).
+    max_moves_per_plan: int = 8
+    # Minimum (capacity recovered / pods migrated) for a plan to execute;
+    # units of the binding resource. 0 = any strict improvement runs.
+    min_efficiency: float = 0.0
+
+
+@dataclass
 class BackendConfig:
     """Scheduler-backend sidecar (GREP-375 boundary)."""
 
@@ -266,6 +293,7 @@ class OperatorConfiguration:
     )
     scheduling: SchedulingConfig = field(default_factory=SchedulingConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
+    defrag: DefragConfig = field(default_factory=DefragConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
@@ -300,6 +328,7 @@ _SECTION_TYPES = {
     "networkAcceleration": ("network_acceleration", NetworkAccelerationConfig),
     "scheduling": ("scheduling", SchedulingConfig),
     "solver": ("solver", SolverConfig),
+    "defrag": ("defrag", DefragConfig),
     "backend": ("backend", BackendConfig),
     "persistence": ("persistence", PersistenceConfig),
     "cluster": ("cluster", ClusterConfig),
@@ -338,6 +367,11 @@ _CAMEL_FIELDS = {
     "prewarmTopK": "prewarm_top_k",
     "shapeHistoryPath": "shape_history_path",
     "portfolioEscalation": "portfolio_escalation",
+    "intervalSeconds": "interval_seconds",
+    "maxConcurrentMigrations": "max_concurrent_migrations",
+    "gangCooldownSeconds": "gang_cooldown_seconds",
+    "maxMovesPerPlan": "max_moves_per_plan",
+    "minEfficiency": "min_efficiency",
     "maxWorkers": "max_workers",
     "snapshotIntervalSeconds": "snapshot_interval_seconds",
     "wTight": "w_tight",
@@ -547,6 +581,29 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             seen_weights[field_name] = wk
             if not isinstance(wv, (int, float)) or isinstance(wv, bool) or not _math.isfinite(float(wv)):
                 errors.append(f"solver.weights.{wk}: {wv!r} is not a finite number")
+    df = cfg.defrag
+    if not isinstance(df.threshold, (int, float)) or isinstance(
+        df.threshold, bool
+    ) or not 0.0 <= float(df.threshold) <= 1.0:
+        errors.append("defrag.threshold: must be a number in [0, 1]")
+    if not isinstance(df.interval_seconds, (int, float)) or isinstance(
+        df.interval_seconds, bool
+    ) or df.interval_seconds <= 0:
+        errors.append("defrag.intervalSeconds: must be > 0")
+    mc = df.max_concurrent_migrations
+    if not isinstance(mc, int) or isinstance(mc, bool) or mc < 1:
+        errors.append("defrag.maxConcurrentMigrations: must be an int >= 1")
+    if not isinstance(df.gang_cooldown_seconds, (int, float)) or isinstance(
+        df.gang_cooldown_seconds, bool
+    ) or df.gang_cooldown_seconds < 0:
+        errors.append("defrag.gangCooldownSeconds: must be >= 0")
+    mm = df.max_moves_per_plan
+    if not isinstance(mm, int) or isinstance(mm, bool) or mm < 1:
+        errors.append("defrag.maxMovesPerPlan: must be an int >= 1")
+    if not isinstance(df.min_efficiency, (int, float)) or isinstance(
+        df.min_efficiency, bool
+    ) or df.min_efficiency < 0:
+        errors.append("defrag.minEfficiency: must be >= 0")
     cl = cfg.cluster
     if cl.initc_mode not in ("operator", "kubernetes"):
         errors.append(

@@ -476,6 +476,13 @@ class Manager:
             slice_resource_name=config.network_acceleration.slice_resource_name,
             initc_server_url=config.servers.advertise_url,
             initc_mode=config.cluster.initc_mode,
+            defrag_enabled=config.defrag.enabled,
+            defrag_threshold=config.defrag.threshold,
+            defrag_interval_seconds=config.defrag.interval_seconds,
+            defrag_max_concurrent=config.defrag.max_concurrent_migrations,
+            defrag_cooldown_seconds=config.defrag.gang_cooldown_seconds,
+            defrag_max_moves=config.defrag.max_moves_per_plan,
+            defrag_min_efficiency=config.defrag.min_efficiency,
         )
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -568,6 +575,32 @@ class Manager:
             "PlacementScore of gangs at first admission (1.0 = optimal)",
             buckets=(0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0),
         )
+        # Defragmentation loop observability (GREP-244 metrics direction):
+        # the fragmentation score is a gauge (sampled each defrag tick), the
+        # per-level stranded fractions carry level+resource labels, and the
+        # migration counters export as real Counters (delta-tracked against
+        # controller.defrag_counts, same discipline as solve passes).
+        self._m_frag_score = self.metrics.gauge(
+            "grove_fragmentation_score",
+            "Cluster fragmentation score (1 - best domain free / ideal)",
+        )
+        self._m_frag_stranded = self.metrics.gauge(
+            "grove_fragmentation_stranded",
+            "Stranded free-capacity fraction per topology level and resource",
+        )
+        self._m_defrag_plans = self.metrics.counter(
+            "grove_defrag_plans_total", "Migration plans executed"
+        )
+        self._m_defrag_migrations = self.metrics.counter(
+            "grove_defrag_migrations_total", "Gang migrations started by defrag"
+        )
+        self._m_defrag_pods = self.metrics.counter(
+            "grove_defrag_pods_migrated_total", "Pods rebound by defrag migrations"
+        )
+        self._m_defrag_migrating = self.metrics.gauge(
+            "grove_defrag_migrating", "Gangs currently mid-migration"
+        )
+        self._defrag_exported = {"plans": 0, "migrations": 0, "pods_migrated": 0}
         # Every (queue, resource) series ever emitted — re-zeroed each pass
         # when usage disappears (gauge values persist otherwise).
         self._queue_metric_keys: dict[str, set] = {}
@@ -827,6 +860,10 @@ class Manager:
             # per-gang encode-row reuse — the measurable side of the
             # compile-amortization discipline.
             "warmPath": self.controller.warm.stats(),
+            # Defrag loop state: last fragmentation report, plan summary,
+            # in-flight migrations, monotonic counters (what `grove-tpu get
+            # defrag` renders).
+            "defrag": self.controller.defrag_status(),
             # The effective ClusterTopology (config TAS levels + auto host
             # level) — what `grove-tpu get topology` renders (kubectl get
             # clustertopology analog; the kubernetes source also syncs it
@@ -1281,6 +1318,10 @@ class Manager:
                 ("solve_pending", _timed("solve_pending", _solve)),
                 ("update_statuses", _step("update_statuses", ctrl.update_statuses)),
                 ("gang_termination", _step("gang_termination", ctrl.gang_termination)),
+                # Defrag background loop (config section `defrag`): interval-
+                # gated inside maybe_defrag, so this runs as a cheap no-op on
+                # every other pass and a score/plan/execute cycle when due.
+                ("defrag", _step("defrag", ctrl.maybe_defrag)),
             ],
             error_recorder=_record,
         )
@@ -1309,6 +1350,29 @@ class Manager:
             if delta > 0:
                 self._m_solve_passes.inc(float(delta), kind=kind)
                 self._solve_passes_exported[kind] = count
+        if self.controller.defrag_enabled:
+            last = self.controller.defrag_last
+            if last:
+                self._m_frag_score.set(float(last.get("score", 0.0)))
+                for entry in last.get("report", {}).get("levels", []):
+                    self._m_frag_stranded.set(
+                        float(entry.get("stranded", 0.0)),
+                        level=str(entry.get("level", "")),
+                        resource=str(entry.get("resource", "")),
+                    )
+            self._m_defrag_migrating.set(
+                float(len(self.controller._defrag_migrating))
+            )
+            counts = self.controller.defrag_counts
+            for key, metric in (
+                ("plans", self._m_defrag_plans),
+                ("migrations", self._m_defrag_migrations),
+                ("pods_migrated", self._m_defrag_pods),
+            ):
+                delta = counts[key] - self._defrag_exported[key]
+                if delta > 0:
+                    metric.inc(float(delta))
+                    self._defrag_exported[key] = counts[key]
         qtree = self.controller.queue_tree
         if qtree is not None:
             # Per-queue usage gauges (GREP-244 metrics direction): refreshed

@@ -344,3 +344,50 @@ def binpack_trap_backlog(n_pairs: int = 6) -> list[PodCliqueSet]:
     smalls = [one(f"bp-small-{i}", "3") for i in range(n_pairs)]
     bigs = [one(f"bp-big-{i}", "4") for i in range(n_pairs)]
     return smalls + bigs
+
+
+def fragmented_backlog(
+    racks: int,
+    hosts_per_rack: int = 8,
+    squat_pods_per_rack: int = 2,
+    tpu_per_host: int = 8,
+) -> tuple[list[PodCliqueSet], PodCliqueSet]:
+    """Defrag-scenario workloads: (squatters, large rack-packed gang).
+
+    One squatter PCS per rack — `squat_pods_per_rack` full-host pods each.
+    With every squatter bound in a DIFFERENT rack (the bench scatters them;
+    churn does it organically in the sim), every rack keeps
+    `hosts_per_rack - squat_pods_per_rack` free hosts, so the large gang
+    (`hosts_per_rack` full-host pods, REQUIRED rack pack) fails admission
+    even though total free capacity is several racks' worth — until the
+    defrag planner consolidates the squatters.
+    """
+    squatters = [
+        _pcs(
+            f"frag-squat-{i}",
+            [
+                _clique(
+                    "sq",
+                    squat_pods_per_rack,
+                    cpu="4",
+                    tpu=tpu_per_host,
+                    min_available=squat_pods_per_rack,
+                )
+            ],
+        )
+        for i in range(racks)
+    ]
+    big = _pcs(
+        "frag-big",
+        [
+            _clique(
+                "big",
+                hosts_per_rack,
+                cpu="4",
+                tpu=tpu_per_host,
+                min_available=hosts_per_rack,
+            )
+        ],
+        constraint_domain="rack",
+    )
+    return squatters, big

@@ -47,7 +47,6 @@ from __future__ import annotations
 
 import hashlib
 import json
-import os
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -57,6 +56,7 @@ import numpy as np
 
 from grove_tpu.solver.core import SolveResult, SolverParams, solve_batch_impl
 from grove_tpu.solver.encode import GangBatch
+from grove_tpu.utils.fsio import atomic_write_json
 
 # jitted solve_batch variants, shared process-wide so every ExecutableCache
 # (controller, sidecar, drain) lowers through the same traced function.
@@ -298,8 +298,6 @@ class ExecutableCache:
 
     def _save_history(self) -> None:
         try:
-            path = self.history_path
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             with self._lock:
                 merged = dict(self._history)
             # Merge with what other processes wrote; counts take the max so
@@ -311,10 +309,10 @@ class ExecutableCache:
                     )
                 else:
                     merged[hkey] = entry
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump({"version": 1, "shapes": merged}, f)
-            os.replace(tmp, path)
+            # Shared atomic-write primitive (utils/fsio): temp file + rename,
+            # temp cleaned on failure — concurrent writers can't tear the
+            # file, and a failed write never leaves droppings behind.
+            atomic_write_json(self.history_path, {"version": 1, "shapes": merged})
         except OSError:
             pass  # history is an optimization; never fatal
 

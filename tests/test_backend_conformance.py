@@ -17,11 +17,20 @@ from __future__ import annotations
 
 import pathlib
 import re
+import shutil
 import subprocess
 import sys
 import tempfile
 
 import pytest
+
+# Same toolchain gate as tests/test_cpp_conformance.py: this tier shells out
+# to protoc, which plain unit-test images may lack — absence is an
+# environment property, not a regression (the conformance CI job provides
+# the toolchain; `make test` ignores this file entirely).
+pytestmark = pytest.mark.skipif(
+    shutil.which("protoc") is None, reason="protoc not available"
+)
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 SIDECAR_PROTO = REPO / "grove_tpu" / "backend" / "proto" / "scheduler_backend.proto"

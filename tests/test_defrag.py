@@ -1,0 +1,518 @@
+"""Defragmentation & rebalance subsystem (solver/defrag.py + the
+controller's defrag_tick + config/manager wiring).
+
+The acceptance scenario (ISSUE-2): churn leaves capacity stranded across
+racks, a rack-packed large gang fails admission despite ample total free
+capacity, one defrag cycle consolidates the squatters under the disruption
+budget (make-before-break), and the large gang is admitted — with the
+second plan of the same shape paying zero new XLA lowerings (warm-path
+reuse).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from scenario_harness import Scenario, build_pcs, clique, e2e_nodes
+
+from grove_tpu.api.types import TopologyDomain
+from grove_tpu.solver.defrag import (
+    GangMove,
+    candidate_ladder,
+    fragmentation_report,
+    largest_placeable,
+    plan_migrations,
+)
+from grove_tpu.state.cluster import Node, build_snapshot
+
+MI = 2**20
+
+
+def _nodes16():
+    """16 one-pod nodes in 4 racks of 4 (2 racks/block, 2 blocks/zone)."""
+    return e2e_nodes(16, hosts_per_rack=4, racks_per_block=2, blocks_per_zone=2)
+
+
+def _fragmented_scenario() -> Scenario:
+    """One 2-pod squatter gang per rack, placed by cordoning every other
+    rack — the post-churn state where each rack holds 2 free one-pod slots
+    (total free = 2 racks' worth) but no rack has 4."""
+    nodes = _nodes16()
+    s = Scenario(0, nodes=nodes)
+    for r in range(4):
+        for i, n in enumerate(nodes):
+            n.schedulable = i // 4 == r
+        s.deploy(build_pcs(f"sq{r}", cliques=[clique("w", 2, 2)]))
+        assert s.until_ready(2 * (r + 1)), f"squatter {r} never became ready"
+    for n in nodes:
+        n.schedulable = True
+    s.settle(2)
+    return s
+
+
+def _snapshot_of(s: Scenario):
+    return build_snapshot(
+        list(s.cluster.nodes.values()),
+        s.topology,
+        bound_pods=[
+            p for p in s.cluster.pods.values() if p.is_scheduled and p.is_active
+        ],
+    )
+
+
+# ---- fragmentation scoring ----------------------------------------------------
+
+
+def test_empty_cluster_scores_zero():
+    """All-free capacity is NOT fragmentation: the best domain already
+    equals the ideal (total free capped at one domain's capacity)."""
+    snap = build_snapshot(_nodes16(), Scenario(0, nodes=_nodes16()).topology)
+    rep = fragmentation_report(snap)
+    assert rep.score == 0.0
+
+
+def test_fragmented_cluster_scores_stranded_fraction():
+    s = _fragmented_scenario()
+    rep = fragmentation_report(_snapshot_of(s))
+    # Each rack: 2 free 150Mi slots + 2 squatted nodes at 70Mi free.
+    # best rack free = 440Mi, ideal = min(total 1760Mi, rack cap 600Mi).
+    assert rep.score == pytest.approx(1 - 440 / 600, abs=1e-6)
+    entry = rep.entry("rack", "memory")
+    assert entry is not None
+    assert entry.ideal_free == pytest.approx(600 * MI)
+    assert entry.best_domain_free == pytest.approx(440 * MI)
+
+
+def test_unschedulable_nodes_hold_no_free_capacity():
+    nodes = _nodes16()
+    for n in nodes[4:]:
+        n.schedulable = False
+    snap = build_snapshot(nodes, Scenario(0, nodes=_nodes16()).topology)
+    rep = fragmentation_report(snap)
+    # Only rack 0 is schedulable: its free IS the total free — score 0.
+    assert rep.score == 0.0
+
+
+def test_largest_placeable_counts_best_single_domain():
+    s = _fragmented_scenario()
+    snap = _snapshot_of(s)
+    req = {"memory": 80 * MI}
+    assert largest_placeable(snap, req, TopologyDomain.RACK) == 2
+    # Block = 2 racks -> 4 free one-pod slots.
+    assert largest_placeable(snap, req, TopologyDomain.BLOCK) == 4
+    assert largest_placeable(snap, {"memory": 0.0}, TopologyDomain.RACK) == 0
+
+
+def test_candidate_ladder_shapes():
+    assert candidate_ladder(1, 8) == [1]
+    assert candidate_ladder(5, 8) == [1, 2, 4, 5]
+    assert candidate_ladder(16, 8) == [1, 2, 4, 8]
+    assert candidate_ladder(3, 8) == [1, 2, 3]
+
+
+# ---- the planner --------------------------------------------------------------
+
+
+def test_planner_consolidates_and_second_plan_pays_zero_lowerings():
+    """The batched planner re-places squatters (cluster minus their own
+    usage) into fewer racks; the projected score strictly improves, the
+    efficiency is capacity-per-pod, and — acceptance — an identical SECOND
+    plan of the same shapes re-lowers NOTHING (warm-path AOT reuse)."""
+    s = _fragmented_scenario()
+    c = s.controller
+    movable = c.defrag_movable(s.sim.now)
+    assert len(movable) == 4
+    args = (
+        list(s.cluster.nodes.values()),
+        s.topology,
+        movable,
+        dict(s.cluster.pods),
+    )
+    plan = plan_migrations(*args, warm=c.warm, params=c.solver_params)
+    assert plan is not None
+    assert plan.score_after < plan.score_before
+    assert plan.pods_migrated > 0 and plan.moves
+    assert plan.capacity_recovered > 0
+    assert plan.efficiency == pytest.approx(
+        plan.capacity_recovered / plan.pods_migrated
+    )
+    # Projected state must free at least one whole rack for a 4-pod gang.
+    pods = dict(s.cluster.pods)
+    for mv in plan.moves:
+        for pod_name, target in mv.bindings.items():
+            pods[pod_name].node_name = target
+    snap_after = _snapshot_of(s)
+    assert largest_placeable(snap_after, {"memory": 80 * MI}, TopologyDomain.RACK) >= 4
+    for mv in plan.moves:  # restore for the second identical plan
+        for pod_name in mv.bindings:
+            gang_rack = int(mv.gang[2])  # sqN-0
+            idx = sorted(mv.bindings).index(pod_name)
+            pods[pod_name].node_name = f"w{gang_rack * 4 + idx}"
+    before = c.warm.executables.lowerings
+    plan2 = plan_migrations(*args, warm=c.warm, params=c.solver_params)
+    assert plan2 is not None
+    assert plan2.lowerings == 0
+    assert c.warm.executables.lowerings == before, (
+        "second defrag solve of the same shape must not re-lower"
+    )
+
+
+def test_planner_returns_none_when_nothing_improves():
+    """A compact (unfragmented) placement yields no improving plan."""
+    nodes = _nodes16()
+    s = Scenario(0, nodes=nodes)
+    s.deploy(build_pcs("sq0", cliques=[clique("w", 2, 2)]))
+    assert s.until_ready(2)
+    movable = s.controller.defrag_movable(s.sim.now)
+    plan = plan_migrations(
+        list(s.cluster.nodes.values()),
+        s.topology,
+        movable,
+        dict(s.cluster.pods),
+        warm=s.controller.warm,
+        params=s.controller.solver_params,
+    )
+    assert plan is None
+
+
+def test_planner_min_efficiency_gate():
+    """An absurd efficiency floor rejects every candidate."""
+    s = _fragmented_scenario()
+    plan = plan_migrations(
+        list(s.cluster.nodes.values()),
+        s.topology,
+        s.controller.defrag_movable(s.sim.now),
+        dict(s.cluster.pods),
+        warm=s.controller.warm,
+        params=s.controller.solver_params,
+        min_efficiency=1e18,
+    )
+    assert plan is None
+
+
+# ---- the executor (controller.defrag_tick) ------------------------------------
+
+
+def test_execute_move_defers_when_target_not_free():
+    """Make-before-break: a move whose target cannot hold the incoming pod
+    WHILE the old placement still exists must not execute."""
+    s = _fragmented_scenario()
+    c = s.controller
+    snap = _snapshot_of(s)
+    sq0 = next(g for g in c.cluster.podgangs.values() if g.name.startswith("sq0"))
+    pods = [p for p in c.cluster.pods_of_gang(sq0.name) if p.is_active]
+    occupied = next(
+        p.node_name
+        for p in c.cluster.pods.values()
+        if p.is_scheduled and p.podgang_name.startswith("sq1")
+    )
+    mv = GangMove(
+        gang=sq0.name,
+        bindings={pods[0].name: occupied},  # a node already holding a pod
+        pods_total=len(pods),
+    )
+    assert c._execute_move(mv, snap, s.sim.now) is False
+    assert pods[0].node_name != occupied
+    assert sq0.name not in c._defrag_migrating
+
+    # The same move onto a genuinely free node executes atomically.
+    free_node = next(
+        n.name
+        for n in s.cluster.nodes.values()
+        if not any(
+            p.node_name == n.name
+            for p in c.cluster.pods.values()
+            if p.is_scheduled and p.is_active
+        )
+    )
+    mv_ok = GangMove(
+        gang=sq0.name, bindings={pods[0].name: free_node}, pods_total=len(pods)
+    )
+    assert c._execute_move(mv_ok, snap, s.sim.now) is True
+    assert pods[0].node_name == free_node
+    assert pods[0].ready is False  # restarts on the new host
+    assert sq0.name in c._defrag_migrating
+    assert c.defrag_counts["migrations"] == 1
+    assert c.defrag_counts["pods_migrated"] == 1
+
+
+def test_movable_excludes_cooldown_migrating_and_unsettled():
+    s = _fragmented_scenario()
+    c = s.controller
+    now = s.sim.now
+    assert len(c.defrag_movable(now)) == 4
+    # In cooldown: excluded until the window passes.
+    sq0 = next(g.name for g in c.cluster.podgangs.values() if g.name.startswith("sq0"))
+    c._defrag_migrated_at[sq0] = now
+    c.defrag_cooldown_seconds = 100.0
+    assert all(not g.name.startswith("sq0") for g in c.defrag_movable(now))
+    assert len(c.defrag_movable(now + 101.0)) == 4
+    # Mid-migration: excluded regardless of cooldown.
+    c._defrag_migrating[sq0] = now
+    assert all(
+        not g.name.startswith("sq0") for g in c.defrag_movable(now + 101.0)
+    )
+    del c._defrag_migrating[sq0]
+    # Unsettled (a pod not Ready): excluded.
+    pod = next(
+        p for p in c.cluster.pods.values() if p.podgang_name.startswith("sq1")
+    )
+    pod.ready = False
+    assert all(not g.name.startswith("sq1") for g in c.defrag_movable(now + 101.0))
+
+
+def test_movable_orders_lowest_priority_first():
+    s = _fragmented_scenario()
+    c = s.controller
+    c.priority_classes = {"critical": 100}
+    hi = next(g for g in c.cluster.podgangs.values() if g.name.startswith("sq3"))
+    hi.spec.priority_class_name = "critical"
+    movable = c.defrag_movable(s.sim.now)
+    assert movable[-1].name == hi.name, "high-priority gangs migrate last"
+
+
+# ---- the end-to-end chaos scenario (ISSUE-2 acceptance) -----------------------
+
+
+def test_chaos_defrag_recovers_unplaceable_gang_within_budget():
+    """Churn -> fragmentation -> a rack-packed 4-pod gang fails admission ->
+    the defrag loop (driven by the normal reconcile cascade) migrates
+    squatters under the disruption budget (never more than the configured
+    concurrent migrations, make-before-break) -> the gang is admitted and
+    becomes Ready."""
+    s = _fragmented_scenario()
+    c = s.controller
+
+    big = build_pcs("big", cliques=[clique("b", 4, 4, pack="rack")])
+    s.deploy(big)
+    s.settle(5)
+    assert len(s.scheduled("big")) == 0, (
+        "the rack-packed gang must NOT fit the fragmented cluster"
+    )
+
+    c.defrag_enabled = True
+    c.defrag_threshold = 0.2
+    c.defrag_interval_seconds = 2.0
+    c.defrag_max_concurrent = 2
+    c.defrag_cooldown_seconds = 30.0
+
+    max_migrating = 0
+    for _ in range(60):
+        s.sim.step(1.0)
+        max_migrating = max(max_migrating, len(c._defrag_migrating))
+        if len(s.ready("big")) == 4:
+            break
+    assert len(s.ready("big")) == 4, "defrag never recovered the large gang"
+    # Disruption budget held at every sampled instant.
+    assert 0 < max_migrating <= c.defrag_max_concurrent
+    # The gang landed packed in ONE rack (its required constraint).
+    assert len(s.domain_of_pods("big", TopologyDomain.RACK)) == 1
+    counts = c.defrag_counts
+    assert counts["plans"] >= 1
+    assert counts["migrations"] >= 1
+    assert counts["pods_migrated"] >= 2
+    assert counts["capacity_recovered"] > 0
+    assert counts["migrations_completed"] >= 1
+    # Migration events recorded (kubectl-describe surface).
+    assert any("migrated by defrag" in msg for _, _, msg in s.cluster.events)
+    # Squatter gangs stayed whole through migration (gang atomicity).
+    for gang in c.cluster.podgangs.values():
+        if gang.name.startswith("sq"):
+            pods = [p for p in c.cluster.pods_of_gang(gang.name) if p.is_active]
+            assert len(pods) == 2 and all(p.is_scheduled for p in pods)
+
+
+def test_defrag_tick_below_threshold_plans_nothing():
+    s = _fragmented_scenario()
+    c = s.controller
+    c.defrag_enabled = True
+    c.defrag_threshold = 0.99  # fragmented, but below this bar
+    out = c.defrag_tick(s.sim.now)
+    assert out is not None and "plan" not in out
+    assert c.defrag_counts["skipped_below_threshold"] == 1
+    assert c.defrag_counts["plans"] == 0
+
+
+def test_defrag_tick_budget_exhausted_defers():
+    s = _fragmented_scenario()
+    c = s.controller
+    c.defrag_enabled = True
+    c.defrag_threshold = 0.1
+    c.defrag_max_concurrent = 1
+    # A gang genuinely mid-migration (one pod not Ready yet) consumes the
+    # whole budget; the completion sweep must NOT clear it.
+    sq0 = next(g.name for g in c.cluster.podgangs.values() if g.name.startswith("sq0"))
+    next(p for p in c.cluster.pods.values() if p.podgang_name == sq0).ready = False
+    c._defrag_migrating[sq0] = s.sim.now
+    out = c.defrag_tick(s.sim.now)
+    assert out is not None and out.get("deferred") == "disruption budget exhausted"
+    assert c.defrag_counts["skipped_budget"] == 1
+    assert sq0 in c._defrag_migrating
+
+
+def test_maybe_defrag_interval_gate():
+    s = _fragmented_scenario()
+    c = s.controller
+    c.defrag_enabled = True
+    c.defrag_threshold = 0.99
+    c.defrag_interval_seconds = 10.0
+    assert c.maybe_defrag(100.0) is not None  # first call runs immediately
+    assert c.maybe_defrag(105.0) is None  # interval not elapsed
+    assert c.maybe_defrag(110.0) is not None
+    assert c.defrag_counts["ticks"] == 2
+
+
+def test_defrag_disabled_is_inert():
+    s = _fragmented_scenario()
+    assert s.controller.maybe_defrag(s.sim.now) is None
+    assert s.controller.defrag_counts["ticks"] == 0
+
+
+# ---- config / manager / statusz wiring ----------------------------------------
+
+
+def test_defrag_config_wiring_to_controller_and_statusz():
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "defrag": {
+                "enabled": True,
+                "threshold": 0.4,
+                "intervalSeconds": 7.5,
+                "maxConcurrentMigrations": 3,
+                "gangCooldownSeconds": 120,
+                "maxMovesPerPlan": 5,
+                "minEfficiency": 0.25,
+            }
+        }
+    )
+    assert errors == []
+    m = Manager(cfg)
+    c = m.controller
+    assert c.defrag_enabled is True
+    assert c.defrag_threshold == 0.4
+    assert c.defrag_interval_seconds == 7.5
+    assert c.defrag_max_concurrent == 3
+    assert c.defrag_cooldown_seconds == 120
+    assert c.defrag_max_moves == 5
+    assert c.defrag_min_efficiency == 0.25
+    doc = m.statusz()["defrag"]
+    assert doc["enabled"] is True and doc["threshold"] == 0.4
+    # Reconcile runs the defrag step and exports the metric families.
+    m.reconcile_once(now=0.0)
+    text = m.metrics.render_text()
+    assert "grove_fragmentation_score" in text
+    assert "grove_defrag_migrations_total" in text
+
+
+def test_defrag_config_validation_rejects_bad_values():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    _, errors = parse_operator_config(
+        {
+            "defrag": {
+                "threshold": 2,
+                "intervalSeconds": 0,
+                "maxConcurrentMigrations": 0,
+                "gangCooldownSeconds": -5,
+                "maxMovesPerPlan": 0,
+                "minEfficiency": -1,
+            }
+        }
+    )
+    joined = "\n".join(errors)
+    for frag in (
+        "defrag.threshold",
+        "defrag.intervalSeconds",
+        "defrag.maxConcurrentMigrations",
+        "defrag.gangCooldownSeconds",
+        "defrag.maxMovesPerPlan",
+        "defrag.minEfficiency",
+    ):
+        assert frag in joined, f"missing validation for {frag}: {errors}"
+
+
+def test_cli_get_defrag_renders_statusz():
+    from grove_tpu.cli.main import _get_table
+
+    class FakeClient:
+        def statusz(self):
+            return {
+                "defrag": {
+                    "enabled": True,
+                    "threshold": 0.5,
+                    "migrating": ["g1"],
+                    "counts": {"plans": 2, "migrations": 3},
+                    "last": {
+                        "score": 0.61,
+                        "report": {
+                            "levels": [
+                                {
+                                    "level": "rack",
+                                    "resource": "memory",
+                                    "stranded": 0.61,
+                                }
+                            ]
+                        },
+                        "plan": {
+                            "moves": 3,
+                            "podsMigrated": 6,
+                            "capacityRecovered": 64.0,
+                            "efficiency": 10.7,
+                            "planSolveSeconds": 0.02,
+                        },
+                    },
+                }
+            }
+
+    out = _get_table(FakeClient(), "defrag")
+    assert "0.6100" in out and "g1" in out
+    assert "stranded.rack.memory" in out
+    assert "lastPlan.podsMigrated" in out and "counts.plans" in out
+
+
+def test_fragmentation_report_doc_roundtrip():
+    s = _fragmented_scenario()
+    rep = fragmentation_report(_snapshot_of(s))
+    doc = rep.to_doc()
+    assert doc["score"] == pytest.approx(rep.score, abs=1e-4)
+    assert doc["bindingLevel"] == rep.binding_level
+    assert {e["level"] for e in doc["levels"]} >= {"rack", "block", "zone"}
+    import json
+
+    json.dumps(doc)  # statusz-safe: everything JSON-serializable
+    assert all(isinstance(e["totalFree"], float) for e in doc["levels"])
+
+
+def test_snapshot_allocated_updates_in_place_across_moves():
+    """Within one tick, snapshot.allocated tracks executed moves so a later
+    move can land on capacity an earlier move freed."""
+    s = _fragmented_scenario()
+    c = s.controller
+    snap = _snapshot_of(s)
+    sq0 = next(g for g in c.cluster.podgangs.values() if g.name.startswith("sq0"))
+    sq1 = next(g for g in c.cluster.podgangs.values() if g.name.startswith("sq1"))
+    p0 = [p for p in c.cluster.pods_of_gang(sq0.name) if p.is_active]
+    p1 = [p for p in c.cluster.pods_of_gang(sq1.name) if p.is_active]
+    # Move sq0's first pod onto a free node; then sq1's first pod onto
+    # sq0's vacated node — only valid because allocated updated in place.
+    vacated = p0[0].node_name
+    free_node = next(
+        n.name
+        for n in s.cluster.nodes.values()
+        if not any(
+            p.node_name == n.name
+            for p in c.cluster.pods.values()
+            if p.is_scheduled and p.is_active
+        )
+    )
+    assert c._execute_move(
+        GangMove(sq0.name, {p0[0].name: free_node}, 2), snap, s.sim.now
+    )
+    assert c._execute_move(
+        GangMove(sq1.name, {p1[0].name: vacated}, 2), snap, s.sim.now
+    )
+    assert p1[0].node_name == vacated
+    assert np.all(snap.allocated >= 0)

@@ -1,0 +1,122 @@
+"""EscalationDamper / escalation fingerprint edge cases (solver/escalation.py).
+
+The damper skips the widened re-solve while the solver-input state matches
+the last pass whose ESCALATED solve still rejected valid gangs. Its edge
+cases are where a wrong answer silently costs either quality (damping a
+solve that could now succeed) or latency (re-escalating a guaranteed no-op):
+zero history, fingerprint sensitivity to in-place node mutation, per-key
+isolation, and the clear-on-drain rule.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.solver.escalation import (
+    EscalationDamper,
+    escalation_fingerprint,
+    node_state_digest,
+)
+from grove_tpu.state.cluster import Node
+
+
+def _fp(nodes, pending=("g1",), bound=()):
+    return escalation_fingerprint(pending, bound, nodes)
+
+
+def test_effective_width_zero_history_escalates():
+    """A fresh damper has no futile record: the first rejecting pass must
+    get the full escalation width, for every key independently."""
+    d = EscalationDamper()
+    fp = _fp([Node("n0")])
+    assert d.effective_width(True, fp, 1, 4) == 4
+    assert d.effective_width(False, fp, 1, 4) == 4
+    assert d.effective_width("sidecar", fp, 2, 8) == 8
+
+
+def test_effective_width_disabled_when_escalation_not_wider():
+    """escalation <= portfolio is 'off' regardless of damper state."""
+    d = EscalationDamper()
+    fp = _fp([Node("n0")])
+    d.record(True, fp, escalated=True, any_valid_rejected=True)
+    assert d.effective_width(True, fp, 4, 4) == 4
+    assert d.effective_width(True, fp, 4, 2) == 2
+
+
+def test_futile_fingerprint_damps_only_exact_match():
+    d = EscalationDamper()
+    nodes = [Node("n0", capacity={"cpu": 8.0})]
+    fp = _fp(nodes)
+    d.record(True, fp, escalated=True, any_valid_rejected=True)
+    # Same state: damped to base width.
+    assert d.effective_width(True, fp, 1, 4) == 1
+    # Different pending set: re-armed.
+    assert d.effective_width(True, _fp(nodes, pending=("g2",)), 1, 4) == 4
+
+
+def test_node_state_change_breaks_fingerprint_collision():
+    """Nodes mutate IN PLACE (cordon, capacity bump) without changing the
+    node-name set — a names-only digest would collide and keep damping an
+    escalation that could now admit. Every solver-read field must break the
+    match: schedulable, capacity, labels, taints."""
+    d = EscalationDamper()
+    node = Node(
+        "n0",
+        capacity={"cpu": 8.0},
+        labels={"topology.kubernetes.io/rack": "r0"},
+    )
+    fp0 = _fp([node])
+    d.record(True, fp0, escalated=True, any_valid_rejected=True)
+    assert d.effective_width(True, fp0, 1, 4) == 1  # armed
+
+    node.schedulable = False
+    assert _fp([node]) != fp0
+    assert d.effective_width(True, _fp([node]), 1, 4) == 4
+    node.schedulable = True
+    assert d.effective_width(True, _fp([node]), 1, 4) == 1  # back: damped again
+
+    node.capacity["cpu"] = 16.0
+    assert d.effective_width(True, _fp([node]), 1, 4) == 4
+    node.capacity["cpu"] = 8.0
+
+    node.labels["topology.kubernetes.io/rack"] = "r1"
+    assert d.effective_width(True, _fp([node]), 1, 4) == 4
+    node.labels["topology.kubernetes.io/rack"] = "r0"
+
+    node.taints.append({"key": "k", "value": "v", "effect": "NoSchedule"})
+    assert d.effective_width(True, _fp([node]), 1, 4) == 4
+
+
+def test_node_state_digest_is_order_independent():
+    a = [Node("n0"), Node("n1", schedulable=False)]
+    b = [Node("n1", schedulable=False), Node("n0")]
+    assert node_state_digest(a) == node_state_digest(b)
+
+
+def test_keys_are_isolated():
+    """The controller uses floors/extras as separate keys: arming one must
+    not damp the other (their encode sets differ by construction)."""
+    d = EscalationDamper()
+    fp = _fp([Node("n0")])
+    d.record(True, fp, escalated=True, any_valid_rejected=True)
+    assert d.effective_width(True, fp, 1, 4) == 1
+    assert d.effective_width(False, fp, 1, 4) == 4
+
+
+def test_record_clears_on_drained_backlog():
+    """No valid rejections => the backlog drained; the next rejection is a
+    NEW episode and deserves a fresh escalated attempt."""
+    d = EscalationDamper()
+    fp = _fp([Node("n0")])
+    d.record(True, fp, escalated=True, any_valid_rejected=True)
+    assert d.effective_width(True, fp, 1, 4) == 1
+    d.record(True, fp, escalated=False, any_valid_rejected=False)
+    assert d.effective_width(True, fp, 1, 4) == 4
+
+
+def test_record_unescalated_rejection_keeps_existing_state():
+    """A damped (base-width) pass that still rejects must NOT overwrite or
+    clear the futile record — only an escalated attempt is evidence."""
+    d = EscalationDamper()
+    fp = _fp([Node("n0")])
+    d.record(True, fp, escalated=True, any_valid_rejected=True)
+    d.record(True, fp, escalated=False, any_valid_rejected=True)
+    assert d.effective_width(True, fp, 1, 4) == 1
