@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim bench bench-cpu bench-defrag bench-defrag-cpu dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -51,6 +51,19 @@ bench-defrag:    ## defrag scenario: fragmented fleet -> plan+execute -> recover
 
 bench-defrag-cpu: ## defrag scenario with the TPU-relay probe skipped
 	GROVE_BENCH_SCENARIO=defrag GROVE_FORCE_CPU=1 $(PY) bench.py
+
+bench-quality:   ## placement-quality report: mixed Required/Preferred backlog, wave harvest, exact bound
+	GROVE_BENCH_SCENARIO=quality $(PY) bench.py
+
+bench-quality-cpu: ## quality report with the TPU-relay probe skipped
+	GROVE_BENCH_SCENARIO=quality GROVE_FORCE_CPU=1 $(PY) bench.py
+
+test-kind:       ## kubernetes-source tier against a REAL cluster; clean skip without a kubeconfig
+	@if $(PY) -c "from grove_tpu.cluster.kubernetes import load_kube_context; load_kube_context()" >/dev/null 2>&1; then \
+		GROVE_TEST_REAL_CLUSTER=1 $(PY) -m pytest tests/test_kubernetes_source.py -q; \
+	else \
+		echo "no usable kubeconfig; skipping live tier (wire contract covered by the fixture apiserver in 'make test')"; \
+	fi
 
 dryrun:          ## multi-chip sharding compile+run on 8 virtual devices
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

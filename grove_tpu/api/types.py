@@ -271,15 +271,33 @@ class TopologyConstraint:
 
     NOTE: this constrains EACH replica independently — different replicas may
     land in different domains (podcliqueset.go:190-196).
+
+    `preferred_domain` is the soft counterpart (wire key `preferredDomain`):
+    the scheduler tries to pack the replica into one domain at that level
+    and degrades the gang's PlacementScore — never rejects — when it cannot
+    (the Required/Preferred pair of the scheduler IR's
+    TopologyPackConstraint, podgang.go:101-117). Either field may be unset;
+    a constraint with both packs hard at `pack_domain` and scores soft at
+    `preferred_domain` (which must be equal or narrower to mean anything).
     """
 
-    pack_domain: TopologyDomain
+    pack_domain: Optional[TopologyDomain] = None
+    preferred_domain: Optional[TopologyDomain] = None
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> Optional["TopologyConstraint"]:
         if not d:
             return None
-        return cls(pack_domain=TopologyDomain(d["packDomain"]))
+        pack = d.get("packDomain")
+        preferred = d.get("preferredDomain")
+        if pack is None and preferred is None:
+            return None
+        return cls(
+            pack_domain=TopologyDomain(pack) if pack is not None else None,
+            preferred_domain=(
+                TopologyDomain(preferred) if preferred is not None else None
+            ),
+        )
 
 
 # ---------------------------------------------------------------------------------

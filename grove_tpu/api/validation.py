@@ -272,17 +272,35 @@ def _validate_topology_constraints(
     def check_domain_exists(tc: TopologyConstraint | None, fld: str) -> None:
         if tc is None or topology is None:
             return
-        if topology.label_key_for(tc.pack_domain) is None:
+        for dom in (tc.pack_domain, tc.preferred_domain):
+            if dom is not None and topology.label_key_for(dom) is None:
+                errs.append(
+                    ValidationError(
+                        fld,
+                        f"topology domain {dom.value!r} is not defined in the cluster topology",
+                    )
+                )
+        # A preferred level BROADER than the required pack is vacuous (the
+        # required domain already confines every pod inside one preferred
+        # domain) — reject it as authored confusion, like the parent check.
+        if (
+            tc.pack_domain is not None
+            and tc.preferred_domain is not None
+            and is_domain_narrower(tc.pack_domain, tc.preferred_domain)
+        ):
             errs.append(
                 ValidationError(
                     fld,
-                    f"topology domain {tc.pack_domain.value!r} is not defined in the cluster topology",
+                    f"preferredDomain {tc.preferred_domain.value!r} must be equal to "
+                    f"or narrower than packDomain {tc.pack_domain.value!r}",
                 )
             )
 
     def check_narrower(child: TopologyConstraint | None, parent: TopologyConstraint | None, fld: str) -> None:
         if child is None or parent is None:
             return
+        if child.pack_domain is None or parent.pack_domain is None:
+            return  # preferred-only constraints never conflict hierarchically
         if is_domain_narrower(parent.pack_domain, child.pack_domain):
             errs.append(
                 ValidationError(

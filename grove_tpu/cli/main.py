@@ -3,7 +3,7 @@
 Talks to a running manager (`python -m grove_tpu.runtime`) over its object
 API via the typed client. Commands:
 
-  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag   table listing
+  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag|quality   table listing
   get <kind> <name>                             full object as JSON
   describe <kind> <name>                        human detail + object events
   apply -f <file.yaml>                          admit a PodCliqueSet
@@ -55,6 +55,7 @@ KIND_ALIASES = {
     "clustertopologies": "topology",
     "solver": "solver",
     "defrag": "defrag",
+    "quality": "quality",
 }
 
 
@@ -211,6 +212,18 @@ def _get_table(client: GroveClient, kind: str) -> str:
                 ["lastPlan.solveSeconds", plan.get("planSolveSeconds", 0)],
             ]
         rows += [[f"counts.{k}", v] for k, v in sorted(counts.items())]
+        return _table(rows, ["METRIC", "VALUE"])
+    if kind == "quality":
+        # Placement quality at a glance: the last solve wave's aggregate +
+        # cumulative counters from /statusz (quality/report.py units; the
+        # same doc the grove_placement_quality_* gauges are cut from).
+        doc = client.statusz().get("quality", {})
+        last = doc.get("last", {})
+        counts = doc.get("counts", {})
+        rows = [["last." + k, v] for k, v in sorted(last.items())]
+        rows += [["counts." + k, v] for k, v in sorted(counts.items())]
+        if not rows:
+            rows = [["(no solve waves yet)", "-"]]
         return _table(rows, ["METRIC", "VALUE"])
     if kind == "services":
         return _table([[n] for n in client.list_services()], ["NAME"])

@@ -219,6 +219,65 @@ def _in_cluster_context(namespace: Optional[str]) -> KubeContext:
 
 
 # ---------------------------------------------------------------------------------
+# Client-side rate limiting (ClientConnectionConfiguration{QPS, Burst} analog)
+# ---------------------------------------------------------------------------------
+
+
+class TokenBucket:
+    """QPS/Burst token bucket — the client-go flowcontrol rate limiter the
+    reference's ClientConnectionConfiguration{QPS, Burst} configures.
+
+    `burst` tokens of headroom refill at `qps` tokens/s; `acquire()` takes
+    one token, sleeping out any deficit first (callers go at most `burst`
+    over the sustained rate before throttling kicks in). qps <= 0 disables
+    the limiter entirely. Thread-safe: the watch source's reader threads and
+    the reconcile thread's binding calls share one bucket, which is the
+    point — TOTAL apiserver pressure is what the server-side priority &
+    fairness layer penalizes.
+    """
+
+    def __init__(
+        self,
+        qps: float,
+        burst: int,
+        time_fn=time.monotonic,
+        sleep_fn=time.sleep,
+    ):
+        self.qps = float(qps)
+        self.capacity = max(1, int(burst))
+        self._tokens = float(self.capacity)
+        self._time = time_fn
+        self._sleep = sleep_fn
+        self._last = time_fn()
+        self._lock = threading.Lock()
+        # Observability (the throttle counter metric's source of truth).
+        self.throttled = 0  # acquisitions that had to wait
+        self.wait_seconds = 0.0  # cumulative time spent waiting
+
+    def acquire(self) -> float:
+        """Take one token; returns the seconds waited (0.0 = no throttle)."""
+        if self.qps <= 0:
+            return 0.0
+        with self._lock:
+            now = self._time()
+            self._tokens = min(
+                self.capacity, self._tokens + (now - self._last) * self.qps
+            )
+            self._last = now
+            self._tokens -= 1.0
+            # Deficit tokens model queued requests: each waiter sleeps until
+            # its token would have refilled, so concurrent callers space out
+            # at the sustained rate instead of thundering on each refill.
+            wait = max(0.0, -self._tokens / self.qps)
+            if wait > 0:
+                self.throttled += 1
+                self.wait_seconds += wait
+        if wait > 0:
+            self._sleep(wait)
+        return wait
+
+
+# ---------------------------------------------------------------------------------
 # Shared transport helpers
 # ---------------------------------------------------------------------------------
 
@@ -311,10 +370,16 @@ class KubernetesWatchSource:
         watch_read_timeout_s: float = 30.0,
         watch_workloads: bool = True,
         initc_kube_tokens: bool = False,
+        qps: float = 50.0,  # ClientConnectionConfiguration.QPS (0 = unlimited)
+        burst: int = 100,  # ClientConnectionConfiguration.Burst
     ):
         if pod_label_selector is None:
             pod_label_selector = DEFAULT_POD_LABEL_SELECTOR
         self.ctx = ctx
+        # One bucket for every request this source issues (unary calls AND
+        # watch-stream initiations): total apiserver pressure is the thing
+        # being limited.
+        self.limiter = TokenBucket(qps, burst)
         self.pod_manifest_for = pod_manifest_for
         self._local = threading.local()  # per-thread persistent connection
         self._queue: "queue.Queue[WatchEvent]" = queue.Queue()
@@ -972,6 +1037,22 @@ class KubernetesWatchSource:
             return False
         return True
 
+    def list_node_capacities(self) -> Optional[list]:
+        """One-shot node LIST for boot-time preflights (the accelerator
+        preflight checks the slice resource is visible SOMEWHERE before the
+        manager commits to auto-slice injection). Returns each node's
+        capacity dict, or None when the apiserver is unreachable — a
+        transient outage must not fail a boot the watch loop would heal."""
+        try:
+            doc = self._request("GET", "/api/v1/nodes")
+        except (KubeApiError, OSError, ValueError) as e:
+            self._record_error(f"node preflight list: {e}")
+            return None
+        return [
+            node_payload(item).get("capacity", {})
+            for item in (doc or {}).get("items", []) or []
+        ]
+
     def observe_deletion(self, pod_name: str, now: float) -> bool:
         try:
             self._request("DELETE", f"{self._pods_path}/{pod_name}")
@@ -1047,6 +1128,9 @@ class KubernetesWatchSource:
             qs["resourceVersion"] = rv
         if rw.selector:
             qs["labelSelector"] = rw.selector
+        # Stream initiation counts against the bucket (long-lived reads do
+        # not — the server's timeoutSeconds already paces re-establishment).
+        self.limiter.acquire()
         conn = self._connect(timeout=self._watch_read_timeout_s + 5.0)
         try:
             conn.request(
@@ -1118,7 +1202,9 @@ class KubernetesWatchSource:
         """One apiserver call over a thread-confined persistent connection
         (binding an N-pod gang is 2N calls per tick — a fresh TLS handshake
         each would tax both sides). A dead cached connection gets exactly
-        one reconnect-and-retry; real API errors propagate as KubeApiError."""
+        one reconnect-and-retry; real API errors propagate as KubeApiError.
+        Every call pays the QPS/Burst token bucket first."""
+        self.limiter.acquire()
         if query:
             path = f"{path}?{urllib.parse.urlencode(query)}"
         headers = self._headers()

@@ -131,6 +131,20 @@ class GroveController:
     # (GREP-244 metrics direction) — the manager drains this into the
     # grove_placement_score histogram each reconcile.
     last_admission_scores: list = field(default_factory=list)
+    # Placement-quality view of serving solves (quality/report.py
+    # discipline): the last NON-EMPTY wave's aggregate — admitted ratio over
+    # the solver-valid gangs it saw, mean PlacementScore of the admitted —
+    # plus cumulative counters. Surfaced on /statusz "quality", the
+    # grove_placement_quality_* gauges, and `grove-tpu get quality`.
+    quality_last: dict = field(default_factory=dict)
+    quality_counts: dict = field(
+        default_factory=lambda: {
+            "waves": 0,
+            "gangs": 0,
+            "admitted": 0,
+            "score_sum": 0.0,
+        }
+    )
     # First-admissions of the current pass (floors wave), so the extras wave
     # can't double-count them (see solve_pending).
     _admitted_this_pass: set = field(default_factory=set)
@@ -805,6 +819,35 @@ class GroveController:
             valid_by_name.get(n, False) and not ok_by_name.get(n, False)
             for n in decode.gang_names
         )
+        # Rolling placement-quality view (quality/report.py units): only
+        # solver-valid gangs count — a gang gated out at encode (missing
+        # base, unresolvable key) is not a quality verdict on this wave.
+        considered = [
+            n for n in decode.gang_names if valid_by_name.get(n, False)
+        ]
+        if considered:
+            adm_names = [n for n in considered if ok_by_name.get(n, False)]
+            mean_q = (
+                float(np.mean([float(scores[n]) for n in adm_names]))
+                if adm_names
+                else 0.0
+            )
+            self.quality_last = {
+                "wave": "floors" if floors_only else "extras",
+                "gangs": len(considered),
+                "admitted": len(adm_names),
+                "admittedRatio": round(len(adm_names) / len(considered), 4),
+                "meanPlacementScore": round(mean_q, 4),
+                # score = 0.5 + 0.5 * preferred fraction, inverted.
+                "preferredFraction": round(max(0.0, 2.0 * mean_q - 1.0), 4)
+                if adm_names
+                else 0.0,
+            }
+            qc = self.quality_counts
+            qc["waves"] += 1
+            qc["gangs"] += len(considered)
+            qc["admitted"] += len(adm_names)
+            qc["score_sum"] += mean_q * len(adm_names)
         if esc_fp is not None:
             self._escalation_damper.record(
                 floors_only, esc_fp, esc > self.portfolio, any_valid_rejected
@@ -1608,6 +1651,25 @@ class GroveController:
             f"make-before-break)",
         )
         return True
+
+    def quality_status(self) -> dict:
+        """JSON-able placement-quality state for /statusz "quality" and
+        `grove-tpu get quality`."""
+        qc = self.quality_counts
+        return {
+            "last": dict(self.quality_last),
+            "counts": {
+                "waves": qc["waves"],
+                "gangs": qc["gangs"],
+                "admitted": qc["admitted"],
+                "admittedRatio": round(qc["admitted"] / qc["gangs"], 4)
+                if qc["gangs"]
+                else 0.0,
+                "meanPlacementScore": round(qc["score_sum"] / qc["admitted"], 4)
+                if qc["admitted"]
+                else 0.0,
+            },
+        }
 
     def defrag_status(self) -> dict:
         """JSON-able defrag state for /statusz and `grove-tpu get defrag`."""

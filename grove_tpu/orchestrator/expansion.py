@@ -120,14 +120,27 @@ def translate_pack_constraint(
     """Workload domain name → IR node-label key (podgang/syncflow.go:341-365).
 
     A domain missing from the ClusterTopology nullifies the constraint (logged
-    and skipped in the reference) rather than failing the sync.
+    and skipped in the reference) rather than failing the sync. `packDomain`
+    becomes the IR's Required key, `preferredDomain` its Preferred key — the
+    Required/Preferred pair of podgang.go:101-117; either may be absent.
     """
     if not tas_enabled or tc is None or topology is None:
         return None
-    key = topology.label_key_for(tc.pack_domain)
-    if key is None:
+    req_key = (
+        topology.label_key_for(tc.pack_domain)
+        if tc.pack_domain is not None
+        else None
+    )
+    pref_key = (
+        topology.label_key_for(tc.preferred_domain)
+        if tc.preferred_domain is not None
+        else None
+    )
+    if req_key is None and pref_key is None:
         return None
-    return IRTopologyConstraint(pack_constraint=TopologyPackConstraint(required=key))
+    return IRTopologyConstraint(
+        pack_constraint=TopologyPackConstraint(required=req_key, preferred=pref_key)
+    )
 
 
 def expand_podcliqueset(
@@ -476,8 +489,18 @@ def _inject_tpu_slices(
                 and group.topology_constraint.pack_constraint.required is not None
             )
             if rack_key is not None and not has_required:
+                # An authored preferred-only constraint keeps its soft level;
+                # the injection only supplies the missing hard ICI-domain pack.
+                pref = (
+                    group.topology_constraint.pack_constraint.preferred
+                    if group.topology_constraint is not None
+                    and group.topology_constraint.pack_constraint is not None
+                    else None
+                )
                 group.topology_constraint = IRTopologyConstraint(
-                    pack_constraint=TopologyPackConstraint(required=rack_key)
+                    pack_constraint=TopologyPackConstraint(
+                        required=rack_key, preferred=pref
+                    )
                 )
     for pod in out.pods:
         if pod.pclq_fqn in slice_groups:

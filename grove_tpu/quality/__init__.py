@@ -1,0 +1,24 @@
+"""Placement-quality evaluation: the correctness-tooling layer.
+
+Two pillars, both host-side (numpy, no device traffic):
+
+- `quality.exact`: an exact branch-and-bound gang packer for SMALL instances
+  (<= 10 gangs x <= 16 nodes) that maximizes admitted count, then locality.
+  It is the optimality yardstick the production solver is pinned against
+  (tests/test_quality_optimal.py) — the Tesserae evaluation discipline
+  (PAPERS.md): measure a placement policy against the optimum where the
+  optimum is computable.
+- `quality.report`: score ANY (snapshot, plan) pair — admitted ratio,
+  preferred-domain fraction, placement score, stranding delta — reusable by
+  bench.py, tests, and the manager's /statusz "quality" section.
+"""
+
+from grove_tpu.quality.exact import ExactResult, exact_pack
+from grove_tpu.quality.report import PlacementQualityReport, evaluate_placement
+
+__all__ = [
+    "ExactResult",
+    "exact_pack",
+    "PlacementQualityReport",
+    "evaluate_placement",
+]

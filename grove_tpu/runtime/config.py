@@ -250,6 +250,14 @@ class ClusterConfig:
     # "" = the managed-by selector derived from api/constants
     # (cluster/kubernetes.py DEFAULT_POD_LABEL_SELECTOR).
     pod_label_selector: str = ""
+    # Client-side rate limit on the kubernetes wire client — the reference's
+    # ClientConnectionConfiguration{QPS, Burst} (types.go client-connection
+    # section; client-go flowcontrol defaults). Token bucket over every
+    # apiserver request the watch source issues (binding an N-pod gang is
+    # 2N calls per tick): sustained `kubeQps` requests/s with `kubeBurst`
+    # tokens of headroom. kubeQps 0 disables throttling entirely.
+    kube_qps: float = 50.0
+    kube_burst: int = 100
     # Watch PodCliqueSet CRs at the apiserver (kubectl-apply -> admission ->
     # reconcile -> status write-back). Off = fleet mirroring only (workloads
     # arrive via the operator's own HTTP API).
@@ -383,6 +391,8 @@ _CAMEL_FIELDS = {
     "kubeContext": "kube_context",
     "kubeNamespace": "kube_namespace",
     "podLabelSelector": "pod_label_selector",
+    "kubeQps": "kube_qps",
+    "kubeBurst": "kube_burst",
     "watchWorkloads": "watch_workloads",
     "initcMode": "initc_mode",
     "kwokNodes": "kwok_nodes",
@@ -617,6 +627,24 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
     if cl.source not in ("none", "kwok", "kubernetes"):
         errors.append(
             f"cluster.source: {cl.source!r} not in none|kwok|kubernetes"
+        )
+    if not isinstance(cl.kube_qps, (int, float)) or isinstance(
+        cl.kube_qps, bool
+    ) or cl.kube_qps < 0:
+        errors.append("cluster.kubeQps: must be a number >= 0 (0 = unlimited)")
+    if not isinstance(cl.kube_burst, int) or isinstance(
+        cl.kube_burst, bool
+    ) or cl.kube_burst < 0:
+        errors.append("cluster.kubeBurst: must be an int >= 0")
+    elif (
+        isinstance(cl.kube_qps, (int, float))
+        and not isinstance(cl.kube_qps, bool)
+        and cl.kube_qps > 0
+        and cl.kube_burst < 1
+    ):
+        errors.append(
+            "cluster.kubeBurst: must be >= 1 when kubeQps > 0 (a zero-token "
+            "bucket would block every request forever)"
         )
     if cl.source == "kubernetes" and cl.kubeconfig:
         import os as _os

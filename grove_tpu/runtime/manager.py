@@ -601,6 +601,29 @@ class Manager:
             "grove_defrag_migrating", "Gangs currently mid-migration"
         )
         self._defrag_exported = {"plans": 0, "migrations": 0, "pods_migrated": 0}
+        # Placement-quality gauges (quality/report.py consumers): the last
+        # non-empty solve wave's aggregate view, refreshed each reconcile —
+        # the live-serving counterpart of the bench's quality report, so a
+        # quality regression shows on /metrics before any bench run does.
+        self._m_quality_admitted_ratio = self.metrics.gauge(
+            "grove_placement_quality_admitted_ratio",
+            "Admitted / schedulable gangs in the last non-empty solve wave",
+        )
+        self._m_quality_score = self.metrics.gauge(
+            "grove_placement_quality_score",
+            "Mean PlacementScore of gangs admitted by the last solve wave",
+        )
+        self._m_quality_pref = self.metrics.gauge(
+            "grove_placement_quality_preferred_fraction",
+            "Mean preferred-domain fraction implied by the last wave's scores",
+        )
+        # Kube wire-client throttling (cluster.kubeQps/kubeBurst token
+        # bucket): requests that had to wait for a token.
+        self._m_kube_throttled = self.metrics.counter(
+            "grove_kube_client_throttled_total",
+            "Apiserver requests delayed by the QPS/Burst token bucket",
+        )
+        self._kube_throttled_exported = 0
         # Every (queue, resource) series ever emitted — re-zeroed each pass
         # when usage disappears (gauge values persist otherwise).
         self._queue_metric_keys: dict[str, set] = {}
@@ -864,6 +887,9 @@ class Manager:
             # in-flight migrations, monotonic counters (what `grove-tpu get
             # defrag` renders).
             "defrag": self.controller.defrag_status(),
+            # Placement quality of live serving solves (quality/report.py
+            # discipline — what `grove-tpu get quality` renders).
+            "quality": self.controller.quality_status(),
             # The effective ClusterTopology (config TAS levels + auto host
             # level) — what `grove-tpu get topology` renders (kubectl get
             # clustertopology analog; the kubernetes source also syncs it
@@ -1025,6 +1051,8 @@ class Manager:
                 pod_manifest_for=_manifest,
                 watch_workloads=cfg.cluster.watch_workloads,
                 initc_kube_tokens=cfg.cluster.initc_mode == "kubernetes",
+                qps=cfg.cluster.kube_qps,
+                burst=cfg.cluster.kube_burst,
             )
             source.start()
             self._kube_source = source
@@ -1056,6 +1084,10 @@ class Manager:
                 server=ctx.server,
                 namespace=ctx.namespace,
             )
+        # Accelerator preflight AFTER the cluster source attached: a boot
+        # that promises auto-slice injection against a fleet with no slice
+        # resource anywhere must fail HERE, not strand gangs at solve time.
+        self._accelerator_preflight()
         self._started = True
         self.log.info(
             "manager started",
@@ -1064,6 +1096,38 @@ class Manager:
             backend_port=self.backend_port,
             webhook_port=self.webhook_port,
         )
+
+    def _accelerator_preflight(self) -> None:
+        """Hard boot-time failure when networkAcceleration.autoSliceEnabled
+        is set but no visible node exposes the slice resource — the MNNVL
+        preflight analog (a GPU fleet without ComputeDomains fails the
+        operator boot rather than silently scheduling nothing). Sources
+        whose nodes only arrive later (externally-fed store with nothing in
+        it yet, apiserver momentarily unreachable) skip: there is nothing
+        visible to falsify, and the knob stays honest once nodes flow in."""
+        na = self.config.network_acceleration
+        if not na.auto_slice_enabled:
+            return
+        res = na.slice_resource_name
+        caps: list | None = None
+        if self.config.cluster.source == "kwok" and self.watch is not None:
+            # The fabricated fleet's bootstrap events sit at t=0 (see
+            # start()); pumping them in makes the fleet inspectable now.
+            self.watch.pump(0.0)
+            caps = [n.capacity for n in self.cluster.nodes.values()]
+        elif self._kube_source is not None:
+            caps = self._kube_source.list_node_capacities()
+        elif self.cluster.nodes:
+            caps = [n.capacity for n in self.cluster.nodes.values()]
+        if not caps:
+            return
+        if not any(float(c.get(res, 0) or 0) > 0 for c in caps):
+            raise RuntimeError(
+                "networkAcceleration.autoSliceEnabled: no visible node "
+                f"exposes the slice resource {res!r} ({len(caps)} nodes "
+                "checked) — fix the fleet's device plugin or disable "
+                "autoSliceEnabled"
+            )
 
     def _bind_server(
         self, port: int, handler_base: type, tls_paths: Optional[tuple[str, str]]
@@ -1373,6 +1437,23 @@ class Manager:
                 if delta > 0:
                     metric.inc(float(delta))
                     self._defrag_exported[key] = counts[key]
+        quality = self.controller.quality_last
+        if quality:
+            self._m_quality_admitted_ratio.set(
+                float(quality.get("admittedRatio", 0.0))
+            )
+            self._m_quality_score.set(
+                float(quality.get("meanPlacementScore", 0.0))
+            )
+            self._m_quality_pref.set(
+                float(quality.get("preferredFraction", 0.0))
+            )
+        limiter = getattr(self._kube_source, "limiter", None)
+        if limiter is not None:
+            delta = limiter.throttled - self._kube_throttled_exported
+            if delta > 0:
+                self._m_kube_throttled.inc(float(delta))
+                self._kube_throttled_exported = limiter.throttled
         qtree = self.controller.queue_tree
         if qtree is not None:
             # Per-queue usage gauges (GREP-244 metrics direction): refreshed

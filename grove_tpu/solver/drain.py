@@ -19,6 +19,11 @@ throughput-optimal shape is different, and it lives here as a public API:
   4. ONE batched device_get harvests every wave's verdicts. Measured on the
      TPU relay (round 3): each separate device->host fetch pays a fixed
      ~70-150ms, and per-wave polling blew a 10k-pod drain from <1s to 39s.
+     `harvest="wave"` deliberately trades that back: it blocks per wave and
+     records completion stamps so p50/p99 bind latency is MEASURED rather
+     than definitional (the placement-quality evaluation configuration —
+     bench.py GROVE_BENCH_HARVEST=wave; the chained mode stays the
+     throughput headline).
 
 bench.py is a thin consumer of this module; tests/test_drain.py pins the
 semantics platform-independently.
@@ -62,6 +67,16 @@ class DrainStats:
     encode_reuse_hits: int = 0
     encode_reuse_misses: int = 0
     donated: bool = False  # wave carry donated (free/ok_global in-place)
+    # Harvest mode: "chained" (default — ONE batched device_get at the end,
+    # so per-gang latency is definitionally the drain wall) or "wave"
+    # (block per wave and record its completion stamp, so p50/p99 are
+    # MEASURED). Wave mode pays the per-fetch device->host fixed cost every
+    # wave (~70-150ms each on the TPU relay, round 3) — it is the
+    # measurement configuration, not the throughput one.
+    harvest: str = "chained"
+    # Wave mode only: (gangs admitted in wave, seconds since drain start at
+    # which the wave's verdicts were host-visible), in dispatch order.
+    wave_latencies: list = field(default_factory=list)
 
 
 def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int]]:
@@ -121,6 +136,7 @@ def drain_backlog(
     warm: bool = True,
     warm_path=None,  # solver.warm.WarmPath; None = the process-shared one
     donate: bool | None = None,  # None = auto (on for accelerators, off CPU)
+    harvest: str = "chained",  # "chained" | "wave" (see DrainStats.harvest)
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
 
@@ -149,6 +165,8 @@ def drain_backlog(
     from grove_tpu.solver import warm as warm_mod
 
     params = params or SolverParams()
+    if harvest not in ("chained", "wave"):
+        raise ValueError(f"harvest must be 'chained' or 'wave', got {harvest!r}")
     wp = warm_path if warm_path is not None else warm_mod.default_warm_path()
     if donate is None:
         donate = warm_mod.donation_default()
@@ -176,7 +194,11 @@ def drain_backlog(
 
     else:
         solver = solve_batch
-    stats = DrainStats(gangs=len(gangs), donated=bool(donate and use_exec_cache))
+    stats = DrainStats(
+        gangs=len(gangs),
+        donated=bool(donate and use_exec_cache),
+        harvest=harvest,
+    )
     if not gangs:
         return {}, stats
     # Warm-path counters are process-shared; report this drain's deltas.
@@ -286,6 +308,15 @@ def drain_backlog(
         free_arr = result.free_after
         ok_g = result.ok_global
         inflight.append((result.ok, result.placement_score, result.assigned, decode))
+        if harvest == "wave":
+            # Per-wave completion stamp: block until THIS wave's verdicts are
+            # host-visible and record (admitted, elapsed) — p50/p99 become
+            # measured per-gang bind latencies instead of the drain wall.
+            # Padded/invalid slots carry ok=False, so the sum is exact.
+            jax.block_until_ready(result.ok)
+            stats.wave_latencies.append(
+                (int(np.asarray(result.ok).sum()), time.perf_counter() - t0)
+            )
 
     th = time.perf_counter()
     jax.device_get([(ok, sc, asg) for ok, sc, asg, _ in inflight])

@@ -216,3 +216,33 @@ def test_drain_portfolio_beats_binpack_trap(simple1):
     bindings, stats = drain_backlog(gangs, pods, snapshot, portfolio=2)
     assert stats.admitted == len(gangs)
     assert sum(len(b) for b in bindings.values()) == 12
+
+
+def test_drain_wave_harvest_measures_per_wave_latency():
+    """harvest="wave": identical admissions to the chained drain, plus a
+    per-wave (admitted, completion-stamp) series whose stamps are
+    monotonically increasing — the measured-p99 configuration the bench's
+    GROVE_BENCH_HARVEST=wave line is built from."""
+    gangs, pods, snap = _setup()
+    chained, cstats = drain_backlog(gangs, pods, snap, wave_size=8)
+    assert cstats.harvest == "chained" and cstats.wave_latencies == []
+    bindings, stats = drain_backlog(
+        gangs, pods, snap, wave_size=8, harvest="wave"
+    )
+    assert set(bindings) == set(chained), "wave harvest changed admissions"
+    assert stats.harvest == "wave"
+    assert len(stats.wave_latencies) == stats.waves
+    stamps = [t for _, t in stats.wave_latencies]
+    assert stamps == sorted(stamps)
+    assert all(t > 0 for t in stamps)
+    assert stamps[-1] <= stats.total_s + 1e-6
+    # Per-wave admitted counts reconcile with the drain total.
+    assert sum(n for n, _ in stats.wave_latencies) == stats.admitted
+
+
+def test_drain_rejects_unknown_harvest_mode():
+    import pytest
+
+    gangs, pods, snap = _setup(n_disagg=1, n_agg=0, n_frontend=0)
+    with pytest.raises(ValueError, match="harvest"):
+        drain_backlog(gangs, pods, snap, harvest="poll")

@@ -2008,3 +2008,33 @@ def test_scale_rejects_pcsg_member_clique(api, tmp_path):
         assert api.child_crs["podcliques"][member]["spec"]["replicas"] == orig
     finally:
         m.stop()
+
+
+# --- live-cluster tier (`make test-kind`) ----------------------------------------
+
+
+def test_live_cluster_wire_smoke():
+    """The `make test-kind` entry point: against a REAL apiserver (kind or
+    otherwise) this lists nodes through the throttled wire client and
+    verifies the watch source boots. Gated on GROVE_TEST_REAL_CLUSTER=1 AND
+    a resolvable kubeconfig — skips cleanly everywhere else, so the tier is
+    safe in plain unit-test environments."""
+    import os
+
+    if os.environ.get("GROVE_TEST_REAL_CLUSTER") != "1":
+        pytest.skip("GROVE_TEST_REAL_CLUSTER != 1 (run via `make test-kind`)")
+    try:
+        ctx = load_kube_context()
+    except (FileNotFoundError, ValueError) as e:
+        pytest.skip(f"no usable kubeconfig: {e}")
+    src = KubernetesWatchSource(ctx, watch_workloads=False)
+    try:
+        caps = src.list_node_capacities()
+        if caps is None:
+            pytest.skip(f"apiserver unreachable: {src.errors[-1:]}")
+        assert len(caps) >= 1, "a real cluster exposes at least one node"
+        assert all(isinstance(c, dict) for c in caps)
+        # The LIST above went through the QPS/Burst bucket.
+        assert src.limiter.capacity >= 1
+    finally:
+        src.stop()
