@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -57,6 +57,12 @@ bench-quality:   ## placement-quality report: mixed Required/Preferred backlog, 
 
 bench-quality-cpu: ## quality report with the TPU-relay probe skipped
 	GROVE_BENCH_SCENARIO=quality GROVE_FORCE_CPU=1 $(PY) bench.py
+
+bench-replay:    ## flight recorder: record a sim drain -> bitwise replay -> +1-rack what-if
+	GROVE_BENCH_SCENARIO=replay $(PY) bench.py
+
+bench-replay-cpu: ## replay scenario with the TPU-relay probe skipped
+	GROVE_BENCH_SCENARIO=replay GROVE_FORCE_CPU=1 $(PY) bench.py
 
 test-kind:       ## kubernetes-source tier against a REAL cluster; clean skip without a kubeconfig
 	@if $(PY) -c "from grove_tpu.cluster.kubernetes import load_kube_context; load_kube_context()" >/dev/null 2>&1; then \

@@ -523,6 +523,142 @@ def run_defrag_bench() -> dict:
     return out
 
 
+def run_replay_bench() -> dict:
+    """Flight-recorder scenario (`make bench-replay` /
+    GROVE_BENCH_SCENARIO=replay): record a sim drain, then measure the
+    recorder's three claims in one JSON line:
+
+      - overhead: the same drain runs with the recorder OFF and ON; the
+        headline gate is ON/OFF wall-clock < 1.05 (recorder cheap enough to
+        leave on in production);
+      - determinism: replaying the journal reproduces every recorded plan
+        bitwise (divergence count is the metric value — 0 or the solver has
+        a nondeterminism regression);
+      - counterfactual: a what-if replay with +1 rack reports the quality
+        delta (admitted ratio / placement score) the extra rack would have
+        bought over the recorded window.
+    """
+    import shutil
+    import tempfile
+
+    from grove_tpu.orchestrator.controller import GroveController
+    from grove_tpu.orchestrator.store import Cluster
+    from grove_tpu.sim.simulator import Simulator
+    from grove_tpu.sim.workloads import (
+        _clique,
+        _pcs,
+        bench_topology,
+        synthetic_cluster,
+    )
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+    from grove_tpu.trace.whatif import whatif_journal
+
+    scale = float(os.environ.get("GROVE_BENCH_SCALE", "1.0"))
+    topo = bench_topology()
+    racks = max(2, round(4 * scale))
+    hosts_per_rack = 4
+
+    def _fleet():
+        return synthetic_cluster(
+            zones=1,
+            blocks_per_zone=1,
+            racks_per_block=racks,
+            hosts_per_rack=hosts_per_rack,
+            cpu=8.0,
+            tpu=0.0,
+        )
+
+    def _backlog():
+        # Sized to overfill the fleet by ~one rack: the recorded window must
+        # contain rejections for the +1-rack what-if to buy anything.
+        out = []
+        for i in range(racks + 1):
+            out.append(
+                _pcs(
+                    f"job{i}",
+                    cliques=[_clique("w", hosts_per_rack, "8")],
+                    constraint_domain="rack",
+                )
+            )
+        return out
+
+    def _drain(recorder):
+        cluster = Cluster()
+        for n in _fleet():
+            cluster.nodes[n.name] = n
+        ctrl = GroveController(
+            cluster=cluster, topology=topo, recorder=recorder
+        )
+        sim = Simulator(cluster=cluster, controller=ctrl)
+        for pcs in _backlog():
+            cluster.podcliquesets[pcs.metadata.name] = pcs
+        t0 = time.perf_counter()
+        sim.run_until(
+            lambda: all(
+                p.ready for p in cluster.pods.values() if p.is_scheduled
+            )
+            and any(p.is_scheduled for p in cluster.pods.values()),
+            timeout=120.0,
+        )
+        wall = time.perf_counter() - t0
+        admitted = sum(
+            1
+            for g in cluster.podgangs.values()
+            if g.is_base_gang_scheduled()
+        )
+        return wall, admitted, len(cluster.podgangs)
+
+    # Warm-up drain: pays the XLA compiles into the process jit caches so
+    # the OFF/ON comparison measures recording, not compilation order.
+    _drain(None)
+    wall_off, admitted_off, gangs_total = _drain(None)
+    journal_dir = tempfile.mkdtemp(prefix="grove-trace-bench-")
+    recorder = TraceRecorder(journal_dir)
+    recorder.start()
+    try:
+        wall_on, admitted_on, _ = _drain(recorder)
+    finally:
+        recorder.stop()
+    overhead = (wall_on - wall_off) / wall_off if wall_off > 0 else 0.0
+
+    records = read_journal(journal_dir)
+    replay = replay_journal(records)
+    whatif = whatif_journal(records, add_rack_count=1)
+    shutil.rmtree(journal_dir, ignore_errors=True)
+    rep_doc = replay.to_doc()
+    wi_doc = whatif.to_doc()
+
+    divergences = rep_doc["divergences"]
+    ok = divergences == 0 and overhead < 0.05 and admitted_on == admitted_off
+    out = {
+        "scenario": "replay",
+        "metric": "replay_divergence_total",
+        "unit": "count",
+        "value": divergences,
+        "vs_baseline": 1.0 if ok else 0.0,
+        "gangs": gangs_total,
+        "gangs_admitted": admitted_on,
+        "drain_wall_off_s": round(wall_off, 3),
+        "drain_wall_on_s": round(wall_on, 3),
+        "record_overhead_frac": round(overhead, 4),
+        "journal_records": len(records),
+        "journal_waves": rep_doc["waves"],
+        "recorder_stats": recorder.stats(),
+        "recorded_solve_s": rep_doc["recordedSolveSeconds"],
+        "replayed_solve_s": rep_doc["replayedSolveSeconds"],
+        "whatif_add_racks": 1,
+        "whatif_recorded_admitted_ratio": wi_doc["recorded"]["admittedRatio"],
+        "whatif_cf_admitted_ratio": wi_doc["counterfactual"]["admittedRatio"],
+        "whatif_admitted_delta": wi_doc["delta"]["admitted"],
+        "whatif_admitted_ratio_delta": wi_doc["delta"]["admittedRatio"],
+        "whatif_score_delta": wi_doc["delta"]["meanPlacementScore"],
+    }
+    if divergences:
+        out["diverged"] = rep_doc["diverged"][:3]  # evidence, bounded
+    return out
+
+
 def run_quality_bench() -> dict:
     """Placement-quality scenario (`make bench-quality` /
     GROVE_BENCH_SCENARIO=quality): the quality report as the headline.
@@ -686,6 +822,12 @@ def main() -> int:
             _RESULT["metric"] = "placement_quality_score"
             _RESULT["unit"] = "score"
             extras = run_quality_bench()
+        elif scenario == "replay":
+            # Flight-recorder scenario (`make bench-replay`): recording
+            # overhead, bitwise replay divergence, +1-rack what-if delta.
+            _RESULT["metric"] = "replay_divergence_total"
+            _RESULT["unit"] = "count"
+            extras = run_replay_bench()
         else:
             extras = run_bench()
         extras["ts_utc"] = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())

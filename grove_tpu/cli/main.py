@@ -12,6 +12,14 @@ API via the typed client. Commands:
   scale <fqn> --replicas N                      kubectl-scale analog
   validate -f <file.yaml>                       dry-run admission check
   events [--tail N]                             recent control-plane events
+  trace info|replay|whatif [--path DIR]         flight-recorder journal tools
+
+`trace` operates on the journal directory on local disk (the recorder's
+trace.path — run it on the operator host or a copied journal), not over the
+HTTP API: replay re-solves every journaled wave, which needs the solver, not
+the server. `trace replay` exits 1 on any divergence (a solver-
+nondeterminism regression); `trace whatif --add-racks N` scores the recorded
+window against a counterfactual fleet.
 
 Exit codes: 0 ok, 1 API/transport error, 2 usage error (cli.go:35-45 shape).
 """
@@ -373,6 +381,112 @@ def _describe(client: GroveClient, kind: str, name: str) -> str:
     return "\n".join(lines)
 
 
+def _trace_cmd(args) -> int:
+    """`grove-tpu trace info|replay|whatif` — local journal tools. Solver
+    imports are deferred: `info` must work on a machine without jax warmup
+    cost, and errors map to the CLI exit-code contract (1 = journal/replay
+    problem, incl. divergence)."""
+    from grove_tpu.trace.recorder import TraceSchemaError, read_journal
+
+    try:
+        records = read_journal(args.path)
+    except (FileNotFoundError, TraceSchemaError, OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if args.verb == "info":
+        kinds: dict[str, int] = {}
+        actions: dict[str, int] = {}
+        times = []
+        waves = 0
+        admitted = 0
+        rejections = 0
+        for rec in records:
+            kinds[rec.get("kind", "?")] = kinds.get(rec.get("kind", "?"), 0) + 1
+            if "now" in rec:
+                times.append(rec["now"])
+            if rec.get("kind") == "action":
+                a = rec.get("action", "?")
+                actions[a] = actions.get(a, 0) + 1
+            elif rec.get("kind") == "wave":
+                waves += 1
+                admitted += sum(1 for v in rec.get("ok", {}).values() if v)
+                rejections += len(rec.get("rejections", {}))
+        rows = [["records", len(records)]]
+        rows += [[f"records.{k}", v] for k, v in sorted(kinds.items())]
+        rows += [
+            ["waves", waves],
+            ["gangsAdmitted", admitted],
+            ["gangsRejected", rejections],
+        ]
+        if times:
+            rows += [
+                ["timeRange", f"{min(times):.1f} - {max(times):.1f}"],
+            ]
+        rows += [[f"actions.{k}", v] for k, v in sorted(actions.items())]
+        print(_table(rows, ["FIELD", "VALUE"]))
+        return 0
+
+    if args.verb == "replay":
+        from grove_tpu.trace.replay import replay_journal
+
+        try:
+            report = replay_journal(records)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        doc = report.to_doc()
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        else:
+            rows = [
+                ["waves", doc["waves"]],
+                ["divergences", doc["divergences"]],
+                ["recordedSolveSeconds", doc["recordedSolveSeconds"]],
+                ["replayedSolveSeconds", doc["replayedSolveSeconds"]],
+            ]
+            print(_table(rows, ["FIELD", "VALUE"]))
+            for w in doc["diverged"]:
+                # The structured diff IS the evidence a nondeterminism
+                # regression gets filed with — print it whole.
+                print(json.dumps(w, indent=2))
+        if doc["divergences"]:
+            print(
+                "replay DIVERGED: solver nondeterminism regression "
+                f"({doc['divergences']} divergence(s))",
+                file=sys.stderr,
+            )
+            return 1
+        print("replay bit-identical: every recorded plan reproduced")
+        return 0
+
+    # whatif
+    from grove_tpu.trace.whatif import whatif_journal
+
+    try:
+        report = whatif_journal(
+            records, add_rack_count=args.add_racks, portfolio=args.portfolio
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    doc = report.to_doc()
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    rows = [["waves", doc["waves"]]]
+    rows += [[f"edits.{k}", v] for k, v in sorted(doc["edits"].items()) if v]
+    for side in ("recorded", "counterfactual"):
+        rows += [[f"{side}.{k}", v] for k, v in sorted(doc[side].items())]
+    rows += [[f"delta.{k}", v] for k, v in sorted(doc["delta"].items())]
+    rows += [
+        ["recordedSolveSeconds", doc["recordedSolveSeconds"]],
+        ["counterfactualSolveSeconds", doc["counterfactualSolveSeconds"]],
+    ]
+    print(_table(rows, ["FIELD", "VALUE"]))
+    return 0
+
+
 def main(argv=None) -> int:
     from grove_tpu.version import version_string
 
@@ -440,7 +554,37 @@ def main(argv=None) -> int:
         metavar="N",
     )
 
+    from grove_tpu.runtime.config import RUNTIME_STATE_DIR
+
+    p_tr = sub.add_parser(
+        "trace", help="flight-recorder journal tools (local journal dir)"
+    )
+    p_tr.add_argument("verb", choices=["info", "replay", "whatif"])
+    p_tr.add_argument(
+        "--path",
+        default=RUNTIME_STATE_DIR + "/trace",
+        help="journal directory (the operator's trace.path)",
+    )
+    p_tr.add_argument(
+        "--add-racks",
+        type=int,
+        default=1,
+        help="whatif: clone N racks of the recorded SKU into the fleet",
+    )
+    p_tr.add_argument(
+        "--portfolio",
+        type=int,
+        default=None,
+        help="whatif: override the recorded portfolio width",
+    )
+    p_tr.add_argument(
+        "--json", action="store_true", help="emit the full report as JSON"
+    )
+
     args = parser.parse_args(argv)
+
+    if args.cmd == "trace":
+        return _trace_cmd(args)
 
     try:
         token = None

@@ -296,4 +296,4 @@ class FakeGroveClient:
             raise GroveApiError(400, [str(e)]) from None
 
     def events(self) -> list[tuple[float, str, str]]:
-        return list(self.manager.cluster.events[-200:])
+        return self.manager.cluster.recent_events(200)

@@ -226,10 +226,16 @@ class WatchDriver:
         publish_events = getattr(self.source, "publish_events", None)
         if publish_events is not None:
             # Control-plane events -> corev1 Events (kubectl get events).
-            # High-water mark in EVENT COUNT; bounded batch per push.
-            new = self.cluster.events[
-                self._pushed_events : self._pushed_events + 100
-            ]
+            # High-water mark in the store's MONOTONIC event index
+            # (events_total), not a deque position — the bounded ring drops
+            # its oldest entries, so positions shift; events that fell off
+            # before mirroring count as pushed (they are gone either way).
+            evs = self.cluster.recent_events()
+            skip = len(evs) - (self.cluster.events_total - self._pushed_events)
+            if skip < 0:
+                self._pushed_events = self.cluster.events_total - len(evs)
+                skip = 0
+            new = evs[skip : skip + 100]
             if new:
                 self._pushed_events += publish_events(new)
         return pushed

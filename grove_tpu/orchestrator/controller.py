@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import math
 import random
+import time
 from dataclasses import dataclass, field
 
 from grove_tpu.api import constants, naming
@@ -175,6 +176,13 @@ class GroveController:
     defrag_cooldown_seconds: float = 300.0
     defrag_max_moves: int = 8
     defrag_min_efficiency: float = 0.0
+    # Decision flight recorder (grove_tpu/trace; config section `trace`):
+    # when set, every solve wave's input closure + resulting plan and every
+    # disruptive action (preemption, reclaim, defrag migration, rolling
+    # update, gang termination) is journaled for deterministic replay and
+    # what-if counterfactuals. Tracing is observability: a recorder failure
+    # must never break serving, so every hook is exception-contained.
+    recorder: object | None = None
     # Gangs mid-migration (name -> start time); a migration completes when
     # every pod of the gang is scheduled and Ready again. This set IS the
     # disruption budget's denominator.
@@ -772,6 +780,7 @@ class GroveController:
         # (capacity freed, node added) copies rows instead of re-walking
         # specs in Python. The sub digests are already computed for the
         # solve-skip fingerprint; the epoch is memoized on the snapshot.
+        t_solve0 = time.perf_counter()
         epoch = snapshot.encode_epoch()
         row_keys = [(d, epoch) for d in sub_digests]
         batch, decode = encode_gangs(
@@ -808,6 +817,7 @@ class GroveController:
             warm=self.warm,
         )
         bindings = decode_assignments(result, decode, snapshot)
+        solve_seconds = time.perf_counter() - t_solve0
 
         admitted = 0
         import numpy as np
@@ -819,6 +829,36 @@ class GroveController:
             valid_by_name.get(n, False) and not ok_by_name.get(n, False)
             for n in decode.gang_names
         )
+        if self.recorder is not None:
+            # Flight-recorder capture BEFORE the binding loop mutates the
+            # pods: the journal holds the pre-solve input closure. The serde
+            # deep copy happens here (synchronously); file I/O does not.
+            try:
+                self.recorder.capture_wave(
+                    now=now,
+                    wave="floors" if floors_only else "extras",
+                    snapshot=snapshot,
+                    gangs=sub_gangs,
+                    pods_by_name=pods_by_name,
+                    scheduled_names=scheduled_names,
+                    bound_nodes=bound_nodes,
+                    reuse_nodes=reuse_nodes,
+                    spread_avoid=spread_avoid,
+                    max_groups=self.max_groups,
+                    max_sets=self.max_sets,
+                    max_pods=self.max_pods,
+                    pad_gangs_to=pad_to,
+                    params=self.solver_params,
+                    portfolio=self.portfolio,
+                    escalate_portfolio=esc,
+                    plan=bindings,
+                    ok_by_name=ok_by_name,
+                    valid_by_name=valid_by_name,
+                    scores=scores,
+                    solve_seconds=solve_seconds,
+                )
+            except Exception:  # noqa: BLE001 — tracing must never break serving
+                pass
         # Rolling placement-quality view (quality/report.py units): only
         # solver-valid gangs count — a gang gated out at encode (missing
         # base, unresolvable key) is not a quality verdict on this wave.
@@ -932,6 +972,16 @@ class GroveController:
             if rejected:
                 self._preempt_for_rejected(rejected, now)
         return admitted
+
+    def _journal_action(self, now: float, action: str, obj: str, **fields) -> None:
+        """Journal one disruptive decision to the flight recorder (no-op
+        without one; contained — tracing must never break serving)."""
+        if self.recorder is None:
+            return
+        try:
+            self.recorder.capture_action(now, action, obj, **fields)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _sub_digest(self, sub: PodGang) -> tuple:
         """Hashable digest of ONE pending subgang — everything encode reads
@@ -1099,6 +1149,13 @@ class GroveController:
             c.record_event(
                 now, gang.name, f"gang preempted by {contender.name} ({len(pods)} pods)"
             )
+        self._journal_action(
+            now,
+            "preemption",
+            contender.name,
+            victims=[g.name for g, _ in chosen],
+            podsEvicted=sum(len(p) for _, p in chosen),
+        )
         return True
 
     def _reclaim_for_quota(
@@ -1208,6 +1265,13 @@ class GroveController:
                 other.name,
                 f"gang reclaimed by in-quota {gang.name} ({len(pods)} pods)",
             )
+        self._journal_action(
+            now,
+            "quota-reclaim",
+            gang.name,
+            victims=[g.name for g, _ in chosen],
+            blockedAt=blocked_at,
+        )
         return True
 
     # --- statuses ----------------------------------------------------------------
@@ -1288,6 +1352,9 @@ class GroveController:
                         f"> terminationDelay {delay:.0f}s)",
                     )
                     terminated.append((pcs.metadata.name, i))
+                    self._journal_action(
+                        now, "gang-termination", pcs.metadata.name, replica=i
+                    )
         return terminated
 
     # --- rolling updates (rollingupdate.go) --------------------------------------
@@ -1310,6 +1377,9 @@ class GroveController:
                 )
                 st.updated_generation_hash = new_hash
                 c.record_event(now, pcs.metadata.name, f"rolling update started -> {new_hash}")
+                self._journal_action(
+                    now, "rolling-update-started", pcs.metadata.name, hash=new_hash
+                )
             if st.rolling_update_progress is None or st.rolling_update_progress.update_ended_at:
                 continue
             self._advance_rolling_update(pcs, now)
@@ -1387,6 +1457,9 @@ class GroveController:
             for clique in c.cliques_of_pcs(pcs.metadata.name):
                 clique.status.current_pcs_generation_hash = new_hash
             c.record_event(now, pcs.metadata.name, f"rolling update complete -> {new_hash}")
+            self._journal_action(
+                now, "rolling-update-complete", pcs.metadata.name, hash=new_hash
+            )
             return
 
         current = min(remaining, key=order_key)
@@ -1649,6 +1722,9 @@ class GroveController:
             mv.gang,
             f"gang migrated by defrag ({moved} pods rebound, "
             f"make-before-break)",
+        )
+        self._journal_action(
+            now, "defrag-migration", mv.gang, podsRebound=moved
         )
         return True
 

@@ -12,8 +12,14 @@ unnecessary by construction.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
+
+# Default control-plane event ring capacity (controllers.eventsBuffer
+# overrides it at manager boot). Events were an unbounded list.append ring
+# through PR 3 — a long soak leaked memory linearly with churn.
+DEFAULT_EVENTS_MAXLEN = 4096
 
 from grove_tpu.api.pod import Pod
 from grove_tpu.api.podgang import PodGang
@@ -115,7 +121,17 @@ class Cluster:
     secrets: dict[str, object] = field(default_factory=dict)  # TokenSecret
     # HPA scale subresource values, keyed by target FQN (pclq or pcsg).
     scale_overrides: dict[str, int] = field(default_factory=dict)
-    events: list[tuple[float, str, str]] = field(default_factory=list)  # (time, obj, msg)
+    # Bounded control-plane event ring: (time, obj, msg). A deque(maxlen)
+    # so long soaks cannot leak; overflow drops the OLDEST event and counts
+    # it (events_dropped -> grove_events_dropped_total). events_total is the
+    # monotonic global index — consumers that mirror the ring incrementally
+    # (watch driver event publishing) track position in it, because deque
+    # indices shift as old entries fall off.
+    events: deque = field(
+        default_factory=lambda: deque(maxlen=DEFAULT_EVENTS_MAXLEN)
+    )
+    events_dropped: int = 0
+    events_total: int = 0
 
     @property
     def headless_services(self) -> set[str]:
@@ -157,7 +173,24 @@ class Cluster:
         return [g for g in self.podgangs.values() if g.pcs_name == pcs_name]
 
     def record_event(self, now: float, obj: str, msg: str) -> None:
-        self.events.append((now, obj, msg))
+        ev = self.events
+        if ev.maxlen is not None and len(ev) == ev.maxlen:
+            self.events_dropped += 1
+        ev.append((now, obj, msg))
+        self.events_total += 1
+
+    def set_events_maxlen(self, maxlen: int) -> None:
+        """Resize the event ring (controllers.eventsBuffer), keeping the
+        newest events that fit."""
+        maxlen = max(1, int(maxlen))
+        if self.events.maxlen != maxlen:
+            self.events = deque(self.events, maxlen=maxlen)
+
+    def recent_events(self, n: int | None = None) -> list[tuple[float, str, str]]:
+        """Newest-last event list (deques don't slice; every tail consumer
+        goes through here)."""
+        evs = list(self.events)
+        return evs if n is None else evs[-n:]
 
     # --- mutations ---------------------------------------------------------------
 

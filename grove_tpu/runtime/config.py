@@ -84,6 +84,15 @@ class ControllerConfig:
 
     concurrent_syncs: int = 1
     reconcile_interval_seconds: float = 1.0
+    # Control-plane event ring capacity (Cluster.events deque maxlen). The
+    # ring was unbounded through PR 3 and leaked on long soaks; overflow now
+    # drops the oldest event and counts it (grove_events_dropped_total).
+    events_buffer: int = 4096
+    # Heal-event dedupe window: repeated "rejected/unparseable CR" heal
+    # events for one (object, reason) pair emit at most once per window —
+    # an external writer flapping between bad values must not flood the
+    # event ring every relist. 0 disables the window (every heal events).
+    heal_event_dedupe_seconds: float = 60.0
 
 
 @dataclass
@@ -210,6 +219,34 @@ class DefragConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Decision flight recorder (grove_tpu/trace): journals every solve wave
+    (snapshot digest, compact node/gang encodings, solver config fingerprint,
+    resulting plan with per-gang rejection reasons, timings) plus preemption/
+    defrag/rolling-update actions, off the hot path via a bounded queue and a
+    writer thread with atomic segment rotation. Journals feed deterministic
+    replay (`grove-tpu trace replay` — bitwise plan equivalence, divergence =
+    solver-nondeterminism regression) and what-if counterfactuals
+    (`grove-tpu trace whatif` — +N racks / different solver config scored
+    with the placement-quality report)."""
+
+    enabled: bool = False
+    # Journal directory (segment files rotate inside it).
+    path: str = RUNTIME_STATE_DIR + "/trace"
+    # Segment rotation: records per segment file, and how many segment files
+    # to keep (oldest pruned; every segment is self-contained for replay).
+    max_records_per_file: int = 256
+    max_files: int = 16
+    # Bounded hand-off queue between the reconcile thread and the writer; a
+    # full queue DROPS records (counted) rather than blocking a solve.
+    queue_size: int = 2048
+    # Writer flush cadence; the manager's trace flow step also requests a
+    # flush each reconcile, so journal staleness is bounded by min(this,
+    # reconcile interval).
+    flush_interval_seconds: float = 1.0
+
+
+@dataclass
 class BackendConfig:
     """Scheduler-backend sidecar (GREP-375 boundary)."""
 
@@ -302,6 +339,7 @@ class OperatorConfiguration:
     scheduling: SchedulingConfig = field(default_factory=SchedulingConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
     defrag: DefragConfig = field(default_factory=DefragConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
@@ -337,6 +375,7 @@ _SECTION_TYPES = {
     "scheduling": ("scheduling", SchedulingConfig),
     "solver": ("solver", SolverConfig),
     "defrag": ("defrag", DefragConfig),
+    "trace": ("trace", TraceConfig),
     "backend": ("backend", BackendConfig),
     "persistence": ("persistence", PersistenceConfig),
     "cluster": ("cluster", ClusterConfig),
@@ -362,6 +401,12 @@ _CAMEL_FIELDS = {
     "webhookSans": "webhook_sans",
     "concurrentSyncs": "concurrent_syncs",
     "reconcileIntervalSeconds": "reconcile_interval_seconds",
+    "eventsBuffer": "events_buffer",
+    "healEventDedupeSeconds": "heal_event_dedupe_seconds",
+    "maxRecordsPerFile": "max_records_per_file",
+    "maxFiles": "max_files",
+    "queueSize": "queue_size",
+    "flushIntervalSeconds": "flush_interval_seconds",
     "exemptActors": "exempt_actors",
     "autoSliceEnabled": "auto_slice_enabled",
     "sliceResourceName": "slice_resource_name",
@@ -614,6 +659,28 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
         df.min_efficiency, bool
     ) or df.min_efficiency < 0:
         errors.append("defrag.minEfficiency: must be >= 0")
+    tr = cfg.trace
+    if tr.enabled and not tr.path:
+        errors.append("trace.path: required when trace is enabled")
+    for tname, tval in (
+        ("trace.maxRecordsPerFile", tr.max_records_per_file),
+        ("trace.maxFiles", tr.max_files),
+        ("trace.queueSize", tr.queue_size),
+    ):
+        if not isinstance(tval, int) or isinstance(tval, bool) or tval < 1:
+            errors.append(f"{tname}: must be an int >= 1")
+    if not isinstance(tr.flush_interval_seconds, (int, float)) or isinstance(
+        tr.flush_interval_seconds, bool
+    ) or tr.flush_interval_seconds <= 0:
+        errors.append("trace.flushIntervalSeconds: must be > 0")
+    eb = cfg.controllers.events_buffer
+    if not isinstance(eb, int) or isinstance(eb, bool) or eb < 1:
+        errors.append("controllers.eventsBuffer: must be an int >= 1")
+    hd = cfg.controllers.heal_event_dedupe_seconds
+    if not isinstance(hd, (int, float)) or isinstance(hd, bool) or hd < 0:
+        errors.append(
+            "controllers.healEventDedupeSeconds: must be >= 0 (0 = off)"
+        )
     cl = cfg.cluster
     if cl.initc_mode not in ("operator", "kubernetes"):
         errors.append(

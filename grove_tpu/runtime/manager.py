@@ -149,7 +149,7 @@ class _ProbeHandler(http.server.BaseHTTPRequestHandler):
         if kind == "events":
             self._respond(
                 200,
-                json.dumps([list(e) for e in c.events[-constants.EVENTS_BUFFER:]]),
+                json.dumps([list(e) for e in c.recent_events(constants.EVENTS_BUFFER)]),
                 "application/json",
             )
             return
@@ -445,6 +445,44 @@ def _require_self_signed(cert_file: str) -> None:
         )
 
 
+class _EventDeduper:
+    """One control-plane event per (object, reason) per window.
+
+    The heal paths (`_apply_child_scale_event`, `_apply_workload_event`)
+    used to rely on last-value/last-spec guards alone: an external writer
+    FLAPPING between two distinct bad values defeated those and re-evented
+    on every relist echo. The window dedupe closes that: however the bad
+    value churns, one (object, reason) pair emits at most once per window —
+    the event ring records the episode, not the flap frequency."""
+
+    def __init__(self, window_seconds: float) -> None:
+        self.window_seconds = float(window_seconds)
+        self.suppressed = 0
+        self._last: dict[tuple[str, str], float] = {}
+
+    def should_emit(self, now: float, obj: str, reason: str) -> bool:
+        if self.window_seconds <= 0:
+            return True
+        key = (obj, reason)
+        last = self._last.get(key)
+        # A clock that moved BACKWARD past the window (virtual-time tests,
+        # wall-clock step) re-arms rather than suppressing forever.
+        if last is not None and 0 <= now - last < self.window_seconds:
+            self.suppressed += 1
+            return False
+        self._last[key] = now
+        if len(self._last) > 4096:  # bound the memo on pathological churn
+            cutoff = now - self.window_seconds
+            self._last = {k: t for k, t in self._last.items() if t >= cutoff}
+        return True
+
+    def reset(self, obj: str, reason: str) -> None:
+        """End the episode early: the heal landed (echo confirmed / apply
+        succeeded), so the NEXT bad write is a new episode and must event
+        even inside the window."""
+        self._last.pop((obj, reason), None)
+
+
 class Manager:
     """Boots and runs the control plane from one OperatorConfiguration."""
 
@@ -484,6 +522,29 @@ class Manager:
             defrag_max_moves=config.defrag.max_moves_per_plan,
             defrag_min_efficiency=config.defrag.min_efficiency,
         )
+        # Bounded event ring (controllers.eventsBuffer): long soaks must not
+        # leak; overflow drops oldest + counts (grove_events_dropped_total).
+        self.cluster.set_events_maxlen(config.controllers.events_buffer)
+        # Heal-event window dedupe (controllers.healEventDedupeSeconds): one
+        # event per (object, reason) episode, whatever the relist cadence.
+        self._heal_dedupe = _EventDeduper(
+            config.controllers.heal_event_dedupe_seconds
+        )
+        # Decision flight recorder (config section `trace`): journals solve
+        # waves + disruptive actions for deterministic replay and what-if
+        # counterfactuals (grove_tpu/trace; docs/design.md).
+        self.trace_recorder = None
+        if config.trace.enabled:
+            from grove_tpu.trace.recorder import TraceRecorder
+
+            self.trace_recorder = TraceRecorder(
+                config.trace.path,
+                max_records_per_file=config.trace.max_records_per_file,
+                max_files=config.trace.max_files,
+                queue_size=config.trace.queue_size,
+                flush_interval_seconds=config.trace.flush_interval_seconds,
+            )
+            self.controller.recorder = self.trace_recorder
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._http_servers: list[http.server.ThreadingHTTPServer] = []
@@ -617,6 +678,28 @@ class Manager:
             "grove_placement_quality_preferred_fraction",
             "Mean preferred-domain fraction implied by the last wave's scores",
         )
+        # Flight recorder + event-ring observability (trace subsystem):
+        # journal records written/dropped (delta-exported from the recorder
+        # counters), replay divergences found by replay_verify (every
+        # divergence is a solver-nondeterminism regression), and events the
+        # bounded ring dropped.
+        self._m_trace_records = self.metrics.counter(
+            "grove_trace_records_total", "Flight-recorder records journaled"
+        )
+        self._m_trace_dropped = self.metrics.counter(
+            "grove_trace_dropped_total",
+            "Flight-recorder records dropped (bounded queue full)",
+        )
+        self._m_replay_divergence = self.metrics.counter(
+            "grove_replay_divergence_total",
+            "Plan divergences found by deterministic replay verification",
+        )
+        self._m_events_dropped = self.metrics.counter(
+            "grove_events_dropped_total",
+            "Control-plane events dropped by the bounded event ring",
+        )
+        self._trace_exported = {"recorded": 0, "dropped": 0}
+        self._events_dropped_exported = 0
         # Kube wire-client throttling (cluster.kubeQps/kubeBurst token
         # bucket): requests that had to wait for a token.
         self._m_kube_throttled = self.metrics.counter(
@@ -745,8 +828,11 @@ class Manager:
                 # back at the pushed value also proves a heal PUT landed —
                 # clear the rejected-value guard so a SECOND genuine write
                 # of the same out-of-range value records and heals again
-                # instead of being silently ignored forever.
+                # instead of being silently ignored forever. The dedupe
+                # window resets with it: the landed heal ENDS the episode,
+                # so the next rejection events even inside the window.
                 self._rejected_child_scales.pop(ev.name, None)
+                self._heal_dedupe.reset(ev.name, "cr-scale-rejected")
                 return
         elif cur.spec.replicas == reps:
             return  # nothing pushed yet and the CR agrees with the store
@@ -762,9 +848,13 @@ class Manager:
             # pump — and heal the wire: invalidate the projection cache so
             # the next sync re-PUTs the effective manifest (the external
             # write changed the CR behind the cache's back; without this
-            # kubectl would show the rejected value forever).
+            # kubectl would show the rejected value forever). The event is
+            # additionally window-deduped per (object, reason): the value
+            # guard above only stops IDENTICAL replays, so a writer flapping
+            # between two bad values would otherwise event on every flip.
             self._rejected_child_scales[ev.name] = reps
-            c.record_event(now, ev.name, f"CR scale rejected: {e}")
+            if self._heal_dedupe.should_emit(now, ev.name, "cr-scale-rejected"):
+                c.record_event(now, ev.name, f"CR scale rejected: {e}")
             if self._kube_source is not None:
                 self._kube_source.invalidate_child_projection(ev.name)
 
@@ -801,6 +891,9 @@ class Manager:
                 return  # already rejected this exact spec; don't re-event
             applied = self.apply_podcliqueset(incoming, actor="apiserver")
             self._rejected_workload_specs.pop(name, None)
+            # A successful apply ends any rejection episode for this CR.
+            self._heal_dedupe.reset(name, "cr-rejected")
+            self._heal_dedupe.reset(name, "cr-unparseable")
             if existing is not None:
                 # CR status is OURS (the operator is the status writer);
                 # a spec update must not reset reconciled state.
@@ -811,16 +904,20 @@ class Manager:
             # AFTER etcd accepted the object, so a rejected edit leaves the
             # CR and the store diverged until the user fixes the CR. Record
             # ONE event per distinct rejected spec — the status write-back
-            # echo would otherwise re-emit it every tick.
+            # echo would otherwise re-emit it every tick — and at most one
+            # per (object, reason) window: distinct bad specs arriving in
+            # quick succession are one heal episode, not an event flood.
             self._rejected_workload_specs[name] = spec_key
-            self.cluster.record_event(
-                now, name,
-                f"workload CR rejected: {'; '.join(str(x) for x in e.errors)}",
-            )
+            if self._heal_dedupe.should_emit(now, name, "cr-rejected"):
+                self.cluster.record_event(
+                    now, name,
+                    f"workload CR rejected: {'; '.join(str(x) for x in e.errors)}",
+                )
         except Exception as e:  # malformed CR must not kill the pump
-            self.cluster.record_event(
-                now, name, f"workload CR unparseable: {e}"
-            )
+            if self._heal_dedupe.should_emit(now, name, "cr-unparseable"):
+                self.cluster.record_event(
+                    now, name, f"workload CR unparseable: {e}"
+                )
 
     def attach_watch(self, source, backend=None) -> "object":
         """Feed the store from an external cluster's watch stream
@@ -890,6 +987,10 @@ class Manager:
             # Placement quality of live serving solves (quality/report.py
             # discipline — what `grove-tpu get quality` renders).
             "quality": self.controller.quality_status(),
+            # Flight recorder state (trace config section): journal path,
+            # records written/dropped, queue depth — what `grove-tpu trace
+            # info` points at and the grove_trace_* metrics are cut from.
+            "trace": self.trace_status(),
             # The effective ClusterTopology (config TAS levels + auto host
             # level) — what `grove-tpu get topology` renders (kubectl get
             # clustertopology analog; the kubernetes source also syncs it
@@ -905,6 +1006,43 @@ class Manager:
                 "nodes": len(self.cluster.nodes),
             },
         }
+
+    def trace_status(self) -> dict:
+        """JSON-able flight-recorder state for /statusz "trace"."""
+        if self.trace_recorder is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            **self.trace_recorder.stats(),
+            "healEventsSuppressed": self._heal_dedupe.suppressed,
+        }
+
+    def replay_verify(self) -> Optional[dict]:
+        """Replay this manager's own journal through the controller's warm
+        path and assert bitwise plan equivalence — the in-process
+        nondeterminism self-check. Divergences increment
+        grove_replay_divergence_total; returns the replay report doc (None
+        when tracing is off or the journal is empty). Re-solves every
+        journaled wave: an operator action (`grove-tpu trace replay`, tests,
+        a canary cron), not a per-reconcile step."""
+        if self.trace_recorder is None:
+            return None
+        from grove_tpu.trace.recorder import read_journal
+        from grove_tpu.trace.replay import replay_journal
+
+        self.trace_recorder.flush()
+        try:
+            records = read_journal(self.trace_recorder.path)
+        except FileNotFoundError:
+            return None
+        report = replay_journal(records, warm_path=self.controller.warm)
+        if report.divergence_count:
+            self._m_replay_divergence.inc(float(report.divergence_count))
+            self.log.error(
+                "replay divergence: solver nondeterminism regression",
+                divergences=report.divergence_count,
+            )
+        return report.to_doc()
 
     def _kube_ctx(self):
         """Memoized kube connection material (shared by the lease and the
@@ -952,6 +1090,11 @@ class Manager:
                 self.log.info(
                     "solver prewarm started", top_k=cfg.solver.prewarm_top_k
                 )
+        if self.trace_recorder is not None:
+            # Flight-recorder writer thread (bounded queue drains to atomic
+            # journal segments); stop() joins it after a final flush.
+            self.trace_recorder.start()
+            self.log.info("trace recorder started", path=cfg.trace.path)
         if cfg.leader_election.enabled:
             if cfg.cluster.source == "kubernetes":
                 # Apiserver-backed Lease: the only store EVERY replica of a
@@ -1374,21 +1517,27 @@ class Manager:
                 ctrl.autoscale(metrics, now)
             return continue_reconcile()
 
-        outcome = run_reconcile_flow(
-            [
-                ("autoscale", _timed("autoscale", _autoscale)),
-                ("sync_workloads", _timed("sync_workloads", _sync_workloads)),
-                ("rolling_updates", _step("rolling_updates", ctrl.rolling_updates)),
-                ("solve_pending", _timed("solve_pending", _solve)),
-                ("update_statuses", _step("update_statuses", ctrl.update_statuses)),
-                ("gang_termination", _step("gang_termination", ctrl.gang_termination)),
-                # Defrag background loop (config section `defrag`): interval-
-                # gated inside maybe_defrag, so this runs as a cheap no-op on
-                # every other pass and a score/plan/execute cycle when due.
-                ("defrag", _step("defrag", ctrl.maybe_defrag)),
-            ],
-            error_recorder=_record,
-        )
+        steps = [
+            ("autoscale", _timed("autoscale", _autoscale)),
+            ("sync_workloads", _timed("sync_workloads", _sync_workloads)),
+            ("rolling_updates", _step("rolling_updates", ctrl.rolling_updates)),
+            ("solve_pending", _timed("solve_pending", _solve)),
+            ("update_statuses", _step("update_statuses", ctrl.update_statuses)),
+            ("gang_termination", _step("gang_termination", ctrl.gang_termination)),
+            # Defrag background loop (config section `defrag`): interval-
+            # gated inside maybe_defrag, so this runs as a cheap no-op on
+            # every other pass and a score/plan/execute cycle when due.
+            ("defrag", _step("defrag", ctrl.maybe_defrag)),
+        ]
+        if self.trace_recorder is not None:
+            # Trace flow step: nudge the writer to persist this pass's
+            # records now — journal staleness is then bounded by the
+            # reconcile cadence, not only the flush interval (a crashed
+            # operator loses at most one pass of decisions).
+            steps.append(
+                ("trace", _step("trace", lambda _now: self.trace_recorder.request_flush()))
+            )
+        outcome = run_reconcile_flow(steps, error_recorder=_record)
         self._m_reconciles.inc()
         self._m_reconcile_seconds.observe(time.perf_counter() - t0)
         if outcome.has_errors:
@@ -1448,6 +1597,22 @@ class Manager:
             self._m_quality_pref.set(
                 float(quality.get("preferredFraction", 0.0))
             )
+        # Bounded-ring + flight-recorder counters (delta-exported, same
+        # discipline as the solve-pass and defrag counters).
+        delta = self.cluster.events_dropped - self._events_dropped_exported
+        if delta > 0:
+            self._m_events_dropped.inc(float(delta))
+            self._events_dropped_exported = self.cluster.events_dropped
+        if self.trace_recorder is not None:
+            for key, metric in (
+                ("recorded", self._m_trace_records),
+                ("dropped", self._m_trace_dropped),
+            ):
+                cur = getattr(self.trace_recorder, key)
+                delta = cur - self._trace_exported[key]
+                if delta > 0:
+                    metric.inc(float(delta))
+                    self._trace_exported[key] = cur
         limiter = getattr(self._kube_source, "limiter", None)
         if limiter is not None:
             delta = limiter.throttled - self._kube_throttled_exported
@@ -1510,6 +1675,10 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.trace_recorder is not None:
+            # Final flush + join BEFORE servers go down, so a stop-triggered
+            # journal read (tests, postmortems) sees every record.
+            self.trace_recorder.stop()
         if getattr(self, "_prewarm_thread", None) is not None:
             self._prewarm_thread.join()
             self._prewarm_thread = None

@@ -112,6 +112,19 @@ class Simulator:
 
     # --- fault injection ----------------------------------------------------------
 
+    def _journal_chaos(self, action: str, obj: str, **fields) -> None:
+        """Chaos events land in the flight-recorder journal (when the
+        controller carries one) so an incident trace shows the fault that
+        displaced a gang right next to the re-admission solve that healed
+        it."""
+        rec = getattr(self.controller, "recorder", None)
+        if rec is None:
+            return
+        try:
+            rec.capture_action(self.now, action, obj, **fields)
+        except Exception:  # noqa: BLE001 — tracing must never break the sim
+            pass
+
     def fail_pod(self, pod_name: str) -> None:
         """Hard failure (eviction/OOM-kill of the pod): phase Failed, inactive,
         replaced by the clique controller."""
@@ -121,6 +134,7 @@ class Simulator:
         pod.phase = PodPhase.FAILED
         pod.ready = False
         self.cluster.record_event(self.now, pod.pclq_fqn, f"pod {pod_name} failed")
+        self._journal_chaos("chaos.fail_pod", pod_name, clique=pod.pclq_fqn)
 
     def crash_pod(self, pod_name: str) -> None:
         """Crash loop: container exits non-zero and restarts forever. The pod
@@ -132,15 +146,19 @@ class Simulator:
         pod.crashlooping = True
         pod.ready = False
         self.cluster.record_event(self.now, pod.pclq_fqn, f"pod {pod_name} crash-looping")
+        self._journal_chaos("chaos.crash_pod", pod_name, clique=pod.pclq_fqn)
 
     def cordon(self, node_name: str) -> None:
         self.cluster.nodes[node_name].schedulable = False
+        self._journal_chaos("chaos.cordon", node_name)
 
     def uncordon(self, node_name: str) -> None:
         self.cluster.nodes[node_name].schedulable = True
+        self._journal_chaos("chaos.uncordon", node_name)
 
     def kill_node(self, node_name: str) -> None:
         """Node dies: cordon + every pod on it fails."""
+        self._journal_chaos("chaos.kill_node", node_name)
         self.cordon(node_name)
         for pod in self.cluster.pods.values():
             if pod.node_name == node_name and pod.is_active:

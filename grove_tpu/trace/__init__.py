@@ -1,0 +1,30 @@
+"""Decision flight recorder, deterministic replay, what-if counterfactuals.
+
+The control plane makes irreversible, hard-to-reproduce decisions (gang
+placement, preemption, defrag migrations). This package journals every solve
+wave off the hot path (`recorder.py`), rebuilds the solver inputs from a
+journal and re-solves them asserting bitwise plan equivalence (`replay.py` —
+any divergence is a solver-nondeterminism regression), and replays a journal
+against a modified fleet or solver config to score counterfactual capacity /
+policy changes with the placement-quality report (`whatif.py`).
+"""
+
+from grove_tpu.trace.recorder import (
+    SCHEMA_VERSION,
+    TraceRecorder,
+    TraceSchemaError,
+    read_journal,
+)
+from grove_tpu.trace.replay import ReplayReport, replay_journal
+from grove_tpu.trace.whatif import WhatIfReport, whatif_journal
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "TraceRecorder",
+    "TraceSchemaError",
+    "read_journal",
+    "ReplayReport",
+    "replay_journal",
+    "WhatIfReport",
+    "whatif_journal",
+]

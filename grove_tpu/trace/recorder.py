@@ -1,0 +1,431 @@
+"""The decision flight recorder: journal every solve wave off the hot path.
+
+The journal is a directory of SELF-CONTAINED segment files, each an atomic
+JSON document `{"version": N, "records": [...]}` written via the shared
+temp-file+rename primitive (`utils/fsio.atomic_write_json`) — readers never
+see a torn segment, and rotation/pruning cannot corrupt older ones. Two
+record kinds matter to replay:
+
+  fleet   the cluster fleet at one instant (nodes + topology), content-
+          addressed by digest and deduplicated — a wave references its fleet
+          by digest instead of re-serializing 5k nodes per tick. The writer
+          re-emits the referenced fleet record into every segment so each
+          segment replays standalone even after older segments are pruned.
+  wave    one solve wave: the exact encode inputs (serde-encoded sub-gangs
+          and their referenced pods, allocated rows, bound/reuse/spread
+          seeds, bucketing pads), the solver config fingerprint (weights,
+          portfolio, effective escalation width), the resulting plan with
+          per-gang verdicts/scores/rejection reasons, and timings.
+
+Everything else (`action` records: preemption, reclaim, defrag migration,
+rolling updates, gang termination, sim chaos) is narrative for `trace info`
+and incident forensics — replay re-solves wave records only.
+
+Hot-path discipline: `capture_wave` runs on the reconcile thread but only
+serde-encodes (a deep copy into plain JSON types — the pods mutate right
+after the solve, so the copy must be synchronous); file I/O happens on the
+bounded-queue writer thread. A full queue DROPS the record and counts it
+(`dropped`) rather than blocking a solve.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import queue
+import threading
+from typing import Any, Optional
+
+from grove_tpu.api import pod as pod_mod
+from grove_tpu.api import podgang as podgang_mod
+from grove_tpu.api import types as types_mod
+from grove_tpu.state import cluster as state_mod
+from grove_tpu.utils import serde
+from grove_tpu.utils.fsio import atomic_write_json
+
+# Journal schema. The replayer refuses a mismatched version outright: a
+# silent best-effort parse of an old journal would "replay" different solver
+# inputs and report fake divergence (or fake equivalence).
+SCHEMA_VERSION = 1
+
+_SEGMENT_GLOB = "segment-*.json"
+
+for _m in (types_mod, pod_mod, podgang_mod, state_mod):
+    serde.register_module(_m)
+
+
+class TraceSchemaError(ValueError):
+    """Journal version does not match this build's SCHEMA_VERSION."""
+
+
+def _jsonable(x: Any) -> Any:
+    """Coerce numpy scalars riding in verdict/score maps to plain JSON."""
+    if hasattr(x, "item"):
+        return x.item()
+    return x
+
+
+def fleet_payload(snapshot) -> dict:
+    """Fleet record body derived from the snapshot itself (the padded rows
+    are excluded — padding is re-derived at replay from `padNodesTo`)."""
+    nodes = []
+    for i, name in enumerate(snapshot.node_names):
+        cap = {
+            res: float(snapshot.capacity[i, j])
+            for j, res in enumerate(snapshot.resource_names)
+            if float(snapshot.capacity[i, j])
+        }
+        nodes.append(
+            {
+                "name": name,
+                "capacity": cap,
+                "labels": dict(snapshot.node_labels[i]),
+                "taints": list(snapshot.node_taints[i]),
+                "schedulable": bool(snapshot.schedulable[i]),
+            }
+        )
+    return {
+        "kind": "fleet",
+        "topology": snapshot.topology.levels_doc(),
+        "nodes": nodes,
+    }
+
+
+def fleet_digest_of(snapshot) -> tuple[str, dict]:
+    """(digest, payload) for the snapshot's fleet; memoized on the snapshot
+    object (immutable for its lifetime — defrag mutates only `allocated`,
+    which the fleet payload excludes)."""
+    cached = getattr(snapshot, "_trace_fleet", None)
+    if cached is not None:
+        return cached
+    payload = fleet_payload(snapshot)
+    digest = hashlib.blake2b(
+        json.dumps(payload, sort_keys=True).encode(), digest_size=16
+    ).hexdigest()
+    payload["digest"] = digest
+    snapshot._trace_fleet = (digest, payload)
+    return digest, payload
+
+
+class TraceRecorder:
+    """Bounded-queue journal writer with atomic segment rotation."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        max_records_per_file: int = 256,
+        max_files: int = 16,
+        queue_size: int = 2048,
+        flush_interval_seconds: float = 1.0,
+    ) -> None:
+        self.path = path
+        self.max_records_per_file = max(1, int(max_records_per_file))
+        self.max_files = max(1, int(max_files))
+        self.flush_interval_seconds = float(flush_interval_seconds)
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(queue_size)))
+        self._stop = threading.Event()
+        self._flush_now = threading.Event()
+        self._flush_done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # counters (observability: /statusz "trace", grove_trace_* metrics)
+        self.recorded = 0
+        self.dropped = 0
+        self.segments_written = 0
+        self.waves = 0
+        self.actions = 0
+        # fleet digests already enqueued this process (the writer re-emits
+        # per segment from its own payload cache).
+        self._announced: set[str] = set()
+
+    # ---- lifecycle ---------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        # Non-daemon: stop() joins; a daemon killed mid-rename could strand
+        # a temp file (harmless) but a clean join never does.
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._writer, name="grove-trace-writer", daemon=False
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+
+    def request_flush(self) -> None:
+        """Ask the writer to persist pending records now (the manager's
+        trace flow step calls this each reconcile, bounding journal staleness
+        by the reconcile cadence instead of the flush interval)."""
+        self._flush_now.set()
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Synchronous flush: block until the writer has drained what was
+        enqueued before this call and persisted it (replay_verify and tests
+        read the journal right after). False when no writer is running or
+        the wait timed out."""
+        if self._thread is None:
+            return False
+        self._flush_done.clear()
+        self._flush_now.set()
+        return self._flush_done.wait(timeout)
+
+    # ---- capture (reconcile thread) ----------------------------------------------
+
+    def record(self, rec: dict) -> bool:
+        """Enqueue one journal record; False (and counted) when full."""
+        try:
+            self._queue.put_nowait(rec)
+        except queue.Full:
+            self.dropped += 1
+            return False
+        self.recorded += 1
+        if rec.get("kind") == "wave":
+            self.waves += 1
+        elif rec.get("kind") == "action":
+            self.actions += 1
+        return True
+
+    def capture_action(self, now: float, action: str, obj: str, **fields) -> bool:
+        """Journal one control-plane decision action (preemption, reclaim,
+        defrag migration, rolling update, gang termination, sim chaos)."""
+        return self.record(
+            {"kind": "action", "now": float(now), "action": action,
+             "object": obj, **fields}
+        )
+
+    def capture_wave(
+        self,
+        *,
+        now: float,
+        wave: str,
+        snapshot,
+        gangs: list,
+        pods_by_name: dict,
+        scheduled_names,
+        bound_nodes: dict,
+        reuse_nodes: dict,
+        spread_avoid: dict,
+        max_groups,
+        max_sets,
+        max_pods,
+        pad_gangs_to,
+        params,
+        portfolio: int,
+        escalate_portfolio: int,
+        plan: dict,
+        ok_by_name: dict,
+        valid_by_name: dict,
+        scores: dict,
+        solve_seconds: float,
+    ) -> bool:
+        """Journal one solve wave — the full encode+solve input closure plus
+        the resulting plan. Serde-encoding here IS the synchronous deep copy;
+        the pods mutate (bind) immediately after the solve."""
+        digest, payload = fleet_digest_of(snapshot)
+        if digest not in self._announced:
+            if self.record(payload):
+                self._announced.add(digest)
+            else:
+                return False  # fleet dropped: a wave referencing it is unreplayable
+        names = {g.name for g in gangs}
+        ref_names = {
+            r.name
+            for g in gangs
+            for grp in g.spec.pod_groups
+            for r in grp.pod_references
+            if r.name in pods_by_name
+        }
+        allocated = {}
+        n_real = len(snapshot.node_names)
+        for i in range(n_real):
+            row = snapshot.allocated[i]
+            if row.any():
+                allocated[snapshot.node_names[i]] = [float(v) for v in row]
+        rejections = {}
+        for name in names:
+            if _jsonable(ok_by_name.get(name, False)):
+                continue
+            rejections[name] = (
+                "rejected (capacity/constraints)"
+                if _jsonable(valid_by_name.get(name, False))
+                else "not solver-valid (gated base or unresolvable constraint)"
+            )
+        rec = {
+            "kind": "wave",
+            "now": float(now),
+            "wave": wave,
+            "fleet": digest,
+            "padNodesTo": int(snapshot.capacity.shape[0]),
+            "resources": list(snapshot.resource_names),
+            "allocated": allocated,
+            "gangs": [serde.encode(g) for g in gangs],
+            "pods": {n: serde.encode(pods_by_name[n]) for n in sorted(ref_names)},
+            "scheduled": sorted(scheduled_names),
+            "boundNodes": {
+                g: {grp: list(map(int, idx)) for grp, idx in per.items()}
+                for g, per in bound_nodes.items()
+                if g in names
+            },
+            "reuseNodes": {
+                g: list(map(int, idx))
+                for g, idx in reuse_nodes.items()
+                if g in names
+            },
+            "spreadAvoid": {
+                g: list(map(int, idx))
+                for g, idx in spread_avoid.items()
+                if g in names
+            },
+            "maxGroups": max_groups,
+            "maxSets": max_sets,
+            "maxPods": max_pods,
+            "padGangsTo": pad_gangs_to,
+            "solver": {
+                "params": [float(w) for w in params],
+                "portfolio": int(portfolio),
+                "escalatePortfolio": int(escalate_portfolio),
+            },
+            "plan": {g: dict(b) for g, b in plan.items()},
+            "ok": {n: bool(_jsonable(ok_by_name.get(n, False))) for n in sorted(names)},
+            "valid": {
+                n: bool(_jsonable(valid_by_name.get(n, False))) for n in sorted(names)
+            },
+            "scores": {
+                n: float(_jsonable(scores.get(n, 0.0))) for n in sorted(names)
+            },
+            "rejections": rejections,
+            "solveSeconds": float(solve_seconds),
+        }
+        return self.record(rec)
+
+    # ---- writer thread -----------------------------------------------------------
+
+    def _writer(self) -> None:
+        seq = self._next_seq()
+        segment: list[dict] = []
+        seg_digests: set[str] = set()
+        fleets: dict[str, dict] = {}  # every fleet payload seen this process
+        dirty = False
+        import time as _time
+
+        last_flush = _time.monotonic()
+
+        def write_segment() -> None:
+            nonlocal dirty, last_flush
+            if segment:
+                atomic_write_json(
+                    os.path.join(self.path, f"segment-{seq:06d}.json"),
+                    {"version": SCHEMA_VERSION, "records": segment},
+                )
+                self.segments_written += 1
+            dirty = False
+            last_flush = _time.monotonic()
+
+        def rotate() -> None:
+            nonlocal seq, segment, seg_digests
+            write_segment()
+            seq += 1
+            segment = []
+            seg_digests = set()
+            self._prune()
+
+        while True:
+            try:
+                rec = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                rec = None
+            if rec is not None:
+                if rec.get("kind") == "fleet":
+                    fleets[rec["digest"]] = rec
+                    # Emitted into a segment only when a wave references it.
+                else:
+                    d = rec.get("fleet")
+                    if d and d not in seg_digests and d in fleets:
+                        segment.append(fleets[d])
+                        seg_digests.add(d)
+                    segment.append(rec)
+                    dirty = True
+                if len(segment) >= self.max_records_per_file:
+                    rotate()
+                continue  # drain the queue before honoring flush/stop
+            want_flush = self._flush_now.is_set()
+            interval_due = (
+                _time.monotonic() - last_flush >= self.flush_interval_seconds
+            )
+            if dirty and (want_flush or interval_due):
+                write_segment()
+            if want_flush:
+                self._flush_now.clear()
+                self._flush_done.set()  # flush(): everything enqueued before
+                # the request is now on disk (the queue drained first).
+            if self._stop.is_set() and self._queue.empty():
+                break
+        write_segment()
+        self._prune()
+
+    def _segments(self) -> list[str]:
+        return sorted(glob.glob(os.path.join(self.path, _SEGMENT_GLOB)))
+
+    def _next_seq(self) -> int:
+        seqs = []
+        for p in self._segments():
+            stem = os.path.basename(p)[len("segment-"):-len(".json")]
+            try:
+                seqs.append(int(stem))
+            except ValueError:
+                continue
+        return max(seqs) + 1 if seqs else 0
+
+    def _prune(self) -> None:
+        files = self._segments()
+        for p in files[: max(0, len(files) - self.max_files)]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass  # pruning is best-effort; the journal stays readable
+
+    def stats(self) -> dict:
+        """JSON-able recorder state for /statusz "trace" and the metrics."""
+        return {
+            "path": self.path,
+            "recorded": self.recorded,
+            "dropped": self.dropped,
+            "waves": self.waves,
+            "actions": self.actions,
+            "segmentsWritten": self.segments_written,
+            "queueDepth": self._queue.qsize(),
+        }
+
+
+def read_journal(path: str) -> list[dict]:
+    """Load a journal (directory of segments, or one segment file) into a
+    record list, oldest first. Raises TraceSchemaError on a version mismatch
+    — replaying a journal written by a different schema would rebuild
+    different solver inputs and report meaningless (non-)divergence."""
+    files = [path] if os.path.isfile(path) else sorted(
+        glob.glob(os.path.join(path, _SEGMENT_GLOB))
+    )
+    if not files:
+        raise FileNotFoundError(f"no trace journal at {path!r}")
+    records: list[dict] = []
+    for p in files:
+        with open(p) as f:
+            doc = json.load(f)
+        version = doc.get("version")
+        if version != SCHEMA_VERSION:
+            raise TraceSchemaError(
+                f"{p}: journal schema version {version!r} does not match this "
+                f"build's {SCHEMA_VERSION} — re-record the journal with this "
+                "build (or replay with the build that wrote it)"
+            )
+        records.extend(doc.get("records", []))
+    return records

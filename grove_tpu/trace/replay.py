@@ -1,0 +1,261 @@
+"""Deterministic replay: rebuild each journaled solve wave and re-solve it.
+
+The batched solver is deterministic in its inputs (seeded portfolio
+populations included), so re-encoding a wave's recorded input closure and
+re-solving it through the warm-path AOT executable cache must reproduce the
+recorded plan BITWISE — identical verdicts, identical pod→node bindings,
+identical placement scores. Any divergence on the same platform is a
+solver-nondeterminism regression (or journal corruption) and is reported as
+a structured diff; the manager surfaces the count as
+`grove_replay_divergence_total`.
+
+Cross-platform note: replaying a TPU-recorded journal on CPU can diverge
+legitimately (different aggregation path, float association). The regression
+gate replays on the recording platform; cross-platform replay is a
+conformance probe, not a correctness oracle.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from grove_tpu.api.types import ClusterTopology
+from grove_tpu.solver.core import SolverParams, decode_assignments, solve
+from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.state.cluster import ClusterSnapshot, Node, build_snapshot
+from grove_tpu.utils import serde
+
+
+@dataclass
+class WaveReplay:
+    """One wave's recorded-vs-replayed outcome."""
+
+    index: int  # position among wave records in the journal
+    wave: str  # floors | extras
+    gangs: int
+    recorded_admitted: int
+    replayed_admitted: int
+    recorded_solve_s: float
+    replayed_solve_s: float
+    divergences: list = field(default_factory=list)  # structured diffs
+
+    def to_doc(self) -> dict:
+        return {
+            "index": self.index,
+            "wave": self.wave,
+            "gangs": self.gangs,
+            "recordedAdmitted": self.recorded_admitted,
+            "replayedAdmitted": self.replayed_admitted,
+            "recordedSolveSeconds": round(self.recorded_solve_s, 4),
+            "replayedSolveSeconds": round(self.replayed_solve_s, 4),
+            "divergences": self.divergences,
+        }
+
+
+@dataclass
+class ReplayReport:
+    waves: list = field(default_factory=list)  # WaveReplay, journal order
+
+    @property
+    def divergence_count(self) -> int:
+        return sum(len(w.divergences) for w in self.waves)
+
+    @property
+    def recorded_solve_s(self) -> float:
+        return sum(w.recorded_solve_s for w in self.waves)
+
+    @property
+    def replayed_solve_s(self) -> float:
+        return sum(w.replayed_solve_s for w in self.waves)
+
+    def to_doc(self) -> dict:
+        return {
+            "waves": len(self.waves),
+            "divergences": self.divergence_count,
+            "recordedSolveSeconds": round(self.recorded_solve_s, 4),
+            "replayedSolveSeconds": round(self.replayed_solve_s, 4),
+            "diverged": [w.to_doc() for w in self.waves if w.divergences],
+        }
+
+
+def nodes_from_fleet(fleet: dict) -> list[Node]:
+    """Fleet record -> Node objects, in recorded order (order IS identity:
+    snapshot node indices derive from it)."""
+    return [
+        Node(
+            name=nd["name"],
+            capacity=dict(nd.get("capacity", {})),
+            labels=dict(nd.get("labels", {})),
+            schedulable=bool(nd.get("schedulable", True)),
+            taints=list(nd.get("taints", [])),
+        )
+        for nd in fleet["nodes"]
+    ]
+
+
+def topology_from_fleet(fleet: dict) -> ClusterTopology:
+    return ClusterTopology.from_dict({"name": "trace", "levels": fleet["topology"]})
+
+
+def snapshot_from_wave(
+    wave: dict, fleet: dict, nodes: list[Node] | None = None
+) -> ClusterSnapshot:
+    """Rebuild the wave's pre-solve snapshot: recorded fleet + recorded
+    per-node allocated rows (float32 round-trips JSON exactly — every f32 is
+    representable as a double)."""
+    snap = build_snapshot(
+        nodes if nodes is not None else nodes_from_fleet(fleet),
+        topology_from_fleet(fleet),
+        resource_names=tuple(wave["resources"]),
+        pad_nodes_to=wave["padNodesTo"],
+    )
+    for name, row in wave.get("allocated", {}).items():
+        if name in snap.node_index_map:
+            snap.allocated[snap.node_index(name)] = np.asarray(row, np.float32)
+    return snap
+
+
+def solve_wave_record(
+    wave: dict,
+    snapshot: ClusterSnapshot,
+    *,
+    warm=None,
+    params: SolverParams | None = None,
+    portfolio: int | None = None,
+    escalate_portfolio: int | None = None,
+) -> tuple[dict, dict, dict, float]:
+    """Re-encode + re-solve one wave record against `snapshot`; returns
+    (plan, ok_by_name, scores_by_name, solve_seconds). The solver config
+    defaults to the recorded fingerprint; the what-if path overrides it."""
+    gangs = [serde.decode(d) for d in wave["gangs"]]
+    pods = {n: serde.decode(d) for n, d in wave["pods"].items()}
+    cfg = wave["solver"]
+    t0 = time.perf_counter()
+    batch, decode = encode_gangs(
+        gangs,
+        pods,
+        snapshot,
+        max_groups=wave.get("maxGroups"),
+        max_sets=wave.get("maxSets"),
+        max_pods=wave.get("maxPods"),
+        pad_gangs_to=wave.get("padGangsTo"),
+        scheduled_gangs=set(wave.get("scheduled", [])),
+        bound_nodes_by_group=wave.get("boundNodes") or None,
+        reuse_nodes_by_gang=wave.get("reuseNodes") or None,
+        spread_avoid_by_gang=wave.get("spreadAvoid") or None,
+    )
+    result = solve(
+        snapshot,
+        batch,
+        params if params is not None else SolverParams(*cfg["params"]),
+        portfolio=portfolio if portfolio is not None else cfg["portfolio"],
+        escalate_portfolio=(
+            escalate_portfolio
+            if escalate_portfolio is not None
+            else cfg["escalatePortfolio"]
+        ),
+        warm=warm,
+    )
+    plan = decode_assignments(result, decode, snapshot)
+    elapsed = time.perf_counter() - t0
+    ok = dict(zip(decode.gang_names, (bool(x) for x in np.asarray(result.ok))))
+    scores = dict(
+        zip(decode.gang_names, (float(x) for x in np.asarray(result.placement_score)))
+    )
+    return plan, ok, scores, elapsed
+
+
+def diff_wave(wave: dict, plan: dict, ok: dict, scores: dict) -> list[dict]:
+    """Structured recorded-vs-replayed diff for one wave: verdict flips,
+    binding differences, and (for admitted gangs) exact score mismatches."""
+    divergences: list[dict] = []
+    rec_ok = wave["ok"]
+    rec_plan = wave["plan"]
+    rec_scores = wave.get("scores", {})
+    for gang in sorted(rec_ok):
+        r_ok = bool(rec_ok[gang])
+        p_ok = bool(ok.get(gang, False))
+        if r_ok != p_ok:
+            divergences.append(
+                {"gang": gang, "type": "verdict", "recorded": r_ok, "replayed": p_ok}
+            )
+            continue
+        if not r_ok:
+            continue
+        rb = rec_plan.get(gang, {})
+        pb = plan.get(gang, {})
+        if rb != pb:
+            moved = {
+                pod: [rb[pod], pb[pod]]
+                for pod in rb.keys() & pb.keys()
+                if rb[pod] != pb[pod]
+            }
+            divergences.append(
+                {
+                    "gang": gang,
+                    "type": "bindings",
+                    "moved": moved,
+                    "missing": sorted(rb.keys() - pb.keys()),
+                    "extra": sorted(pb.keys() - rb.keys()),
+                }
+            )
+            continue
+        r_score = rec_scores.get(gang)
+        p_score = scores.get(gang)
+        if r_score is not None and p_score is not None and r_score != p_score:
+            divergences.append(
+                {
+                    "gang": gang,
+                    "type": "score",
+                    "recorded": r_score,
+                    "replayed": p_score,
+                }
+            )
+    return divergences
+
+
+def replay_journal(records: list[dict], *, warm_path=None) -> ReplayReport:
+    """Replay every wave record in `records` (as returned by
+    `recorder.read_journal`), asserting bitwise plan equivalence. Raises
+    KeyError-derived ValueError when a wave references a fleet digest the
+    journal does not contain (dropped under queue pressure, or a hand-pruned
+    segment set)."""
+    from grove_tpu.solver.warm import WarmPath
+
+    warm = warm_path if warm_path is not None else WarmPath()
+    fleets: dict[str, dict] = {}
+    report = ReplayReport()
+    index = 0
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "fleet":
+            fleets[rec["digest"]] = rec
+            continue
+        if kind != "wave":
+            continue
+        fleet = fleets.get(rec["fleet"])
+        if fleet is None:
+            raise ValueError(
+                f"wave {index} references fleet {rec['fleet']!r} which this "
+                "journal does not contain (record dropped under queue "
+                "pressure, or segments pruned apart) — cannot replay"
+            )
+        snapshot = snapshot_from_wave(rec, fleet)
+        plan, ok, scores, elapsed = solve_wave_record(rec, snapshot, warm=warm)
+        report.waves.append(
+            WaveReplay(
+                index=index,
+                wave=rec.get("wave", "?"),
+                gangs=len(rec["ok"]),
+                recorded_admitted=sum(1 for v in rec["ok"].values() if v),
+                replayed_admitted=sum(1 for v in ok.values() if v),
+                recorded_solve_s=float(rec.get("solveSeconds", 0.0)),
+                replayed_solve_s=elapsed,
+                divergences=diff_wave(rec, plan, ok, scores),
+            )
+        )
+        index += 1
+    return report

@@ -1,0 +1,214 @@
+"""What-if counterfactuals: replay a journal against a modified world.
+
+The Tesserae/BandPilot evaluation loop (PAPERS.md) made native: every
+recorded solve wave is re-solved against an EDITED fleet (e.g. +1 rack) or
+an overridden solver configuration (different portfolio width, different
+score weights), and both the recorded and the counterfactual plans are
+scored with the placement-quality report (`quality/report.py`) — admitted
+ratio, mean placement score, preferred-domain fraction — plus the measured
+wave solve latency. The aggregate deltas answer "what would this capacity /
+policy change have bought us over this recorded window?".
+
+Scope: each wave replays against its own RECORDED pre-solve allocated state
+(per-decision counterfactual, the trace-replay evaluation idiom). Admissions
+the counterfactual adds do not cascade into later waves' allocated state —
+that would require re-simulating the whole control loop, which the sim
+harness does; this tool scores the recorded decision points.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from grove_tpu.quality.report import evaluate_placement
+from grove_tpu.solver.core import SolverParams
+from grove_tpu.solver.encode import next_pow2
+from grove_tpu.state.cluster import Node
+from grove_tpu.trace.replay import (
+    nodes_from_fleet,
+    snapshot_from_wave,
+    solve_wave_record,
+    topology_from_fleet,
+)
+from grove_tpu.utils import serde
+
+
+def add_racks(fleet: dict, count: int = 1) -> list[Node]:
+    """Recorded fleet + `count` cloned racks. The template is the rack of
+    the LAST recorded node (narrowest non-host level of the recorded
+    topology); clones keep its capacity/labels/taints shape with a fresh
+    rack label value and fresh hostnames, so the counterfactual asks "one
+    more rack of the same SKU", not an arbitrary fleet."""
+    nodes = nodes_from_fleet(fleet)
+    if count <= 0:
+        return nodes
+    topo = topology_from_fleet(fleet)
+    non_host = [
+        lvl for lvl in topo.sorted_levels() if lvl.domain.value != "host"
+    ]
+    if not non_host or not nodes:
+        raise ValueError("fleet has no non-host topology level to clone a rack in")
+    rack_key = non_host[-1].node_label_key
+    template_rack = nodes[-1].labels.get(rack_key)
+    template = [n for n in nodes if n.labels.get(rack_key) == template_rack]
+    if not template:
+        template = [nodes[-1]]
+    out = list(nodes)
+    for i in range(count):
+        for j, src in enumerate(template):
+            labels = dict(src.labels)
+            labels[rack_key] = f"whatif-r{i}"
+            out.append(
+                Node(
+                    name=f"whatif{i}h{j}",
+                    capacity=dict(src.capacity),
+                    labels=labels,
+                    schedulable=True,
+                    taints=[dict(t) for t in src.taints],
+                )
+            )
+    return out
+
+
+@dataclass
+class WhatIfWave:
+    index: int
+    recorded: dict  # quality-report doc of the recorded plan
+    counterfactual: dict  # quality-report doc of the counterfactual plan
+    recorded_solve_s: float
+    counterfactual_solve_s: float
+
+    def to_doc(self) -> dict:
+        return {
+            "index": self.index,
+            "recorded": self.recorded,
+            "counterfactual": self.counterfactual,
+            "recordedSolveSeconds": round(self.recorded_solve_s, 4),
+            "counterfactualSolveSeconds": round(self.counterfactual_solve_s, 4),
+        }
+
+
+@dataclass
+class WhatIfReport:
+    """Aggregate recorded-vs-counterfactual quality over the journal."""
+
+    waves: list = field(default_factory=list)  # WhatIfWave
+    edits: dict = field(default_factory=dict)  # what was changed
+
+    def _agg(self, which: str) -> dict:
+        gangs = sum(getattr(w, which)["gangs"] for w in self.waves)
+        admitted = sum(getattr(w, which)["admitted"] for w in self.waves)
+        scored = [
+            getattr(w, which)["meanPlacementScore"]
+            for w in self.waves
+            if getattr(w, which)["admitted"]
+        ]
+        return {
+            "gangs": gangs,
+            "admitted": admitted,
+            "admittedRatio": round(admitted / gangs, 4) if gangs else 0.0,
+            "meanPlacementScore": round(float(np.mean(scored)), 4) if scored else 0.0,
+        }
+
+    def to_doc(self) -> dict:
+        rec = self._agg("recorded")
+        cf = self._agg("counterfactual")
+        return {
+            "edits": self.edits,
+            "waves": len(self.waves),
+            "recorded": rec,
+            "counterfactual": cf,
+            "delta": {
+                "admitted": cf["admitted"] - rec["admitted"],
+                "admittedRatio": round(
+                    cf["admittedRatio"] - rec["admittedRatio"], 4
+                ),
+                "meanPlacementScore": round(
+                    cf["meanPlacementScore"] - rec["meanPlacementScore"], 4
+                ),
+            },
+            "recordedSolveSeconds": round(
+                sum(w.recorded_solve_s for w in self.waves), 4
+            ),
+            "counterfactualSolveSeconds": round(
+                sum(w.counterfactual_solve_s for w in self.waves), 4
+            ),
+        }
+
+
+def whatif_journal(
+    records: list[dict],
+    *,
+    add_rack_count: int = 0,
+    params: SolverParams | None = None,
+    portfolio: int | None = None,
+    escalate_portfolio: int | None = None,
+    warm_path=None,
+) -> WhatIfReport:
+    """Score every recorded wave against the counterfactual world. At least
+    one edit (fleet or solver config) should be given — with none this
+    degenerates to a scored replay."""
+    from grove_tpu.solver.warm import WarmPath
+
+    warm = warm_path if warm_path is not None else WarmPath()
+    fleets: dict[str, dict] = {}
+    cf_nodes_cache: dict[str, list[Node]] = {}
+    report = WhatIfReport(
+        edits={
+            "addRacks": add_rack_count,
+            "portfolio": portfolio,
+            "escalatePortfolio": escalate_portfolio,
+            "weights": None if params is None else [float(w) for w in params],
+        }
+    )
+    index = 0
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "fleet":
+            fleets[rec["digest"]] = rec
+            continue
+        if kind != "wave":
+            continue
+        fleet = fleets.get(rec["fleet"])
+        if fleet is None:
+            raise ValueError(
+                f"wave {index} references fleet {rec['fleet']!r} missing from "
+                "this journal — cannot evaluate"
+            )
+        gangs = [serde.decode(d) for d in rec["gangs"]]
+        pods = {n: serde.decode(d) for n, d in rec["pods"].items()}
+
+        # Recorded side: the plan as journaled, scored on the recorded fleet.
+        rec_snap = snapshot_from_wave(rec, fleet)
+        rec_report = evaluate_placement(gangs, pods, rec_snap, rec["plan"])
+
+        # Counterfactual side: edited fleet (node pad grows with the fleet)
+        # and/or overridden solver config, re-solved then scored.
+        if rec["fleet"] not in cf_nodes_cache:
+            cf_nodes_cache[rec["fleet"]] = add_racks(fleet, add_rack_count)
+        cf_nodes = cf_nodes_cache[rec["fleet"]]
+        cf_wave = dict(rec)
+        cf_wave["padNodesTo"] = max(rec["padNodesTo"], next_pow2(len(cf_nodes)))
+        cf_snap = snapshot_from_wave(cf_wave, fleet, nodes=cf_nodes)
+        cf_plan, _cf_ok, _cf_scores, cf_s = solve_wave_record(
+            cf_wave,
+            cf_snap,
+            warm=warm,
+            params=params,
+            portfolio=portfolio,
+            escalate_portfolio=escalate_portfolio,
+        )
+        cf_report = evaluate_placement(gangs, pods, cf_snap, cf_plan)
+        report.waves.append(
+            WhatIfWave(
+                index=index,
+                recorded=rec_report.to_doc(),
+                counterfactual=cf_report.to_doc(),
+                recorded_solve_s=float(rec.get("solveSeconds", 0.0)),
+                counterfactual_solve_s=cf_s,
+            )
+        )
+        index += 1
+    return report

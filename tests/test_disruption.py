@@ -201,6 +201,123 @@ def test_reuse_reservation_biases_placement():
     assert placed != default_nodes
 
 
+def test_chaos_recovery_crash_pod_journaled_and_readmitted(tmp_path):
+    """crash_pod: the crash-looping pod breaches the floor, gang termination
+    tears the replica down, the rebuilt gang re-admits — and both the chaos
+    event and the termination land in the flight-recorder journal."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+
+    cluster = _small_cluster(hosts=4, cpu=4.0)
+    ctrl, sim = _setup(cluster)
+    recorder = TraceRecorder(str(tmp_path / "journal"))
+    recorder.start()
+    ctrl.recorder = recorder
+    pcs = _one_clique_pcs("a", replicas=2, cpu="2")
+    pcs.spec.template.termination_delay_seconds = 10.0
+    cluster.podcliquesets["a"] = pcs
+    assert sim.run_until(
+        lambda: all(p.ready for p in cluster.pods.values() if p.is_active), 60
+    )
+    victim = next(p.name for p in cluster.pods.values())
+    sim.crash_pod(victim)
+    # Crash-looping pods never return Ready; recovery is the full loop:
+    # breach -> gang termination -> recreate -> re-solve -> Ready again.
+    assert sim.run_until(
+        lambda: victim not in cluster.pods
+        and all(p.ready for p in cluster.pods.values() if p.is_active)
+        and sum(1 for p in cluster.pods.values() if p.is_active) == 2,
+        120,
+    ), "displaced gang must be re-admitted whole"
+    recorder.stop()
+    actions = {
+        (r["action"], r["object"])
+        for r in read_journal(recorder.path)
+        if r["kind"] == "action"
+    }
+    assert ("chaos.crash_pod", victim) in actions
+    assert any(a == "gang-termination" for a, _ in actions)
+
+
+def test_chaos_recovery_cordon_journaled_and_readmitted(tmp_path):
+    """cordon + drain of the node's pods: replacements must land on OTHER
+    nodes (the cordoned one is unschedulable) and the gang comes back whole;
+    the cordon is journaled."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+
+    cluster = _small_cluster(hosts=4, cpu=4.0)
+    ctrl, sim = _setup(cluster)
+    recorder = TraceRecorder(str(tmp_path / "journal"))
+    recorder.start()
+    ctrl.recorder = recorder
+    cluster.podcliquesets["a"] = _one_clique_pcs("a", replicas=2, cpu="2")
+    assert sim.run_until(
+        lambda: all(p.ready for p in cluster.pods.values() if p.is_active), 60
+    )
+    node = next(
+        p.node_name for p in cluster.pods.values() if p.node_name is not None
+    )
+    sim.cordon(node)
+    for p in list(cluster.pods.values()):
+        if p.node_name == node:
+            sim.fail_pod(p.name)
+    assert sim.run_until(
+        lambda: all(
+            p.ready and p.node_name != node
+            for p in cluster.pods.values()
+            if p.is_active
+        )
+        and sum(1 for p in cluster.pods.values() if p.is_active) == 2,
+        60,
+    ), "drained pods must re-admit off the cordoned node"
+    recorder.stop()
+    actions = {
+        (r["action"], r["object"])
+        for r in read_journal(recorder.path)
+        if r["kind"] == "action"
+    }
+    assert ("chaos.cordon", node) in actions
+
+
+def test_chaos_recovery_kill_node_journaled_and_readmitted(tmp_path):
+    """kill_node: every pod on the node fails at once; the gang re-admits on
+    surviving nodes and the kill (plus the per-pod failures) is journaled."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+
+    cluster = _small_cluster(hosts=4, cpu=4.0)
+    ctrl, sim = _setup(cluster)
+    recorder = TraceRecorder(str(tmp_path / "journal"))
+    recorder.start()
+    ctrl.recorder = recorder
+    cluster.podcliquesets["a"] = _one_clique_pcs("a", replicas=2, cpu="2")
+    assert sim.run_until(
+        lambda: all(p.ready for p in cluster.pods.values() if p.is_active), 60
+    )
+    node = next(
+        p.node_name for p in cluster.pods.values() if p.node_name is not None
+    )
+    sim.kill_node(node)
+    assert sim.run_until(
+        lambda: all(
+            p.ready and p.node_name != node
+            for p in cluster.pods.values()
+            if p.is_active
+        )
+        and sum(1 for p in cluster.pods.values() if p.is_active) == 2,
+        60,
+    ), "gang must re-admit on surviving nodes"
+    recorder.stop()
+    records = read_journal(recorder.path)
+    actions = {
+        (r["action"], r["object"]) for r in records if r["kind"] == "action"
+    }
+    assert ("chaos.kill_node", node) in actions
+    assert any(a == "chaos.fail_pod" for a, _ in actions)
+    # The healing re-solve is in the journal too: a wave after the kill
+    # admits the displaced gang onto surviving nodes.
+    waves = [r for r in records if r["kind"] == "wave"]
+    assert any(r["plan"] for r in waves)
+
+
 def test_controller_collects_reuse_nodes_from_ref():
     """A gang whose ReuseReservationRef names a torn-down gang re-lands on the
     old gang's nodes."""

@@ -1693,14 +1693,16 @@ def test_kubectl_scale_child_cr_drives_operator(api, tmp_path):
 
         # Echo guard: keep reconciling; the projection's own writes must not
         # flap the override or spawn scale events.
-        events_before = len(m.cluster.events)
+        # Bounded ring: track by the monotonic event index, not a deque slice.
+        events_before = m.cluster.events_total
         for _ in range(5):
             t += 1.0
             m.reconcile_once(now=t)
             time.sleep(0.02)
-        scale_events = [
-            e for e in m.cluster.events[events_before:] if "scaled" in e[2]
-        ]
+        new_events = m.cluster.recent_events(
+            m.cluster.events_total - events_before
+        ) if m.cluster.events_total > events_before else []
+        scale_events = [e for e in new_events if "scaled" in e[2]]
         assert not scale_events, scale_events
 
         # Out-of-range external scale (HPA ceiling 5): rejected with an

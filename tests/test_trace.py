@@ -1,0 +1,351 @@
+"""Flight recorder, deterministic replay, what-if counterfactuals
+(grove_tpu/trace) + the satellite guarantees that ride with them: the
+bounded control-plane event ring and the heal-event dedupe window.
+
+The tier-1 determinism gate lives here: a recorded sim drain must replay
+BIT-IDENTICALLY (every recorded plan reproduced, zero divergence) — any
+divergence on the recording platform is a solver-nondeterminism regression.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import types
+
+import pytest
+
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.runtime.config import parse_operator_config
+from grove_tpu.sim.simulator import Simulator
+from grove_tpu.sim.workloads import _clique, _pcs, bench_topology, synthetic_cluster
+from grove_tpu.trace.recorder import (
+    SCHEMA_VERSION,
+    TraceRecorder,
+    TraceSchemaError,
+    read_journal,
+)
+from grove_tpu.trace.replay import replay_journal
+from grove_tpu.trace.whatif import whatif_journal
+
+
+def _small_fleet(racks=2, hosts=2, cpu=8.0):
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=1, racks_per_block=racks,
+        hosts_per_rack=hosts, cpu=cpu, tpu=0.0,
+    ):
+        cluster.nodes[n.name] = n
+    return cluster
+
+
+def _recorded_sim(tmp_path, n_jobs=3, racks=2, hosts=2, **recorder_kw):
+    """Cluster + controller + sim with a started recorder. n_jobs rack-packed
+    gangs of `hosts` x 8cpu on a `racks`-rack fleet: n_jobs > racks leaves
+    rejections in the journal (what the what-if needs)."""
+    cluster = _small_fleet(racks=racks, hosts=hosts)
+    recorder = TraceRecorder(str(tmp_path / "journal"), **recorder_kw)
+    recorder.start()
+    ctrl = GroveController(
+        cluster=cluster, topology=bench_topology(), recorder=recorder
+    )
+    sim = Simulator(cluster=cluster, controller=ctrl)
+    for i in range(n_jobs):
+        pcs = _pcs(
+            f"job{i}", cliques=[_clique("w", hosts, "8")],
+            constraint_domain="rack",
+        )
+        cluster.podcliquesets[pcs.metadata.name] = pcs
+    return cluster, ctrl, sim, recorder
+
+
+# --- tier-1 determinism gate -------------------------------------------------------
+
+
+def test_recorded_sim_drain_replays_bit_identical(tmp_path):
+    """Record a sim drain; replay must reproduce EVERY recorded plan with
+    zero divergence (bindings, verdicts, and scores all bitwise equal)."""
+    cluster, ctrl, sim, recorder = _recorded_sim(tmp_path)
+    sim.run(30)
+    recorder.stop()
+    records = read_journal(recorder.path)
+    waves = [r for r in records if r["kind"] == "wave"]
+    assert waves, "the drain must have journaled solve waves"
+    assert any(r["plan"] for r in waves), "some wave must carry admissions"
+    assert any(r["rejections"] for r in waves), (
+        "the overfilled backlog must journal per-gang rejection reasons"
+    )
+    report = replay_journal(records)
+    assert len(report.waves) == len(waves)
+    assert report.divergence_count == 0, report.to_doc()
+    for w in report.waves:
+        assert w.replayed_admitted == w.recorded_admitted
+
+
+def test_replay_detects_a_forged_plan_as_divergence(tmp_path):
+    """The diff actually fires: corrupt one recorded binding and the replay
+    must report a structured bindings divergence for exactly that gang."""
+    cluster, ctrl, sim, recorder = _recorded_sim(tmp_path)
+    sim.run(20)
+    recorder.stop()
+    records = read_journal(recorder.path)
+    forged = None
+    for rec in records:
+        if rec.get("kind") == "wave" and rec["plan"]:
+            gang, bindings = next(iter(rec["plan"].items()))
+            pod = next(iter(bindings))
+            bindings[pod] = "node-that-never-was"
+            forged = gang
+            break
+    assert forged is not None
+    report = replay_journal(records)
+    assert report.divergence_count >= 1
+    divs = [d for w in report.waves for d in w.divergences]
+    assert any(d["gang"] == forged and d["type"] == "bindings" for d in divs)
+
+
+# --- journal mechanics -------------------------------------------------------------
+
+
+def test_replayer_refuses_mismatched_schema_version(tmp_path):
+    path = tmp_path / "journal"
+    path.mkdir()
+    (path / "segment-000000.json").write_text(
+        json.dumps({"version": SCHEMA_VERSION + 1, "records": []})
+    )
+    with pytest.raises(TraceSchemaError, match="schema version"):
+        read_journal(str(path))
+
+
+def test_segments_rotate_and_replay_standalone(tmp_path):
+    """Small segments force rotation; every segment must be self-contained
+    (its waves' fleet records re-emitted into it), so replaying ONE segment
+    file works even after the others are pruned away."""
+    cluster, ctrl, sim, recorder = _recorded_sim(
+        tmp_path, max_records_per_file=2
+    )
+    sim.run(30)
+    recorder.stop()
+    segments = sorted(
+        f for f in os.listdir(recorder.path) if f.startswith("segment-")
+    )
+    assert len(segments) >= 2, "rotation must have produced multiple segments"
+    replayed_any = False
+    for seg in segments:
+        records = read_journal(os.path.join(recorder.path, seg))
+        wave_digests = {r["fleet"] for r in records if r["kind"] == "wave"}
+        fleet_digests = {r["digest"] for r in records if r["kind"] == "fleet"}
+        assert wave_digests <= fleet_digests, f"{seg} is not self-contained"
+        if wave_digests:
+            assert replay_journal(records).divergence_count == 0
+            replayed_any = True
+    assert replayed_any
+
+
+def test_recorder_bounded_queue_drops_and_counts(tmp_path):
+    """No writer running + a 1-slot queue: the hot path must DROP (and
+    count) rather than block the reconcile thread."""
+    recorder = TraceRecorder(str(tmp_path / "j"), queue_size=1)
+    assert recorder.record({"kind": "action", "now": 0.0, "action": "x", "object": "a"})
+    assert not recorder.record({"kind": "action", "now": 0.0, "action": "x", "object": "b"})
+    assert recorder.dropped == 1
+    assert recorder.stats()["dropped"] == 1
+
+
+def test_recorder_prunes_oldest_segments(tmp_path):
+    cluster, ctrl, sim, recorder = _recorded_sim(
+        tmp_path, max_records_per_file=1, max_files=3
+    )
+    sim.run(30)
+    recorder.stop()
+    segments = [f for f in os.listdir(recorder.path) if f.startswith("segment-")]
+    assert 0 < len(segments) <= 3
+
+
+# --- what-if counterfactuals -------------------------------------------------------
+
+
+def test_whatif_plus_one_rack_reports_quality_delta(tmp_path):
+    """The acceptance scenario: a journal with rack-packed rejections,
+    replayed against +1 rack, must report a positive admitted delta."""
+    cluster, ctrl, sim, recorder = _recorded_sim(tmp_path, n_jobs=3, racks=2)
+    sim.run(30)
+    recorder.stop()
+    records = read_journal(recorder.path)
+    report = whatif_journal(records, add_rack_count=1)
+    doc = report.to_doc()
+    assert doc["waves"] >= 1
+    assert doc["delta"]["admitted"] > 0
+    assert doc["delta"]["admittedRatio"] > 0
+    assert doc["counterfactual"]["admittedRatio"] > doc["recorded"]["admittedRatio"]
+    # Placement score stays a reported (possibly zero) delta.
+    assert "meanPlacementScore" in doc["delta"]
+
+
+# --- manager wiring ----------------------------------------------------------------
+
+
+def _trace_manager(tmp_path):
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "trace": {"enabled": True, "path": str(tmp_path / "journal")},
+            "controllers": {"eventsBuffer": 64},
+        }
+    )
+    assert not errors
+    cluster = _small_fleet()
+    return Manager(cfg, cluster=cluster)
+
+
+def test_manager_wires_recorder_statusz_and_replay_verify(tmp_path):
+    m = _trace_manager(tmp_path)
+    m.start()
+    try:
+        pcs = _pcs("job0", cliques=[_clique("w", 2, "8")], constraint_domain="rack")
+        m.cluster.podcliquesets["job0"] = pcs
+        for t in range(3):
+            m.reconcile_once(now=float(t))
+        st = m.statusz()["trace"]
+        assert st["enabled"] and st["waves"] >= 1
+        assert m.cluster.events.maxlen == 64  # controllers.eventsBuffer applied
+        doc = m.replay_verify()
+        assert doc is not None and doc["divergences"] == 0
+        assert m.metrics.counter("grove_replay_divergence_total").value() == 0
+        assert (
+            m.metrics.counter("grove_trace_records_total").value()
+            >= st["waves"]
+        )
+    finally:
+        m.stop()
+
+
+def test_trace_config_validation():
+    _, errors = parse_operator_config(
+        {
+            "trace": {
+                "enabled": True,
+                "path": "",
+                "maxRecordsPerFile": 0,
+                "queueSize": -1,
+                "flushIntervalSeconds": 0,
+            },
+            "controllers": {"eventsBuffer": 0, "healEventDedupeSeconds": -1},
+        }
+    )
+    msgs = "\n".join(errors)
+    for field in (
+        "trace.path",
+        "trace.maxRecordsPerFile",
+        "trace.queueSize",
+        "trace.flushIntervalSeconds",
+        "controllers.eventsBuffer",
+        "controllers.healEventDedupeSeconds",
+    ):
+        assert field in msgs, f"{field} missing from: {msgs}"
+
+
+# --- bounded event ring (satellite: store.py) --------------------------------------
+
+
+def test_event_ring_is_bounded_and_counts_drops():
+    c = Cluster()
+    c.set_events_maxlen(5)
+    for i in range(12):
+        c.record_event(float(i), "obj", f"msg {i}")
+    assert len(c.events) == 5
+    assert c.events_dropped == 7
+    assert c.events_total == 12
+    # Newest survive; recent_events slices the tail deque-safely.
+    assert [msg for _, _, msg in c.recent_events(2)] == ["msg 10", "msg 11"]
+    # Growing the ring preserves the retained events.
+    c.set_events_maxlen(10)
+    assert len(c.events) == 5
+
+
+def test_watch_event_publish_survives_ring_overflow():
+    """The watch driver's event mirror tracks the MONOTONIC event index:
+    events that fall off the bounded ring before a push are skipped, never
+    re-published, and never crash the slice math."""
+    from grove_tpu.cluster.watch import WatchDriver
+
+    c = Cluster()
+    c.set_events_maxlen(4)
+    published: list = []
+
+    class _Source:
+        def poll(self, now):
+            return []
+
+        def push(self, *a, **k):
+            return 0
+
+        def publish_events(self, batch):
+            published.extend(batch)
+            return len(batch)
+
+    driver = WatchDriver(cluster=c, source=_Source())
+    for i in range(3):
+        c.record_event(float(i), "o", f"m{i}")
+    driver.push(0.0)
+    assert [m for _, _, m in published] == ["m0", "m1", "m2"]
+    # Overflow the ring between pushes: m3..m9 recorded, ring keeps last 4.
+    for i in range(3, 10):
+        c.record_event(float(i), "o", f"m{i}")
+    driver.push(1.0)
+    # m3..m5 fell off unpublished (gone either way); m6..m9 publish once.
+    assert [m for _, _, m in published][3:] == ["m6", "m7", "m8", "m9"]
+    driver.push(2.0)
+    assert len(published) == 7  # no re-publish
+
+
+# --- heal-event dedupe window (satellite: manager.py) ------------------------------
+
+
+def _scale_event(name, replicas):
+    return types.SimpleNamespace(
+        type=types.SimpleNamespace(value="MODIFIED"),
+        name=name,
+        obj={"spec": {"replicas": replicas}},
+    )
+
+
+def test_heal_event_dedupe_window_regression(tmp_path):
+    """An external writer FLAPPING between two distinct out-of-range scale
+    values defeats the last-value guard (each flip is a 'new' value); the
+    (object, reason) window must hold the event ring to one heal event per
+    window, then re-arm after it elapses."""
+    from grove_tpu.api.constants import MAX_SCALE_REPLICAS
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "controllers": {"healEventDedupeSeconds": 60},
+        }
+    )
+    assert not errors
+    m = Manager(cfg, cluster=_small_fleet())
+    pcs = _pcs("job0", cliques=[_clique("w", 2, "1")])
+    m.cluster.podcliquesets["job0"] = pcs
+    m.controller.sync_workload(pcs, 0.0)
+    target = next(iter(m.cluster.podcliques))
+
+    bad_a, bad_b = MAX_SCALE_REPLICAS + 1, MAX_SCALE_REPLICAS + 2
+    for i in range(6):  # flap a/b/a/b... inside one window
+        m._apply_child_scale_event(_scale_event(target, bad_a if i % 2 == 0 else bad_b), now=float(i))
+    heals = [e for e in m.cluster.events if "CR scale rejected" in e[2]]
+    assert len(heals) == 1, heals
+    assert m._heal_dedupe.suppressed >= 5
+    # Window elapsed: the next flap is a NEW episode and must event again.
+    m._apply_child_scale_event(_scale_event(target, bad_a), now=100.0)
+    heals = [e for e in m.cluster.events if "CR scale rejected" in e[2]]
+    assert len(heals) == 2, heals
+    # The value guard still exists UNDER the window: an identical replay at
+    # the same value emits nothing and doesn't even consult the window.
+    m._apply_child_scale_event(_scale_event(target, bad_a), now=200.0)
+    heals = [e for e in m.cluster.events if "CR scale rejected" in e[2]]
+    assert len(heals) == 2, heals
