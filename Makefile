@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -63,6 +63,12 @@ bench-replay:    ## flight recorder: record a sim drain -> bitwise replay -> +1-
 
 bench-replay-cpu: ## replay scenario with the TPU-relay probe skipped
 	GROVE_BENCH_SCENARIO=replay GROVE_FORCE_CPU=1 $(PY) bench.py
+
+bench-scale:     ## fleet-scale sweep: dense vs candidate-pruned solve at GROVE_BENCH_SCALES (1,2,4)
+	GROVE_BENCH_SCENARIO=scale $(PY) bench.py
+
+bench-scale-cpu: ## scale sweep with the TPU-relay probe skipped
+	GROVE_BENCH_SCENARIO=scale GROVE_FORCE_CPU=1 $(PY) bench.py
 
 test-kind:       ## kubernetes-source tier against a REAL cluster; clean skip without a kubeconfig
 	@if $(PY) -c "from grove_tpu.cluster.kubernetes import load_kube_context; load_kube_context()" >/dev/null 2>&1; then \

@@ -28,6 +28,16 @@ default 540 — below the driver's kill timeout), GROVE_BENCH_CPU_RESERVE_S
 (time kept back for the CPU-fallback run, default 180; everything before the
 reserve is spent probing the relay), GROVE_FORCE_CPU=1 (skip probing, run on
 CPU).
+
+Scale scenario (GROVE_BENCH_SCENARIO=scale, `make bench-scale`):
+GROVE_BENCH_SCALES (comma list of FLEET multipliers at a fixed backlog,
+default "1,2,4"), GROVE_BENCH_SCALE_RACKS (base racks per block, 16),
+GROVE_BENCH_SCALE_BACKLOG_FRAC (backlog size fraction, 1.0),
+GROVE_BENCH_PRUNE_MAX / GROVE_BENCH_PRUNE_MIN_FLEET (solver.pruning knobs).
+The relay probe verdict persists under /tmp/grove-tpu-state with a TTL
+(GROVE_PLATFORM_PROBE_TTL_S, default 900; GROVE_PLATFORM_PROBE_TIMEOUT_S and
+GROVE_PLATFORM_PROBE_MAX_ATTEMPTS tune the loop) — a wedged relay costs one
+probe loop per window, not one per bench run.
 """
 
 from __future__ import annotations
@@ -659,6 +669,133 @@ def run_replay_bench() -> dict:
     return out
 
 
+def run_scale_bench() -> dict:
+    """Fleet-scale scenario (`make bench-scale` / GROVE_BENCH_SCENARIO=scale):
+    dense vs candidate-pruned solve across growing FLEETS under a FIXED
+    backlog — the pruning claim is that solve time tracks the candidate
+    axis (workload-determined), not the fleet axis.
+
+    Sweeps GROVE_BENCH_SCALES (default "1,2,4"): each scale multiplies the
+    rack count while the gang backlog stays constant. Per scale, the same
+    backlog drains twice — dense (full node axis) and pruned
+    (solver/pruning.py candidate axis) — through two warm paths SHARED
+    across the sweep: the dense path re-lowers at every scale (the node pad
+    changed), the pruned path must pay ZERO new lowerings after the first
+    pruned scale (same candidate bucket => same executables, the
+    cache-key-independence acceptance gate). Reports per-scale solve times,
+    candidate-axis sizes, escalation counts, and admitted-set parity; the
+    headline value is the pruned-vs-dense speedup at the top scale
+    (vs_baseline >= 1.0 means the >= 2x target holds)."""
+    import numpy as np
+
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import (
+        bench_topology,
+        synthetic_backlog,
+        synthetic_cluster,
+    )
+    from grove_tpu.solver.core import SolverParams
+    from grove_tpu.solver.drain import drain_backlog
+    from grove_tpu.solver.pruning import PruningConfig
+    from grove_tpu.solver.warm import WarmPath
+    from grove_tpu.state import build_snapshot
+
+    scales = [
+        float(s)
+        for s in os.environ.get("GROVE_BENCH_SCALES", "1,2,4").split(",")
+        if s.strip()
+    ]
+    wave_size = int(os.environ.get("GROVE_BENCH_WAVE", "256"))
+    base_racks = int(os.environ.get("GROVE_BENCH_SCALE_RACKS", "16"))
+    backlog_frac = float(os.environ.get("GROVE_BENCH_SCALE_BACKLOG_FRAC", "1.0"))
+    pruning = PruningConfig(
+        enabled=True,
+        max_candidates=int(os.environ.get("GROVE_BENCH_PRUNE_MAX", "8191")),
+        min_fleet=int(os.environ.get("GROVE_BENCH_PRUNE_MIN_FLEET", "256")),
+    )
+
+    topo = bench_topology()
+    backlog = synthetic_backlog(
+        n_disagg=max(1, round(350 * backlog_frac)),
+        n_agg=max(1, round(250 * backlog_frac)),
+        n_frontend=max(1, round(300 * backlog_frac)),
+    )
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+
+    wp_dense = WarmPath()
+    wp_pruned = WarmPath()
+    points = []
+    parity = True
+    for scale in scales:
+        nodes = synthetic_cluster(racks_per_block=max(1, round(base_racks * scale)))
+        snapshot = build_snapshot(nodes, topo)
+        b_dense, s_dense = drain_backlog(
+            gangs, pods, snapshot, wave_size=wave_size,
+            params=SolverParams(), warm_path=wp_dense,
+        )
+        lower0 = wp_pruned.executables.lowerings
+        b_pruned, s_pruned = drain_backlog(
+            gangs, pods, snapshot, wave_size=wave_size,
+            params=SolverParams(), warm_path=wp_pruned, pruning=pruning,
+        )
+        same = set(b_dense) == set(b_pruned)
+        parity = parity and same
+        points.append(
+            {
+                "scale": scale,
+                "nodes": len(nodes),
+                "gangs": len(gangs),
+                "dense_total_s": round(s_dense.total_s, 3),
+                "pruned_total_s": round(s_pruned.total_s, 3),
+                "speedup": round(s_dense.total_s / s_pruned.total_s, 2)
+                if s_pruned.total_s > 0
+                else None,
+                "admitted_dense": s_dense.admitted,
+                "admitted_pruned": s_pruned.admitted,
+                "admitted_equal": same,
+                "pruned_waves": s_pruned.pruned_waves,
+                "candidate_nodes": s_pruned.candidate_nodes,
+                "candidate_pad": s_pruned.candidate_pad,
+                "escalations": s_pruned.escalations,
+                "escalations_adopted": s_pruned.escalations_adopted,
+                "pruned_lowerings": wp_pruned.executables.lowerings - lower0,
+                "prune_s": round(s_pruned.prune_s, 3),
+            }
+        )
+    top = points[-1]
+    # Cache-key independence: after the FIRST pruned scale, later scales
+    # must re-use the candidate-bucket executables byte-for-byte.
+    first_pruned = next((p for p in points if p["pruned_waves"] > 0), None)
+    reuse_ok = all(
+        p["pruned_lowerings"] == 0
+        for p in points
+        if first_pruned is not None and p["scale"] > first_pruned["scale"]
+    )
+    speedup = top["speedup"] or 0.0
+    return {
+        "scenario": "scale",
+        "metric": "scale_pruned_speedup",
+        "unit": "x",
+        "value": speedup,
+        # >= 1.0 = the >= 2x-at-top-scale target holds AND pruned/dense
+        # admitted the identical gang set at every scale AND the pruned
+        # executables were fleet-pad independent.
+        "vs_baseline": round(
+            (speedup / 2.0) * (1.0 if parity and reuse_ok else 0.0), 3
+        ),
+        "scales": scales,
+        "wave_size": wave_size,
+        "max_candidates": pruning.max_candidates,
+        "admitted_parity": parity,
+        "exec_reuse_across_scales": reuse_ok,
+        "points": points,
+    }
+
+
 def run_quality_bench() -> dict:
     """Placement-quality scenario (`make bench-quality` /
     GROVE_BENCH_SCENARIO=quality): the quality report as the headline.
@@ -828,6 +965,12 @@ def main() -> int:
             _RESULT["metric"] = "replay_divergence_total"
             _RESULT["unit"] = "count"
             extras = run_replay_bench()
+        elif scenario == "scale":
+            # Fleet-scale scenario (`make bench-scale`): dense vs candidate-
+            # pruned solve across growing fleets under a fixed backlog.
+            _RESULT["metric"] = "scale_pruned_speedup"
+            _RESULT["unit"] = "x"
+            extras = run_scale_bench()
         else:
             extras = run_bench()
         extras["ts_utc"] = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())

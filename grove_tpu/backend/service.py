@@ -212,6 +212,9 @@ class TPUSchedulerBackend:
         self._solver_config = solver_config or SolverConfig()
         # Frozen config -> build once; Solve is the p99-tuned path.
         self._solver_params = self._solver_config.solver_params()
+        # Candidate pruning (solver/pruning.py): sidecar Solve RPCs ride the
+        # same pruned path as the in-process controller when configured.
+        self._pruning = self._solver_config.pruning_config()
         # Host-config defaults; an Init carrying priority_classes overrides.
         self._priority_classes: dict[str, int] = dict(priority_classes or {})
 
@@ -612,6 +615,7 @@ class TPUSchedulerBackend:
             portfolio=self._solver_config.portfolio,
             escalate_portfolio=esc,
             warm=self._warm,
+            pruning=self._pruning,
         )
         bindings = decode_assignments(result, decode, snapshot)
         self._m_encode_reuse.inc(self._warm.encode_rows.hits - h0)

@@ -178,7 +178,9 @@ def _get_table(client: GroveClient, kind: str) -> str:
         return _table(rows, ["NAME", "PARENT", "QUOTA", "LIMIT", "USED"])
     if kind == "solver":
         # Solver health at a glance: pass dispositions (damper
-        # effectiveness) + warm-path cache traffic from /statusz.
+        # effectiveness), warm-path cache traffic, candidate-pruning
+        # counters, and the last drain's measured wave-harvest p50/p99 —
+        # all from /statusz.
         st = client.statusz()
         passes = st.get("solvePasses", {})
         rows = [
@@ -188,6 +190,15 @@ def _get_table(client: GroveClient, kind: str) -> str:
         rows += [
             ["warmPath." + k, v]
             for k, v in sorted(st.get("warmPath", {}).items())
+        ]
+        solver_doc = st.get("solver", {})
+        rows += [
+            ["pruning." + k, v if not isinstance(v, list) else ",".join(map(str, v))]
+            for k, v in sorted(solver_doc.get("pruning", {}).items())
+        ]
+        rows += [
+            ["lastDrain." + k, v]
+            for k, v in sorted(solver_doc.get("lastDrain", {}).items())
         ]
         return _table(rows, ["METRIC", "VALUE"])
     if kind == "defrag":

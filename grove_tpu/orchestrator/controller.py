@@ -78,6 +78,12 @@ class GroveController:
     max_sets: int | None = None
     max_pods: int | None = None
     pad_gangs_to: int | None = None
+    # candidate-node pruning (solver.pruning config -> pruning_config()):
+    # when set, per-tick solves and defrag planning solves run on the
+    # gathered candidate sub-fleet with exactness escalation — the AOT
+    # executable cache then keys on the candidate pad, not the fleet pad
+    # (solver/pruning.py; stats on warm.prune)
+    pruning: object | None = None
     # portfolio width: >1 solves each wave under P weight variants, winner
     # kept (solver.portfolio; parallel/portfolio.py)
     portfolio: int = 1
@@ -815,6 +821,9 @@ class GroveController:
             # whose shapes recur never re-lowers, and unchanged capacity/
             # topology/free tensors skip the per-tick host->device upload.
             warm=self.warm,
+            # Candidate pruning (solver.pruning config): solve on the
+            # gathered sub-fleet; lossy rejections escalate dense.
+            pruning=self.pruning,
         )
         bindings = decode_assignments(result, decode, snapshot)
         solve_seconds = time.perf_counter() - t_solve0
@@ -851,6 +860,7 @@ class GroveController:
                     params=self.solver_params,
                     portfolio=self.portfolio,
                     escalate_portfolio=esc,
+                    pruning=self.pruning,
                     plan=bindings,
                     ok_by_name=ok_by_name,
                     valid_by_name=valid_by_name,
@@ -1634,6 +1644,7 @@ class GroveController:
             warm=self.warm,
             max_moves=self.defrag_max_moves,
             min_efficiency=self.defrag_min_efficiency,
+            pruning=self.pruning,
         )
         if plan is None:
             summary["deferred"] = "no improving plan"

@@ -182,6 +182,20 @@ class SolverConfig:
     # Score-weight overrides (SolverParams fields, camelCase: wTight, wPref,
     # wReuse, wReserve, wSpread). Unset fields keep their defaults.
     weights: dict = field(default_factory=dict)
+    # Candidate-node pruning (solver/pruning.py): a cheap host pre-filter
+    # gathers the nodes that could possibly serve any gang in the wave onto
+    # a compact pow2 candidate axis, the unchanged batched solver runs on
+    # the sub-fleet, and the AOT executable cache keys on the CANDIDATE pad
+    # instead of the fleet pad (executables stop growing with fleet size).
+    # Lossy rejections escalate to a dense re-solve — admitted sets match
+    # the dense solver, escalations counted, never silent. Keys:
+    #   enabled        bool, default false
+    #   maxCandidates  int >= 1, candidate budget (default 8191 — pairs with
+    #                  the 8192 bucket + the cap-anchor pad row)
+    #   padLadder      list of increasing ints; [] = every pow2 from minPad
+    #   minPad         int >= 2, smallest candidate bucket (default 64)
+    #   minFleet       int >= 0, fleets below this never prune (default 256)
+    pruning: dict = field(default_factory=dict)
 
     def solver_params(self):
         """SolverConfig.weights -> SolverParams (validated at config load)."""
@@ -189,6 +203,25 @@ class SolverConfig:
 
         snake = {_CAMEL_FIELDS.get(k, k): float(v) for k, v in self.weights.items()}
         return SolverParams(**snake)
+
+    def pruning_config(self):
+        """SolverConfig.pruning -> solver.pruning.PruningConfig, or None
+        when pruning is disabled (validated at config load)."""
+        p = self.pruning or {}
+        if not p.get("enabled", False):
+            return None
+        from grove_tpu.solver.pruning import PruningConfig
+
+        kwargs = {}
+        if "maxCandidates" in p:
+            kwargs["max_candidates"] = int(p["maxCandidates"])
+        if "padLadder" in p:
+            kwargs["pad_ladder"] = tuple(int(x) for x in p["padLadder"])
+        if "minPad" in p:
+            kwargs["min_pad"] = int(p["minPad"])
+        if "minFleet" in p:
+            kwargs["min_fleet"] = int(p["minFleet"])
+        return PruningConfig(enabled=True, **kwargs)
 
 
 @dataclass
@@ -636,6 +669,38 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             seen_weights[field_name] = wk
             if not isinstance(wv, (int, float)) or isinstance(wv, bool) or not _math.isfinite(float(wv)):
                 errors.append(f"solver.weights.{wk}: {wv!r} is not a finite number")
+    pr = cfg.solver.pruning
+    if not isinstance(pr, dict):
+        errors.append("solver.pruning: must be a mapping")
+    elif pr:
+        _PRUNING_KEYS = {
+            "enabled", "maxCandidates", "padLadder", "minPad", "minFleet",
+        }
+        for pk in pr:
+            if pk not in _PRUNING_KEYS:
+                errors.append(f"solver.pruning.{pk}: unknown field")
+        if "enabled" in pr and not isinstance(pr["enabled"], bool):
+            errors.append("solver.pruning.enabled: must be a boolean")
+        for pk, lo in (("maxCandidates", 1), ("minPad", 2), ("minFleet", 0)):
+            if pk in pr and (
+                not isinstance(pr[pk], int)
+                or isinstance(pr[pk], bool)
+                or pr[pk] < lo
+            ):
+                errors.append(f"solver.pruning.{pk}: must be an int >= {lo}")
+        ladder = pr.get("padLadder")
+        if ladder is not None:
+            if not isinstance(ladder, list) or any(
+                not isinstance(v, int) or isinstance(v, bool) or v < 2
+                for v in ladder
+            ):
+                errors.append(
+                    "solver.pruning.padLadder: must be a list of ints >= 2"
+                )
+            elif any(b <= a for a, b in zip(ladder, ladder[1:])):
+                errors.append(
+                    "solver.pruning.padLadder: must be strictly increasing"
+                )
     df = cfg.defrag
     if not isinstance(df.threshold, (int, float)) or isinstance(
         df.threshold, bool

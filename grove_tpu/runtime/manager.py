@@ -510,6 +510,7 @@ class Manager:
             pad_gangs_to=config.solver.pad_gangs_to,
             portfolio=config.solver.portfolio,
             portfolio_escalation=config.solver.portfolio_escalation,
+            pruning=config.solver.pruning_config(),
             auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
             slice_resource_name=config.network_acceleration.slice_resource_name,
             initc_server_url=config.servers.advertise_url,
@@ -707,6 +708,19 @@ class Manager:
             "Apiserver requests delayed by the QPS/Burst token bucket",
         )
         self._kube_throttled_exported = 0
+        # Candidate-pruning observability (solver/pruning.py): the last
+        # pruned solve's candidate-axis size (gauge) and the exactness-
+        # escalation counter (lossy rejection -> dense re-solve; delta-
+        # exported from warm.prune, same discipline as solve passes).
+        self._m_candidate_nodes = self.metrics.gauge(
+            "grove_solver_candidate_nodes",
+            "Candidate-axis size of the last pruned solve (0 = dense)",
+        )
+        self._m_candidate_escalations = self.metrics.counter(
+            "grove_solver_candidate_escalations_total",
+            "Pruned-solve rejections re-verified by a dense re-solve",
+        )
+        self._prune_escalations_exported = 0
         # Every (queue, resource) series ever emitted — re-zeroed each pass
         # when usage disappears (gauge values persist otherwise).
         self._queue_metric_keys: dict[str, set] = {}
@@ -976,10 +990,14 @@ class Manager:
             # Damper effectiveness: solve waves by disposition.
             "solvePasses": dict(self.controller.solve_pass_counts),
             # Warm-path caches (solver/warm.py): AOT executable hits/misses/
-            # lowerings + prewarm count, device-resident tensor reuse, and
-            # per-gang encode-row reuse — the measurable side of the
-            # compile-amortization discipline.
+            # lowerings + prewarm count, device-resident tensor reuse,
+            # per-gang encode-row reuse, candidate-pruning counters, and the
+            # last drain's measured wave-harvest latencies — the measurable
+            # side of the compile-amortization discipline.
             "warmPath": self.controller.warm.stats(),
+            # Candidate-pruning view (solver/pruning.py): effective config +
+            # the counters the grove_solver_candidate_* metrics are cut from.
+            "solver": self.solver_status(),
             # Defrag loop state: last fragmentation report, plan summary,
             # in-flight migrations, monotonic counters (what `grove-tpu get
             # defrag` renders).
@@ -1006,6 +1024,29 @@ class Manager:
                 "nodes": len(self.cluster.nodes),
             },
         }
+
+    def solver_status(self) -> dict:
+        """JSON-able solver view for /statusz "solver" and `grove-tpu get
+        solver`: the effective pruning configuration plus its counters and
+        the last drain's wave-harvest latencies (warm.stats carries the
+        same counters flat; this section adds the config context)."""
+        pruning = self.controller.pruning
+        doc: dict = {
+            "pruning": {
+                "enabled": bool(pruning is not None),
+            }
+        }
+        if pruning is not None:
+            doc["pruning"].update(
+                maxCandidates=int(pruning.max_candidates),
+                padLadder=[int(x) for x in pruning.pad_ladder],
+                minPad=int(pruning.min_pad),
+                minFleet=int(pruning.min_fleet),
+            )
+        doc["pruning"].update(self.controller.warm.prune.stats())
+        if self.controller.warm.last_drain:
+            doc["lastDrain"] = dict(self.controller.warm.last_drain)
+        return doc
 
     def trace_status(self) -> dict:
         """JSON-able flight-recorder state for /statusz "trace"."""
@@ -1586,6 +1627,12 @@ class Manager:
                 if delta > 0:
                     metric.inc(float(delta))
                     self._defrag_exported[key] = counts[key]
+        prune = self.controller.warm.prune
+        self._m_candidate_nodes.set(float(prune.last_candidate_nodes))
+        delta = prune.escalations - self._prune_escalations_exported
+        if delta > 0:
+            self._m_candidate_escalations.inc(float(delta))
+            self._prune_escalations_exported = prune.escalations
         quality = self.controller.quality_last
         if quality:
             self._m_quality_admitted_ratio.set(

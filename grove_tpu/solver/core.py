@@ -677,6 +677,7 @@ def solve(
     escalate_portfolio: int = 1,
     warm=None,  # solver.warm.WarmPath: AOT executables + device-resident state
     donate: bool = False,
+    pruning=None,  # solver.pruning.PruningConfig: candidate-pruned solve path
 ) -> SolveResult:
     """Convenience wrapper: snapshot (numpy) -> device -> solve_batch.
 
@@ -699,6 +700,18 @@ def solve(
     (solver.portfolio config knob): on a multi-device mesh the variants ride
     the portfolio axis; on one device they vmap into a single batched
     program.
+
+    `pruning` (a solver.pruning.PruningConfig with enabled=True) routes the
+    single-variant solve through the candidate-pruned path: a cheap host
+    pre-filter gathers the nodes that could possibly serve any gang in the
+    batch onto a compact pow2 candidate axis and runs the UNCHANGED
+    solve_batch on the sub-fleet (the AOT cache then keys on the candidate
+    pad, not the fleet pad). Exactness escalation: a gang rejected on the
+    pruned fleet whose prune was lossy (its feasible-domain witness clipped
+    by the candidate budget — solver/pruning.py) re-solves dense before the
+    rejection stands; escalations are counted on `warm.prune`, never
+    silent. Pruning only applies to the snapshot-state single-variant solve
+    (free/schedulable overrides and portfolio solves pass through dense).
 
     `escalate_portfolio` > portfolio: when the single-variant solve leaves
     VALID gangs rejected, re-solve the same batch once under P=escalate
@@ -740,20 +753,91 @@ def solve(
             ok_global, coarse_dmax=cdmax,
         )
 
-    if portfolio > 1:
-        result = _psolve(portfolio)
-    elif warm is not None:
-        # Donation only when the caller owns the carry: a cached `free`
-        # buffer (free is None -> device-cache owned) must survive the call.
-        result = warm.executables.solve(
-            free0, capacity, sched, node_domain_id, jbatch, params, ok_global,
-            coarse_dmax=cdmax, donate=bool(donate and free is not None),
-        )
-    else:
-        result = solve_batch(
-            free0, capacity, sched, node_domain_id, jbatch, params, ok_global,
-            coarse_dmax=cdmax,
-        )
+    result = None
+    pruned_ok = None  # pruned verdicts, kept to grade an escalated re-solve
+    if (
+        pruning is not None
+        and getattr(pruning, "enabled", False)
+        and portfolio == 1
+        and free is None
+        and schedulable is None
+    ):
+        from grove_tpu.solver import pruning as pruning_mod
+
+        pstats = warm.prune if warm is not None else None
+        plan = pruning_mod.plan_candidates(snapshot, batch, pruning)
+        if plan is None:
+            if pstats is not None:
+                pstats.dense_fallbacks += 1
+        else:
+            pbatch = plan.gather_batch(batch)
+            jpbatch = GangBatch(
+                *(None if x is None else jnp.asarray(x) for x in pbatch)
+            )
+            if warm is not None:
+                cap_p = warm.device.device_array(plan.capacity, jnp.float32)
+                sched_p = warm.device.device_array(plan.schedulable)
+                ndid_p = warm.device.device_array(plan.node_domain_id, jnp.int32)
+            else:
+                cap_p = jnp.asarray(plan.capacity)
+                sched_p = jnp.asarray(plan.schedulable)
+                ndid_p = jnp.asarray(plan.node_domain_id)
+            free_p = plan.gather_free(free0)
+            solver_fn = (
+                warm.executables.solve if warm is not None else solve_batch
+            )
+            presult = solver_fn(
+                free_p, cap_p, sched_p, ndid_p, jpbatch, params, ok_global,
+                coarse_dmax=plan.coarse_dmax(),
+            )
+            if pstats is not None:
+                pstats.pruned_solves += 1
+                pstats.last_candidate_nodes = plan.count
+                pstats.last_candidate_pad = plan.pad
+                pstats.last_fleet_nodes = plan.fleet_pad
+            pruned_ok = np.asarray(presult.ok, dtype=bool)
+            valid_np = np.asarray(
+                _apply_global_deps(jbatch, ok_global), dtype=bool
+            )
+            if pruning_mod.lossy_rejections(plan, valid_np, pruned_ok).any():
+                # Exactness escalation: the prune may have cost this gang
+                # its domain aggregates — the rejection only stands if the
+                # DENSE solver agrees. Fall through to the dense dispatch.
+                if pstats is not None:
+                    pstats.escalations += 1
+            else:
+                result = SolveResult(
+                    assigned=plan.remap_assigned(presult.assigned),
+                    ok=presult.ok,
+                    placement_score=presult.placement_score,
+                    free_after=plan.scatter_free(free0, presult.free_after),
+                    ok_global=presult.ok_global,
+                )
+    if result is None:
+        if portfolio > 1:
+            result = _psolve(portfolio)
+        elif warm is not None:
+            # Donation only when the caller owns the carry: a cached `free`
+            # buffer (free is None -> device-cache owned) must survive the
+            # call.
+            result = warm.executables.solve(
+                free0, capacity, sched, node_domain_id, jbatch, params,
+                ok_global,
+                coarse_dmax=cdmax, donate=bool(donate and free is not None),
+            )
+        else:
+            result = solve_batch(
+                free0, capacity, sched, node_domain_id, jbatch, params,
+                ok_global,
+                coarse_dmax=cdmax,
+            )
+        if pruned_ok is not None and warm is not None:
+            # Escalated re-solve: did the full fleet actually change any
+            # verdict, or did it confirm the pruned rejection?
+            if bool(
+                np.any(np.asarray(result.ok, dtype=bool) != pruned_ok)
+            ):
+                warm.prune.escalations_adopted += 1
     if escalate_portfolio > portfolio:
         ok = np.asarray(result.ok, dtype=bool)
         # Fold ok_global: a gang whose cross-wave base dependency already

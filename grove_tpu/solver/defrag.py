@@ -305,6 +305,7 @@ def plan_migrations(
     min_efficiency: float = 0.0,
     candidate_sizes: list[int] | None = None,
     resource_names: tuple[str, ...] | None = None,
+    pruning=None,  # solver.pruning.PruningConfig (candidate-pruned solves)
 ) -> Optional[MigrationPlan]:
     """Plan migrations for `movable` gangs (caller-ordered: cheapest/lowest
     priority first) against `nodes`. `pods_by_name` holds EVERY pod — the
@@ -385,7 +386,7 @@ def plan_migrations(
             row_keys=row_keys,
         )
         t0 = time.perf_counter()
-        result = solve(snap_k, batch, params, warm=warm)
+        result = solve(snap_k, batch, params, warm=warm, pruning=pruning)
         new_bindings = decode_assignments(result, decode, snap_k)
         solve_s += time.perf_counter() - t0
         evaluated += 1

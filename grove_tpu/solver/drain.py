@@ -67,6 +67,18 @@ class DrainStats:
     encode_reuse_hits: int = 0
     encode_reuse_misses: int = 0
     donated: bool = False  # wave carry donated (free/ok_global in-place)
+    # Candidate pruning (solver/pruning.py): waves solved on the gathered
+    # candidate axis, the max candidate count / pad seen, host seconds spent
+    # cutting candidate plans, and the exactness-escalation ledger — a
+    # lossy-rejected wave re-solves dense; `escalations_adopted` counts the
+    # re-solves that actually changed a verdict (the rest CONFIRMED the
+    # rejection against the full fleet).
+    pruned_waves: int = 0
+    candidate_nodes: int = 0  # max candidates over pruned waves
+    candidate_pad: int = 0  # max candidate bucket over pruned waves
+    prune_s: float = 0.0
+    escalations: int = 0
+    escalations_adopted: int = 0
     # Harvest mode: "chained" (default — ONE batched device_get at the end,
     # so per-gang latency is definitionally the drain wall) or "wave"
     # (block per wave and record its completion stamp, so p50/p99 are
@@ -137,6 +149,7 @@ def drain_backlog(
     warm_path=None,  # solver.warm.WarmPath; None = the process-shared one
     donate: bool | None = None,  # None = auto (on for accelerators, off CPU)
     harvest: str = "chained",  # "chained" | "wave" (see DrainStats.harvest)
+    pruning=None,  # solver.pruning.PruningConfig; None/disabled = dense
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
 
@@ -157,6 +170,18 @@ def drain_backlog(
     reuse across drains via the per-gang row cache, and the free/ok_global
     wave carry is donated (`donate`) so chaining is an in-place device
     update rather than a copy per wave.
+
+    Candidate pruning (`pruning`, solver/pruning.py): each wave's solve runs
+    on the gathered candidate sub-fleet; the fleet free carry chains on
+    device through per-wave gather/scatter. Candidate plans are cut against
+    the INITIAL snapshot free — free only shrinks while draining, so the
+    initial candidates are a superset of every later wave's eligible set.
+    Exactness escalation after harvest: a wave holding a valid gang that was
+    rejected AND marked lossy by its plan re-solves DENSE from its recorded
+    entering carry; a re-solve that changes any verdict is adopted wholesale
+    and the chain re-runs from that wave (executables already cached).
+    Pruning disables carry donation — entering carries are retained for the
+    escalation re-solves.
     """
     import jax
     import jax.numpy as jnp
@@ -168,8 +193,16 @@ def drain_backlog(
     if harvest not in ("chained", "wave"):
         raise ValueError(f"harvest must be 'chained' or 'wave', got {harvest!r}")
     wp = warm_path if warm_path is not None else warm_mod.default_warm_path()
+    if pruning is not None and not getattr(pruning, "enabled", False):
+        pruning = None
+    if pruning is not None and portfolio > 1:
+        pruning = None  # portfolio solves own the node-axis layout
     if donate is None:
         donate = warm_mod.donation_default()
+    if pruning is not None:
+        # Entering free/ok_global carries are retained per wave for the
+        # exactness-escalation re-solves; a donated buffer would be dead.
+        donate = False
     use_exec_cache = portfolio == 1
     if portfolio > 1:
         # Per-wave portfolio: every wave solved under P weight variants, the
@@ -212,8 +245,33 @@ def drain_backlog(
     capacity = jnp.asarray(snapshot.capacity)
     schedulable = jnp.asarray(snapshot.schedulable)
     node_domain_id = jnp.asarray(snapshot.node_domain_id)
+    # Hoisted once for BOTH the warm pre-pass and the timed section — the
+    # timed region must not re-pay the host->device transfer of the fleet
+    # free tensor (it used to upload a second copy inside t0).
+    free_init = jnp.asarray(snapshot.free)
     dmax = coarse_dmax_of(snapshot)
     epoch = snapshot.encode_epoch()
+
+    def cut_plan(batch):
+        """Candidate plan for one wave's batch (None = solve dense)."""
+        if pruning is None:
+            return None
+        from grove_tpu.solver.pruning import plan_candidates
+
+        t0p = time.perf_counter()
+        plan = plan_candidates(snapshot, batch, pruning)
+        stats.prune_s += time.perf_counter() - t0p
+        return plan
+
+    def pruned_inputs(plan, batch):
+        """(jnp batch on the candidate axis, capacity, schedulable,
+        node_domain_id) — static tensors ride the content-digest device
+        cache, so repeated waves of one class upload once."""
+        pbatch = plan.gather_batch(batch)
+        cap_p = wp.device.device_array(plan.capacity, jnp.float32)
+        sched_p = wp.device.device_array(plan.schedulable)
+        ndid_p = wp.device.device_array(plan.node_domain_id, jnp.int32)
+        return pbatch, cap_p, sched_p, ndid_p
 
     def encode_wave(ws, reuse_rows: bool = True):
         wave, (mg_c, ms_c, mp_c), pad = ws
@@ -249,20 +307,39 @@ def drain_backlog(
             warm_batch, _ = encode_wave(ws, reuse_rows=False)
             if use_exec_cache:
                 # AOT: lower+compile only — no execution, no device chaining.
-                wp.executables.ensure_compiled(
-                    jnp.asarray(snapshot.free),
-                    capacity,
-                    schedulable,
-                    node_domain_id,
-                    warm_batch,
-                    params,
-                    jnp.zeros((len(gangs),), dtype=bool),
-                    coarse_dmax=dmax,
-                    donate=donate,
-                )
+                warm_plan = cut_plan(warm_batch)
+                if warm_plan is not None:
+                    wb, cap_p, sched_p, ndid_p = pruned_inputs(
+                        warm_plan, warm_batch
+                    )
+                    wp.executables.ensure_compiled(
+                        warm_plan.gather_free(
+                            np.asarray(snapshot.free, np.float32)
+                        ),
+                        cap_p,
+                        sched_p,
+                        ndid_p,
+                        wb,
+                        params,
+                        jnp.zeros((len(gangs),), dtype=bool),
+                        coarse_dmax=warm_plan.coarse_dmax(),
+                        donate=donate,
+                    )
+                else:
+                    wp.executables.ensure_compiled(
+                        free_init,
+                        capacity,
+                        schedulable,
+                        node_domain_id,
+                        warm_batch,
+                        params,
+                        jnp.zeros((len(gangs),), dtype=bool),
+                        coarse_dmax=dmax,
+                        donate=donate,
+                    )
             else:
                 last = solver(
-                    jnp.asarray(snapshot.free),
+                    free_init,
                     capacity,
                     schedulable,
                     node_domain_id,
@@ -279,56 +356,151 @@ def drain_backlog(
         np.asarray(last.ok if last is not None else jnp.zeros((1,), dtype=bool))
 
     t0 = time.perf_counter()
-    free_arr = jnp.asarray(snapshot.free)
+    free_arr = free_init
     ok_g = jnp.zeros((len(gangs),), dtype=bool)
+
+    def solve_wave(rec, free_in, okg_in):
+        """Dispatch one wave from its carry; updates the record in place and
+        returns the outgoing (free, ok_global) carry."""
+        if rec["plan"] is not None:
+            plan = rec["plan"]
+            wb, cap_p, sched_p, ndid_p = rec["pruned_inputs"]
+            result = wp.executables.solve(
+                plan.gather_free(free_in), cap_p, sched_p, ndid_p, wb,
+                params, okg_in, coarse_dmax=plan.coarse_dmax(), donate=False,
+            )
+            free_out = plan.scatter_free(free_in, result.free_after)
+        elif use_exec_cache:
+            # Donated wave carry: free/ok_g are forfeited to the solve and
+            # immediately rebound to the result — the capacity update is an
+            # in-place device buffer, never a host round trip. The stale
+            # host free (snapshot.free) is recomputed on access and never
+            # consulted again inside this chain.
+            result = wp.executables.solve(
+                free_in, capacity, schedulable, node_domain_id, rec["batch"],
+                params, okg_in, coarse_dmax=dmax, donate=donate,
+            )
+            free_out = result.free_after
+        else:
+            result = solver(
+                free_in, capacity, schedulable, node_domain_id, rec["batch"],
+                params, okg_in, coarse_dmax=dmax,
+            )
+            free_out = result.free_after
+        rec.update(
+            ok=result.ok,
+            score=result.placement_score,
+            assigned=result.assigned,
+            free_in=free_in if pruning is not None else None,
+            okg_in=okg_in if pruning is not None else None,
+        )
+        return free_out, result.ok_global
+
     # Keep only what decode needs per wave — retaining full SolveResults
-    # would pin every wave's chaining buffers in device memory.
-    inflight = []  # (ok, placement_score, assigned, decode_info)
+    # would pin every wave's chaining buffers in device memory. (Pruned
+    # drains additionally retain each wave's ENTERING carry for the
+    # escalation re-solves.)
+    inflight: list[dict] = []
     for ws in waves:
         te = time.perf_counter()
         batch, decode = encode_wave(ws)
         stats.encode_s += time.perf_counter() - te
+        plan = cut_plan(batch) if use_exec_cache else None
+        rec = {
+            "batch": batch,
+            "decode": decode,
+            "plan": plan,
+            "escalated": False,
+        }
+        if plan is not None:
+            rec["pruned_inputs"] = pruned_inputs(plan, batch)
+            stats.pruned_waves += 1
+            stats.candidate_nodes = max(stats.candidate_nodes, plan.count)
+            stats.candidate_pad = max(stats.candidate_pad, plan.pad)
         ts = time.perf_counter()
-        if use_exec_cache:
-            # Donated wave carry: free_arr/ok_g are forfeited to the solve
-            # and immediately rebound to the result — the capacity update is
-            # an in-place device buffer, never a host round trip. The stale
-            # host free (snapshot.free) is recomputed on access and never
-            # consulted again inside this chain.
-            result = wp.executables.solve(
-                free_arr, capacity, schedulable, node_domain_id, batch,
-                params, ok_g, coarse_dmax=dmax, donate=donate,
-            )
-        else:
-            result = solver(
-                free_arr, capacity, schedulable, node_domain_id, batch, params,
-                ok_g, coarse_dmax=dmax,
-            )
+        free_arr, ok_g = solve_wave(rec, free_arr, ok_g)
         stats.dispatch_s += time.perf_counter() - ts
-        free_arr = result.free_after
-        ok_g = result.ok_global
-        inflight.append((result.ok, result.placement_score, result.assigned, decode))
+        inflight.append(rec)
         if harvest == "wave":
             # Per-wave completion stamp: block until THIS wave's verdicts are
             # host-visible and record (admitted, elapsed) — p50/p99 become
             # measured per-gang bind latencies instead of the drain wall.
             # Padded/invalid slots carry ok=False, so the sum is exact.
-            jax.block_until_ready(result.ok)
+            jax.block_until_ready(rec["ok"])
             stats.wave_latencies.append(
-                (int(np.asarray(result.ok).sum()), time.perf_counter() - t0)
+                (int(np.asarray(rec["ok"]).sum()), time.perf_counter() - t0)
             )
 
     th = time.perf_counter()
-    jax.device_get([(ok, sc, asg) for ok, sc, asg, _ in inflight])
+    jax.device_get([(r["ok"], r["score"], r["assigned"]) for r in inflight])
     stats.harvest_s = time.perf_counter() - th
 
+    if stats.pruned_waves:
+        # Exactness escalation: scan waves in dispatch order for a valid
+        # gang rejected on the pruned fleet whose plan marked it lossy. The
+        # wave re-solves DENSE from its recorded entering carry; identical
+        # verdicts CONFIRM the rejections (results stand), any changed
+        # verdict ADOPTS the dense wave and re-runs the chain behind it
+        # (every shape is already compiled, so a re-run is pure execution).
+        # Each escalated wave is visited at most once -> termination.
+        from grove_tpu.solver.pruning import lossy_rejections
+
+        while True:
+            target = None
+            for i, rec in enumerate(inflight):
+                if rec["plan"] is None or rec["escalated"]:
+                    continue
+                lossy = lossy_rejections(
+                    rec["plan"],
+                    rec["batch"].gang_valid,
+                    np.asarray(rec["ok"]),
+                )
+                if bool(lossy.any()):
+                    target = i
+                    break
+            if target is None:
+                break
+            rec = inflight[target]
+            rec["escalated"] = True
+            stats.escalations += 1
+            dense = wp.executables.solve(
+                rec["free_in"], capacity, schedulable, node_domain_id,
+                rec["batch"], params, rec["okg_in"], coarse_dmax=dmax,
+                donate=False,
+            )
+            if bool(
+                np.all(np.asarray(dense.ok) == np.asarray(rec["ok"]))
+            ):
+                continue  # full fleet agrees: the rejection was real
+            stats.escalations_adopted += 1
+            free_arr, ok_g = dense.free_after, dense.ok_global
+            rec.update(
+                ok=dense.ok,
+                score=dense.placement_score,
+                assigned=dense.assigned,
+                plan=None,  # dense verdicts: decode skips the remap
+            )
+            for rec2 in inflight[target + 1 :]:
+                rec2["escalated"] = False  # inputs changed; re-verify
+                free_arr, ok_g = solve_wave(rec2, free_arr, ok_g)
+            jax.device_get(
+                [
+                    (r["ok"], r["score"], r["assigned"])
+                    for r in inflight[target:]
+                ]
+            )
+
     bindings: dict[str, dict[str, str]] = {}
-    for ok, sc, asg, decode in inflight:
+    for rec in inflight:
         td = time.perf_counter()
-        wave_bindings = decode_bindings(ok, asg, decode, snapshot)
+        asg = np.asarray(rec["assigned"])
+        if rec["plan"] is not None:
+            # Decode scatters candidate ordinals back through the gather map.
+            asg = rec["plan"].remap_assigned(asg)
+        wave_bindings = decode_bindings(rec["ok"], asg, rec["decode"], snapshot)
         stats.decode_s += time.perf_counter() - td
-        scores = np.asarray(sc)
-        ok_mask = np.asarray(ok)
+        scores = np.asarray(rec["score"])
+        ok_mask = np.asarray(rec["ok"])
         stats.scores.extend(scores[ok_mask].tolist())
         for gang_name, pod_bindings in wave_bindings.items():
             bindings[gang_name] = pod_bindings
@@ -340,4 +512,12 @@ def drain_backlog(
     stats.lowerings = wp.executables.lowerings - exec0[2]
     stats.encode_reuse_hits = wp.encode_rows.hits - rows0[0]
     stats.encode_reuse_misses = wp.encode_rows.misses - rows0[1]
+    if stats.pruned_waves:
+        wp.prune.pruned_solves += stats.pruned_waves
+        wp.prune.escalations += stats.escalations
+        wp.prune.escalations_adopted += stats.escalations_adopted
+        wp.prune.last_candidate_nodes = stats.candidate_nodes
+        wp.prune.last_candidate_pad = stats.candidate_pad
+        wp.prune.last_fleet_nodes = int(snapshot.free.shape[0])
+    wp.record_drain(stats)
     return bindings, stats

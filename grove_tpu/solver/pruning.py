@@ -1,0 +1,463 @@
+"""Candidate-node pruning: solve on the sub-fleet that could matter.
+
+Every solve in `solver/core.py` is dense over the full padded node axis —
+`_place_gang`'s domain tables, slot counts, and top-k picks are all O(N) per
+gang per set, so a 4-8x larger fleet makes every wave 4-8x slower even though
+a gang can only ever land on a handful of racks (the Tesserae observation:
+placement policies scale when the search is restricted to a structured
+candidate set, and the Turbo-Charged Mapper line prunes the search space
+BEFORE the solve, not during it).
+
+This module adds that pre-filter as a wrapper around the UNCHANGED solver:
+
+1. **Candidate selection** (`plan_candidates`, host numpy, cheap): a node is
+   a candidate iff it is schedulable AND has enough free capacity to host at
+   least one pod of some group in the batch (the smallest-group-request
+   test) AND sits inside a pack domain that can feasibly serve some gang's
+   required floor demand (gangs without required pack-sets disable the
+   domain test — their pods can land anywhere eligible). The candidate list
+   is clipped to `max_candidates` (budget) and padded to a pow2 ladder
+   bucket (`solver.pruning` config), so recurring workloads land on a SMALL
+   stable executable shape regardless of fleet size.
+
+2. **Gather/scatter** (`CandidatePlan`): node tensors, domain ids (remapped
+   to compact per-level ordinals; the host level keeps its ordinal==index
+   invariant), and the batch's node-axis fields (reuse/selector/spread
+   seeds, pack-set pins) are gathered onto the candidate axis; the existing
+   `solve_batch` runs unchanged on the sub-fleet; decode scatters node
+   ordinals back through the gather map. One pad row carries the FULL
+   fleet's per-resource capacity maxima so `cap_scale` (score
+   normalization) matches the dense solve, and stays unschedulable so it
+   can never host a pod.
+
+3. **Exactness escalation**: pruning is an approximation — nodes outside
+   the candidate set still contribute free capacity to the dense solver's
+   domain aggregates and best-fit scores. Each gang therefore carries a
+   LOSSY witness: True iff some excluded schedulable node had free capacity
+   in a resource the gang demands (or its pack-set pin's domain lost all
+   its nodes to the prune). The invariant callers enforce (core.solve, the
+   drain): a gang REJECTED on the pruned fleet whose witness is lossy is
+   re-solved dense before the rejection stands — so no gang is ever
+   rejected because of pruning, and every pruned admission carries its own
+   feasibility certificate (a concrete capacity-respecting placement on
+   real nodes). Escalations are counted (`PruneStats`), never silent.
+
+The warm-path AOT cache keys on array shapes, so pruned solves key on the
+CANDIDATE pad instead of the fleet pad — executables stop growing with
+fleet size, and a 4x fleet with the same workload re-uses the 1x
+executables byte-for-byte (pinned by tests/test_pruning.py and the
+`GROVE_BENCH_SCENARIO=scale` sweep).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from grove_tpu.solver.encode import GangBatch, next_pow2
+
+_EPS = 1e-6
+
+
+@dataclass(frozen=True)
+class PruningConfig:
+    """`solver.pruning` config block (runtime/config.py validates the YAML
+    shape; this is the solver-side value object)."""
+
+    enabled: bool = False
+    # Candidate budget: at most this many nodes enter the pruned solve; the
+    # rest are clipped (clipping marks affected gangs lossy, so a clipped
+    # rejection always escalates to a dense re-solve). Default pairs with
+    # the 8192 bucket: 8191 candidates + the cap-anchor pad row.
+    max_candidates: int = 8191
+    # Pow2 pad ladder for the candidate axis; () = every power of two from
+    # `min_pad` up. An explicit ladder caps executable diversity further.
+    pad_ladder: tuple = ()
+    # Smallest candidate bucket — tiny fleets share one executable shape.
+    min_pad: int = 64
+    # Fleets below this many snapshot rows never prune (the dense solve is
+    # already cheap; the gather would be pure overhead).
+    min_fleet: int = 256
+
+
+@dataclass
+class PruneStats:
+    """Process-visible pruning counters (a WarmPath carries one; /statusz
+    warmPath and `grove-tpu get solver` render them)."""
+
+    pruned_solves: int = 0
+    dense_fallbacks: int = 0  # pruning requested but not worthwhile
+    escalations: int = 0  # lossy rejection -> dense re-solve
+    escalations_adopted: int = 0  # dense re-solve changed a verdict
+    last_candidate_nodes: int = 0
+    last_candidate_pad: int = 0
+    last_fleet_nodes: int = 0
+
+    def stats(self) -> dict:
+        return {
+            "pruneSolves": self.pruned_solves,
+            "pruneDenseFallbacks": self.dense_fallbacks,
+            "pruneEscalations": self.escalations,
+            "pruneEscalationsAdopted": self.escalations_adopted,
+            "pruneCandidateNodes": self.last_candidate_nodes,
+            "pruneCandidatePad": self.last_candidate_pad,
+            "pruneFleetNodes": self.last_fleet_nodes,
+        }
+
+
+@dataclass
+class CandidatePlan:
+    """One batch's candidate axis: gather map, remapped topology, pruned
+    static node tensors, and the per-gang lossy witness."""
+
+    idx: np.ndarray  # i32 [count] fleet ordinals of the candidates
+    count: int
+    pad: int  # candidate bucket (> count; one row is the cap anchor)
+    fleet_pad: int  # the dense node axis this plan was cut from
+    clipped: bool  # candidate budget truncated the eligible set
+    gang_lossy: np.ndarray  # bool [G] prune could have cost this gang
+    capacity: np.ndarray  # f32 [pad, R] gathered + cap-anchor pad row
+    schedulable: np.ndarray  # bool [pad]
+    node_domain_id: np.ndarray  # i32 [L, pad] remapped compact ordinals
+    num_domains: np.ndarray  # i32 [L] domain count per level on the sub-fleet
+    # per level: original ordinal -> remapped ordinal (pin translation)
+    _remap: list = field(default_factory=list)
+
+    # ---- gather ------------------------------------------------------------
+
+    def gather_free(self, free):
+        """Fleet free [N, R] -> candidate free [pad, R] (pad rows zero).
+        Works on numpy (host path) and jax arrays (device-chained drain)."""
+        if isinstance(free, np.ndarray):
+            out = np.zeros((self.pad, free.shape[1]), dtype=np.float32)
+            out[: self.count] = free[self.idx]
+            return out
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(self._padded_idx())
+        # mode="fill": the pad rows' out-of-range index reads as zero — no
+        # phantom row concat per wave on the chained device carry.
+        return free.at[idx].get(mode="fill", fill_value=0.0)
+
+    def scatter_free(self, fleet_free, pruned_free):
+        """Write the pruned solve's free_after back into the fleet axis
+        (device op; pad rows drop via out-of-range scatter)."""
+        idx = self._padded_idx()
+        if isinstance(fleet_free, np.ndarray):
+            out = np.array(fleet_free, copy=True)
+            out[self.idx] = np.asarray(pruned_free)[: self.count]
+            return out
+        import jax.numpy as jnp
+
+        return fleet_free.at[jnp.asarray(idx)].set(
+            pruned_free, mode="drop", unique_indices=True
+        )
+
+    def _padded_idx(self) -> np.ndarray:
+        """[pad] gather/scatter map; pad rows point past the fleet axis so
+        gathers fill 0 and scatters drop."""
+        out = np.full((self.pad,), self.fleet_pad, dtype=np.int32)
+        out[: self.count] = self.idx
+        return out
+
+    def gather_batch(self, batch: GangBatch) -> GangBatch:
+        """Gather the batch's node-axis fields onto the candidate axis and
+        translate pack-set pins to the remapped domain ordinals."""
+        reuse = batch.reuse_nodes
+        node_ok = batch.group_node_ok
+        avoid = batch.spread_avoid
+        if reuse is not None:
+            reuse = self._gather_bool_axis(np.asarray(reuse))
+        if node_ok is not None:
+            node_ok = self._gather_bool_axis(np.asarray(node_ok))
+        if avoid is not None:
+            avoid = self._gather_bool_axis(np.asarray(avoid))
+        pinned = np.asarray(batch.set_pinned)
+        if (pinned >= 0).any():
+            pinned = self._remap_pins(pinned, np.asarray(batch.set_req_level))
+        return batch._replace(
+            reuse_nodes=reuse,
+            group_node_ok=node_ok,
+            spread_avoid=avoid,
+            set_pinned=pinned,
+        )
+
+    def _gather_bool_axis(self, arr: np.ndarray) -> np.ndarray:
+        out = np.zeros(arr.shape[:-1] + (self.pad,), dtype=bool)
+        out[..., : self.count] = arr[..., self.idx]
+        return out
+
+    def _remap_pins(self, pinned: np.ndarray, req_level: np.ndarray) -> np.ndarray:
+        """Translate fleet domain ordinals to candidate ordinals; a pinned
+        domain with NO candidate nodes maps to `count` (matches nothing, so
+        the pin fails closed — the affected gang is already marked lossy)."""
+        out = np.array(pinned, copy=True)
+        it = np.nonzero(pinned >= 0)
+        for gi, si in zip(*it):
+            lvl = int(req_level[gi, si])
+            if not 0 <= lvl < len(self._remap):
+                continue
+            out[gi, si] = self._remap[lvl].get(int(pinned[gi, si]), self.count)
+        return out
+
+    def remap_assigned(self, assigned):
+        """Candidate ordinals -> fleet ordinals (decode scatters through the
+        gather map); numpy or jax."""
+        if isinstance(assigned, np.ndarray):
+            safe = np.clip(assigned, 0, self.count - 1)
+            return np.where(assigned >= 0, self.idx[safe], -1)
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(self.idx)
+        safe = jnp.clip(assigned, 0, self.count - 1)
+        return jnp.where(assigned >= 0, idx[safe], -1)
+
+    def coarse_dmax(self) -> Optional[int]:
+        """Static domain bound for the pruned axis, mirroring
+        core.coarse_dmax_of: the matmul aggregation path on accelerators,
+        segment-sum (None) on CPU."""
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return None
+        if self.num_domains.shape[0] <= 1:
+            return 1
+        return max(int(self.num_domains[:-1].max()), 1)
+
+
+def candidate_pad(count: int, cfg: PruningConfig) -> Optional[int]:
+    """Smallest ladder bucket holding `count` candidates PLUS the cap-anchor
+    pad row; None when no ladder entry fits."""
+    need = count + 1
+    if cfg.pad_ladder:
+        for v in sorted(int(x) for x in cfg.pad_ladder):
+            if v >= need:
+                return v
+        return None
+    return next_pow2(max(need, cfg.min_pad))
+
+
+def _eligible_nodes(
+    free: np.ndarray, schedulable: np.ndarray, batch: GangBatch
+) -> tuple[np.ndarray, bool]:
+    """(eligible mask [N], any_zero_request): a node is eligible iff it can
+    host >= 1 pod of SOME valid group (elementwise on that group's positive
+    requests). A valid group with no positive request at all can land on any
+    schedulable node, which disables the capacity prune entirely."""
+    gv = np.asarray(batch.gang_valid)[:, None] & np.asarray(batch.group_valid)
+    reqs = np.asarray(batch.group_req)[gv]  # [K, R]
+    if reqs.size == 0:
+        return np.asarray(schedulable, bool).copy(), False
+    reqs = np.unique(reqs, axis=0)
+    if (reqs <= 0).all(axis=1).any():
+        return np.asarray(schedulable, bool).copy(), True
+    fits = (
+        (free[None, :, :] + _EPS >= reqs[:, None, :]) | (reqs[:, None, :] <= 0)
+    ).all(axis=-1)  # [K, N]
+    return np.asarray(schedulable, bool) & fits.any(axis=0), False
+
+
+def _domain_useful(
+    free: np.ndarray,
+    schedulable: np.ndarray,
+    node_domain_id: np.ndarray,
+    batch: GangBatch,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(useful-by-domain mask [N], pin_absent_lossy [G]).
+
+    A node passes iff SOME valid gang's broadest required pack-set could be
+    served by the node's domain at that set's level: the domain's aggregate
+    free (over schedulable nodes) covers the set's member floor demand, and
+    a pinned set only accepts its pinned domain. Gangs with NO required
+    pack-set disable the filter (their pods may land on any eligible node).
+    Conservative by construction — aggregate feasibility over-approximates
+    the solver's joint checks, so this can only keep too many nodes, never
+    too few."""
+    g, ms = np.asarray(batch.set_valid).shape
+    n = free.shape[0]
+    gang_valid = np.asarray(batch.gang_valid)
+    set_valid = np.asarray(batch.set_valid)
+    set_req = np.asarray(batch.set_req_level)
+    set_pin = np.asarray(batch.set_pinned)
+    set_member = np.asarray(batch.set_member)
+    group_req = np.asarray(batch.group_req)
+    group_required = np.asarray(batch.group_required)
+    group_valid = np.asarray(batch.group_valid)
+    levels = node_domain_id.shape[0]
+
+    sched_free = np.where(schedulable[:, None], np.maximum(free, 0.0), 0.0)
+    dom_free: dict[int, np.ndarray] = {}
+
+    def dom_free_at(lvl: int) -> np.ndarray:
+        if lvl not in dom_free:
+            dom = node_domain_id[lvl]
+            d = int(dom.max(initial=-1)) + 1
+            acc = np.zeros((d + 1, free.shape[1]), dtype=np.float64)
+            valid = dom >= 0
+            np.add.at(acc, dom[valid], sched_free[valid])
+            dom_free[lvl] = acc[:d]
+        return dom_free[lvl]
+
+    useful = np.zeros((n,), dtype=bool)
+    pin_lossy = np.zeros((g,), dtype=bool)
+    any_unconstrained = False
+    # Per (level) OR of feasible domains, then one [N] gather per level.
+    level_dom_ok: dict[int, np.ndarray] = {}
+    for gi in range(g):
+        if not gang_valid[gi]:
+            continue
+        req_sets = [
+            si
+            for si in range(ms)
+            if set_valid[gi, si] and 0 <= set_req[gi, si] < levels
+        ]
+        if not req_sets:
+            any_unconstrained = True
+            continue
+        # Broadest required set (sets are encoded broad->narrow; the level
+        # index orders broad->narrow too).
+        si = min(req_sets, key=lambda s: set_req[gi, s])
+        lvl = int(set_req[gi, si])
+        members = set_member[gi, si] & group_valid[gi]
+        demand = (
+            group_req[gi] * (group_required[gi] * members).astype(np.float64)[:, None]
+        ).sum(axis=0)  # [R]
+        df = dom_free_at(lvl)
+        ok = (df + _EPS >= demand[None, :]).all(axis=-1)  # [D]
+        pin = int(set_pin[gi, si])
+        if pin >= 0:
+            mask = np.zeros_like(ok)
+            if pin < ok.shape[0]:
+                mask[pin] = ok[pin]
+            ok = mask
+        acc = level_dom_ok.setdefault(lvl, np.zeros_like(ok))
+        if acc.shape[0] < ok.shape[0]:  # defensive; same level, same D
+            acc = np.resize(acc, ok.shape)
+            level_dom_ok[lvl] = acc
+        level_dom_ok[lvl] = acc | ok
+    if any_unconstrained:
+        return np.ones((n,), dtype=bool), pin_lossy
+    for lvl, ok in level_dom_ok.items():
+        dom = node_domain_id[lvl]
+        valid = dom >= 0
+        hit = np.zeros((n,), dtype=bool)
+        hit[valid] = ok[np.clip(dom[valid], 0, ok.shape[0] - 1)]
+        useful |= hit
+    if not level_dom_ok:
+        # No valid gang carried a resolvable required set: filter is moot.
+        return np.ones((n,), dtype=bool), pin_lossy
+    return useful, pin_lossy
+
+
+def plan_candidates(
+    snapshot, batch: GangBatch, cfg: PruningConfig
+) -> Optional[CandidatePlan]:
+    """Cut the candidate axis for one batch against `snapshot`'s CURRENT
+    free state (or any state whose free is <= it — a drain computes plans
+    from the initial snapshot: free only shrinks while draining, so the
+    initial candidates are a superset of every later wave's).
+
+    Returns None when pruning is not worthwhile: fleet below `min_fleet`,
+    candidate bucket not smaller than the fleet axis, or no valid gangs."""
+    free = np.asarray(snapshot.free, dtype=np.float32)
+    schedulable = np.asarray(snapshot.schedulable, dtype=bool)
+    node_domain_id = np.asarray(snapshot.node_domain_id)
+    n = free.shape[0]
+    if n < cfg.min_fleet:
+        return None
+    gang_valid = np.asarray(batch.gang_valid)
+    if not gang_valid.any():
+        return None
+
+    eligible, zero_req = _eligible_nodes(free, schedulable, batch)
+    dom_useful, pin_lossy = _domain_useful(free, schedulable, node_domain_id, batch)
+    useful = eligible & dom_useful
+    cand = np.flatnonzero(useful)
+    clipped = False
+    budget = max(1, int(cfg.max_candidates))
+    if cand.shape[0] > budget:
+        cand = cand[:budget]
+        clipped = True
+    count = int(cand.shape[0])
+    if count == 0:
+        return None  # nothing can place; the dense solve rejects cheaply
+    pad = candidate_pad(count, cfg)
+    if pad is None or pad >= n:
+        return None
+
+    # Lossy witness: an excluded schedulable node with free capacity in a
+    # resource the gang demands could have changed the dense solve's domain
+    # aggregates or scores — that gang's REJECTION must not stand un-checked.
+    kept = np.zeros((n,), dtype=bool)
+    kept[cand] = True
+    excluded = schedulable & ~kept
+    lossy_res = (free > _EPS) & excluded[:, None]  # [N, R]
+    lossy_by_res = lossy_res.any(axis=0)  # [R]
+    gv = gang_valid[:, None] & np.asarray(batch.group_valid)  # [G, MG]
+    demand_pos = (np.asarray(batch.group_req) > 0) & gv[:, :, None]  # [G, MG, R]
+    gang_demands = demand_pos.any(axis=1)  # [G, R]
+    gang_lossy = (gang_demands & lossy_by_res[None, :]).any(axis=-1)
+    if zero_req and excluded.any():
+        # Zero-request groups can land on ANY schedulable node, so every
+        # exclusion is potentially theirs.
+        gang_lossy = gang_lossy | gv.any(axis=1)
+    gang_lossy = (gang_lossy | pin_lossy) & gang_valid
+
+    # Remap per-level domain ordinals to a compact range over the candidates;
+    # host level (last) keeps ordinal == row index by construction.
+    levels = node_domain_id.shape[0]
+    ndid_p = np.full((levels, pad), -1, dtype=np.int32)
+    num_domains = np.zeros((levels,), dtype=np.int32)
+    remap: list[dict] = []
+    for li in range(levels):
+        ids = node_domain_id[li, cand]
+        if li == levels - 1:
+            rows = np.arange(count, dtype=np.int32)
+            ndid_p[li, :count] = np.where(ids >= 0, rows, -1)
+            num_domains[li] = int((ids >= 0).sum())
+            remap.append({})
+            continue
+        uniq = np.unique(ids[ids >= 0])
+        table = {int(v): i for i, v in enumerate(uniq.tolist())}
+        ndid_p[li, :count] = np.where(
+            ids >= 0, np.searchsorted(uniq, np.clip(ids, 0, None)), -1
+        )
+        num_domains[li] = len(table)
+        remap.append(table)
+
+    cap_p = np.zeros((pad, free.shape[1]), dtype=np.float32)
+    cap_p[:count] = np.asarray(snapshot.capacity, dtype=np.float32)[cand]
+    # Cap anchor: the dense solver normalizes scores by the FULL fleet's
+    # per-resource capacity maxima (including unschedulable nodes); carry
+    # them on the first pad row so pruned scores use the same scale. The
+    # row stays unschedulable/zero-free, so it can never host a pod or
+    # perturb any masked aggregate.
+    cap_p[count] = np.asarray(snapshot.capacity, dtype=np.float32).max(axis=0)
+    sched_p = np.zeros((pad,), dtype=bool)
+    sched_p[:count] = schedulable[cand]
+
+    plan = CandidatePlan(
+        idx=cand.astype(np.int32),
+        count=count,
+        pad=pad,
+        fleet_pad=n,
+        clipped=clipped,
+        gang_lossy=gang_lossy,
+        capacity=cap_p,
+        schedulable=sched_p,
+        node_domain_id=ndid_p,
+        num_domains=num_domains,
+        _remap=remap,
+    )
+    return plan
+
+
+def lossy_rejections(plan: CandidatePlan, gang_valid, ok) -> np.ndarray:
+    """bool [G]: gangs whose pruned rejection requires a dense re-solve."""
+    return (
+        np.asarray(gang_valid, bool)
+        & ~np.asarray(ok, bool)
+        & plan.gang_lossy
+    )

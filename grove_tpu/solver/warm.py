@@ -56,6 +56,7 @@ import numpy as np
 
 from grove_tpu.solver.core import SolveResult, SolverParams, solve_batch_impl
 from grove_tpu.solver.encode import GangBatch
+from grove_tpu.solver.pruning import PruneStats
 from grove_tpu.utils.fsio import atomic_write_json
 
 # jitted solve_batch variants, shared process-wide so every ExecutableCache
@@ -556,12 +557,42 @@ class WarmPath:
     executables: ExecutableCache = field(default_factory=ExecutableCache)
     encode_rows: EncodeRowCache = field(default_factory=EncodeRowCache)
     device: SnapshotDeviceCache = field(default_factory=SnapshotDeviceCache)
+    # Candidate-pruning counters (solver/pruning.py): pruned solves,
+    # exactness escalations, last candidate-axis size — surfaced through
+    # stats() so /statusz warmPath and `grove-tpu get solver` carry them.
+    prune: PruneStats = field(default_factory=PruneStats)
+    # Last drain seen through this warm path (drain_backlog reports at
+    # exit): measured wave-harvest p50/p99 when the drain ran with
+    # harvest="wave", so the latency distribution is visible OUTSIDE the
+    # bench (/statusz warmPath, `grove-tpu get solver`).
+    last_drain: dict = field(default_factory=dict)
+
+    def record_drain(self, stats) -> None:
+        """Fold one DrainStats into the observable surface."""
+        doc = {
+            "drainWaves": stats.waves,
+            "drainGangs": stats.gangs,
+            "drainAdmitted": stats.admitted,
+            "drainHarvest": stats.harvest,
+            "drainTotalS": round(stats.total_s, 4),
+        }
+        if stats.harvest == "wave" and stats.wave_latencies:
+            import numpy as np
+
+            lat = np.concatenate(
+                [np.full(n, t) for n, t in stats.wave_latencies if n > 0]
+            ) if any(n > 0 for n, _ in stats.wave_latencies) else np.zeros((1,))
+            doc["waveP50S"] = round(float(np.percentile(lat, 50)), 4)
+            doc["waveP99S"] = round(float(np.percentile(lat, 99)), 4)
+        self.last_drain = doc
 
     def stats(self) -> dict:
         out = {}
         out.update(self.executables.stats())
         out.update(self.encode_rows.stats())
         out.update(self.device.stats())
+        out.update(self.prune.stats())
+        out.update(self.last_drain)
         return out
 
 

@@ -221,6 +221,7 @@ class TraceRecorder:
         params,
         portfolio: int,
         escalate_portfolio: int,
+        pruning=None,  # solver.pruning.PruningConfig (or None = dense)
         plan: dict,
         ok_by_name: dict,
         valid_by_name: dict,
@@ -293,6 +294,18 @@ class TraceRecorder:
                 "params": [float(w) for w in params],
                 "portfolio": int(portfolio),
                 "escalatePortfolio": int(escalate_portfolio),
+                # Candidate-pruning fingerprint: replay must route through
+                # the same pruned path (pruned placements legitimately
+                # differ from dense ones) for bitwise equivalence.
+                "pruning": None
+                if pruning is None or not getattr(pruning, "enabled", False)
+                else {
+                    "enabled": True,
+                    "maxCandidates": int(pruning.max_candidates),
+                    "padLadder": [int(x) for x in pruning.pad_ladder],
+                    "minPad": int(pruning.min_pad),
+                    "minFleet": int(pruning.min_fleet),
+                },
             },
             "plan": {g: dict(b) for g, b in plan.items()},
             "ok": {n: bool(_jsonable(ok_by_name.get(n, False))) for n in sorted(names)},

@@ -133,6 +133,21 @@ def solve_wave_record(
     gangs = [serde.decode(d) for d in wave["gangs"]]
     pods = {n: serde.decode(d) for n, d in wave["pods"].items()}
     cfg = wave["solver"]
+    pruning = None
+    pr = cfg.get("pruning")
+    if pr and pr.get("enabled"):
+        # Recorded pruning fingerprint: the replay must take the SAME
+        # candidate-pruned path (pruned placements legitimately differ from
+        # dense ones — bitwise equivalence holds per configuration).
+        from grove_tpu.solver.pruning import PruningConfig
+
+        pruning = PruningConfig(
+            enabled=True,
+            max_candidates=int(pr.get("maxCandidates", 8191)),
+            pad_ladder=tuple(pr.get("padLadder", ())),
+            min_pad=int(pr.get("minPad", 64)),
+            min_fleet=int(pr.get("minFleet", 256)),
+        )
     t0 = time.perf_counter()
     batch, decode = encode_gangs(
         gangs,
@@ -158,6 +173,7 @@ def solve_wave_record(
             else cfg["escalatePortfolio"]
         ),
         warm=warm,
+        pruning=pruning,
     )
     plan = decode_assignments(result, decode, snapshot)
     elapsed = time.perf_counter() - t0

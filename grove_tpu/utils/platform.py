@@ -27,6 +27,7 @@ MULTICHIP_r01 rc=124).
 
 from __future__ import annotations
 
+import json
 import os
 import pathlib
 import subprocess
@@ -37,6 +38,76 @@ from typing import Optional
 _REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
 
 _PROBE_SRC = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+
+# Persisted probe-verdict cache: a WEDGE verdict (relay failed to answer
+# within the budget) is written here so the next process in the window falls
+# back to CPU immediately instead of re-paying the full multi-minute probe
+# loop (BENCH_r05's `error` field shows the 5 x 72s re-probe being paid on
+# every bench run against the same wedged relay). Success verdicts are
+# recorded for observability but never short-circuit the probe — a healthy
+# probe is seconds, and trusting a stale success could hang the process at
+# first device use if the relay wedged since. TTL 0 disables the cache.
+_PROBE_CACHE_PATH_ENV = "GROVE_PLATFORM_PROBE_CACHE_PATH"
+_PROBE_CACHE_TTL_ENV = "GROVE_PLATFORM_PROBE_TTL_S"
+_PROBE_TIMEOUT_ENV = "GROVE_PLATFORM_PROBE_TIMEOUT_S"
+_PROBE_MAX_ATTEMPTS_ENV = "GROVE_PLATFORM_PROBE_MAX_ATTEMPTS"
+_DEFAULT_PROBE_CACHE = "/tmp/grove-tpu-state/platform-probe.json"
+_DEFAULT_PROBE_TTL_S = 900.0
+
+
+def _probe_cache_path() -> str:
+    return os.environ.get(_PROBE_CACHE_PATH_ENV, _DEFAULT_PROBE_CACHE)
+
+
+def _probe_cache_ttl() -> float:
+    try:
+        return float(os.environ.get(_PROBE_CACHE_TTL_ENV, _DEFAULT_PROBE_TTL_S))
+    except ValueError:
+        return _DEFAULT_PROBE_TTL_S
+
+
+def read_probe_verdict() -> Optional[dict]:
+    """The persisted probe verdict if present AND inside its TTL window,
+    else None. Verdict doc: {"platform": str|None, "wedged": bool,
+    "ts": epoch-seconds, "attempts": int}."""
+    ttl = _probe_cache_ttl()
+    if ttl <= 0:
+        return None
+    try:
+        with open(_probe_cache_path()) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    try:
+        age = time.time() - float(doc.get("ts", 0.0))
+    except (TypeError, ValueError):
+        return None
+    if age < 0 or age >= ttl:
+        return None
+    return doc
+
+
+def write_probe_verdict(platform: Optional[str], wedged: bool, attempts: int) -> None:
+    """Persist the probe outcome (best-effort; the cache is an optimization,
+    never fatal)."""
+    if _probe_cache_ttl() <= 0:
+        return
+    try:
+        from grove_tpu.utils.fsio import atomic_write_json
+
+        atomic_write_json(
+            _probe_cache_path(),
+            {
+                "platform": platform,
+                "wedged": bool(wedged),
+                "ts": time.time(),
+                "attempts": int(attempts),
+            },
+        )
+    except OSError:
+        pass
 
 
 def probe_default_platform(timeout_s: float = 90.0) -> Optional[str]:
@@ -140,10 +211,40 @@ def wait_for_accelerator(
 
     Returns (platform, error) like ensure_usable_backend. A probe that finds
     a CPU-only default backend returns immediately (nothing to wait for).
+
+    Wedge verdicts persist across processes (GROVE_PLATFORM_PROBE_TTL_S,
+    default 900; 0 disables): when a previous process already burned its
+    budget proving the relay wedged, this one falls back to CPU immediately
+    instead of re-paying the probe loop. Probe timeout and attempt count are
+    env-tunable (GROVE_PLATFORM_PROBE_TIMEOUT_S overrides `probe_timeout_s`,
+    GROVE_PLATFORM_PROBE_MAX_ATTEMPTS caps the loop).
     """
     if os.environ.get("GROVE_FORCE_CPU") == "1":
         force_cpu()
         return "cpu", None
+    env_timeout = os.environ.get(_PROBE_TIMEOUT_ENV)
+    if env_timeout:
+        try:
+            probe_timeout_s = float(env_timeout)
+        except ValueError:
+            pass
+    max_attempts = 0  # 0 = unbounded within the budget
+    env_attempts = os.environ.get(_PROBE_MAX_ATTEMPTS_ENV)
+    if env_attempts:
+        try:
+            max_attempts = max(0, int(env_attempts))
+        except ValueError:
+            pass
+    verdict = read_probe_verdict()
+    if verdict is not None and verdict.get("wedged"):
+        force_cpu()
+        return (
+            "cpu",
+            "TPU relay marked wedged by a probe "
+            f"{time.time() - float(verdict.get('ts', 0.0)):.0f}s ago "
+            f"(cached verdict, ttl {_probe_cache_ttl():.0f}s); "
+            "forced jax_platforms=cpu",
+        )
     deadline = time.monotonic() + max(0.0, wait_budget_s)
     attempts = 0
     while True:
@@ -154,9 +255,13 @@ def wait_for_accelerator(
         platform = probe_default_platform(timeout)
         attempts += 1
         if platform is not None:
+            write_probe_verdict(platform, wedged=False, attempts=attempts)
             return platform, None
+        if max_attempts and attempts >= max_attempts:
+            break
         if deadline - time.monotonic() > retry_sleep_s:
             time.sleep(retry_sleep_s)
+    write_probe_verdict(None, wedged=True, attempts=attempts)
     force_cpu()
     return (
         "cpu",

@@ -27,6 +27,11 @@ from grove_tpu.utils.platform import (  # noqa: E402
 )
 
 force_virtual_cpu_devices(8)
+# Probe-verdict cache off by default in tests: a unit test exercising the
+# wedge path must not persist a verdict that short-circuits every later
+# wait_for_accelerator call in the suite (tests opting in set their own
+# GROVE_PLATFORM_PROBE_CACHE_PATH/TTL explicitly).
+__import__("os").environ.setdefault("GROVE_PLATFORM_PROBE_TTL_S", "0")
 # Persistent XLA compilation cache: solver compiles are the dominant suite
 # cost (a single cold solve+escalation pair is ~10s of XLA on CPU), and
 # shapes recur heavily across tests AND across runs. Keyed by HLO+config,

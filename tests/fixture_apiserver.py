@@ -426,6 +426,26 @@ class FixtureApiServer:
     def fail_watch_once(self, code: int = 410):
         self._fail_watch_code = code
 
+    def wait_for_fresh_watcher(self, resource: str, timeout: float = 5.0) -> bool:
+        """Block until a watch stream REGISTERED AFTER this call is live for
+        `resource`. Tests that emit churn relative to stream-cycle phase
+        (e.g. the bookmark-compaction test) synchronize here: a burst
+        emitted right after a fresh registration lands INSIDE that stream's
+        timeout window instead of racing the resume gap between streams —
+        where a 410 relist is legitimate apiserver behavior, not the path
+        under test."""
+        with self._lock:
+            old = {id(q) for q in self._watchers.get(resource, [])}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if any(
+                    id(q) not in old for q in self._watchers.get(resource, [])
+                ):
+                    return True
+            time.sleep(0.01)
+        return False
+
     # ---- protocol internals ---------------------------------------------------------
 
     def _rbac_at(self, path: str):

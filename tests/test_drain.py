@@ -129,6 +129,61 @@ def test_plan_waves_pad_clamps_small_classes():
     assert pads == [32, 32], pads  # full wave of 8 -> 32; trailing 3 shares it
 
 
+def test_plan_waves_non_pow2_wave_size():
+    """wave_size=48 (non-pow2): full waves pad to max(32, next_pow2(48))=64;
+    a trailing remainder either clamps to its own pow2 (when that is a new
+    executable shape anyway) or keeps the class's full-wave pad (when the
+    floored pad would equal it and can share the compiled executable). The
+    remainder-pad clamp vs full-wave floor interaction was previously only
+    pinned for pow2 sizes."""
+    from grove_tpu.solver.encode import next_pow2
+
+    full_pad = max(32, next_pow2(48))
+    assert full_pad == 64
+
+    # 100 frontend gangs of one shape class: 48 + 48 + remainder 4.
+    gangs, _, _ = _setup(n_disagg=0, n_agg=0, n_frontend=100)
+    frontend = [g for g in gangs if g.base_podgang_name is None]
+    waves = plan_waves(frontend, wave_size=48)
+    sizes_pads = [(len(w), pad) for w, _, pad in waves]
+    assert sizes_pads == [(48, 64), (48, 64), (4, 4)], sizes_pads
+
+    # Remainder of 33..48 floors to 64 == the class full-wave pad: it must
+    # KEEP the floor and share the already-compiled 64-slot executable.
+    gangs2, _, _ = _setup(n_disagg=0, n_agg=0, n_frontend=88)
+    frontend2 = [g for g in gangs2 if g.base_podgang_name is None]
+    waves2 = plan_waves(frontend2, wave_size=48)
+    sizes_pads2 = [(len(w), pad) for w, _, pad in waves2]
+    assert sizes_pads2 == [(48, 64), (40, 64)], sizes_pads2
+
+    # Single-wave class below the floor clamps to its own pow2 regardless
+    # of the non-pow2 wave_size.
+    gangs3, _, _ = _setup(n_disagg=0, n_agg=0, n_frontend=5)
+    waves3 = plan_waves(gangs3, wave_size=48)
+    assert [(len(w), pad) for w, _, pad in waves3] == [(5, 8)]
+    # Every pad covers its wave.
+    for w, _, pad in waves + waves2 + waves3:
+        assert pad >= len(w)
+
+
+def test_drain_wave_harvest_surfaces_on_warm_path():
+    """DrainStats.wave_latencies surface OUTSIDE the bench: a wave-harvest
+    drain records measured p50/p99 on its WarmPath (what /statusz warmPath
+    and `grove-tpu get solver` render)."""
+    from grove_tpu.solver.warm import WarmPath
+
+    gangs, pods, snap = _setup()
+    wp = WarmPath()
+    _, stats = drain_backlog(gangs, pods, snap, wave_size=8, warm_path=wp, harvest="wave")
+    doc = wp.stats()
+    assert doc["drainHarvest"] == "wave"
+    assert doc["drainWaves"] == stats.waves
+    assert doc["drainAdmitted"] == stats.admitted
+    assert doc["waveP50S"] > 0
+    assert doc["waveP99S"] >= doc["waveP50S"]
+    assert doc["waveP99S"] <= stats.total_s + 1e-6
+
+
 def test_plan_waves_class_order_follows_input_order():
     """The class containing the FIRST gang of the (priority-sorted) input
     dispatches first within its rank."""

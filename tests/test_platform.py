@@ -74,6 +74,111 @@ def test_wait_for_accelerator_deadline_falls_back_to_cpu(monkeypatch):
     assert all(p <= 60.0 for p in probes)
 
 
+def test_wait_for_accelerator_persists_wedge_verdict(tmp_path, monkeypatch):
+    """A budget-exhausting wedge writes a verdict file; the NEXT call inside
+    the TTL window falls back to CPU immediately — one multi-minute probe
+    loop per window, not one per bench run."""
+    cache = tmp_path / "probe.json"
+    monkeypatch.setenv("GROVE_PLATFORM_PROBE_CACHE_PATH", str(cache))
+    monkeypatch.setenv("GROVE_PLATFORM_PROBE_TTL_S", "900")
+    probes = []
+    clock = {"t": 0.0}
+
+    def fake_probe(timeout_s):
+        probes.append(timeout_s)
+        clock["t"] += timeout_s
+        return None
+
+    monkeypatch.setattr(plat, "probe_default_platform", fake_probe)
+    monkeypatch.setattr(plat, "force_cpu", lambda: None)
+    monkeypatch.setattr(plat.time, "monotonic", lambda: clock["t"])
+    monkeypatch.setattr(
+        plat.time, "sleep", lambda s: clock.__setitem__("t", clock["t"] + s)
+    )
+    platform, err = plat.wait_for_accelerator(
+        wait_budget_s=200.0, probe_timeout_s=60.0, retry_sleep_s=10.0
+    )
+    assert platform == "cpu" and "relay wedged" in err
+    assert cache.exists()
+    paid = len(probes)
+    assert paid >= 3
+
+    # Second call inside the TTL: zero probes, immediate CPU verdict.
+    platform2, err2 = plat.wait_for_accelerator(
+        wait_budget_s=200.0, probe_timeout_s=60.0
+    )
+    assert platform2 == "cpu"
+    assert err2 is not None and "cached verdict" in err2
+    assert len(probes) == paid
+
+
+def test_wait_for_accelerator_expired_verdict_reprobes(tmp_path, monkeypatch):
+    """A verdict past its TTL is ignored — the relay gets re-probed (and a
+    recovery clears the wedge marker)."""
+    import json as _json
+    import time as _time
+
+    cache = tmp_path / "probe.json"
+    cache.write_text(
+        _json.dumps({"platform": None, "wedged": True, "ts": _time.time() - 10_000})
+    )
+    monkeypatch.setenv("GROVE_PLATFORM_PROBE_CACHE_PATH", str(cache))
+    monkeypatch.setenv("GROVE_PLATFORM_PROBE_TTL_S", "900")
+    monkeypatch.setattr(plat, "probe_default_platform", lambda t: "tpu")
+    platform, err = plat.wait_for_accelerator(wait_budget_s=300.0)
+    assert (platform, err) == ("tpu", None)
+    doc = _json.loads(cache.read_text())
+    assert doc["wedged"] is False and doc["platform"] == "tpu"
+    # A healthy verdict never short-circuits: probing again still probes.
+    calls = []
+    monkeypatch.setattr(
+        plat, "probe_default_platform", lambda t: calls.append(t) or "tpu"
+    )
+    plat.wait_for_accelerator(wait_budget_s=300.0)
+    assert calls, "success verdicts must not skip the live probe"
+
+
+def test_wait_for_accelerator_ttl_zero_disables_cache(tmp_path, monkeypatch):
+    cache = tmp_path / "probe.json"
+    monkeypatch.setenv("GROVE_PLATFORM_PROBE_CACHE_PATH", str(cache))
+    monkeypatch.setenv("GROVE_PLATFORM_PROBE_TTL_S", "0")
+    clock = {"t": 0.0}
+    monkeypatch.setattr(plat, "probe_default_platform", lambda t: None)
+    monkeypatch.setattr(plat, "force_cpu", lambda: None)
+    monkeypatch.setattr(plat.time, "monotonic", lambda: clock.__setitem__("t", clock["t"] + 30.0) or clock["t"])
+    monkeypatch.setattr(plat.time, "sleep", lambda s: None)
+    platform, _ = plat.wait_for_accelerator(wait_budget_s=100.0, probe_timeout_s=30.0)
+    assert platform == "cpu"
+    assert not cache.exists()
+
+
+def test_wait_for_accelerator_max_attempts_env(monkeypatch):
+    """GROVE_PLATFORM_PROBE_MAX_ATTEMPTS caps the loop even when budget
+    remains; GROVE_PLATFORM_PROBE_TIMEOUT_S overrides the per-probe cap."""
+    probes = []
+    clock = {"t": 0.0}
+
+    def fake_probe(timeout_s):
+        probes.append(timeout_s)
+        clock["t"] += timeout_s
+        return None
+
+    monkeypatch.setenv("GROVE_PLATFORM_PROBE_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("GROVE_PLATFORM_PROBE_TIMEOUT_S", "25")
+    monkeypatch.setattr(plat, "probe_default_platform", fake_probe)
+    monkeypatch.setattr(plat, "force_cpu", lambda: None)
+    monkeypatch.setattr(plat.time, "monotonic", lambda: clock["t"])
+    monkeypatch.setattr(
+        plat.time, "sleep", lambda s: clock.__setitem__("t", clock["t"] + s)
+    )
+    platform, err = plat.wait_for_accelerator(
+        wait_budget_s=10_000.0, probe_timeout_s=60.0
+    )
+    assert platform == "cpu"
+    assert len(probes) == 2
+    assert all(p == 25.0 for p in probes)
+
+
 def test_wait_for_accelerator_force_cpu_env(monkeypatch):
     monkeypatch.setenv("GROVE_FORCE_CPU", "1")
     called = []

@@ -1,0 +1,449 @@
+"""Candidate-node pruning (solver/pruning.py): the pre-filtered solve path.
+
+The contract under test, from strongest to weakest:
+
+1. EXACTNESS — pruned and dense solves admit the IDENTICAL gang set on the
+   tier-1 scenarios (uncontended drains, clipped candidate budgets, the
+   contended trap-block workload), with every lossy rejection escalated to
+   a dense re-solve and counted, never silent.
+2. CACHE-KEY INDEPENDENCE — pruned executables key on the candidate pad:
+   the same backlog on a 2x fleet re-uses the small fleet's executables
+   byte-for-byte (zero new XLA lowerings).
+3. REPLAY — a journal recorded by a pruning-enabled controller replays
+   bit-identically through the recorded pruning fingerprint.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.sim.workloads import (
+    bench_topology,
+    synthetic_backlog,
+    synthetic_cluster,
+)
+from grove_tpu.solver.core import SolverParams, decode_assignments, solve
+from grove_tpu.solver.drain import drain_backlog
+from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.solver.pruning import (
+    PruningConfig,
+    candidate_pad,
+    plan_candidates,
+)
+from grove_tpu.solver.warm import WarmPath
+from grove_tpu.state import build_snapshot
+
+TOPO = bench_topology()
+
+
+def _expand(backlog):
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, TOPO)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    return gangs, pods
+
+
+def _setup(racks=2, nd=6, na=4, nf=5, blocks=1):
+    nodes = synthetic_cluster(
+        zones=1, blocks_per_zone=blocks, racks_per_block=racks
+    )
+    gangs, pods = _expand(
+        synthetic_backlog(n_disagg=nd, n_agg=na, n_frontend=nf)
+    )
+    return gangs, pods, build_snapshot(nodes, TOPO)
+
+
+# Budget below the 80-node test fleet so the candidate bucket (64) actually
+# beats the fleet axis and the pruned path engages.
+CFG = PruningConfig(enabled=True, max_candidates=60, min_fleet=16, min_pad=8)
+
+
+# --- candidate planning -------------------------------------------------------
+
+
+def test_plan_candidates_prunes_full_and_unschedulable_nodes():
+    """Nodes that cannot host one pod of ANY group (full, unschedulable)
+    leave the candidate axis; the survivors keep a compact remapped
+    topology with the host-level ordinal == row-index invariant."""
+    gangs, pods, snap = _setup(racks=4)
+    # Fill half the fleet solid and cordon a few nodes.
+    n = len(snap.node_names)
+    snap.allocated[: n // 2] = snap.capacity[: n // 2]
+    snap.schedulable[n // 2 : n // 2 + 3] = False
+    batch, _ = encode_gangs(gangs, pods, snap)
+    plan = plan_candidates(snap, batch, CFG)
+    assert plan is not None
+    assert plan.count <= n - n // 2 - 3
+    # No full/cordoned node made it in.
+    for i in plan.idx:
+        assert snap.schedulable[i]
+        assert (snap.free[i] > 0).any()
+    # Host level: ordinal == row index; coarse levels: compact ordinals.
+    levels = plan.node_domain_id.shape[0]
+    host = plan.node_domain_id[levels - 1, : plan.count]
+    assert (host == np.arange(plan.count)).all()
+    for li in range(levels - 1):
+        ids = plan.node_domain_id[li, : plan.count]
+        ids = ids[ids >= 0]
+        assert ids.max(initial=-1) < plan.count
+        assert plan.num_domains[li] == len(np.unique(ids))
+    # Pad rows: unschedulable, -1 domains; the cap-anchor row carries the
+    # FULL fleet's per-resource maxima so cap_scale matches dense.
+    assert not plan.schedulable[plan.count :].any()
+    assert (plan.node_domain_id[:, plan.count :] == -1).all()
+    assert np.allclose(plan.capacity[plan.count], snap.capacity.max(axis=0))
+
+
+def test_plan_candidates_not_worthwhile_cases():
+    gangs, pods, snap = _setup(racks=1)  # 20 nodes
+    batch, _ = encode_gangs(gangs, pods, snap)
+    # Fleet below minFleet: never prune.
+    assert plan_candidates(snap, batch, PruningConfig(enabled=True, min_fleet=64)) is None
+    # Bucket >= fleet axis: pruning buys nothing.
+    assert (
+        plan_candidates(
+            snap, batch, PruningConfig(enabled=True, min_fleet=8, min_pad=64)
+        )
+        is None
+    )
+
+
+def test_candidate_pad_ladder():
+    assert candidate_pad(10, PruningConfig(min_pad=8)) == 16
+    assert candidate_pad(15, PruningConfig(min_pad=8)) == 16
+    assert candidate_pad(16, PruningConfig(min_pad=8)) == 32  # +1 cap anchor
+    assert candidate_pad(3, PruningConfig(min_pad=64)) == 64
+    assert candidate_pad(100, PruningConfig(pad_ladder=(32, 256))) == 256
+    assert candidate_pad(300, PruningConfig(pad_ladder=(32, 256))) is None
+
+
+def test_clipped_budget_marks_gangs_lossy():
+    gangs, pods, snap = _setup(racks=4)
+    batch, _ = encode_gangs(gangs, pods, snap)
+    cfg = PruningConfig(enabled=True, max_candidates=24, min_fleet=16, min_pad=8)
+    plan = plan_candidates(snap, batch, cfg)
+    assert plan is not None and plan.clipped
+    # Every valid gang demanded a resource some excluded node still had
+    # free — all of them must carry the lossy witness.
+    assert plan.gang_lossy[np.asarray(batch.gang_valid)].all()
+
+
+# --- solve parity -------------------------------------------------------------
+
+
+def test_pruned_solve_admits_identical_set_uncontended():
+    gangs, pods, snap = _setup(racks=4)
+    batch, decode = encode_gangs(gangs, pods, snap)
+    wp = WarmPath()
+    dense = solve(snap, batch, SolverParams(), warm=wp)
+    pruned = solve(snap, batch, SolverParams(), warm=wp, pruning=CFG)
+    bd = decode_assignments(dense, decode, snap)
+    bp = decode_assignments(pruned, decode, snap)
+    assert set(bd) == set(bp)
+    assert wp.prune.pruned_solves == 1
+    # Every pruned binding lands on a REAL node of the fleet (decode
+    # scattered candidate ordinals back through the gather map).
+    for gb in bp.values():
+        for node in gb.values():
+            assert node in snap.node_index_map
+
+
+def test_pruned_solve_escalates_lossy_rejections_to_dense():
+    """A candidate budget too small for the backlog rejects gangs on the
+    pruned fleet; the lossy witness forces a dense re-solve, so the final
+    verdicts match the dense solver exactly — and the escalation is
+    counted, never silent."""
+    gangs, pods, snap = _setup(racks=2, nd=10, na=10, nf=10)
+    batch, decode = encode_gangs(gangs, pods, snap)
+    cfg = PruningConfig(enabled=True, max_candidates=12, min_fleet=16, min_pad=8)
+    wp = WarmPath()
+    dense = solve(snap, batch, SolverParams(), warm=wp)
+    pruned = solve(snap, batch, SolverParams(), warm=wp, pruning=cfg)
+    assert set(decode_assignments(dense, decode, snap)) == set(
+        decode_assignments(pruned, decode, snap)
+    )
+    assert wp.prune.escalations >= 1
+
+
+def test_pruned_solve_parity_on_contended_trap_blocks():
+    """Tier-1 contended scenario (sim/workloads.contended_cluster): the
+    admitted set under pruning equals the dense solver's — including the
+    gangs the dense solver genuinely rejects (escalation must CONFIRM those
+    rejections against the full fleet, not flip them)."""
+    from grove_tpu.sim.workloads import contended_backlog, contended_cluster
+
+    cn, csq = contended_cluster()
+    gangs, pods = _expand(contended_backlog(n_gangs=48))
+    snap = build_snapshot(cn, TOPO, bound_pods=csq)
+    batch, decode = encode_gangs(gangs, pods, snap)
+    cfg = PruningConfig(enabled=True, max_candidates=48, min_fleet=16, min_pad=8)
+    wp = WarmPath()
+    dense = solve(snap, batch, SolverParams(), warm=wp)
+    pruned = solve(snap, batch, SolverParams(), warm=wp, pruning=cfg)
+    bd = decode_assignments(dense, decode, snap)
+    bp = decode_assignments(pruned, decode, snap)
+    assert set(bd) == set(bp)
+    assert len(bd) < len(gangs), "scenario must carry real rejections"
+
+
+# --- drain parity + escalation ledger -----------------------------------------
+
+
+def test_pruned_drain_matches_dense_admissions():
+    gangs, pods, snap = _setup(racks=4)
+    bd, sd = drain_backlog(gangs, pods, snap, wave_size=8, warm_path=WarmPath())
+    cfg = PruningConfig(enabled=True, max_candidates=40, min_fleet=16, min_pad=8)
+    bp, sp = drain_backlog(
+        gangs, pods, snap, wave_size=8, warm_path=WarmPath(), pruning=cfg
+    )
+    assert set(bd) == set(bp)
+    assert sp.admitted == sd.admitted
+    assert sp.pruned_waves > 0
+    assert 0 < sp.candidate_nodes <= 40
+    assert not sp.donated  # pruning retains carries for escalation
+
+
+def test_pruned_drain_escalation_adopts_dense_verdicts():
+    """A clipped budget strands gangs the dense fleet would admit: the
+    escalation pass re-solves those waves dense, ADOPTS the changed
+    verdicts, and re-chains — the final admitted set equals dense."""
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=2)
+    gangs, pods = _expand(synthetic_backlog(n_disagg=10, n_agg=10, n_frontend=10))
+    snap = build_snapshot(nodes, TOPO)
+    bd, sd = drain_backlog(gangs, pods, snap, wave_size=8, warm_path=WarmPath())
+    cfg = PruningConfig(enabled=True, max_candidates=24, min_fleet=16, min_pad=8)
+    wp = WarmPath()
+    bp, sp = drain_backlog(
+        gangs, pods, snap, wave_size=8, warm_path=wp, pruning=cfg
+    )
+    assert set(bd) == set(bp)
+    assert sp.escalations >= 1
+    assert sp.escalations_adopted >= 1
+    assert wp.prune.escalations == sp.escalations
+    # First-principles capacity accounting: the pruned chain (gather,
+    # scatter, escalation re-runs) must never oversubscribe a node.
+    from grove_tpu.state.cluster import pod_request_vector
+
+    used: dict[str, float] = {}
+    for gb in bp.values():
+        for pod_name, node_name in gb.items():
+            req = pod_request_vector(pods[pod_name], snap.resource_names)
+            used[node_name] = used.get(node_name, 0.0) + float(req[0])
+    for node_name, cpu in used.items():
+        assert cpu <= snap.capacity[snap.node_index(node_name), 0] + 1e-5
+
+
+def test_pruned_drain_quality_report_parity():
+    """Quality-report parity (quality/report.py): the pruned drain's
+    bindings score identically on admitted count — the acceptance gate's
+    report-level view of set equality."""
+    from grove_tpu.quality.report import evaluate_placement
+
+    gangs, pods, snap = _setup(racks=4)
+    bd, _ = drain_backlog(gangs, pods, snap, wave_size=8, warm_path=WarmPath())
+    cfg = PruningConfig(enabled=True, max_candidates=40, min_fleet=16, min_pad=8)
+    bp, _ = drain_backlog(
+        gangs, pods, snap, wave_size=8, warm_path=WarmPath(), pruning=cfg
+    )
+    rd = evaluate_placement(gangs, pods, snap, bd)
+    rp = evaluate_placement(gangs, pods, snap, bp)
+    assert rp.admitted == rd.admitted
+    assert rp.admitted_ratio == rd.admitted_ratio
+
+
+# --- cache-key independence ---------------------------------------------------
+
+
+def test_pruned_executables_independent_of_fleet_pad():
+    """The SAME backlog on a 2x fleet must re-use every pruned executable:
+    the cache keys on the candidate pad, which is workload-determined, not
+    fleet-determined. (Dense solves of the same sweep re-lower — that IS
+    the problem pruning removes.)"""
+    gangs, pods = _expand(synthetic_backlog(n_disagg=4, n_agg=3, n_frontend=3))
+    cfg = PruningConfig(enabled=True, max_candidates=30, min_fleet=16, min_pad=8)
+    wp = WarmPath()
+    wp_dense = WarmPath()
+    lowerings = []
+    dense_lowerings = []
+    for racks in (4, 8):
+        nodes = synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=racks)
+        snap = build_snapshot(nodes, TOPO)
+        l0 = wp.executables.lowerings
+        _, sp = drain_backlog(
+            gangs, pods, snap, wave_size=8, warm_path=wp, pruning=cfg
+        )
+        assert sp.pruned_waves == sp.waves, "every wave must prune"
+        lowerings.append(wp.executables.lowerings - l0)
+        d0 = wp_dense.executables.lowerings
+        drain_backlog(gangs, pods, snap, wave_size=8, warm_path=wp_dense)
+        dense_lowerings.append(wp_dense.executables.lowerings - d0)
+    assert lowerings[0] > 0  # first fleet: shapes actually compiled
+    assert lowerings[1] == 0, "2x fleet must hit the candidate-pad executables"
+    assert dense_lowerings[1] > 0  # dense keys on the fleet pad: re-lowers
+
+
+# --- replay cross-check -------------------------------------------------------
+
+
+def test_pruning_enabled_controller_journal_replays_bitwise(tmp_path):
+    """PR-4 machinery as the exactness cross-check: a journal recorded by a
+    pruning-enabled controller carries the pruning fingerprint and replays
+    bit-identically through the same pruned path."""
+    from grove_tpu.orchestrator.controller import GroveController
+    from grove_tpu.orchestrator.store import Cluster
+    from grove_tpu.sim.simulator import Simulator
+    from grove_tpu.sim.workloads import _clique, _pcs
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=1, racks_per_block=4, hosts_per_rack=8,
+        cpu=8.0, tpu=0.0,
+    ):
+        cluster.nodes[n.name] = n
+    recorder = TraceRecorder(str(tmp_path / "journal"))
+    recorder.start()
+    cfg = PruningConfig(enabled=True, max_candidates=12, min_fleet=16, min_pad=8)
+    ctrl = GroveController(
+        cluster=cluster, topology=TOPO, recorder=recorder, pruning=cfg
+    )
+    sim = Simulator(cluster=cluster, controller=ctrl)
+    for i in range(5):
+        pcs = _pcs(
+            f"job{i}", cliques=[_clique("w", 4, "8")], constraint_domain="rack"
+        )
+        cluster.podcliquesets[pcs.metadata.name] = pcs
+    sim.run(30)
+    recorder.stop()
+    records = read_journal(recorder.path)
+    waves = [r for r in records if r["kind"] == "wave"]
+    assert waves
+    assert all(
+        r["solver"]["pruning"] and r["solver"]["pruning"]["enabled"]
+        for r in waves
+    ), "wave records must carry the pruning fingerprint"
+    report = replay_journal(records)
+    assert report.divergence_count == 0, report.to_doc()
+
+
+# --- config / surfaces --------------------------------------------------------
+
+
+def test_solver_pruning_config_block_validated():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {
+            "solver": {
+                "pruning": {
+                    "enabled": True,
+                    "maxCandidates": 1023,
+                    "padLadder": [128, 1024],
+                    "minPad": 32,
+                    "minFleet": 128,
+                }
+            }
+        }
+    )
+    assert not errors, errors
+    pc = cfg.solver.pruning_config()
+    assert pc is not None and pc.enabled
+    assert pc.max_candidates == 1023
+    assert pc.pad_ladder == (128, 1024)
+    assert pc.min_pad == 32 and pc.min_fleet == 128
+    # Disabled block -> None (the controller solves dense).
+    cfg2, errs2 = parse_operator_config({"solver": {"pruning": {}}})
+    assert not errs2 and cfg2.solver.pruning_config() is None
+
+    _, errs = parse_operator_config(
+        {"solver": {"pruning": {"maxCandidate": 5}}}
+    )
+    assert any("unknown field" in e for e in errs)
+    _, errs = parse_operator_config(
+        {"solver": {"pruning": {"maxCandidates": 0}}}
+    )
+    assert any("maxCandidates" in e for e in errs)
+    _, errs = parse_operator_config(
+        {"solver": {"pruning": {"padLadder": [64, 32]}}}
+    )
+    assert any("strictly increasing" in e for e in errs)
+    _, errs = parse_operator_config(
+        {"solver": {"pruning": {"enabled": "yes"}}}
+    )
+    assert any("enabled" in e for e in errs)
+
+
+def test_statusz_solver_section_and_metrics(tmp_path):
+    """Manager wiring: /statusz carries the solver.pruning view, warmPath
+    carries the flat prune counters, and the candidate metrics exist."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "solver": {
+                "compilationCacheDir": "",
+                "prewarmTopK": 0,
+                "pruning": {"enabled": True, "maxCandidates": 100, "minFleet": 8},
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    assert m.controller.pruning is not None
+    assert m.controller.pruning.max_candidates == 100
+    doc = m.statusz()
+    assert doc["solver"]["pruning"]["enabled"] is True
+    assert doc["solver"]["pruning"]["maxCandidates"] == 100
+    assert "pruneEscalations" in doc["solver"]["pruning"]
+    assert "pruneSolves" in doc["warmPath"]
+    text = m.metrics.render_text()
+    assert "grove_solver_candidate_nodes" in text
+    assert "grove_solver_candidate_escalations_total" in text
+
+
+# --- scale sweep (GROVE_BENCH_SCENARIO=scale engine, small sizes) -------------
+
+
+def test_scale_bench_small(monkeypatch):
+    """The scale scenario's engine at test size: parity + per-scale points
+    with candidate counts; the GROVE_BENCH_SCALE>1 full-size variant is the
+    slow tier below."""
+    import bench
+
+    monkeypatch.setenv("GROVE_BENCH_SCALES", "1,2")
+    monkeypatch.setenv("GROVE_BENCH_SCALE_RACKS", "2")
+    monkeypatch.setenv("GROVE_BENCH_SCALE_BACKLOG_FRAC", "0.02")
+    monkeypatch.setenv("GROVE_BENCH_PRUNE_MAX", "200")
+    monkeypatch.setenv("GROVE_BENCH_PRUNE_MIN_FLEET", "64")
+    monkeypatch.setenv("GROVE_BENCH_WAVE", "16")
+    out = bench.run_scale_bench()
+    assert out["admitted_parity"] is True
+    assert out["exec_reuse_across_scales"] is True
+    assert len(out["points"]) == 2
+    assert out["points"][1]["pruned_waves"] > 0
+    assert out["points"][1]["pruned_lowerings"] == 0
+
+
+@pytest.mark.slow
+def test_scale_bench_large_fleet_speedup(monkeypatch):
+    """GROVE_BENCH_SCALE>1 variant at meaningful size (slow tier): on the
+    4x fleet the pruned drain must beat dense and keep parity."""
+    import bench
+
+    monkeypatch.setenv("GROVE_BENCH_SCALES", "1,4")
+    monkeypatch.setenv("GROVE_BENCH_SCALE_RACKS", "16")
+    monkeypatch.setenv("GROVE_BENCH_SCALE_BACKLOG_FRAC", "1.0")
+    monkeypatch.delenv("GROVE_BENCH_PRUNE_MAX", raising=False)
+    monkeypatch.delenv("GROVE_BENCH_WAVE", raising=False)
+    out = bench.run_scale_bench()
+    assert out["admitted_parity"] is True
+    top = out["points"][-1]
+    assert top["pruned_waves"] > 0
+    assert top["speedup"] is not None and top["speedup"] >= 2.0, out
