@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -69,6 +69,17 @@ bench-scale:     ## fleet-scale sweep: dense vs candidate-pruned solve at GROVE_
 
 bench-scale-cpu: ## scale sweep with the TPU-relay probe skipped
 	GROVE_BENCH_SCENARIO=scale GROVE_FORCE_CPU=1 $(PY) bench.py
+
+# Streaming-drain scenario writes its evidence JSON under evidence/ (the
+# one stdout line is tee'd, so the acceptance artifact survives the run).
+# GROVE_BENCH_STREAM_SOAK=1 lengthens the trace (the slow-marked soak tier).
+bench-stream:    ## streaming drain: serial vs double-buffered pipeline under live arrivals
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=stream $(PY) bench.py | tee evidence/bench_stream_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+bench-stream-cpu: ## stream scenario with the TPU-relay probe skipped
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=stream GROVE_FORCE_CPU=1 $(PY) bench.py | tee evidence/bench_stream_cpu_$$(date -u +%Y%m%dT%H%M%SZ).json
 
 test-kind:       ## kubernetes-source tier against a REAL cluster; clean skip without a kubeconfig
 	@if $(PY) -c "from grove_tpu.cluster.kubernetes import load_kube_context; load_kube_context()" >/dev/null 2>&1; then \

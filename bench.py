@@ -38,6 +38,12 @@ The relay probe verdict persists under /tmp/grove-tpu-state with a TTL
 (GROVE_PLATFORM_PROBE_TTL_S, default 900; GROVE_PLATFORM_PROBE_TIMEOUT_S and
 GROVE_PLATFORM_PROBE_MAX_ATTEMPTS tune the loop) — a wedged relay costs one
 probe loop per window, not one per bench run.
+
+Stream scenario (GROVE_BENCH_SCENARIO=stream, `make bench-stream`):
+serial vs double-buffered pipelined streaming drain over one deterministic
+arrival trace. GROVE_BENCH_STREAM_{DURATION_S,RATE,SEED,DEPTH,WAVE} shape
+the trace and the pipeline; GROVE_BENCH_STREAM_SOAK=1 runs the long-soak
+variant (slow test tier, excluded from tier-1).
 """
 
 from __future__ import annotations
@@ -905,6 +911,155 @@ def run_quality_bench() -> dict:
     return out
 
 
+def run_stream_bench() -> dict:
+    """Streaming-drain scenario (`make bench-stream` /
+    GROVE_BENCH_SCENARIO=stream): sustained admission under live arrival
+    traffic (sim/workloads.arrival_process — Poisson + bursts, diurnal
+    modulation, heavy-tailed train gangs, multi-tenant churn).
+
+    Three runs over the SAME deterministic arrival trace through one warm
+    path (a warm-up pass pays XLA first, so the measured runs compare
+    pipelining, not compilation):
+
+      - serial (wave-at-a-time: retire every wave before forming the next) —
+        the baseline the tentpole is benchmarked against;
+      - pipelined saturated (depth-buffered: encode wave N+1 and decode/bind
+        wave N-depth while wave N solves) — the steady-state throughput
+        headline, gated on ADMITTED-SET PARITY with the serial run (wave
+        composition is a pure function of arrival order, so overlap must be
+        a latency optimization, never a semantics change);
+      - pipelined paced (arrivals become visible at their trace offsets) —
+        MEASURED per-gang time-to-bind (enqueue->bound) p50/p99 under the
+        arrival mix.
+
+    Headline value: pipelined/serial steady-state throughput ratio;
+    vs_baseline >= 1.0 means the >= 1.3x target holds AND parity held.
+    GROVE_BENCH_STREAM_SOAK=1 lengthens the trace (the long-soak variant,
+    slow-marked in tests and excluded from tier-1).
+
+    Host-core caveat (reported as host_cpus): overlap converts host-blocked
+    wait into throughput only when the solve runs on hardware the host is
+    NOT timesharing — a real accelerator, or spare cores for XLA-CPU. On a
+    single-core host, wall-clock is conserved by construction and the
+    pipeline's effect shows in host_blocked_*_s (host time spent blocked on
+    verdict fetches) instead of the wall ratio."""
+    from grove_tpu.sim.workloads import (
+        arrival_process,
+        bench_topology,
+        expand_arrivals,
+        synthetic_cluster,
+    )
+    from grove_tpu.solver.stream import StreamConfig, drain_stream
+    from grove_tpu.solver.warm import WarmPath
+    from grove_tpu.state import build_snapshot
+
+    soak = os.environ.get("GROVE_BENCH_STREAM_SOAK", "0") == "1"
+    duration = float(
+        os.environ.get("GROVE_BENCH_STREAM_DURATION_S", "90" if soak else "25")
+    )
+    rate = float(os.environ.get("GROVE_BENCH_STREAM_RATE", "12"))
+    seed = int(os.environ.get("GROVE_BENCH_STREAM_SEED", "20260804"))
+    depth = int(os.environ.get("GROVE_BENCH_STREAM_DEPTH", "2"))
+    wave_size = int(os.environ.get("GROVE_BENCH_STREAM_WAVE", "64"))
+
+    topo = bench_topology()
+    # 1280 hosts: big enough that per-wave solve compute is the term the
+    # overlap targets, small enough that the 3-run sweep fits the budget.
+    nodes = synthetic_cluster(
+        zones=2, blocks_per_zone=2, racks_per_block=16, hosts_per_rack=20
+    )
+    snapshot = build_snapshot(nodes, topo)
+    events = arrival_process(seed, duration_s=duration, base_rate=rate)
+    arrivals, pods = expand_arrivals(events, topo)
+    cfg = StreamConfig(depth=depth, wave_size=wave_size)
+    wp = WarmPath()
+
+    def _run(pipeline: bool, pace: bool = False):
+        return drain_stream(
+            arrivals,
+            pods,
+            snapshot,
+            config=cfg,
+            warm_path=wp,
+            pipeline=pipeline,
+            pace=pace,
+        )
+
+    _run(True)  # warm-up: pays XLA for every shape in the trace
+    b_serial, s_serial = _run(False)
+    b_pipe, s_pipe = _run(True)
+    parity = set(b_serial) == set(b_pipe)
+    speedup = (
+        s_serial.wall_s / s_pipe.wall_s if s_pipe.wall_s > 0 else 0.0
+    )
+    _, s_paced = _run(True, pace=True)
+    paced_pct = s_paced.bind_percentiles((50.0, 99.0)) or {}
+
+    target_speedup = 1.3
+    out = {
+        "scenario": "stream",
+        "metric": "stream_pipeline_speedup",
+        "unit": "x",
+        "value": round(speedup, 3),
+        "host_cpus": len(os.sched_getaffinity(0)),
+        # >= 1.0 = the >= 1.3x pipelined-throughput target holds AND the
+        # pipelined run admitted the identical gang set to the serial drain.
+        "vs_baseline": round(
+            (speedup / target_speedup) * (1.0 if parity else 0.0), 3
+        ),
+        "soak": soak,
+        "nodes": len(nodes),
+        "trace_duration_s": duration,
+        "trace_base_rate": rate,
+        "trace_seed": seed,
+        "arrival_events": len(events),
+        "gangs_offered": s_pipe.offered,
+        "pods_offered": len(pods),
+        "depth": depth,
+        "wave_size": wave_size,
+        "admitted_parity": parity,
+        "serial_admitted": s_serial.admitted,
+        "pipeline_admitted": s_pipe.admitted,
+        "serial_wall_s": round(s_serial.wall_s, 3),
+        "pipeline_wall_s": round(s_pipe.wall_s, 3),
+        "serial_gangs_per_sec": round(s_serial.gangs_per_sec, 2),
+        "pipeline_gangs_per_sec": round(s_pipe.gangs_per_sec, 2),
+        "pipeline_waves": s_pipe.waves,
+        "pipeline_windows": s_pipe.windows,
+        # Phase split of the measured pipelined run: harvest_s is the host's
+        # residual blocking time — the overlap target.
+        "pipeline_encode_s": round(s_pipe.drain.encode_s, 3),
+        "pipeline_dispatch_s": round(s_pipe.drain.dispatch_s, 3),
+        "pipeline_harvest_s": round(s_pipe.drain.harvest_s, 3),
+        "pipeline_decode_s": round(s_pipe.drain.decode_s, 3),
+        # Host time spent BLOCKED on verdict fetches — the quantity the
+        # pipeline exists to hide. On a single-core host this is the
+        # pipeline's observable effect (see the docstring caveat).
+        "host_blocked_serial_s": round(s_serial.drain.harvest_s, 3),
+        "host_blocked_pipeline_s": round(s_pipe.drain.harvest_s, 3),
+        # Measured time-to-bind (enqueue->bound) under PACED arrivals — the
+        # latency-under-load numbers the acceptance criteria ask for.
+        "paced_admitted": s_paced.admitted,
+        "paced_wall_s": round(s_paced.wall_s, 3),
+        "paced_bind_p50_s": round(paced_pct[50.0], 4) if paced_pct else None,
+        "paced_bind_p99_s": round(paced_pct[99.0], 4) if paced_pct else None,
+    }
+    return out
+
+
+# Scenario registry: GROVE_BENCH_SCENARIO -> (headline metric, unit, runner).
+# "" is the default north-star drain. New scenarios slot in as one entry —
+# main() owns no per-scenario branching.
+SCENARIOS: dict[str, tuple[str, str, object]] = {
+    "": ("gang_p99_bind_latency", "s", run_bench),
+    "defrag": ("defrag_plan_solve_s", "s", run_defrag_bench),
+    "quality": ("placement_quality_score", "score", run_quality_bench),
+    "replay": ("replay_divergence_total", "count", run_replay_bench),
+    "scale": ("scale_pruned_speedup", "x", run_scale_bench),
+    "stream": ("stream_pipeline_speedup", "x", run_stream_bench),
+}
+
+
 def main() -> int:
     # Budget must sit BELOW the driver's own kill timeout (round-1 evidence:
     # rc=124 at <=600s) or the watchdog never gets to emit the JSON line.
@@ -948,31 +1103,19 @@ def main() -> int:
 
         _RESULT["platform"] = jax.devices()[0].platform
         scenario = os.environ.get("GROVE_BENCH_SCENARIO", "")
-        if scenario == "defrag":
-            # Defrag scenario (`make bench-defrag`): plan latency + recovery
-            # headline instead of the drain p99.
-            _RESULT["metric"] = "defrag_plan_solve_s"
-            extras = run_defrag_bench()
-        elif scenario == "quality":
-            # Placement-quality scenario (`make bench-quality`): solver vs
-            # greedy vs exact on the discriminating mixed backlog.
-            _RESULT["metric"] = "placement_quality_score"
-            _RESULT["unit"] = "score"
-            extras = run_quality_bench()
-        elif scenario == "replay":
-            # Flight-recorder scenario (`make bench-replay`): recording
-            # overhead, bitwise replay divergence, +1-rack what-if delta.
-            _RESULT["metric"] = "replay_divergence_total"
-            _RESULT["unit"] = "count"
-            extras = run_replay_bench()
-        elif scenario == "scale":
-            # Fleet-scale scenario (`make bench-scale`): dense vs candidate-
-            # pruned solve across growing fleets under a fixed backlog.
-            _RESULT["metric"] = "scale_pruned_speedup"
-            _RESULT["unit"] = "x"
-            extras = run_scale_bench()
-        else:
-            extras = run_bench()
+        entry = SCENARIOS.get(scenario)
+        if entry is None:
+            # A typo'd scenario silently running the default drain is the
+            # worst failure mode of env config (same stance as the operator
+            # config validation).
+            raise ValueError(
+                f"GROVE_BENCH_SCENARIO={scenario!r} unknown; one of "
+                + "|".join(sorted(k for k in SCENARIOS if k))
+            )
+        metric, unit, runner = entry
+        _RESULT["metric"] = metric
+        _RESULT["unit"] = unit
+        extras = runner()
         extras["ts_utc"] = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
         extras["git_commit"] = _git_commit()
         if _RESULT["platform"] != "tpu":

@@ -179,7 +179,8 @@ def _get_table(client: GroveClient, kind: str) -> str:
     if kind == "solver":
         # Solver health at a glance: pass dispositions (damper
         # effectiveness), warm-path cache traffic, candidate-pruning
-        # counters, and the last drain's measured wave-harvest p50/p99 —
+        # counters, the last drain's measured wave-harvest p50/p99, and the
+        # streaming-drain config + last run (gangs/sec, bind p50/p99) —
         # all from /statusz.
         st = client.statusz()
         passes = st.get("solvePasses", {})
@@ -199,6 +200,14 @@ def _get_table(client: GroveClient, kind: str) -> str:
         rows += [
             ["lastDrain." + k, v]
             for k, v in sorted(solver_doc.get("lastDrain", {}).items())
+        ]
+        rows += [
+            ["streaming." + k, v]
+            for k, v in sorted(solver_doc.get("streaming", {}).items())
+        ]
+        rows += [
+            ["lastStream." + k, v]
+            for k, v in sorted(solver_doc.get("lastStream", {}).items())
         ]
         return _table(rows, ["METRIC", "VALUE"])
     if kind == "defrag":

@@ -196,6 +196,19 @@ class SolverConfig:
     #   minPad         int >= 2, smallest candidate bucket (default 64)
     #   minFleet       int >= 0, fleets below this never prune (default 256)
     pruning: dict = field(default_factory=dict)
+    # Streaming drain (solver/stream.py): the double-buffered pipelined
+    # admission loop under live arrival traffic — encode wave N+1 and
+    # decode/bind wave N-1 on the host while wave N solves on device. Keys:
+    #   depth     int >= 1, waves in flight before the host blocks on the
+    #             oldest (default 2 — classic double buffering)
+    #   waveSize  int >= 1, max gangs per formed arrival window (default 64;
+    #             smaller binds sooner, larger amortizes dispatch better)
+    #   maxWaitS  number >= 0, paced mode: how long the oldest queued gang
+    #             waits for companions before a partial wave dispatches
+    #             (default 0.05)
+    #   pollS     number > 0, paced mode: idle poll granularity (default
+    #             0.005)
+    streaming: dict = field(default_factory=dict)
 
     def solver_params(self):
         """SolverConfig.weights -> SolverParams (validated at config load)."""
@@ -222,6 +235,24 @@ class SolverConfig:
         if "minFleet" in p:
             kwargs["min_fleet"] = int(p["minFleet"])
         return PruningConfig(enabled=True, **kwargs)
+
+    def streaming_config(self):
+        """SolverConfig.streaming -> solver.stream.StreamConfig (validated
+        at config load; always returns a config — streaming has no enabled
+        bit, the block only parameterizes callers of drain_stream)."""
+        s = self.streaming or {}
+        from grove_tpu.solver.stream import StreamConfig
+
+        kwargs = {}
+        if "depth" in s:
+            kwargs["depth"] = int(s["depth"])
+        if "waveSize" in s:
+            kwargs["wave_size"] = int(s["waveSize"])
+        if "maxWaitS" in s:
+            kwargs["max_wait_s"] = float(s["maxWaitS"])
+        if "pollS" in s:
+            kwargs["poll_s"] = float(s["pollS"])
+        return StreamConfig(**kwargs)
 
 
 @dataclass
@@ -701,6 +732,33 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
                 errors.append(
                     "solver.pruning.padLadder: must be strictly increasing"
                 )
+    sm = cfg.solver.streaming
+    if not isinstance(sm, dict):
+        errors.append("solver.streaming: must be a mapping")
+    elif sm:
+        _STREAM_KEYS = {"depth", "waveSize", "maxWaitS", "pollS"}
+        for sk in sm:
+            if sk not in _STREAM_KEYS:
+                errors.append(f"solver.streaming.{sk}: unknown field")
+        for sk in ("depth", "waveSize"):
+            if sk in sm and (
+                not isinstance(sm[sk], int)
+                or isinstance(sm[sk], bool)
+                or sm[sk] < 1
+            ):
+                errors.append(f"solver.streaming.{sk}: must be an int >= 1")
+        if "maxWaitS" in sm and (
+            not isinstance(sm["maxWaitS"], (int, float))
+            or isinstance(sm["maxWaitS"], bool)
+            or sm["maxWaitS"] < 0
+        ):
+            errors.append("solver.streaming.maxWaitS: must be >= 0")
+        if "pollS" in sm and (
+            not isinstance(sm["pollS"], (int, float))
+            or isinstance(sm["pollS"], bool)
+            or sm["pollS"] <= 0
+        ):
+            errors.append("solver.streaming.pollS: must be > 0")
     df = cfg.defrag
     if not isinstance(df.threshold, (int, float)) or isinstance(
         df.threshold, bool

@@ -721,6 +721,24 @@ class Manager:
             "Pruned-solve rejections re-verified by a dense re-solve",
         )
         self._prune_escalations_exported = 0
+        # Streaming-drain observability (solver/stream.py): pipeline depth
+        # and steady-state throughput of the last streaming run (gauges cut
+        # from warm.last_stream), and the measured per-gang enqueue->bound
+        # distribution (samples drained from the warm path's bounded queue
+        # each refresh — a stream outrunning the scrape loses oldest
+        # samples, never memory).
+        self._m_stream_depth = self.metrics.gauge(
+            "grove_stream_depth",
+            "Pipeline depth of the last streaming drain (0 = serial)",
+        )
+        self._m_stream_gps = self.metrics.gauge(
+            "grove_stream_gangs_per_sec",
+            "Steady-state admitted gangs/sec of the last streaming drain",
+        )
+        self._m_stream_ttb = self.metrics.histogram(
+            "grove_stream_time_to_bind_seconds",
+            "Per-gang enqueue->bound seconds under streaming admission",
+        )
         # Every (queue, resource) series ever emitted — re-zeroed each pass
         # when usage disappears (gauge values persist otherwise).
         self._queue_metric_keys: dict[str, set] = {}
@@ -1044,6 +1062,19 @@ class Manager:
                 minFleet=int(pruning.min_fleet),
             )
         doc["pruning"].update(self.controller.warm.prune.stats())
+        # Streaming-drain view (solver/stream.py): the effective
+        # solver.streaming block plus the last streaming run's throughput
+        # and measured time-to-bind percentiles (source of the
+        # grove_stream_* metrics and the `get solver` stream rows).
+        scfg = self.config.solver.streaming_config()
+        doc["streaming"] = {
+            "depth": int(scfg.depth),
+            "waveSize": int(scfg.wave_size),
+            "maxWaitS": float(scfg.max_wait_s),
+            "pollS": float(scfg.poll_s),
+        }
+        if self.controller.warm.last_stream:
+            doc["lastStream"] = dict(self.controller.warm.last_stream)
         if self.controller.warm.last_drain:
             doc["lastDrain"] = dict(self.controller.warm.last_drain)
         return doc
@@ -1633,6 +1664,21 @@ class Manager:
         if delta > 0:
             self._m_candidate_escalations.inc(float(delta))
             self._prune_escalations_exported = prune.escalations
+        warm = self.controller.warm
+        if warm.last_stream:
+            self._m_stream_depth.set(float(warm.last_stream.get("depth", 0)))
+            self._m_stream_gps.set(
+                float(warm.last_stream.get("gangsPerSec", 0.0))
+            )
+        samples = warm.stream_bind_samples
+        if samples:
+            # Drain-once: the deque is the warm path's hand-off buffer; each
+            # sample lands in the histogram exactly once.
+            while True:
+                try:
+                    self._m_stream_ttb.observe(samples.popleft())
+                except IndexError:
+                    break
         quality = self.controller.quality_last
         if quality:
             self._m_quality_admitted_ratio.set(

@@ -9,6 +9,7 @@ from grove_tpu.solver.core import (  # noqa: F401
 )
 from grove_tpu.solver.encode import GangBatch, GangDecodeInfo, encode_gangs  # noqa: F401
 from grove_tpu.solver.drain import DrainStats, drain_backlog, plan_waves  # noqa: F401
+from grove_tpu.solver.stream import StreamConfig, StreamStats, drain_stream  # noqa: F401
 from grove_tpu.solver.pruning import (  # noqa: F401
     CandidatePlan,
     PruneStats,

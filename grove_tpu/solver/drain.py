@@ -16,14 +16,32 @@ throughput-optimal shape is different, and it lives here as a public API:
   3. Fully async dispatch: waves chain device-side through free_after and
      the ok_global bitmap (cross-wave base-gang gating costs zero host round
      trips), so the host enqueues every wave back to back.
-  4. ONE batched device_get harvests every wave's verdicts. Measured on the
-     TPU relay (round 3): each separate device->host fetch pays a fixed
-     ~70-150ms, and per-wave polling blew a 10k-pod drain from <1s to 39s.
-     `harvest="wave"` deliberately trades that back: it blocks per wave and
-     records completion stamps so p50/p99 bind latency is MEASURED rather
-     than definitional (the placement-quality evaluation configuration —
-     bench.py GROVE_BENCH_HARVEST=wave; the chained mode stays the
-     throughput headline).
+  4. Three HARVEST disciplines over the one dispatch chain (identical
+     bindings by construction — the chain is the same; only where the host
+     blocks differs):
+
+     - "chained":  ONE batched device_get harvests every wave's verdicts.
+       Measured on the TPU relay (round 3): each separate device->host fetch
+       pays a fixed ~70-150ms, and per-wave polling blew a 10k-pod drain
+       from <1s to 39s. The throughput headline.
+     - "wave":     block per wave and record completion stamps, so p50/p99
+       bind latency is MEASURED rather than definitional. Pays the per-fetch
+       cost every wave AND idles the device while the host encodes — the
+       measurement configuration and the serial baseline the pipelined mode
+       is benchmarked against.
+     - "pipeline": double-buffered. Dispatch wave N, then retire (fetch +
+       decode + journal) wave N-depth while N is in flight — the host's
+       encode/decode overlaps device compute instead of serializing with
+       it, and per-wave completion stamps are still MEASURED. The streaming
+       drain (solver/stream.py) drives this mode continuously under live
+       arrival traffic.
+
+The engine below (`_WavePipeline`) owns the carry chain, retirement order,
+exactness escalation (solver/pruning.py), and flight-recorder journaling;
+`drain_backlog` and `solver/stream.py`'s `drain_stream` are thin drivers.
+Retirement is strictly in dispatch order, so journaled waves carry monotonic
+ids in commit order — trace replay (trace/replay.py) stays bitwise-green on
+the overlapped path.
 
 bench.py is a thin consumer of this module; tests/test_drain.py pins the
 semantics platform-independently.
@@ -42,6 +60,8 @@ from grove_tpu.solver.core import (
 )
 from grove_tpu.solver.encode import encode_gangs, gang_shape, next_pow2
 
+HARVEST_MODES = ("chained", "wave", "pipeline")
+
 
 @dataclass
 class DrainStats:
@@ -50,7 +70,7 @@ class DrainStats:
     compile_s: float = 0.0  # warm-up of each (shape, pad) program
     encode_s: float = 0.0  # host dense encode, all waves
     dispatch_s: float = 0.0  # async enqueue of all solves
-    harvest_s: float = 0.0  # the single blocking batched device_get
+    harvest_s: float = 0.0  # host time blocked fetching verdicts
     decode_s: float = 0.0  # host decode of all bindings
     total_s: float = 0.0  # timed section: encode+dispatch+harvest+decode
     waves: int = 0
@@ -80,15 +100,37 @@ class DrainStats:
     escalations: int = 0
     escalations_adopted: int = 0
     # Harvest mode: "chained" (default — ONE batched device_get at the end,
-    # so per-gang latency is definitionally the drain wall) or "wave"
-    # (block per wave and record its completion stamp, so p50/p99 are
-    # MEASURED). Wave mode pays the per-fetch device->host fixed cost every
-    # wave (~70-150ms each on the TPU relay, round 3) — it is the
-    # measurement configuration, not the throughput one.
+    # so per-gang latency is definitionally the drain wall), "wave" (block
+    # per wave: serial, measured stamps), or "pipeline" (double-buffered:
+    # retire wave N-depth while wave N is in flight — measured stamps AND
+    # host/device overlap). See the module docstring.
     harvest: str = "chained"
-    # Wave mode only: (gangs admitted in wave, seconds since drain start at
-    # which the wave's verdicts were host-visible), in dispatch order.
+    # Pipeline depth (harvest="pipeline"): waves allowed in flight before
+    # the host blocks on the oldest. 0 for the other modes.
+    depth: int = 0
+    # Waves journaled to a flight recorder, in commit order (monotonic ids).
+    journaled_waves: int = 0
+    # Wave/pipeline modes only: (gangs admitted in wave, seconds since drain
+    # start at which the wave's verdicts were host-visible), in commit order.
     wave_latencies: list = field(default_factory=list)
+
+    def latency_percentiles(self, qs=(50.0, 99.0)) -> dict | None:
+        """Measured per-gang bind-latency percentiles from `wave_latencies`
+        (every gang of a wave lands at that wave's completion stamp).
+
+        Edge cases are part of the contract (bench and /statusz consumers
+        must not fabricate numbers): returns None for a 0-wave drain, a
+        chained drain (nothing measured), or a drain in which NO wave
+        admitted any gang — a percentile over completion stamps of waves
+        that bound nothing is not a bind latency. A 1-wave drain returns
+        that wave's stamp at every requested percentile."""
+        series = [(n, t) for n, t in self.wave_latencies if n > 0]
+        if not series:
+            return None
+        import numpy as np
+
+        lat = np.concatenate([np.full(n, t) for n, t in series])
+        return {float(q): float(np.percentile(lat, q)) for q in qs}
 
 
 def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int]]:
@@ -137,6 +179,460 @@ def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int
     return waves
 
 
+class _WavePipeline:
+    """The drain engine: one device-chained dispatch stream with ordered
+    retirement.
+
+    Dispatch is always fully async (the free/ok_global carry chains on
+    device); `retire_lag` decides where the host blocks:
+
+      None  chained — retire only at flush(), via ONE batched device_get
+      0     wave-serial — retire each wave immediately after dispatch
+      k>0   pipelined — at most k waves in flight; submitting wave N first
+            retires wave N-k, so the host decodes/journals old waves while
+            new ones compute
+
+    Retirement is strictly in dispatch order. A retiring pruned wave with a
+    lossy rejection escalates to a dense re-solve from its retained entering
+    carry (solver/pruning.py exactness invariant); an ADOPTED dense verdict
+    re-chains every wave still in flight from the adopted carry, so the
+    final bindings are identical across all three disciplines — harvest is a
+    latency/throughput choice, never a semantics change (test-pinned).
+
+    With a flight recorder attached, every retired wave journals at commit
+    with a monotonic wave id, its exact entering free rows, the entering
+    allocated table, prior-wave admissions as `scheduled`, and (pruned
+    waves) the candidate-node list — the closure trace/replay.py needs to
+    reproduce the wave bitwise standalone.
+    """
+
+    def __init__(
+        self,
+        *,
+        gangs: list,
+        pods_by_name: dict,
+        snapshot,
+        params: SolverParams,
+        warm_path,
+        stats: DrainStats,
+        solver=None,  # non-None: portfolio closure (bypasses the exec cache)
+        pruning=None,
+        donate: bool = False,
+        retire_lag: int | None = None,
+        recorder=None,
+        wave_prefix: str = "drain",
+        record_stamps: bool = False,
+        on_commit=None,  # fn(members, wave_bindings, stamp_s) at each commit
+    ) -> None:
+        import jax.numpy as jnp
+
+        self.pods_by_name = pods_by_name
+        self.snapshot = snapshot
+        self.params = params
+        self.wp = warm_path
+        self.stats = stats
+        self.pruning = pruning
+        self.solver = solver
+        self.use_exec_cache = solver is None
+        self.retire_lag = retire_lag
+        self.recorder = recorder if self.use_exec_cache else None
+        self.wave_prefix = wave_prefix
+        self.record_stamps = record_stamps
+        self.on_commit = on_commit
+        # Entering free/ok_global carries are retained per wave for the
+        # exactness-escalation re-solves and for journaling the exact
+        # entering state; a donated buffer would be dead.
+        self.retain_carries = pruning is not None or self.recorder is not None
+        self.donate = bool(donate and self.use_exec_cache and not self.retain_carries)
+        stats.donated = self.donate
+
+        self.gidx = {g.name: i for i, g in enumerate(gangs)}
+        self.capacity = jnp.asarray(snapshot.capacity)
+        self.schedulable = jnp.asarray(snapshot.schedulable)
+        self.node_domain_id = jnp.asarray(snapshot.node_domain_id)
+        # Hoisted once for BOTH the warm pre-pass and the timed section — the
+        # timed region must not re-pay the host->device transfer of the fleet
+        # free tensor.
+        self.free = jnp.asarray(snapshot.free)
+        self.ok_g = jnp.zeros((len(gangs),), dtype=bool)
+        self.dmax = coarse_dmax_of(snapshot)
+        self.epoch = snapshot.encode_epoch()
+
+        self.inflight: list[dict] = []
+        self.bindings: dict[str, dict[str, str]] = {}
+        self.commit_seq = 0
+        self.scheduled_admitted: set[str] = set()
+        self._warmed: set[tuple] = set()
+        self.t0 = time.perf_counter()  # restamped by drain_backlog after warm
+        if self.recorder is not None:
+            import numpy as np
+
+            # Running host-side allocation table: wave k journals the state
+            # ENTERING it, then commits its own bindings into the table.
+            self._alloc = np.array(snapshot.allocated, copy=True)
+            self._cap_np = np.asarray(snapshot.capacity)
+
+    # ---- encode + candidate plan -------------------------------------------------
+
+    def encode_wave(self, ws, reuse_rows: bool = True):
+        from grove_tpu.solver import warm as warm_mod
+
+        wave, (mg_c, ms_c, mp_c), pad = ws
+        row_keys = None
+        if reuse_rows:
+            row_keys = [
+                (warm_mod.gang_row_digest(g, self.pods_by_name), self.epoch)
+                for g in wave
+            ]
+        return encode_gangs(
+            wave,
+            self.pods_by_name,
+            self.snapshot,
+            max_groups=mg_c,
+            max_sets=ms_c,
+            max_pods=mp_c,
+            pad_gangs_to=pad,
+            global_index_of=self.gidx,
+            row_cache=self.wp.encode_rows if reuse_rows else None,
+            row_keys=row_keys,
+        )
+
+    def cut_plan(self, batch):
+        """Candidate plan for one wave's batch (None = solve dense).
+        Plans are cut against the INITIAL snapshot free — free only shrinks
+        while draining, so the initial candidates are a superset of every
+        later wave's eligible set (solver/pruning.py)."""
+        if self.pruning is None or not self.use_exec_cache:
+            return None
+        from grove_tpu.solver.pruning import plan_candidates
+
+        t0p = time.perf_counter()
+        plan = plan_candidates(self.snapshot, batch, self.pruning)
+        self.stats.prune_s += time.perf_counter() - t0p
+        return plan
+
+    def pruned_inputs(self, plan, batch):
+        """(jnp batch on the candidate axis, capacity, schedulable,
+        node_domain_id) — static tensors ride the content-digest device
+        cache, so repeated waves of one class upload once."""
+        import jax.numpy as jnp
+
+        pbatch = plan.gather_batch(batch)
+        cap_p = self.wp.device.device_array(plan.capacity, jnp.float32)
+        sched_p = self.wp.device.device_array(plan.schedulable)
+        ndid_p = self.wp.device.device_array(plan.node_domain_id, jnp.int32)
+        return pbatch, cap_p, sched_p, ndid_p
+
+    def warm_shape(self, ws) -> bool:
+        """AOT-compile (never execute) the executable this wave shape needs;
+        False when the shape was already warmed through this engine. The
+        streaming driver calls this lazily on first encounter; drain_backlog
+        pre-warms every planned shape up front."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        if ws[1:] in self._warmed or not self.use_exec_cache:
+            return False
+        self._warmed.add(ws[1:])
+        # Warm-up encodes bypass the row cache so the TIMED encode stays an
+        # honest measurement (the warm drain of a repeated backlog still
+        # hits: the timed encodes populate the cache).
+        warm_batch, _ = self.encode_wave(ws, reuse_rows=False)
+        zeros_okg = jnp.zeros_like(self.ok_g)
+        warm_plan = self.cut_plan(warm_batch)
+        if warm_plan is not None:
+            wb, cap_p, sched_p, ndid_p = self.pruned_inputs(warm_plan, warm_batch)
+            self.wp.executables.ensure_compiled(
+                warm_plan.gather_free(np.asarray(self.snapshot.free, np.float32)),
+                cap_p,
+                sched_p,
+                ndid_p,
+                wb,
+                self.params,
+                zeros_okg,
+                coarse_dmax=warm_plan.coarse_dmax(),
+                donate=self.donate,
+            )
+        else:
+            self.wp.executables.ensure_compiled(
+                self.free,
+                self.capacity,
+                self.schedulable,
+                self.node_domain_id,
+                warm_batch,
+                self.params,
+                zeros_okg,
+                coarse_dmax=self.dmax,
+                donate=self.donate,
+            )
+        return True
+
+    # ---- dispatch ----------------------------------------------------------------
+
+    def _dispatch(self, rec: dict) -> None:
+        """Dispatch (or re-dispatch) one wave from the current carry; updates
+        the record in place and advances the carry."""
+        free_in, okg_in = self.free, self.ok_g
+        if rec["plan"] is not None:
+            plan = rec["plan"]
+            wb, cap_p, sched_p, ndid_p = rec["pruned_inputs"]
+            result = self.wp.executables.solve(
+                plan.gather_free(free_in), cap_p, sched_p, ndid_p, wb,
+                self.params, okg_in, coarse_dmax=plan.coarse_dmax(), donate=False,
+            )
+            free_out = plan.scatter_free(free_in, result.free_after)
+        elif self.use_exec_cache:
+            # Donated wave carry: free/ok_g are forfeited to the solve and
+            # immediately rebound to the result — the capacity update is an
+            # in-place device buffer, never a host round trip. The stale
+            # host free (snapshot.free) is recomputed on access and never
+            # consulted again inside this chain.
+            result = self.wp.executables.solve(
+                free_in, self.capacity, self.schedulable, self.node_domain_id,
+                rec["batch"], self.params, okg_in, coarse_dmax=self.dmax,
+                donate=self.donate,
+            )
+            free_out = result.free_after
+        else:
+            result = self.solver(
+                free_in, self.capacity, self.schedulable, self.node_domain_id,
+                rec["batch"], self.params, okg_in, coarse_dmax=self.dmax,
+            )
+            free_out = result.free_after
+        rec.update(
+            ok=result.ok,
+            score=result.placement_score,
+            assigned=result.assigned,
+            ok_np=None,  # host copy; fetched at retirement
+            free_in=free_in if self.retain_carries else None,
+            okg_in=okg_in if self.retain_carries else None,
+        )
+        self.free, self.ok_g = free_out, result.ok_global
+
+    def submit(self, ws) -> None:
+        """Encode + dispatch one planned wave, then retire down to the
+        pipeline depth. Keeps only what decode needs per wave — retaining
+        full SolveResults would pin every wave's chaining buffers in device
+        memory. (Carry-retaining drains additionally keep each wave's
+        ENTERING free/ok_global for escalation and journaling.)"""
+        stats = self.stats
+        te = time.perf_counter()
+        batch, decode = self.encode_wave(ws)
+        stats.encode_s += time.perf_counter() - te
+        plan = self.cut_plan(batch)
+        rec = {
+            "members": ws[0],
+            "shape": ws[1],
+            "pad": ws[2],
+            "batch": batch,
+            "decode": decode,
+            "plan": plan,
+            "escalated": False,
+        }
+        if plan is not None:
+            rec["pruned_inputs"] = self.pruned_inputs(plan, batch)
+            stats.pruned_waves += 1
+            stats.candidate_nodes = max(stats.candidate_nodes, plan.count)
+            stats.candidate_pad = max(stats.candidate_pad, plan.pad)
+        ts = time.perf_counter()
+        self._dispatch(rec)
+        stats.dispatch_s += time.perf_counter() - ts
+        stats.waves += 1
+        self.inflight.append(rec)
+        if self.retire_lag is not None:
+            while len(self.inflight) > self.retire_lag:
+                self._retire_next()
+
+    # ---- retirement --------------------------------------------------------------
+
+    def _fetch(self, rec: dict) -> None:
+        """Make this wave's verdicts host-visible (blocks until its solve
+        completes; later waves keep computing — they are already enqueued)."""
+        import numpy as np
+
+        if rec.get("ok_np") is not None:
+            return
+        th = time.perf_counter()
+        rec["ok_np"] = np.asarray(rec["ok"])
+        rec["score_np"] = np.asarray(rec["score"])
+        rec["assigned_np"] = np.asarray(rec["assigned"])
+        self.stats.harvest_s += time.perf_counter() - th
+
+    def _retire_next(self) -> None:
+        rec = self.inflight.pop(0)
+        self._fetch(rec)
+        self._finalize(rec)
+
+    def _finalize(self, rec: dict) -> None:
+        """Escalate if needed, then commit: decode, stamp, journal."""
+        import numpy as np
+
+        stats = self.stats
+        if rec["plan"] is not None and not rec["escalated"]:
+            # Exactness escalation: a valid gang rejected on the pruned
+            # fleet whose plan marked it lossy re-solves DENSE from the
+            # recorded entering carry. Identical verdicts CONFIRM the
+            # rejections (pruned results stand); any changed verdict ADOPTS
+            # the dense wave and re-chains every wave still in flight
+            # (every shape is already compiled, so a re-run is pure
+            # execution). Retirement order makes this equivalent to the
+            # serial scan: when wave k retires, waves < k are final.
+            from grove_tpu.solver.pruning import lossy_rejections
+
+            lossy = lossy_rejections(
+                rec["plan"], rec["batch"].gang_valid, rec["ok_np"]
+            )
+            if bool(lossy.any()):
+                rec["escalated"] = True
+                stats.escalations += 1
+                dense = self.wp.executables.solve(
+                    rec["free_in"], self.capacity, self.schedulable,
+                    self.node_domain_id, rec["batch"], self.params,
+                    rec["okg_in"], coarse_dmax=self.dmax, donate=False,
+                )
+                dense_ok = np.asarray(dense.ok)
+                if not bool(np.all(dense_ok == rec["ok_np"])):
+                    stats.escalations_adopted += 1
+                    rec.update(
+                        ok=dense.ok,
+                        score=dense.placement_score,
+                        assigned=dense.assigned,
+                        ok_np=dense_ok,
+                        score_np=np.asarray(dense.placement_score),
+                        assigned_np=np.asarray(dense.assigned),
+                        plan=None,  # dense verdicts: decode skips the remap
+                    )
+                    # Re-chain everything still in flight from the adopted
+                    # carry; their inputs changed, so they re-verify (fresh
+                    # lossy check) at their own retirement.
+                    self.free, self.ok_g = dense.free_after, dense.ok_global
+                    for rec2 in self.inflight:
+                        rec2["escalated"] = False
+                        self._dispatch(rec2)
+
+        stamp = time.perf_counter() - self.t0
+        if self.record_stamps:
+            stats.wave_latencies.append((int(rec["ok_np"].sum()), stamp))
+
+        td = time.perf_counter()
+        asg = rec["assigned_np"]
+        if rec["plan"] is not None:
+            # Decode scatters candidate ordinals back through the gather map.
+            asg = rec["plan"].remap_assigned(asg)
+        wave_bindings = decode_bindings(
+            rec["ok_np"], asg, rec["decode"], self.snapshot
+        )
+        stats.decode_s += time.perf_counter() - td
+        stats.scores.extend(rec["score_np"][rec["ok_np"]].tolist())
+        for gang_name, pod_bindings in wave_bindings.items():
+            self.bindings[gang_name] = pod_bindings
+            stats.admitted += 1
+            stats.pods_bound += len(pod_bindings)
+        if self.recorder is not None:
+            self._journal(rec, wave_bindings)
+        self.scheduled_admitted.update(wave_bindings)
+        self.commit_seq += 1
+        if self.on_commit is not None:
+            self.on_commit(rec["members"], wave_bindings, stamp)
+
+    def flush(self) -> None:
+        """Retire everything still in flight. Chained mode harvests with ONE
+        batched device_get (a single d2h relay round trip) before retiring
+        in order; the other modes have at most `retire_lag` waves left."""
+        import numpy as np
+
+        if self.retire_lag is None and self.inflight:
+            import jax
+
+            th = time.perf_counter()
+            fetched = jax.device_get(
+                [(r["ok"], r["score"], r["assigned"]) for r in self.inflight]
+            )
+            self.stats.harvest_s += time.perf_counter() - th
+            for rec, (ok, score, assigned) in zip(self.inflight, fetched):
+                rec["ok_np"] = np.asarray(ok)
+                rec["score_np"] = np.asarray(score)
+                rec["assigned_np"] = np.asarray(assigned)
+        while self.inflight:
+            self._retire_next()
+
+    # ---- flight-recorder journaling ---------------------------------------------
+
+    def _journal(self, rec: dict, wave_bindings: dict) -> None:
+        """Journal the committed wave with a monotonic id and the closure
+        replay needs to reproduce it STANDALONE: exact entering free rows
+        (the device-chained carry, fetched bitwise), the entering allocated
+        table, prior-wave admissions as `scheduled` (cross-wave base-gang
+        deps resolve without the ok_global bitmap), and — for pruned waves —
+        the candidate-node list (plans were cut against the INITIAL free, so
+        replay must not re-cut them against the wave's entering free)."""
+        import numpy as np
+
+        from grove_tpu.state.cluster import pod_request_vector
+
+        snap = self.snapshot
+        members = rec["members"]
+        free_in = np.asarray(rec["free_in"], dtype=np.float32)
+        n_real = len(snap.node_names)
+        diff_rows = np.flatnonzero(
+            (free_in[:n_real] != self._cap_np[:n_real]).any(axis=1)
+        )
+        free_rows = {
+            snap.node_names[i]: [float(v) for v in free_in[i]] for i in diff_rows
+        }
+        ok_by_name = {
+            g.name: bool(rec["ok_np"][i]) for i, g in enumerate(members)
+        }
+        valid_by_name = {
+            g.name: bool(rec["batch"].gang_valid[i]) for i, g in enumerate(members)
+        }
+        scores = {
+            g.name: float(rec["score_np"][i]) for i, g in enumerate(members)
+        }
+        mg_c, ms_c, mp_c = rec["shape"]
+        try:
+            journaled = self.recorder.capture_wave(
+                now=time.time(),
+                wave=f"{self.wave_prefix}-{self.commit_seq:06d}",
+                snapshot=snap,
+                gangs=members,
+                pods_by_name=self.pods_by_name,
+                scheduled_names=set(self.scheduled_admitted),
+                bound_nodes={},
+                reuse_nodes={},
+                spread_avoid={},
+                max_groups=mg_c,
+                max_sets=ms_c,
+                max_pods=mp_c,
+                pad_gangs_to=rec["pad"],
+                params=self.params,
+                portfolio=1,
+                escalate_portfolio=1,
+                pruning=self.pruning if rec["plan"] is not None else None,
+                plan=wave_bindings,
+                ok_by_name=ok_by_name,
+                valid_by_name=valid_by_name,
+                scores=scores,
+                solve_seconds=0.0,  # async dispatch: no per-wave solve wall
+                allocated_override=self._alloc,
+                free_rows=free_rows,
+                candidates=(
+                    rec["plan"].idx.tolist() if rec["plan"] is not None else None
+                ),
+            )
+            if journaled:
+                self.stats.journaled_waves += 1
+        except Exception:  # noqa: BLE001 — tracing must never break the drain
+            pass
+        # Commit this wave's bindings into the running allocation table so
+        # the NEXT journaled wave records the state entering it.
+        for pod_bindings in wave_bindings.values():
+            for pod_name, node_name in pod_bindings.items():
+                self._alloc[snap.node_index(node_name)] += pod_request_vector(
+                    self.pods_by_name[pod_name], snap.resource_names
+                )
+
+
 def drain_backlog(
     gangs: list,
     pods_by_name: dict,
@@ -148,8 +644,10 @@ def drain_backlog(
     warm: bool = True,
     warm_path=None,  # solver.warm.WarmPath; None = the process-shared one
     donate: bool | None = None,  # None = auto (on for accelerators, off CPU)
-    harvest: str = "chained",  # "chained" | "wave" (see DrainStats.harvest)
+    harvest: str = "chained",  # see HARVEST_MODES / DrainStats.harvest
+    depth: int = 2,  # harvest="pipeline": waves in flight before blocking
     pruning=None,  # solver.pruning.PruningConfig; None/disabled = dense
+    recorder=None,  # trace.recorder.TraceRecorder; journals committed waves
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
 
@@ -171,17 +669,24 @@ def drain_backlog(
     wave carry is donated (`donate`) so chaining is an in-place device
     update rather than a copy per wave.
 
+    Harvest disciplines (identical bindings by construction — test-pinned):
+    "chained" batches every wave's fetch into one device_get; "wave" blocks
+    per wave (serial; measured completion stamps); "pipeline" retires wave
+    N-`depth` while wave N is in flight — measured stamps at near-chained
+    throughput. See the module docstring.
+
     Candidate pruning (`pruning`, solver/pruning.py): each wave's solve runs
     on the gathered candidate sub-fleet; the fleet free carry chains on
-    device through per-wave gather/scatter. Candidate plans are cut against
-    the INITIAL snapshot free — free only shrinks while draining, so the
-    initial candidates are a superset of every later wave's eligible set.
-    Exactness escalation after harvest: a wave holding a valid gang that was
-    rejected AND marked lossy by its plan re-solves DENSE from its recorded
-    entering carry; a re-solve that changes any verdict is adopted wholesale
-    and the chain re-runs from that wave (executables already cached).
-    Pruning disables carry donation — entering carries are retained for the
-    escalation re-solves.
+    device through per-wave gather/scatter. Exactness escalation at
+    retirement: a wave holding a valid gang that was rejected AND marked
+    lossy by its plan re-solves DENSE from its retained entering carry;
+    adopted verdicts re-chain the waves still in flight — admitted sets are
+    identical to dense. Pruning (and journaling) disable carry donation —
+    entering carries are retained.
+
+    `recorder` (single-variant drains only): journal every committed wave to
+    the flight recorder with monotonic wave ids in commit order, carrying
+    the exact closure for bitwise standalone replay (trace/replay.py).
     """
     import jax
     import jax.numpy as jnp
@@ -190,8 +695,12 @@ def drain_backlog(
     from grove_tpu.solver import warm as warm_mod
 
     params = params or SolverParams()
-    if harvest not in ("chained", "wave"):
-        raise ValueError(f"harvest must be 'chained' or 'wave', got {harvest!r}")
+    if harvest not in HARVEST_MODES:
+        raise ValueError(
+            f"harvest must be one of {'|'.join(HARVEST_MODES)}, got {harvest!r}"
+        )
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
     wp = warm_path if warm_path is not None else warm_mod.default_warm_path()
     if pruning is not None and not getattr(pruning, "enabled", False):
         pruning = None
@@ -199,11 +708,7 @@ def drain_backlog(
         pruning = None  # portfolio solves own the node-axis layout
     if donate is None:
         donate = warm_mod.donation_default()
-    if pruning is not None:
-        # Entering free/ok_global carries are retained per wave for the
-        # exactness-escalation re-solves; a donated buffer would be dead.
-        donate = False
-    use_exec_cache = portfolio == 1
+    solver = None
     if portfolio > 1:
         # Per-wave portfolio: every wave solved under P weight variants, the
         # winner's free_after/ok chained forward (solver.portfolio knob; the
@@ -225,12 +730,10 @@ def drain_backlog(
                 pstack=pstack, mesh=mesh,
             )
 
-    else:
-        solver = solve_batch
     stats = DrainStats(
         gangs=len(gangs),
-        donated=bool(donate and use_exec_cache),
         harvest=harvest,
+        depth=depth if harvest == "pipeline" else 0,
     )
     if not gangs:
         return {}, stats
@@ -239,114 +742,43 @@ def drain_backlog(
     rows0 = (wp.encode_rows.hits, wp.encode_rows.misses)
 
     waves = plan_waves(gangs, wave_size)
-    stats.waves = len(waves)
-    gidx = {g.name: i for i, g in enumerate(gangs)}
 
-    capacity = jnp.asarray(snapshot.capacity)
-    schedulable = jnp.asarray(snapshot.schedulable)
-    node_domain_id = jnp.asarray(snapshot.node_domain_id)
-    # Hoisted once for BOTH the warm pre-pass and the timed section — the
-    # timed region must not re-pay the host->device transfer of the fleet
-    # free tensor (it used to upload a second copy inside t0).
-    free_init = jnp.asarray(snapshot.free)
-    dmax = coarse_dmax_of(snapshot)
-    epoch = snapshot.encode_epoch()
-
-    def cut_plan(batch):
-        """Candidate plan for one wave's batch (None = solve dense)."""
-        if pruning is None:
-            return None
-        from grove_tpu.solver.pruning import plan_candidates
-
-        t0p = time.perf_counter()
-        plan = plan_candidates(snapshot, batch, pruning)
-        stats.prune_s += time.perf_counter() - t0p
-        return plan
-
-    def pruned_inputs(plan, batch):
-        """(jnp batch on the candidate axis, capacity, schedulable,
-        node_domain_id) — static tensors ride the content-digest device
-        cache, so repeated waves of one class upload once."""
-        pbatch = plan.gather_batch(batch)
-        cap_p = wp.device.device_array(plan.capacity, jnp.float32)
-        sched_p = wp.device.device_array(plan.schedulable)
-        ndid_p = wp.device.device_array(plan.node_domain_id, jnp.int32)
-        return pbatch, cap_p, sched_p, ndid_p
-
-    def encode_wave(ws, reuse_rows: bool = True):
-        wave, (mg_c, ms_c, mp_c), pad = ws
-        row_keys = None
-        if reuse_rows:
-            row_keys = [
-                (warm_mod.gang_row_digest(g, pods_by_name), epoch) for g in wave
-            ]
-        return encode_gangs(
-            wave,
-            pods_by_name,
-            snapshot,
-            max_groups=mg_c,
-            max_sets=ms_c,
-            max_pods=mp_c,
-            pad_gangs_to=pad,
-            global_index_of=gidx,
-            row_cache=wp.encode_rows if reuse_rows else None,
-            row_keys=row_keys,
-        )
+    retire_lag = {"chained": None, "wave": 0, "pipeline": depth}[harvest]
+    engine = _WavePipeline(
+        gangs=gangs,
+        pods_by_name=pods_by_name,
+        snapshot=snapshot,
+        params=params,
+        warm_path=wp,
+        stats=stats,
+        solver=solver,
+        pruning=pruning,
+        donate=bool(donate),
+        retire_lag=retire_lag,
+        recorder=recorder,
+        wave_prefix="drain",
+        record_stamps=harvest in ("wave", "pipeline"),
+    )
 
     if warm:
         t0 = time.perf_counter()
-        warmed: set[tuple] = set()
         last = None
         for ws in waves:
-            if ws[1:] in warmed:
-                continue
-            warmed.add(ws[1:])
-            # Warm-up encodes bypass the row cache so the TIMED encode below
-            # stays an honest measurement (the warm drain of a repeated
-            # backlog still hits: the timed encodes populate the cache).
-            warm_batch, _ = encode_wave(ws, reuse_rows=False)
-            if use_exec_cache:
-                # AOT: lower+compile only — no execution, no device chaining.
-                warm_plan = cut_plan(warm_batch)
-                if warm_plan is not None:
-                    wb, cap_p, sched_p, ndid_p = pruned_inputs(
-                        warm_plan, warm_batch
-                    )
-                    wp.executables.ensure_compiled(
-                        warm_plan.gather_free(
-                            np.asarray(snapshot.free, np.float32)
-                        ),
-                        cap_p,
-                        sched_p,
-                        ndid_p,
-                        wb,
-                        params,
-                        jnp.zeros((len(gangs),), dtype=bool),
-                        coarse_dmax=warm_plan.coarse_dmax(),
-                        donate=donate,
-                    )
-                else:
-                    wp.executables.ensure_compiled(
-                        free_init,
-                        capacity,
-                        schedulable,
-                        node_domain_id,
-                        warm_batch,
-                        params,
-                        jnp.zeros((len(gangs),), dtype=bool),
-                        coarse_dmax=dmax,
-                        donate=donate,
-                    )
-            else:
+            if engine.use_exec_cache:
+                engine.warm_shape(ws)
+            elif ws[1:] not in engine._warmed:
+                # Portfolio path has no AOT cache: warm by executing once.
+                engine._warmed.add(ws[1:])
+                warm_batch, _ = engine.encode_wave(ws, reuse_rows=False)
                 last = solver(
-                    free_init,
-                    capacity,
-                    schedulable,
-                    node_domain_id,
+                    engine.free,
+                    engine.capacity,
+                    engine.schedulable,
+                    engine.node_domain_id,
                     warm_batch,
                     params,
                     jnp.zeros((len(gangs),), dtype=bool),
-                    coarse_dmax=dmax,
+                    coarse_dmax=engine.dmax,
                 )
                 jax.block_until_ready(last.ok)
         stats.compile_s = time.perf_counter() - t0
@@ -356,156 +788,10 @@ def drain_backlog(
         np.asarray(last.ok if last is not None else jnp.zeros((1,), dtype=bool))
 
     t0 = time.perf_counter()
-    free_arr = free_init
-    ok_g = jnp.zeros((len(gangs),), dtype=bool)
-
-    def solve_wave(rec, free_in, okg_in):
-        """Dispatch one wave from its carry; updates the record in place and
-        returns the outgoing (free, ok_global) carry."""
-        if rec["plan"] is not None:
-            plan = rec["plan"]
-            wb, cap_p, sched_p, ndid_p = rec["pruned_inputs"]
-            result = wp.executables.solve(
-                plan.gather_free(free_in), cap_p, sched_p, ndid_p, wb,
-                params, okg_in, coarse_dmax=plan.coarse_dmax(), donate=False,
-            )
-            free_out = plan.scatter_free(free_in, result.free_after)
-        elif use_exec_cache:
-            # Donated wave carry: free/ok_g are forfeited to the solve and
-            # immediately rebound to the result — the capacity update is an
-            # in-place device buffer, never a host round trip. The stale
-            # host free (snapshot.free) is recomputed on access and never
-            # consulted again inside this chain.
-            result = wp.executables.solve(
-                free_in, capacity, schedulable, node_domain_id, rec["batch"],
-                params, okg_in, coarse_dmax=dmax, donate=donate,
-            )
-            free_out = result.free_after
-        else:
-            result = solver(
-                free_in, capacity, schedulable, node_domain_id, rec["batch"],
-                params, okg_in, coarse_dmax=dmax,
-            )
-            free_out = result.free_after
-        rec.update(
-            ok=result.ok,
-            score=result.placement_score,
-            assigned=result.assigned,
-            free_in=free_in if pruning is not None else None,
-            okg_in=okg_in if pruning is not None else None,
-        )
-        return free_out, result.ok_global
-
-    # Keep only what decode needs per wave — retaining full SolveResults
-    # would pin every wave's chaining buffers in device memory. (Pruned
-    # drains additionally retain each wave's ENTERING carry for the
-    # escalation re-solves.)
-    inflight: list[dict] = []
+    engine.t0 = t0
     for ws in waves:
-        te = time.perf_counter()
-        batch, decode = encode_wave(ws)
-        stats.encode_s += time.perf_counter() - te
-        plan = cut_plan(batch) if use_exec_cache else None
-        rec = {
-            "batch": batch,
-            "decode": decode,
-            "plan": plan,
-            "escalated": False,
-        }
-        if plan is not None:
-            rec["pruned_inputs"] = pruned_inputs(plan, batch)
-            stats.pruned_waves += 1
-            stats.candidate_nodes = max(stats.candidate_nodes, plan.count)
-            stats.candidate_pad = max(stats.candidate_pad, plan.pad)
-        ts = time.perf_counter()
-        free_arr, ok_g = solve_wave(rec, free_arr, ok_g)
-        stats.dispatch_s += time.perf_counter() - ts
-        inflight.append(rec)
-        if harvest == "wave":
-            # Per-wave completion stamp: block until THIS wave's verdicts are
-            # host-visible and record (admitted, elapsed) — p50/p99 become
-            # measured per-gang bind latencies instead of the drain wall.
-            # Padded/invalid slots carry ok=False, so the sum is exact.
-            jax.block_until_ready(rec["ok"])
-            stats.wave_latencies.append(
-                (int(np.asarray(rec["ok"]).sum()), time.perf_counter() - t0)
-            )
-
-    th = time.perf_counter()
-    jax.device_get([(r["ok"], r["score"], r["assigned"]) for r in inflight])
-    stats.harvest_s = time.perf_counter() - th
-
-    if stats.pruned_waves:
-        # Exactness escalation: scan waves in dispatch order for a valid
-        # gang rejected on the pruned fleet whose plan marked it lossy. The
-        # wave re-solves DENSE from its recorded entering carry; identical
-        # verdicts CONFIRM the rejections (results stand), any changed
-        # verdict ADOPTS the dense wave and re-runs the chain behind it
-        # (every shape is already compiled, so a re-run is pure execution).
-        # Each escalated wave is visited at most once -> termination.
-        from grove_tpu.solver.pruning import lossy_rejections
-
-        while True:
-            target = None
-            for i, rec in enumerate(inflight):
-                if rec["plan"] is None or rec["escalated"]:
-                    continue
-                lossy = lossy_rejections(
-                    rec["plan"],
-                    rec["batch"].gang_valid,
-                    np.asarray(rec["ok"]),
-                )
-                if bool(lossy.any()):
-                    target = i
-                    break
-            if target is None:
-                break
-            rec = inflight[target]
-            rec["escalated"] = True
-            stats.escalations += 1
-            dense = wp.executables.solve(
-                rec["free_in"], capacity, schedulable, node_domain_id,
-                rec["batch"], params, rec["okg_in"], coarse_dmax=dmax,
-                donate=False,
-            )
-            if bool(
-                np.all(np.asarray(dense.ok) == np.asarray(rec["ok"]))
-            ):
-                continue  # full fleet agrees: the rejection was real
-            stats.escalations_adopted += 1
-            free_arr, ok_g = dense.free_after, dense.ok_global
-            rec.update(
-                ok=dense.ok,
-                score=dense.placement_score,
-                assigned=dense.assigned,
-                plan=None,  # dense verdicts: decode skips the remap
-            )
-            for rec2 in inflight[target + 1 :]:
-                rec2["escalated"] = False  # inputs changed; re-verify
-                free_arr, ok_g = solve_wave(rec2, free_arr, ok_g)
-            jax.device_get(
-                [
-                    (r["ok"], r["score"], r["assigned"])
-                    for r in inflight[target:]
-                ]
-            )
-
-    bindings: dict[str, dict[str, str]] = {}
-    for rec in inflight:
-        td = time.perf_counter()
-        asg = np.asarray(rec["assigned"])
-        if rec["plan"] is not None:
-            # Decode scatters candidate ordinals back through the gather map.
-            asg = rec["plan"].remap_assigned(asg)
-        wave_bindings = decode_bindings(rec["ok"], asg, rec["decode"], snapshot)
-        stats.decode_s += time.perf_counter() - td
-        scores = np.asarray(rec["score"])
-        ok_mask = np.asarray(rec["ok"])
-        stats.scores.extend(scores[ok_mask].tolist())
-        for gang_name, pod_bindings in wave_bindings.items():
-            bindings[gang_name] = pod_bindings
-            stats.admitted += 1
-            stats.pods_bound += len(pod_bindings)
+        engine.submit(ws)
+    engine.flush()
     stats.total_s = time.perf_counter() - t0
     stats.exec_cache_hits = wp.executables.hits - exec0[0]
     stats.exec_cache_misses = wp.executables.misses - exec0[1]
@@ -520,4 +806,4 @@ def drain_backlog(
         wp.prune.last_candidate_pad = stats.candidate_pad
         wp.prune.last_fleet_nodes = int(snapshot.free.shape[0])
     wp.record_drain(stats)
-    return bindings, stats
+    return engine.bindings, stats

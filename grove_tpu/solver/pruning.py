@@ -405,6 +405,20 @@ def plan_candidates(
         gang_lossy = gang_lossy | gv.any(axis=1)
     gang_lossy = (gang_lossy | pin_lossy) & gang_valid
 
+    return _assemble_plan(snapshot, cand, pad, clipped, gang_lossy)
+
+
+def _assemble_plan(
+    snapshot, cand: np.ndarray, pad: int, clipped: bool, gang_lossy: np.ndarray
+) -> CandidatePlan:
+    """Derive the gathered static tensors + compact domain remap for a fixed
+    candidate list — pure function of (snapshot, cand, pad), shared by the
+    live cut (`plan_candidates`) and replay reconstruction
+    (`plan_from_indices`)."""
+    node_domain_id = np.asarray(snapshot.node_domain_id)
+    schedulable = np.asarray(snapshot.schedulable, dtype=bool)
+    n = int(np.asarray(snapshot.capacity).shape[0])
+    count = int(cand.shape[0])
     # Remap per-level domain ordinals to a compact range over the candidates;
     # host level (last) keeps ordinal == row index by construction.
     levels = node_domain_id.shape[0]
@@ -427,18 +441,19 @@ def plan_candidates(
         num_domains[li] = len(table)
         remap.append(table)
 
-    cap_p = np.zeros((pad, free.shape[1]), dtype=np.float32)
-    cap_p[:count] = np.asarray(snapshot.capacity, dtype=np.float32)[cand]
+    cap = np.asarray(snapshot.capacity, dtype=np.float32)
+    cap_p = np.zeros((pad, cap.shape[1]), dtype=np.float32)
+    cap_p[:count] = cap[cand]
     # Cap anchor: the dense solver normalizes scores by the FULL fleet's
     # per-resource capacity maxima (including unschedulable nodes); carry
     # them on the first pad row so pruned scores use the same scale. The
     # row stays unschedulable/zero-free, so it can never host a pod or
     # perturb any masked aggregate.
-    cap_p[count] = np.asarray(snapshot.capacity, dtype=np.float32).max(axis=0)
+    cap_p[count] = cap.max(axis=0)
     sched_p = np.zeros((pad,), dtype=bool)
     sched_p[:count] = schedulable[cand]
 
-    plan = CandidatePlan(
+    return CandidatePlan(
         idx=cand.astype(np.int32),
         count=count,
         pad=pad,
@@ -451,7 +466,27 @@ def plan_candidates(
         num_domains=num_domains,
         _remap=remap,
     )
-    return plan
+
+
+def plan_from_indices(
+    snapshot, indices, cfg: PruningConfig, n_gangs: int
+) -> CandidatePlan:
+    """Rebuild a CandidatePlan from a journaled candidate-node list
+    (trace/replay.py): live plans are cut against the free state at DISPATCH
+    time, which a wave record does not carry — replaying with the recorded
+    list reproduces the exact gather the recorded solve ran on. The lossy
+    witness is moot at replay (the recorded verdicts already absorbed any
+    escalation), so it is all-False."""
+    cand = np.asarray(indices, dtype=np.int32)
+    pad = candidate_pad(int(cand.shape[0]), cfg)
+    if pad is None:
+        raise ValueError(
+            f"recorded candidate list ({cand.shape[0]} nodes) does not fit "
+            f"the recorded pad ladder {cfg.pad_ladder!r}"
+        )
+    return _assemble_plan(
+        snapshot, cand, pad, False, np.zeros((n_gangs,), dtype=bool)
+    )
 
 
 def lossy_rejections(plan: CandidatePlan, gang_valid, ok) -> np.ndarray:

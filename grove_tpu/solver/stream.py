@@ -1,0 +1,272 @@
+"""Streaming drain: the always-on admission loop under live arrival traffic.
+
+`drain_backlog` answers "a backlog arrived at once"; this module answers the
+BandPilot-shaped question (PAPERS.md): a scheduler that solves CONTINUOUSLY
+while gangs keep arriving — bursty, diurnally modulated, heavy-tailed,
+multi-tenant traffic (sim/workloads.arrival_process). The loop batches
+queued arrivals into shape-bucketed waves and feeds them to the SAME
+double-buffered pipeline engine as the drain (solver/drain._WavePipeline):
+while wave N solves on device, the host encodes wave N+1 from fresh arrivals
+and decodes/binds wave N-depth — the drain never syncs except at retirement.
+
+Two disciplines, one dispatch chain (identical admissions by construction —
+the chain is the same; test-pinned):
+
+  pipeline   retire wave N-depth while wave N is in flight (the steady-state
+             serving shape; ~chained-drain throughput, measured latencies)
+  serial     retire every wave before forming the next (the wave-at-a-time
+             baseline the pipelined mode is benchmarked against)
+
+Two clocks:
+
+  saturated  (pace=False) arrivals are consumed flat-out in arrival order —
+             wave composition is a pure function of (arrival order,
+             wave_size), so serial and pipelined runs see IDENTICAL waves
+             and their admitted sets must match exactly. The throughput
+             measurement: steady-state gangs/sec is admitted/wall.
+  paced      (pace=True) arrivals become visible at their trace offsets in
+             wall time; a wave forms when wave_size gangs are queued, the
+             oldest has waited max_wait_s, or the trace is exhausted.
+             Time-to-bind (enqueue->bound) is MEASURED per gang against its
+             arrival instant — the latency-under-load configuration. Wave
+             composition depends on wall time, so paced runs are not the
+             parity gate.
+
+Ordering invariant: the arrival list must place a base gang before every
+gang scaled from it (`sim.workloads.expand_arrivals` guarantees this);
+within a window `plan_waves` enforces base-rank-first, and across windows
+the ok_global device chain resolves the verdict.
+
+The engine journals committed waves to an attached flight recorder with
+monotonic `stream-NNNNNN` ids in commit order; trace replay stays bitwise
+on the overlapped path (tests/test_stream.py pins it).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from grove_tpu.solver.core import SolverParams
+from grove_tpu.solver.drain import DrainStats, _WavePipeline, plan_waves
+
+
+@dataclass(frozen=True)
+class StreamConfig:
+    """`solver.streaming` config block (runtime/config.py validates the
+    YAML shape; this is the solver-side value object)."""
+
+    # Pipeline depth: waves allowed in flight before the host blocks on the
+    # oldest. 2 = classic double buffering (one solving, one encoding, one
+    # retiring). Ignored by the serial discipline.
+    depth: int = 2
+    # Max gangs per formed window; also the plan_waves wave size. Smaller
+    # waves bind arrivals sooner (lower time-to-bind), bigger waves amortize
+    # per-wave dispatch better (higher throughput).
+    wave_size: int = 64
+    # Paced mode: how long the oldest queued gang may wait for companions
+    # before a partial wave dispatches anyway.
+    max_wait_s: float = 0.05
+    # Paced mode: idle poll granularity while waiting for arrivals.
+    poll_s: float = 0.005
+
+
+@dataclass
+class StreamStats:
+    """One streaming run, as measured (wall seconds unless noted)."""
+
+    offered: int = 0  # gangs fed from the arrival trace
+    admitted: int = 0
+    pods_bound: int = 0
+    waves: int = 0
+    windows: int = 0  # arrival windows formed (each plans >= 1 wave)
+    wall_s: float = 0.0
+    gangs_per_sec: float = 0.0  # admitted / wall — steady-state throughput
+    depth: int = 0
+    mode: str = "pipeline"  # pipeline | serial
+    paced: bool = False
+    # Per-ADMITTED-gang enqueue->bound seconds, in commit order. Under
+    # pacing this is the real time-to-bind against the arrival instant;
+    # saturated runs measure pull->bound (queueing excluded by design —
+    # a saturated backlog's queueing delay is an artifact of the replay
+    # rate, not of the scheduler).
+    bind_latencies: list = field(default_factory=list)
+    # The engine's phase/cache/escalation breakdown for this run.
+    drain: DrainStats = field(default_factory=DrainStats)
+
+    def bind_percentiles(self, qs=(50.0, 99.0)) -> dict | None:
+        """Measured time-to-bind percentiles; None when nothing was bound
+        (same no-fabrication contract as DrainStats.latency_percentiles)."""
+        if not self.bind_latencies:
+            return None
+        import numpy as np
+
+        return {
+            float(q): float(np.percentile(self.bind_latencies, q)) for q in qs
+        }
+
+    def to_doc(self) -> dict:
+        doc = {
+            "streamGangs": self.offered,
+            "streamAdmitted": self.admitted,
+            "streamPodsBound": self.pods_bound,
+            "streamWaves": self.waves,
+            "streamWallS": round(self.wall_s, 4),
+            "gangsPerSec": round(self.gangs_per_sec, 2),
+            "depth": self.depth,
+            "mode": self.mode,
+            "paced": self.paced,
+        }
+        pct = self.bind_percentiles((50.0, 99.0))
+        if pct is not None:
+            doc["bindP50S"] = round(pct[50.0], 4)
+            doc["bindP99S"] = round(pct[99.0], 4)
+        return doc
+
+
+def drain_stream(
+    arrivals: list,
+    pods_by_name: dict,
+    snapshot,
+    *,
+    config: StreamConfig | None = None,
+    params: SolverParams | None = None,
+    warm_path=None,  # solver.warm.WarmPath; None = the process-shared one
+    pruning=None,  # solver.pruning.PruningConfig; None/disabled = dense
+    recorder=None,  # trace.recorder.TraceRecorder; journals committed waves
+    pipeline: bool = True,  # False = wave-serial baseline
+    pace: bool = False,  # True = honor arrival offsets in wall time
+    donate: bool | None = None,
+) -> tuple[dict[str, dict[str, str]], StreamStats]:
+    """Admit a live arrival trace; returns ({gang: {pod: node}}, StreamStats).
+
+    `arrivals` is a list of (t_offset_seconds, PodGang) sorted by offset,
+    base gangs before their scaled gangs (sim.workloads.expand_arrivals
+    builds it from an ArrivalEvent trace). See the module docstring for the
+    pipeline/serial and saturated/paced semantics.
+
+    Warm path: shapes are AOT-compiled lazily on FIRST encounter (counted in
+    stats.drain.compile_s — a cold stream pays XLA inline; prewarm from
+    shape history and a warm-up run both make the steady state compile-free,
+    and the in-flight compile tracking in solver/warm.py dedupes against a
+    concurrently running prewarm thread). Everything else — executable
+    cache, encode-row reuse, candidate pruning with exactness escalation,
+    flight-recorder journaling — behaves exactly as in drain_backlog.
+    """
+    from grove_tpu.solver import warm as warm_mod
+
+    cfg = config or StreamConfig()
+    params = params or SolverParams()
+    wp = warm_path if warm_path is not None else warm_mod.default_warm_path()
+    if pruning is not None and not getattr(pruning, "enabled", False):
+        pruning = None
+    if donate is None:
+        donate = warm_mod.donation_default()
+    if cfg.depth < 1:
+        raise ValueError(f"streaming depth must be >= 1, got {cfg.depth}")
+    if cfg.wave_size < 1:
+        raise ValueError(f"streaming waveSize must be >= 1, got {cfg.wave_size}")
+
+    gangs_all = [g for _, g in arrivals]
+    stats = StreamStats(
+        offered=len(gangs_all),
+        depth=cfg.depth if pipeline else 0,
+        mode="pipeline" if pipeline else "serial",
+        paced=bool(pace),
+    )
+    dstats = stats.drain
+    dstats.gangs = len(gangs_all)
+    dstats.harvest = "pipeline" if pipeline else "wave"
+    dstats.depth = stats.depth
+    if not gangs_all:
+        return {}, stats
+
+    exec0 = (wp.executables.hits, wp.executables.misses, wp.executables.lowerings)
+    avail: dict[str, float] = {}  # gang name -> wall instant it became visible
+    engine_box: list = []
+
+    def on_commit(members, wave_bindings, stamp):
+        wall = engine_box[0].t0 + stamp
+        for g in members:
+            if g.name in wave_bindings:
+                stats.bind_latencies.append(max(0.0, wall - avail[g.name]))
+
+    engine = _WavePipeline(
+        gangs=gangs_all,
+        pods_by_name=pods_by_name,
+        snapshot=snapshot,
+        params=params,
+        warm_path=wp,
+        stats=dstats,
+        pruning=pruning,
+        donate=bool(donate),
+        retire_lag=cfg.depth if pipeline else 0,
+        recorder=recorder,
+        wave_prefix="stream",
+        record_stamps=True,
+        on_commit=on_commit,
+    )
+    engine_box.append(engine)
+
+    t0 = time.perf_counter()
+    engine.t0 = t0
+    queue: list = []
+    i, n = 0, len(arrivals)
+    while i < n or queue:
+        now = time.perf_counter()
+        if pace:
+            while i < n and arrivals[i][0] <= now - t0:
+                off, g = arrivals[i]
+                queue.append(g)
+                avail[g.name] = t0 + off
+                i += 1
+        else:
+            while i < n and len(queue) < cfg.wave_size:
+                g = arrivals[i][1]
+                queue.append(g)
+                avail[g.name] = now
+                i += 1
+        ready = len(queue) >= cfg.wave_size or (i >= n and bool(queue))
+        if pace and queue and not ready:
+            # Batching window: the oldest queued gang only waits so long.
+            ready = (now - avail[queue[0].name]) >= cfg.max_wait_s
+        if ready:
+            window, queue = queue[: cfg.wave_size], queue[cfg.wave_size :]
+            stats.windows += 1
+            for ws in plan_waves(window, cfg.wave_size):
+                # Lazy AOT warm-up of first-seen shapes (compile-only; the
+                # executable cache + in-flight tracking dedupe process-wide).
+                tc = time.perf_counter()
+                if engine.warm_shape(ws):
+                    dstats.compile_s += time.perf_counter() - tc
+                engine.submit(ws)
+        elif pace:
+            if engine.inflight:
+                # Host idle until the next arrival: retire the oldest
+                # in-flight wave now instead of sleeping on it later.
+                engine._retire_next()
+            else:
+                next_due = (t0 + arrivals[i][0]) if i < n else now
+                time.sleep(min(cfg.poll_s, max(0.0, next_due - now)))
+    engine.flush()
+    stats.wall_s = time.perf_counter() - t0
+    dstats.total_s = stats.wall_s
+    stats.waves = dstats.waves
+    stats.admitted = dstats.admitted
+    stats.pods_bound = dstats.pods_bound
+    stats.gangs_per_sec = (
+        stats.admitted / stats.wall_s if stats.wall_s > 0 else 0.0
+    )
+    dstats.exec_cache_hits = wp.executables.hits - exec0[0]
+    dstats.exec_cache_misses = wp.executables.misses - exec0[1]
+    dstats.lowerings = wp.executables.lowerings - exec0[2]
+    if dstats.pruned_waves:
+        wp.prune.pruned_solves += dstats.pruned_waves
+        wp.prune.escalations += dstats.escalations
+        wp.prune.escalations_adopted += dstats.escalations_adopted
+        wp.prune.last_candidate_nodes = dstats.candidate_nodes
+        wp.prune.last_candidate_pad = dstats.candidate_pad
+        wp.prune.last_fleet_nodes = int(snapshot.free.shape[0])
+    wp.record_drain(dstats)
+    wp.record_stream(stats.to_doc(), stats.bind_latencies)
+    return engine.bindings, stats

@@ -212,6 +212,14 @@ class ExecutableCache:
         self.misses = 0
         self.lowerings = 0  # actual .lower().compile() invocations
         self.prewarmed = 0
+        self.inflight_waits = 0  # lookups that waited on an in-flight compile
+        # In-flight shape tracking: key -> threading.Event for compiles in
+        # progress. The background prewarm thread and a live caller (the
+        # streaming drain warming a just-arrived shape) race for the same
+        # key; without this, both pay the FULL XLA lowering and one result
+        # is discarded. The second arrival now waits on the first compile
+        # instead — prewarm genuinely covers streaming shapes.
+        self._inflight: dict[tuple, threading.Event] = {}
         # use counts per shape descriptor, persisted alongside new shapes
         self._history: dict[str, dict] = {}
         self._history_loaded = False
@@ -264,21 +272,40 @@ class ExecutableCache:
 
     def _get_or_compile(self, args: tuple, coarse_dmax, donate: bool):
         key = _exec_key(args, coarse_dmax, donate)
-        with self._lock:
-            compiled = self._entries.get(key)
-        if compiled is not None:
-            self.hits += 1
-            self._record(args, coarse_dmax, donate, new=False)
-            return compiled
-        self.lowerings += 1
-        compiled = (
-            _jitted_solve(donate)
-            .lower(*args, coarse_dmax=coarse_dmax)
-            .compile()
-        )
-        with self._lock:
-            self._entries.setdefault(key, compiled)
-        self.misses += 1
+        while True:
+            with self._lock:
+                compiled = self._entries.get(key)
+                if compiled is None:
+                    pending = self._inflight.get(key)
+                    if pending is None:
+                        # Claim the compile: others wait instead of lowering.
+                        self._inflight[key] = threading.Event()
+            if compiled is not None:
+                self.hits += 1
+                self._record(args, coarse_dmax, donate, new=False)
+                return compiled
+            if pending is None:
+                break
+            # Another thread (prewarm, or a concurrent serving path) is
+            # lowering this exact shape right now — wait for its result
+            # rather than paying a duplicate XLA compile.
+            self.inflight_waits += 1
+            pending.wait()
+        try:
+            self.lowerings += 1
+            compiled = (
+                _jitted_solve(donate)
+                .lower(*args, coarse_dmax=coarse_dmax)
+                .compile()
+            )
+            with self._lock:
+                self._entries.setdefault(key, compiled)
+            self.misses += 1
+        finally:
+            with self._lock:
+                ev = self._inflight.pop(key, None)
+            if ev is not None:
+                ev.set()
         self._record(args, coarse_dmax, donate, new=True)
         return compiled
 
@@ -350,14 +377,31 @@ class ExecutableCache:
                 with self._lock:
                     if key in self._entries:
                         continue
-                self.lowerings += 1
-                exe = (
-                    _jitted_solve(bool(desc.get("donate", False)))
-                    .lower(*args, coarse_dmax=desc.get("coarse_dmax"))
-                    .compile()
-                )
-                with self._lock:
-                    self._entries.setdefault(key, exe)
+                    # In-flight claim, same protocol as _get_or_compile: a
+                    # serving path warming this shape RIGHT NOW (streaming
+                    # drain, first tick) must not pay a duplicate lowering —
+                    # whoever claims second waits for the first.
+                    pending = self._inflight.get(key)
+                    if pending is None:
+                        self._inflight[key] = threading.Event()
+                if pending is not None:
+                    self.inflight_waits += 1
+                    pending.wait()
+                    continue
+                try:
+                    self.lowerings += 1
+                    exe = (
+                        _jitted_solve(bool(desc.get("donate", False)))
+                        .lower(*args, coarse_dmax=desc.get("coarse_dmax"))
+                        .compile()
+                    )
+                    with self._lock:
+                        self._entries.setdefault(key, exe)
+                finally:
+                    with self._lock:
+                        ev = self._inflight.pop(key, None)
+                    if ev is not None:
+                        ev.set()
                 compiled += 1
                 self.prewarmed += 1
             except Exception:  # noqa: BLE001 — a stale descriptor must not kill prewarm
@@ -393,6 +437,7 @@ class ExecutableCache:
             "execMisses": self.misses,
             "lowerings": self.lowerings,
             "prewarmed": self.prewarmed,
+            "inflightWaits": self.inflight_waits,
             "executables": len(self._entries),
         }
 
@@ -563,9 +608,18 @@ class WarmPath:
     prune: PruneStats = field(default_factory=PruneStats)
     # Last drain seen through this warm path (drain_backlog reports at
     # exit): measured wave-harvest p50/p99 when the drain ran with
-    # harvest="wave", so the latency distribution is visible OUTSIDE the
-    # bench (/statusz warmPath, `grove-tpu get solver`).
+    # harvest="wave" or "pipeline", so the latency distribution is visible
+    # OUTSIDE the bench (/statusz warmPath, `grove-tpu get solver`).
     last_drain: dict = field(default_factory=dict)
+    # Last streaming drain (solver/stream.py reports at exit): steady-state
+    # throughput + measured time-to-bind percentiles, the source for the
+    # grove_stream_* metrics and the `get solver` stream rows.
+    last_stream: dict = field(default_factory=dict)
+    # Unexported per-gang time-to-bind samples (seconds), drained by the
+    # manager's metrics refresh into the grove_stream_time_to_bind_seconds
+    # histogram. Bounded: a stream outrunning the scrape loses oldest
+    # samples, never memory.
+    stream_bind_samples: object = None  # collections.deque, lazy
 
     def record_drain(self, stats) -> None:
         """Fold one DrainStats into the observable surface."""
@@ -576,15 +630,25 @@ class WarmPath:
             "drainHarvest": stats.harvest,
             "drainTotalS": round(stats.total_s, 4),
         }
-        if stats.harvest == "wave" and stats.wave_latencies:
-            import numpy as np
-
-            lat = np.concatenate(
-                [np.full(n, t) for n, t in stats.wave_latencies if n > 0]
-            ) if any(n > 0 for n, _ in stats.wave_latencies) else np.zeros((1,))
-            doc["waveP50S"] = round(float(np.percentile(lat, 50)), 4)
-            doc["waveP99S"] = round(float(np.percentile(lat, 99)), 4)
+        # Measured per-gang percentiles; None for chained drains, empty
+        # drains, and drains in which no wave admitted anything (the
+        # percentile helper owns the 0-/1-wave edge cases — a fabricated
+        # 0.0 or inf here used to leak into /statusz and the bench JSON).
+        pct = stats.latency_percentiles((50.0, 99.0))
+        if pct is not None:
+            doc["waveP50S"] = round(pct[50.0], 4)
+            doc["waveP99S"] = round(pct[99.0], 4)
         self.last_drain = doc
+
+    def record_stream(self, doc: dict, bind_latencies=()) -> None:
+        """Fold one StreamStats doc into the observable surface and queue
+        its per-gang time-to-bind samples for histogram export."""
+        from collections import deque
+
+        self.last_stream = dict(doc)
+        if self.stream_bind_samples is None:
+            self.stream_bind_samples = deque(maxlen=65536)
+        self.stream_bind_samples.extend(float(x) for x in bind_latencies)
 
     def stats(self) -> dict:
         out = {}

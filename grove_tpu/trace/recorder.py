@@ -227,10 +227,22 @@ class TraceRecorder:
         valid_by_name: dict,
         scores: dict,
         solve_seconds: float,
+        allocated_override=None,  # np [N, R]: allocation ENTERING this wave
+        free_rows: dict | None = None,  # node -> exact entering free row
+        candidates: list | None = None,  # pruned waves: fixed candidate list
     ) -> bool:
         """Journal one solve wave — the full encode+solve input closure plus
         the resulting plan. Serde-encoding here IS the synchronous deep copy;
-        the pods mutate (bind) immediately after the solve."""
+        the pods mutate (bind) immediately after the solve.
+
+        The pipelined drain (solver/drain._WavePipeline) journals waves whose
+        entering state is NOT the snapshot: `allocated_override` is the
+        running allocation table at the wave's commit point, `free_rows` the
+        exact device-chained free carry (fetched bitwise — f32 round-trips
+        JSON exactly), and `candidates` the fixed candidate-node list its
+        plan was cut with (plans are cut against the INITIAL free, so replay
+        must not re-cut them against the wave's entering free). Replay
+        (trace/replay.py) prefers these over the snapshot-derived state."""
         digest, payload = fleet_digest_of(snapshot)
         if digest not in self._announced:
             if self.record(payload):
@@ -247,8 +259,11 @@ class TraceRecorder:
         }
         allocated = {}
         n_real = len(snapshot.node_names)
+        alloc_src = (
+            snapshot.allocated if allocated_override is None else allocated_override
+        )
         for i in range(n_real):
-            row = snapshot.allocated[i]
+            row = alloc_src[i]
             if row.any():
                 allocated[snapshot.node_names[i]] = [float(v) for v in row]
         rejections = {}
@@ -318,6 +333,12 @@ class TraceRecorder:
             "rejections": rejections,
             "solveSeconds": float(solve_seconds),
         }
+        if free_rows:
+            rec["freeRows"] = {
+                str(n): [float(v) for v in row] for n, row in free_rows.items()
+            }
+        if candidates is not None:
+            rec["candidates"] = [int(i) for i in candidates]
         return self.record(rec)
 
     # ---- writer thread -----------------------------------------------------------

@@ -162,19 +162,82 @@ def solve_wave_record(
         reuse_nodes_by_gang=wave.get("reuseNodes") or None,
         spread_avoid_by_gang=wave.get("spreadAvoid") or None,
     )
-    result = solve(
-        snapshot,
-        batch,
-        params if params is not None else SolverParams(*cfg["params"]),
-        portfolio=portfolio if portfolio is not None else cfg["portfolio"],
-        escalate_portfolio=(
-            escalate_portfolio
-            if escalate_portfolio is not None
-            else cfg["escalatePortfolio"]
-        ),
-        warm=warm,
-        pruning=pruning,
-    )
+    # Pipelined-drain waves (solver/drain._WavePipeline) carry their exact
+    # entering free rows: the device-chained carry fetched bitwise at journal
+    # time. `capacity - allocated` recomputes the same values only when the
+    # chain's float associations match the host's — the recorded rows make
+    # replay independent of that. Rows absent from freeRows entered the wave
+    # untouched (free == capacity bitwise).
+    free_override = None
+    if wave.get("freeRows"):
+        free_override = np.array(snapshot.capacity, dtype=np.float32, copy=True)
+        for name, row in wave["freeRows"].items():
+            if name in snapshot.node_index_map:
+                free_override[snapshot.node_index(name)] = np.asarray(
+                    row, np.float32
+                )
+    candidates = wave.get("candidates")
+    if candidates is not None and pruning is not None:
+        # Pruned pipelined wave: the plan was cut against the drain's INITIAL
+        # free (a superset of every later wave's eligible set), which the
+        # record does not carry — rebuild the exact gather from the journaled
+        # candidate list instead of re-cutting against the entering free.
+        # Escalation is moot here: a wave whose dense re-solve changed a
+        # verdict journaled AS dense (no candidates), so the recorded
+        # verdicts equal the pruned solve's.
+        import jax.numpy as jnp
+
+        from grove_tpu.solver.core import SolveResult, solve_batch
+        from grove_tpu.solver.encode import GangBatch
+        from grove_tpu.solver.pruning import plan_from_indices
+
+        plan = plan_from_indices(
+            snapshot, candidates, pruning, int(np.asarray(batch.gang_valid).shape[0])
+        )
+        free_np = (
+            free_override
+            if free_override is not None
+            else np.asarray(snapshot.free, np.float32)
+        )
+        jpbatch = GangBatch(
+            *(
+                None if x is None else jnp.asarray(x)
+                for x in plan.gather_batch(batch)
+            )
+        )
+        params_ = params if params is not None else SolverParams(*cfg["params"])
+        solver_fn = warm.executables.solve if warm is not None else solve_batch
+        presult = solver_fn(
+            jnp.asarray(plan.gather_free(free_np)),
+            jnp.asarray(plan.capacity),
+            jnp.asarray(plan.schedulable),
+            jnp.asarray(plan.node_domain_id),
+            jpbatch,
+            params_,
+            None,
+            coarse_dmax=plan.coarse_dmax(),
+        )
+        result = SolveResult(
+            assigned=plan.remap_assigned(np.asarray(presult.assigned)),
+            ok=presult.ok,
+            placement_score=presult.placement_score,
+            free_after=presult.free_after,
+        )
+    else:
+        result = solve(
+            snapshot,
+            batch,
+            params if params is not None else SolverParams(*cfg["params"]),
+            free=free_override,
+            portfolio=portfolio if portfolio is not None else cfg["portfolio"],
+            escalate_portfolio=(
+                escalate_portfolio
+                if escalate_portfolio is not None
+                else cfg["escalatePortfolio"]
+            ),
+            warm=warm,
+            pruning=pruning,
+        )
     plan = decode_assignments(result, decode, snapshot)
     elapsed = time.perf_counter() - t0
     ok = dict(zip(decode.gang_names, (bool(x) for x in np.asarray(result.ok))))

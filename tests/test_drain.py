@@ -301,3 +301,96 @@ def test_drain_rejects_unknown_harvest_mode():
     gangs, pods, snap = _setup(n_disagg=1, n_agg=0, n_frontend=0)
     with pytest.raises(ValueError, match="harvest"):
         drain_backlog(gangs, pods, snap, harvest="poll")
+
+
+def test_drain_pipeline_harvest_matches_chained_and_wave():
+    """harvest="pipeline": double-buffered retirement admits the IDENTICAL
+    set to the chained and wave-serial disciplines (one dispatch chain; only
+    where the host blocks differs), with measured per-wave stamps in commit
+    order — the overlap is a latency optimization, never a semantics
+    change."""
+    gangs, pods, snap = _setup()
+    chained, _ = drain_backlog(gangs, pods, snap, wave_size=8)
+    serial, _ = drain_backlog(gangs, pods, snap, wave_size=8, harvest="wave")
+    piped, stats = drain_backlog(
+        gangs, pods, snap, wave_size=8, harvest="pipeline", depth=2
+    )
+    assert piped == chained == serial
+    assert stats.harvest == "pipeline" and stats.depth == 2
+    assert len(stats.wave_latencies) == stats.waves
+    stamps = [t for _, t in stats.wave_latencies]
+    assert stamps == sorted(stamps)
+    assert sum(n for n, _ in stats.wave_latencies) == stats.admitted
+
+
+def test_drain_pipeline_depth_one_and_large():
+    """Depth 1 (block on the previous wave each submit) and depth larger
+    than the wave count (degenerates to chained-like retirement at flush)
+    both preserve admissions."""
+    gangs, pods, snap = _setup(n_disagg=3, n_agg=2, n_frontend=3)
+    ref, _ = drain_backlog(gangs, pods, snap, wave_size=4)
+    for depth in (1, 64):
+        b, stats = drain_backlog(
+            gangs, pods, snap, wave_size=4, harvest="pipeline", depth=depth
+        )
+        assert set(b) == set(ref)
+        assert stats.depth == depth
+
+
+def test_drain_rejects_bad_depth():
+    import pytest
+
+    gangs, pods, snap = _setup(n_disagg=1, n_agg=0, n_frontend=0)
+    with pytest.raises(ValueError, match="depth"):
+        drain_backlog(gangs, pods, snap, harvest="pipeline", depth=0)
+
+
+def test_latency_percentiles_edge_cases():
+    """The percentile helper owns the 0-/1-wave edge cases so bench and
+    /statusz consumers never fabricate numbers: None for a drain that
+    measured nothing (0 waves, chained, or no wave admitted anything); a
+    1-wave drain reports that wave's stamp at every percentile."""
+    from grove_tpu.solver.drain import DrainStats
+
+    assert DrainStats().latency_percentiles() is None  # 0-wave drain
+    # Waves ran but nothing was admitted: a percentile over stamps of waves
+    # that bound nothing is not a bind latency.
+    s = DrainStats()
+    s.wave_latencies = [(0, 0.1), (0, 0.2)]
+    assert s.latency_percentiles() is None
+    # 1-wave drain: every requested percentile is that wave's stamp.
+    s = DrainStats()
+    s.wave_latencies = [(3, 0.25)]
+    pct = s.latency_percentiles((50.0, 99.0))
+    assert pct == {50.0: 0.25, 99.0: 0.25}
+    # Mixed: zero-admit waves contribute no samples.
+    s = DrainStats()
+    s.wave_latencies = [(0, 0.1), (2, 0.2), (0, 0.3), (1, 0.4)]
+    pct = s.latency_percentiles((50.0, 99.0))
+    assert 0.2 <= pct[50.0] <= 0.4
+    assert pct[99.0] <= 0.4
+
+
+def test_record_drain_never_fabricates_percentiles():
+    """WarmPath.record_drain only publishes waveP50S/waveP99S when the drain
+    measured them — a chained drain or an all-rejected wave drain leaves the
+    keys absent instead of publishing 0.0/inf."""
+    from grove_tpu.solver.drain import DrainStats
+    from grove_tpu.solver.warm import WarmPath
+
+    wp = WarmPath()
+    chained = DrainStats(harvest="chained")
+    chained.waves = 2
+    wp.record_drain(chained)
+    assert "waveP50S" not in wp.last_drain
+    rejected = DrainStats(harvest="wave")
+    rejected.waves = 1
+    rejected.wave_latencies = [(0, 0.5)]
+    wp.record_drain(rejected)
+    assert "waveP50S" not in wp.last_drain
+    measured = DrainStats(harvest="pipeline")
+    measured.waves = 1
+    measured.wave_latencies = [(2, 0.5)]
+    wp.record_drain(measured)
+    assert wp.last_drain["waveP50S"] == 0.5
+    assert wp.last_drain["waveP99S"] == 0.5

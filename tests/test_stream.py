@@ -1,0 +1,457 @@
+"""Streaming drain (solver/stream.py) + arrival process (sim/workloads.py).
+
+Pins the tentpole invariants platform-independently: deterministic arrival
+traces, serial/pipelined admitted-set parity on identical offered work,
+exactness under candidate pruning, bitwise trace replay of the overlapped
+path, and measured (never fabricated) time-to-bind.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+
+import pytest
+
+from grove_tpu.sim.workloads import (
+    arrival_process,
+    bench_topology,
+    expand_arrivals,
+    synthetic_cluster,
+)
+from grove_tpu.solver.stream import StreamConfig, StreamStats, drain_stream
+from grove_tpu.state import build_snapshot
+
+SEED = 1234
+
+
+def _fleet(racks=4, hosts=8):
+    topo = bench_topology()
+    nodes = synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=racks, hosts_per_rack=hosts
+    )
+    return topo, build_snapshot(nodes, topo)
+
+
+def _trace(seed=SEED, duration_s=8.0, rate=3.0, **kw):
+    evs = arrival_process(seed, duration_s=duration_s, base_rate=rate, **kw)
+    arrivals, pods = expand_arrivals(evs)
+    return evs, arrivals, pods
+
+
+# ---- arrival process --------------------------------------------------------------
+
+
+def test_arrival_process_deterministic_in_seed():
+    """Same seed => identical trace, field for field (timestamps, tenants,
+    kinds, sizes, names); distinct seeds diverge."""
+    a = arrival_process(SEED, duration_s=10.0)
+    b = arrival_process(SEED, duration_s=10.0)
+    assert a == b
+    c = arrival_process(SEED + 1, duration_s=10.0)
+    assert a != c
+
+
+def test_arrival_process_rate_sanity():
+    """Offered load tracks the configured rate: a pure-Poisson trace (no
+    bursts, flat rate) lands near base_rate * duration; enabling bursts only
+    adds arrivals."""
+    flat = arrival_process(
+        SEED, duration_s=60.0, base_rate=4.0, diurnal_amplitude=0.0, burst_rate=0.0
+    )
+    expect = 4.0 * 60.0
+    assert 0.6 * expect <= len(flat) <= 1.4 * expect
+    bursty = arrival_process(
+        SEED, duration_s=60.0, base_rate=4.0, diurnal_amplitude=0.0, burst_rate=0.2
+    )
+    assert len(bursty) > len(flat)
+
+
+def test_arrival_process_burstiness():
+    """Burst episodes make the per-second arrival counts overdispersed
+    relative to the pure-Poisson trace (index of dispersion var/mean)."""
+    import numpy as np
+
+    def dispersion(events, duration):
+        counts = np.bincount(
+            [int(e.t) for e in events], minlength=int(duration)
+        )
+        return float(counts.var() / counts.mean()) if counts.mean() > 0 else 0.0
+
+    flat = arrival_process(
+        SEED, duration_s=120.0, base_rate=3.0, diurnal_amplitude=0.0, burst_rate=0.0
+    )
+    bursty = arrival_process(
+        SEED,
+        duration_s=120.0,
+        base_rate=3.0,
+        diurnal_amplitude=0.0,
+        burst_rate=0.3,
+        burst_size_mean=10.0,
+    )
+    assert dispersion(bursty, 120.0) > dispersion(flat, 120.0) + 0.5
+
+
+def test_arrival_process_shapes_and_churn():
+    """The mix carries all three kinds, train sizes are heavy-tailed within
+    the cap, and the tenant window rotates (a tenant absent early appears
+    later — churn, not a static pool)."""
+    evs = arrival_process(SEED, duration_s=60.0, base_rate=4.0)
+    kinds = {e.kind for e in evs}
+    assert kinds == {"frontend", "disagg", "train"}
+    sizes = [e.size for e in evs if e.kind == "train"]
+    assert sizes and all(1 <= s <= 16 for s in sizes)
+    assert max(sizes) > min(sizes), "heavy tail collapsed to one size"
+    early = {e.tenant for e in evs if e.t < 10.0}
+    late = {e.tenant for e in evs if e.t >= 30.0}
+    assert late - early, "tenant window never rotated"
+    # Offsets are sorted and names unique.
+    ts = [e.t for e in evs]
+    assert ts == sorted(ts)
+    assert len({e.name for e in evs}) == len(evs)
+
+
+def test_expand_arrivals_base_before_scaled():
+    """Expansion preserves the ordering invariant drain_stream relies on:
+    a base gang precedes every gang scaled from it."""
+    _, arrivals, _ = _trace()
+    seen = set()
+    for _, g in arrivals:
+        if g.base_podgang_name is not None:
+            assert g.base_podgang_name in seen, g.name
+        seen.add(g.name)
+    offs = [t for t, _ in arrivals]
+    assert offs == sorted(offs)
+
+
+# ---- streaming drain --------------------------------------------------------------
+
+
+def test_stream_serial_pipeline_parity():
+    """Saturated arrivals: wave composition is a pure function of (arrival
+    order, wave_size), so the serial and pipelined disciplines must admit
+    the IDENTICAL gang set — overlap is never a semantics change."""
+    _, arrivals, pods = _trace()
+    _, snap = _fleet()
+    cfg = StreamConfig(depth=2, wave_size=8)
+    b_ser, s_ser = drain_stream(arrivals, pods, snap, config=cfg, pipeline=False)
+    b_pip, s_pip = drain_stream(arrivals, pods, snap, config=cfg, pipeline=True)
+    assert b_ser == b_pip
+    assert s_ser.admitted == s_pip.admitted == len(b_pip)
+    assert s_pip.mode == "pipeline" and s_pip.depth == 2
+    assert s_ser.mode == "serial" and s_ser.depth == 0
+    assert s_pip.offered == len(arrivals)
+    assert s_pip.waves >= s_pip.windows >= 1
+    # Saturated runs still measure pull->bound latencies, one per admission.
+    assert len(s_pip.bind_latencies) == s_pip.admitted
+    assert all(x >= 0 for x in s_pip.bind_latencies)
+
+
+def test_stream_matches_drain_backlog_admissions():
+    """The streaming loop is a windowed feed into the same engine: on the
+    same gangs it admits the same set as drain_backlog."""
+    from grove_tpu.solver import drain_backlog
+
+    _, arrivals, pods = _trace(duration_s=5.0)
+    _, snap = _fleet()
+    gangs = [g for _, g in arrivals]
+    ref, _ = drain_backlog(gangs, pods, snap, wave_size=8)
+    got, _ = drain_stream(
+        arrivals, pods, snap, config=StreamConfig(depth=2, wave_size=8)
+    )
+    assert set(got) == set(ref)
+
+
+def test_stream_pruned_parity_with_escalation():
+    """Candidate pruning under the stream: a deliberately clipped candidate
+    budget forces lossy escalations, and the admitted set still equals the
+    dense stream's (the PR-5 exactness invariant holds on the overlapped
+    path), with escalations counted, never silent."""
+    from grove_tpu.solver.pruning import PruningConfig
+
+    _, arrivals, pods = _trace(duration_s=6.0)
+    topo, snap = _fleet(racks=8, hosts=16)  # 256 nodes: pruning engages
+    cfg = StreamConfig(depth=2, wave_size=8)
+    b_dense, _ = drain_stream(arrivals, pods, snap, config=cfg)
+    pr = PruningConfig(enabled=True, min_fleet=64, max_candidates=24, min_pad=16)
+    b_pruned, s = drain_stream(arrivals, pods, snap, config=cfg, pruning=pr)
+    assert set(b_pruned) == set(b_dense)
+    assert s.drain.pruned_waves > 0
+    assert s.drain.escalations >= s.drain.escalations_adopted
+
+
+def test_stream_replay_bitwise():
+    """A journal recorded from the PIPELINED streaming path replays bitwise:
+    monotonic wave ids in commit order, exact entering carries, candidate
+    lists for pruned waves — zero divergences."""
+    from grove_tpu.solver.pruning import PruningConfig
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    _, arrivals, pods = _trace(duration_s=6.0)
+    _, snap = _fleet(racks=8, hosts=16)
+    pr = PruningConfig(enabled=True, min_fleet=64, max_candidates=24, min_pad=16)
+    journal = tempfile.mkdtemp(prefix="grove-test-stream-")
+    rec = TraceRecorder(journal)
+    rec.start()
+    try:
+        _, stats = drain_stream(
+            arrivals,
+            pods,
+            snap,
+            config=StreamConfig(depth=2, wave_size=8),
+            pruning=pr,
+            recorder=rec,
+        )
+    finally:
+        rec.stop()
+    records = read_journal(journal)
+    shutil.rmtree(journal, ignore_errors=True)
+    waves = [r for r in records if r.get("kind") == "wave"]
+    assert len(waves) == stats.drain.journaled_waves == stats.waves
+    names = [r["wave"] for r in waves]
+    assert names == sorted(names), "wave ids not monotonic in commit order"
+    assert all(n.startswith("stream-") for n in names)
+    report = replay_journal(records)
+    assert report.divergence_count == 0, report.to_doc()["diverged"][:3]
+
+
+def test_drain_pipeline_replay_bitwise():
+    """Same bitwise-replay guarantee for drain_backlog's pipelined harvest
+    (the acceptance gate: replay stays green on the overlapped path)."""
+    from grove_tpu.solver import drain_backlog
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    _, arrivals, pods = _trace(duration_s=5.0)
+    _, snap = _fleet()
+    gangs = [g for _, g in arrivals]
+    journal = tempfile.mkdtemp(prefix="grove-test-dpipe-")
+    rec = TraceRecorder(journal)
+    rec.start()
+    try:
+        _, stats = drain_backlog(
+            gangs, pods, snap, wave_size=8, harvest="pipeline", recorder=rec
+        )
+    finally:
+        rec.stop()
+    records = read_journal(journal)
+    shutil.rmtree(journal, ignore_errors=True)
+    assert stats.journaled_waves == stats.waves > 0
+    report = replay_journal(records)
+    assert report.divergence_count == 0, report.to_doc()["diverged"][:3]
+
+
+def test_stream_paced_measures_time_to_bind():
+    """Paced mode: arrivals become visible at their trace offsets, and
+    time-to-bind is measured against each gang's arrival instant — bounded
+    below by 0 and above by the run wall."""
+    _, arrivals, pods = _trace(duration_s=2.0, rate=6.0)
+    _, snap = _fleet()
+    bindings, stats = drain_stream(
+        arrivals,
+        pods,
+        snap,
+        config=StreamConfig(depth=2, wave_size=8, max_wait_s=0.02),
+        pace=True,
+    )
+    assert stats.paced
+    assert stats.admitted == len(bindings) > 0
+    assert len(stats.bind_latencies) == stats.admitted
+    assert all(0.0 <= x <= stats.wall_s + 1e-6 for x in stats.bind_latencies)
+    pct = stats.bind_percentiles((50.0, 99.0))
+    assert pct is not None and pct[50.0] <= pct[99.0]
+    # The paced wall covers the trace span (arrivals were honored in time).
+    assert stats.wall_s >= max(t for t, _ in arrivals) - 1e-6
+
+
+def test_stream_stats_surface_on_warm_path():
+    """drain_stream folds its run into the warm path: last_stream doc (the
+    grove_stream_* metric source) and the bounded time-to-bind sample queue
+    for histogram export."""
+    from grove_tpu.solver.warm import WarmPath
+
+    _, arrivals, pods = _trace(duration_s=4.0)
+    _, snap = _fleet()
+    wp = WarmPath()
+    _, stats = drain_stream(
+        arrivals, pods, snap, config=StreamConfig(depth=3, wave_size=8), warm_path=wp
+    )
+    doc = wp.last_stream
+    assert doc["depth"] == 3 and doc["mode"] == "pipeline"
+    assert doc["streamAdmitted"] == stats.admitted
+    assert doc["gangsPerSec"] == round(stats.gangs_per_sec, 2)
+    assert len(wp.stream_bind_samples) == len(stats.bind_latencies)
+
+
+def test_stream_empty_and_validation():
+    _, snap = _fleet(racks=1, hosts=2)
+    bindings, stats = drain_stream([], {}, snap)
+    assert bindings == {} and stats.offered == 0
+    assert stats.bind_percentiles() is None
+    assert StreamStats().bind_percentiles() is None
+    with pytest.raises(ValueError, match="depth"):
+        drain_stream([], {}, snap, config=StreamConfig(depth=0))
+    with pytest.raises(ValueError, match="waveSize"):
+        drain_stream([], {}, snap, config=StreamConfig(wave_size=0))
+
+
+@pytest.mark.slow
+def test_stream_soak_long_trace_parity():
+    """Long-soak tier (GROVE_BENCH_STREAM_SOAK analog, excluded from
+    tier-1): a multi-minute-shaped trace holds serial/pipelined parity and
+    keeps the executable cache stable after the first window sweep."""
+    from grove_tpu.solver.warm import WarmPath
+
+    evs = arrival_process(SEED, duration_s=90.0, base_rate=8.0)
+    arrivals, pods = expand_arrivals(evs)
+    _, snap = _fleet(racks=8, hosts=16)
+    wp = WarmPath()
+    cfg = StreamConfig(depth=2, wave_size=32)
+    b_ser, _ = drain_stream(
+        arrivals, pods, snap, config=cfg, warm_path=wp, pipeline=False
+    )
+    lower0 = wp.executables.lowerings
+    b_pip, stats = drain_stream(
+        arrivals, pods, snap, config=cfg, warm_path=wp, pipeline=True
+    )
+    assert b_ser == b_pip
+    assert wp.executables.lowerings == lower0, "steady state re-lowered"
+    assert stats.gangs_per_sec > 0
+
+
+# ---- config / surfaces ------------------------------------------------------------
+
+
+def test_solver_streaming_config_block_validated():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {
+            "solver": {
+                "streaming": {
+                    "depth": 3,
+                    "waveSize": 128,
+                    "maxWaitS": 0.1,
+                    "pollS": 0.01,
+                }
+            }
+        }
+    )
+    assert not errors, errors
+    sc = cfg.solver.streaming_config()
+    assert sc.depth == 3 and sc.wave_size == 128
+    assert sc.max_wait_s == 0.1 and sc.poll_s == 0.01
+    # Empty block -> defaults (streaming has no enabled bit).
+    cfg2, errs2 = parse_operator_config({"solver": {"streaming": {}}})
+    assert not errs2
+    assert cfg2.solver.streaming_config() == StreamConfig()
+
+    _, errs = parse_operator_config(
+        {"solver": {"streaming": {"waveSizes": 4}}}
+    )
+    assert any("unknown field" in e for e in errs)
+    _, errs = parse_operator_config({"solver": {"streaming": {"depth": 0}}})
+    assert any("depth" in e for e in errs)
+    _, errs = parse_operator_config(
+        {"solver": {"streaming": {"waveSize": True}}}
+    )
+    assert any("waveSize" in e for e in errs)
+    _, errs = parse_operator_config(
+        {"solver": {"streaming": {"maxWaitS": -1}}}
+    )
+    assert any("maxWaitS" in e for e in errs)
+    _, errs = parse_operator_config({"solver": {"streaming": {"pollS": 0}}})
+    assert any("pollS" in e for e in errs)
+
+
+def test_statusz_stream_section_and_metrics(tmp_path):
+    """Manager wiring: /statusz solver.streaming carries the effective
+    config, lastStream appears once a streaming run folded into the warm
+    path, the grove_stream_* metrics exist, and the time-to-bind samples
+    drain into the histogram exactly once."""
+    import time as _time
+
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "solver": {
+                "compilationCacheDir": "",
+                "prewarmTopK": 0,
+                "streaming": {"depth": 4, "waveSize": 32},
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    doc = m.statusz()
+    assert doc["solver"]["streaming"] == {
+        "depth": 4,
+        "waveSize": 32,
+        "maxWaitS": 0.05,
+        "pollS": 0.005,
+    }
+    assert "lastStream" not in doc["solver"]
+    # Fold a streaming run into the warm path (what drain_stream does at
+    # exit) and refresh: gauges update, samples land in the histogram once.
+    m.controller.warm.record_stream(
+        {"depth": 4, "gangsPerSec": 12.5, "mode": "pipeline"},
+        [0.01, 0.02, 0.03],
+    )
+    m.reconcile_once(_time.time())
+    doc = m.statusz()
+    assert doc["solver"]["lastStream"]["gangsPerSec"] == 12.5
+    text = m.metrics.render_text()
+    assert "grove_stream_depth 4" in text
+    assert "grove_stream_gangs_per_sec 12.5" in text
+    assert "grove_stream_time_to_bind_seconds_count 3" in text
+    # Second refresh must not re-observe the drained samples.
+    m.reconcile_once(_time.time())
+    assert "grove_stream_time_to_bind_seconds_count 3" in m.metrics.render_text()
+
+
+def test_cli_get_solver_renders_stream_rows():
+    from grove_tpu.cli.main import _get_table
+
+    class FakeClient:
+        def statusz(self):
+            return {
+                "solvePasses": {"full": 1, "delta": 2, "skipped": 3},
+                "warmPath": {"execHits": 5},
+                "solver": {
+                    "pruning": {"enabled": False},
+                    "streaming": {"depth": 2, "waveSize": 64},
+                    "lastStream": {
+                        "gangsPerSec": 99.5,
+                        "bindP50S": 0.01,
+                        "bindP99S": 0.09,
+                    },
+                },
+            }
+
+    out = _get_table(FakeClient(), "solver")
+    assert "streaming.depth" in out and "streaming.waveSize" in out
+    assert "lastStream.gangsPerSec" in out and "99.5" in out
+    assert "lastStream.bindP99S" in out
+
+
+def test_stream_bench_small(monkeypatch):
+    """The stream scenario's engine at test size: serial/pipelined parity,
+    measured paced time-to-bind, and the registry exposing the scenario.
+    The full-length soak variant is env-gated slow tier."""
+    import bench
+
+    assert "stream" in bench.SCENARIOS
+    monkeypatch.setenv("GROVE_BENCH_STREAM_DURATION_S", "2")
+    monkeypatch.setenv("GROVE_BENCH_STREAM_RATE", "5")
+    monkeypatch.setenv("GROVE_BENCH_STREAM_WAVE", "16")
+    out = bench.run_stream_bench()
+    assert out["admitted_parity"] is True
+    assert out["pipeline_admitted"] == out["serial_admitted"] > 0
+    assert out["value"] > 0
+    assert out["paced_bind_p50_s"] is not None
+    assert out["paced_bind_p99_s"] >= out["paced_bind_p50_s"]
+    assert out["host_cpus"] >= 1
