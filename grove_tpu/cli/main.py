@@ -198,6 +198,10 @@ def _get_table(client: GroveClient, kind: str) -> str:
             for k, v in sorted(solver_doc.get("pruning", {}).items())
         ]
         rows += [
+            ["mesh." + k, v]
+            for k, v in sorted(solver_doc.get("mesh", {}).items())
+        ]
+        rows += [
             ["lastDrain." + k, v]
             for k, v in sorted(solver_doc.get("lastDrain", {}).items())
         ]

@@ -84,6 +84,12 @@ class GroveController:
     # executable cache then keys on the candidate pad, not the fleet pad
     # (solver/pruning.py; stats on warm.prune)
     pruning: object | None = None
+    # mesh-sharded solve (solver.mesh config -> mesh_config(); parallel/
+    # mesh.MeshConfig): when enabled, single-variant per-tick solves shard
+    # their node/candidate axis across the device mesh — layout negotiated
+    # per fleet pad (memoized), fallbacks counted on the shard ledger,
+    # journaled waves carry the mesh fingerprint
+    mesh_cfg: object | None = None
     # portfolio width: >1 solves each wave under P weight variants, winner
     # kept (solver.portfolio; parallel/portfolio.py)
     portfolio: int = 1
@@ -811,6 +817,13 @@ class GroveController:
             esc = self._escalation_damper.effective_width(
                 floors_only, esc_fp, self.portfolio, esc
             )
+        mesh_layout = None
+        if self.mesh_cfg is not None:
+            from grove_tpu.parallel.mesh import resolve_layout
+
+            mesh_layout = resolve_layout(
+                self.mesh_cfg, int(snapshot.free.shape[0])
+            )
         result = solve(
             snapshot,
             batch,
@@ -824,6 +837,9 @@ class GroveController:
             # Candidate pruning (solver.pruning config): solve on the
             # gathered sub-fleet; lossy rejections escalate dense.
             pruning=self.pruning,
+            # Mesh-sharded solve (solver.mesh config): node/candidate axis
+            # split across the device mesh, bitwise-equal to unsharded.
+            mesh=mesh_layout,
         )
         bindings = decode_assignments(result, decode, snapshot)
         solve_seconds = time.perf_counter() - t_solve0
@@ -866,6 +882,7 @@ class GroveController:
                     valid_by_name=valid_by_name,
                     scores=scores,
                     solve_seconds=solve_seconds,
+                    mesh=mesh_layout.fingerprint() if mesh_layout else None,
                 )
             except Exception:  # noqa: BLE001 — tracing must never break serving
                 pass

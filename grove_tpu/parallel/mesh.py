@@ -18,12 +18,48 @@ operator/api/config/v1alpha1/types.go:180-208) done the XLA way.
 
 from __future__ import annotations
 
+import logging
+import threading
+from dataclasses import dataclass
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 PORTFOLIO_AXIS = "portfolio"
 NODE_AXIS = "node"
+
+logger = logging.getLogger(__name__)
+
+# Shard-fallback ledger: every time layout negotiation declines to shard on a
+# MULTI-device host (no divisible split, fleet under the floor, axis too
+# small) the caller silently solves unsharded — correct, but one chip does
+# all the work. The first fallback logs its reason; all of them count, and
+# WarmPath.stats()/DrainStats surface the counter (/statusz warmPath
+# shardFallbacks, `grove-tpu get solver`).
+_FALLBACKS = 0
+_FALLBACK_LOCK = threading.Lock()
+_FALLBACK_LOGGED = False
+
+
+def _note_fallback(reason: str) -> None:
+    global _FALLBACKS, _FALLBACK_LOGGED
+    with _FALLBACK_LOCK:
+        _FALLBACKS += 1
+        first = not _FALLBACK_LOGGED
+        _FALLBACK_LOGGED = True
+    if first:
+        logger.warning(
+            "solver mesh fallback: %s — solving unsharded on one device "
+            "(counted as shardFallbacks; only the first fallback logs)",
+            reason,
+        )
+
+
+def shard_fallbacks() -> int:
+    """Process-wide count of mesh-negotiation fallbacks to unsharded."""
+    with _FALLBACK_LOCK:
+        return _FALLBACKS
 
 
 def factor_devices(n: int) -> tuple[int, int]:
@@ -71,6 +107,10 @@ def solver_mesh_for(
             return Mesh(
                 np.asarray(devices).reshape(pa, k), (PORTFOLIO_AXIS, NODE_AXIS)
             )
+    _note_fallback(
+        f"no (portfolio, node) split of {nd} devices divides "
+        f"portfolio={portfolio}, nodes={n_nodes}"
+    )
     return None
 
 
@@ -88,3 +128,263 @@ def node_sharding(mesh: Mesh, node_axis_index: int, ndim: int) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def mesh_divisible_pad(pad: int, k: int) -> int:
+    """Round `pad` up to the next multiple of `k` (identity for k <= 1).
+
+    NamedSharding needs each sharded dimension divisible by its mesh axis;
+    the pow2 pads the encode/pruning ladders produce are divisible by any
+    pow2 device count already, so this only moves the pad on exotic axis
+    sizes (6 devices -> node axis 3, say). Keeping the bump HERE — in the
+    pad, not in the mesh search — is what lets `solve_layout_for` and
+    `solver_mesh_for` succeed at bench scale instead of silently falling
+    back to one device."""
+    if k <= 1:
+        return pad
+    return ((pad + k - 1) // k) * k
+
+
+@dataclass(frozen=True)
+class SolveLayout:
+    """One negotiated mesh layout for the single-variant production solve.
+
+    The portfolio axis is size 1 here (weight-variant data parallelism rides
+    `portfolio_solve`'s own mesh); the node axis carries the model-parallel
+    split of every node-axis tensor: free/capacity [N, R], schedulable [N],
+    node_domain_id [L, N], and the batch's node-seed fields. XLA GSPMD
+    inserts the collectives for the per-domain segment reductions and the
+    stage-2 top-k — the solver function itself is UNCHANGED.
+
+    One instance = one executable family: `key()` feeds the AOT cache key
+    (solver/warm.py) and the jitted-variant table, `fingerprint()` is what
+    the flight recorder journals so replay can rebuild the same layout.
+    """
+
+    mesh: Mesh
+
+    @property
+    def node_devices(self) -> int:
+        return int(self.mesh.shape[NODE_AXIS])
+
+    @property
+    def portfolio_devices(self) -> int:
+        return int(self.mesh.shape[PORTFOLIO_AXIS])
+
+    def key(self) -> tuple:
+        """Hashable executable-cache identity: axis sizes + device ids (two
+        same-shape meshes over different device subsets must not alias)."""
+        return (
+            self.portfolio_devices,
+            self.node_devices,
+            tuple(d.id for d in self.mesh.devices.flat),
+        )
+
+    def fingerprint(self) -> dict:
+        """JSON-able journal record (trace/recorder.py wave records)."""
+        return {"portfolio": self.portfolio_devices, "node": self.node_devices}
+
+    # ---- shardings per solver argument --------------------------------------
+
+    def replicated(self) -> NamedSharding:
+        return replicated(self.mesh)
+
+    def node_sharding(self, node_axis_index: int, ndim: int) -> NamedSharding:
+        return node_sharding(self.mesh, node_axis_index, ndim)
+
+    def free_sharding(self) -> NamedSharding:
+        return self.node_sharding(0, 2)
+
+    def batch_sharding(self, field: str, ndim: int) -> NamedSharding:
+        """Sharding for one GangBatch field: node-seed fields shard their
+        trailing node axis, everything else is replicated."""
+        if field in ("reuse_nodes", "spread_avoid", "group_node_ok"):
+            return self.node_sharding(ndim - 1, ndim)
+        return self.replicated()
+
+    def shard_solve_args(
+        self, free0, capacity, schedulable, node_domain_id, batch, ok_global=None
+    ):
+        """device_put every solver input with its layout sharding (no-ops
+        for arrays already resident with the right sharding — the drain's
+        chained carry and the content-digest device cache stay zero-copy)."""
+        rep = self.replicated()
+        free0 = jax.device_put(free0, self.free_sharding())
+        capacity = jax.device_put(capacity, self.free_sharding())
+        schedulable = jax.device_put(schedulable, self.node_sharding(0, 1))
+        node_domain_id = jax.device_put(node_domain_id, self.node_sharding(1, 2))
+        batch = type(batch)(
+            *(
+                None
+                if x is None
+                else jax.device_put(x, self.batch_sharding(name, x.ndim))
+                for name, x in zip(type(batch)._fields, batch)
+            )
+        )
+        if ok_global is not None:
+            ok_global = jax.device_put(ok_global, rep)
+        return free0, capacity, schedulable, node_domain_id, batch, ok_global
+
+    def gather_rows(self, free, padded_idx):
+        """free [N, R] (node-sharded) -> rows [pad, R], node-sharded; pad
+        rows (out-of-range idx) read as zero. The pruned drain's per-wave
+        candidate gather, layout-stable by out_shardings."""
+        import jax.numpy as jnp
+
+        return _row_ops(self)[0](free, jnp.asarray(padded_idx))
+
+    def scatter_rows(self, fleet_free, padded_idx, rows):
+        """Write solved candidate rows back into the node-sharded fleet
+        carry (pad rows drop via out-of-range scatter)."""
+        import jax.numpy as jnp
+
+        return _row_ops(self)[1](fleet_free, jnp.asarray(padded_idx), rows)
+
+
+# Per-layout jitted gather/scatter for the pruned drain's device-chained
+# fleet carry: out_shardings pin the result to the layout's node sharding,
+# so gathering a wave's candidate rows out of the sharded fleet free (and
+# scattering the solved rows back) keeps the chain sharded end to end — the
+# pipeline never reshards between waves (eager .at[] ops would leave the
+# output layout to GSPMD's whim and force a device_put per wave).
+_ROW_OPS: dict[tuple, tuple] = {}
+_ROW_OPS_LOCK = threading.Lock()
+
+
+def _row_ops(layout: "SolveLayout") -> tuple:
+    key = layout.key()
+    with _ROW_OPS_LOCK:
+        ops = _ROW_OPS.get(key)
+    if ops is None:
+        sh = layout.free_sharding()
+        gather = jax.jit(
+            lambda free, idx: free.at[idx].get(mode="fill", fill_value=0.0),
+            out_shardings=sh,
+        )
+        scatter = jax.jit(
+            lambda fleet, idx, rows: fleet.at[idx].set(
+                rows, mode="drop", unique_indices=True
+            ),
+            out_shardings=sh,
+        )
+        with _ROW_OPS_LOCK:
+            ops = _ROW_OPS.setdefault(key, (gather, scatter))
+    return ops
+
+
+def solve_layout_for(
+    n_nodes: int,
+    devices: list | None = None,
+    *,
+    max_devices: int = 0,
+    min_nodes: int = 0,
+    count_fallback: bool = True,
+) -> SolveLayout | None:
+    """Negotiate the (1, K) node-sharded layout for a single-variant solve.
+
+    K is the largest device count <= the available devices (clamped by
+    `max_devices` when > 0) that divides `n_nodes` — with pow2 node pads and
+    pow2 device counts that is simply "all of them". None means stay
+    unsharded: one device, a fleet below `min_nodes` (sharding overhead
+    would dominate), or no K > 1 dividing the axis (counted + logged once
+    via the shard-fallback ledger unless `count_fallback=False` — probes
+    and status renders must not inflate the production counter)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if max_devices > 0:
+        devices = devices[:max_devices]
+    nd = len(devices)
+    if nd <= 1:
+        return None
+    if n_nodes < min_nodes:
+        if count_fallback:
+            _note_fallback(
+                f"fleet axis {n_nodes} below solver.mesh.minNodes={min_nodes}"
+            )
+        return None
+    for k in range(nd, 1, -1):
+        if n_nodes % k == 0:
+            return SolveLayout(
+                mesh=Mesh(
+                    np.asarray(devices[:k]).reshape(1, k),
+                    (PORTFOLIO_AXIS, NODE_AXIS),
+                )
+            )
+    if count_fallback:
+        _note_fallback(
+            f"no node-axis split: {n_nodes} nodes not divisible by any "
+            f"k in 2..{nd}"
+        )
+    return None
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """`solver.mesh` config block (runtime/config.py validates the YAML
+    shape; this is the solver-side value object)."""
+
+    enabled: bool = False
+    # Fleets whose padded node axis is below this stay unsharded — at small
+    # sizes the collectives cost more than the split saves.
+    min_nodes: int = 512
+    # Devices the solve may occupy; 0 = every visible device.
+    max_devices: int = 0
+
+    def layout_for(self, n_nodes: int) -> SolveLayout | None:
+        """Negotiated layout for a fleet axis (memoized — serving paths call
+        this per solve); None when disabled or negotiation falls back."""
+        if not self.enabled:
+            return None
+        key = (self, int(n_nodes))
+        with _LAYOUT_MEMO_LOCK:
+            if key in _LAYOUT_MEMO:
+                return _LAYOUT_MEMO[key]
+        layout = solve_layout_for(
+            int(n_nodes), max_devices=self.max_devices, min_nodes=self.min_nodes
+        )
+        with _LAYOUT_MEMO_LOCK:
+            if len(_LAYOUT_MEMO) > 64:
+                _LAYOUT_MEMO.clear()  # tiny key space in practice; bound anyway
+            _LAYOUT_MEMO[key] = layout
+        return layout
+
+
+_LAYOUT_MEMO: dict[tuple, SolveLayout | None] = {}
+_LAYOUT_MEMO_LOCK = threading.Lock()
+
+
+def resolve_layout(mesh, n_nodes: int) -> SolveLayout | None:
+    """Normalize a caller-facing `mesh` argument (None | SolveLayout |
+    MeshConfig) to a SolveLayout or None — the one sniffing point for the
+    drain/stream/solve entries."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, SolveLayout):
+        return mesh
+    if isinstance(mesh, MeshConfig):
+        return mesh.layout_for(n_nodes)
+    raise TypeError(f"mesh must be None, SolveLayout, or MeshConfig; got {type(mesh)!r}")
+
+
+def layout_from_fingerprint(fp: dict | None, n_nodes: int) -> SolveLayout | None:
+    """Rebuild a journaled layout when this process can host it.
+
+    Replay contract (trace/replay.py): the sharded solve is bitwise-equal to
+    the unsharded solve (pinned by tests/test_mesh.py), so a plan recorded
+    on an 8-device mesh replays bitwise on ANY device count — when the
+    recorded mesh fits the current runtime we rebuild it (exercising the
+    recorded configuration), otherwise replay solves unsharded. Returns
+    None when fp is absent/1-device/unbuildable; never counts a fallback
+    (replay is not the production path)."""
+    if not fp:
+        return None
+    k = int(fp.get("node", 1))
+    if k <= 1:
+        return None
+    devices = jax.devices()
+    if len(devices) < k or n_nodes % k != 0:
+        return None
+    return SolveLayout(
+        mesh=Mesh(
+            np.asarray(devices[:k]).reshape(1, k), (PORTFOLIO_AXIS, NODE_AXIS)
+        )
+    )

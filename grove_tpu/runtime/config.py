@@ -209,6 +209,22 @@ class SolverConfig:
     #   pollS     number > 0, paced mode: idle poll granularity (default
     #             0.005)
     streaming: dict = field(default_factory=dict)
+    # Mesh-sharded solve (parallel/mesh.py): distribute the single-variant
+    # batched solve across the TPU mesh — node-axis tensors split over the
+    # devices (GSPMD inserts the segment-reduction collectives), the free
+    # carry chains node-sharded between waves, the AOT cache keys on the
+    # mesh shape, and journaled waves record the mesh fingerprint for
+    # replay. Bitwise-equal to the unsharded solve, so enabling it is a
+    # pure throughput choice; negotiation fallbacks (no divisible layout)
+    # solve unsharded and are counted (/statusz warmPath shardFallbacks).
+    # Keys:
+    #   enabled     bool, default false
+    #   minNodes    int >= 0, fleets whose padded node axis is below this
+    #               stay unsharded (default 512 — collectives would cost
+    #               more than the split saves)
+    #   maxDevices  int >= 0, devices the solve may occupy (default 0 =
+    #               every visible device)
+    mesh: dict = field(default_factory=dict)
 
     def solver_params(self):
         """SolverConfig.weights -> SolverParams (validated at config load)."""
@@ -235,6 +251,19 @@ class SolverConfig:
         if "minFleet" in p:
             kwargs["min_fleet"] = int(p["minFleet"])
         return PruningConfig(enabled=True, **kwargs)
+
+    def mesh_config(self):
+        """SolverConfig.mesh -> parallel.mesh.MeshConfig (validated at
+        config load; always returns a config — the enabled bit rides it)."""
+        m = self.mesh or {}
+        from grove_tpu.parallel.mesh import MeshConfig
+
+        kwargs = {}
+        if "minNodes" in m:
+            kwargs["min_nodes"] = int(m["minNodes"])
+        if "maxDevices" in m:
+            kwargs["max_devices"] = int(m["maxDevices"])
+        return MeshConfig(enabled=bool(m.get("enabled", False)), **kwargs)
 
     def streaming_config(self):
         """SolverConfig.streaming -> solver.stream.StreamConfig (validated
@@ -759,6 +788,23 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             or sm["pollS"] <= 0
         ):
             errors.append("solver.streaming.pollS: must be > 0")
+    mh = cfg.solver.mesh
+    if not isinstance(mh, dict):
+        errors.append("solver.mesh: must be a mapping")
+    elif mh:
+        _MESH_KEYS = {"enabled", "minNodes", "maxDevices"}
+        for mk in mh:
+            if mk not in _MESH_KEYS:
+                errors.append(f"solver.mesh.{mk}: unknown field")
+        if "enabled" in mh and not isinstance(mh["enabled"], bool):
+            errors.append("solver.mesh.enabled: must be a boolean")
+        for mk in ("minNodes", "maxDevices"):
+            if mk in mh and (
+                not isinstance(mh[mk], int)
+                or isinstance(mh[mk], bool)
+                or mh[mk] < 0
+            ):
+                errors.append(f"solver.mesh.{mk}: must be an int >= 0")
     df = cfg.defrag
     if not isinstance(df.threshold, (int, float)) or isinstance(
         df.threshold, bool

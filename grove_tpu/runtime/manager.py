@@ -511,6 +511,7 @@ class Manager:
             portfolio=config.solver.portfolio,
             portfolio_escalation=config.solver.portfolio_escalation,
             pruning=config.solver.pruning_config(),
+            mesh_cfg=config.solver.mesh_config(),
             auto_slice_enabled=config.network_acceleration.auto_slice_enabled,
             slice_resource_name=config.network_acceleration.slice_resource_name,
             initc_server_url=config.servers.advertise_url,
@@ -716,6 +717,14 @@ class Manager:
             "grove_solver_candidate_nodes",
             "Candidate-axis size of the last pruned solve (0 = dense)",
         )
+        # Mesh-shard fallback ledger (parallel/mesh.py): solves that wanted
+        # a multi-device layout but ran unsharded — the observable side of
+        # the solver_mesh_for/solve_layout_for no-divisible-split path.
+        self._m_shard_fallbacks = self.metrics.counter(
+            "grove_solver_shard_fallbacks_total",
+            "Solver mesh-layout negotiations that fell back to unsharded",
+        )
+        self._shard_fallbacks_exported = 0
         self._m_candidate_escalations = self.metrics.counter(
             "grove_solver_candidate_escalations_total",
             "Pruned-solve rejections re-verified by a dense re-solve",
@@ -1062,6 +1071,24 @@ class Manager:
                 minFleet=int(pruning.min_fleet),
             )
         doc["pruning"].update(self.controller.warm.prune.stats())
+        # Mesh-sharded solve view (parallel/mesh.py): the effective
+        # solver.mesh block plus the shard-fallback ledger (solves that
+        # wanted a multi-device layout but ran unsharded — never silent).
+        mcfg = self.controller.mesh_cfg
+        doc["mesh"] = {
+            "enabled": bool(getattr(mcfg, "enabled", False)),
+        }
+        if mcfg is not None and getattr(mcfg, "enabled", False):
+            doc["mesh"].update(
+                minNodes=int(mcfg.min_nodes),
+                maxDevices=int(mcfg.max_devices),
+            )
+        try:
+            from grove_tpu.parallel.mesh import shard_fallbacks
+
+            doc["mesh"]["shardFallbacks"] = shard_fallbacks()
+        except Exception:  # noqa: BLE001 — status must never fail a scrape
+            pass
         # Streaming-drain view (solver/stream.py): the effective
         # solver.streaming block plus the last streaming run's throughput
         # and measured time-to-bind percentiles (source of the
@@ -1664,6 +1691,17 @@ class Manager:
         if delta > 0:
             self._m_candidate_escalations.inc(float(delta))
             self._prune_escalations_exported = prune.escalations
+        try:
+            from grove_tpu.parallel.mesh import shard_fallbacks
+
+            sf = shard_fallbacks()
+            if sf > self._shard_fallbacks_exported:
+                self._m_shard_fallbacks.inc(
+                    float(sf - self._shard_fallbacks_exported)
+                )
+                self._shard_fallbacks_exported = sf
+        except Exception:  # noqa: BLE001 — metrics must never break reconcile
+            pass
         warm = self.controller.warm
         if warm.last_stream:
             self._m_stream_depth.set(float(warm.last_stream.get("depth", 0)))

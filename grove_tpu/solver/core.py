@@ -30,6 +30,7 @@ f32; the MXU-heavy parts are the [MG,N,R] slot/score tensors).
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import NamedTuple
 
@@ -646,6 +647,75 @@ def solve_batch_impl(
 solve_batch = partial(jax.jit, static_argnames=("coarse_dmax",))(solve_batch_impl)
 
 
+# Mesh-sharded solve entries, one jitted variant per (donate, layout): the
+# SAME solve_batch_impl trace, with every output pinned by an explicit
+# sharding constraint — free_after stays node-sharded (the drain's wave
+# carry chains shard-to-shard with zero resharding), verdict/assignment/
+# score/ok_global outputs are replicated (host fetches and the cross-wave
+# bitmap cost one small transfer, not a gather). Inputs take their sharding
+# from the arrays at lowering time (parallel/mesh.SolveLayout places them),
+# so GSPMD sees the node axis split end to end and inserts the collectives
+# for the per-domain segment reductions and the stage-2 top-k.
+_SHARDED_JIT: dict[tuple, object] = {}
+_SHARDED_JIT_LOCK = threading.Lock()
+
+
+def sharded_solve_fn(layout, donate: bool = False):
+    """jitted solve_batch_impl whose result layout is pinned to `layout`.
+
+    Process-wide memo per (donate, layout key) — the AOT executable cache
+    (solver/warm.py) lowers through this function, so a sharded shape
+    lowered by the prewarm thread and one lowered by a live solve are the
+    one traced function, exactly like the dense path."""
+    key = (bool(donate), layout.key())
+    with _SHARDED_JIT_LOCK:
+        cached = _SHARDED_JIT.get(key)
+        if cached is not None:
+            return cached
+
+    rep = layout.replicated()
+    free_sh = layout.free_sharding()
+
+    def impl(
+        free0,
+        capacity,
+        schedulable,
+        node_domain_id,
+        batch,
+        params=SolverParams(),
+        ok_global=None,
+        coarse_dmax=None,
+    ):
+        res = solve_batch_impl(
+            free0,
+            capacity,
+            schedulable,
+            node_domain_id,
+            batch,
+            params,
+            ok_global,
+            coarse_dmax=coarse_dmax,
+        )
+        c = jax.lax.with_sharding_constraint
+        return SolveResult(
+            assigned=c(res.assigned, rep),
+            ok=c(res.ok, rep),
+            placement_score=c(res.placement_score, rep),
+            free_after=c(res.free_after, free_sh),
+            ok_global=None if res.ok_global is None else c(res.ok_global, rep),
+        )
+
+    jitted = jax.jit(
+        impl,
+        static_argnames=("coarse_dmax",),
+        # Same wave-carry donation contract as the dense variants
+        # (solver/warm.py _jitted_solve): free0 (arg 0) + ok_global (arg 6).
+        donate_argnums=(0, 6) if donate else (),
+    )
+    with _SHARDED_JIT_LOCK:
+        return _SHARDED_JIT.setdefault(key, jitted)
+
+
 def coarse_dmax_of(snapshot) -> int | None:
     """Static bound on domains per non-host level, selecting the aggregation
     strategy for the backend the solve will run on:
@@ -678,6 +748,7 @@ def solve(
     warm=None,  # solver.warm.WarmPath: AOT executables + device-resident state
     donate: bool = False,
     pruning=None,  # solver.pruning.PruningConfig: candidate-pruned solve path
+    mesh=None,  # parallel.mesh.SolveLayout: node-sharded solve across devices
 ) -> SolveResult:
     """Convenience wrapper: snapshot (numpy) -> device -> solve_batch.
 
@@ -712,6 +783,16 @@ def solve(
     rejection stands; escalations are counted on `warm.prune`, never
     silent. Pruning only applies to the snapshot-state single-variant solve
     (free/schedulable overrides and portfolio solves pass through dense).
+
+    `mesh` (a parallel.mesh.SolveLayout) shards the single-variant solve
+    across the device mesh: node-axis tensors split over the layout's node
+    axis, GSPMD inserting the segment-reduction collectives; verdicts come
+    back replicated and the free carry stays node-sharded. Bitwise-equal to
+    the unsharded solve (pinned by tests/test_mesh.py), so sharding is a
+    pure throughput choice. Pruned solves shard the CANDIDATE axis — the
+    candidate pad is negotiated mesh-divisible (solver/pruning.py), so the
+    layout never forces a dense fallback. Portfolio (> 1) solves ignore it:
+    they negotiate their own (portfolio, node) mesh in portfolio_solve.
 
     `escalate_portfolio` > portfolio: when the single-variant solve leaves
     VALID gangs rejected, re-solve the same batch once under P=escalate
@@ -765,7 +846,10 @@ def solve(
         from grove_tpu.solver import pruning as pruning_mod
 
         pstats = warm.prune if warm is not None else None
-        plan = pruning_mod.plan_candidates(snapshot, batch, pruning)
+        plan = pruning_mod.plan_candidates(
+            snapshot, batch, pruning,
+            mesh_axis=mesh.node_devices if mesh is not None else 1,
+        )
         if plan is None:
             if pstats is not None:
                 pstats.dense_fallbacks += 1
@@ -782,14 +866,27 @@ def solve(
                 cap_p = jnp.asarray(plan.capacity)
                 sched_p = jnp.asarray(plan.schedulable)
                 ndid_p = jnp.asarray(plan.node_domain_id)
-            free_p = plan.gather_free(free0)
-            solver_fn = (
-                warm.executables.solve if warm is not None else solve_batch
-            )
-            presult = solver_fn(
-                free_p, cap_p, sched_p, ndid_p, jpbatch, params, ok_global,
-                coarse_dmax=plan.coarse_dmax(),
-            )
+            free_p = plan.gather_free(free0, layout=mesh)
+            if warm is not None:
+                presult = warm.executables.solve(
+                    free_p, cap_p, sched_p, ndid_p, jpbatch, params, ok_global,
+                    coarse_dmax=plan.coarse_dmax(), layout=mesh,
+                )
+            elif mesh is not None:
+                free_p, cap_p, sched_p, ndid_p, jpbatch, okg_p = (
+                    mesh.shard_solve_args(
+                        free_p, cap_p, sched_p, ndid_p, jpbatch, ok_global
+                    )
+                )
+                presult = sharded_solve_fn(mesh)(
+                    free_p, cap_p, sched_p, ndid_p, jpbatch, params, okg_p,
+                    coarse_dmax=plan.coarse_dmax(),
+                )
+            else:
+                presult = solve_batch(
+                    free_p, cap_p, sched_p, ndid_p, jpbatch, params, ok_global,
+                    coarse_dmax=plan.coarse_dmax(),
+                )
             if pstats is not None:
                 pstats.pruned_solves += 1
                 pstats.last_candidate_nodes = plan.count
@@ -810,7 +907,9 @@ def solve(
                     assigned=plan.remap_assigned(presult.assigned),
                     ok=presult.ok,
                     placement_score=presult.placement_score,
-                    free_after=plan.scatter_free(free0, presult.free_after),
+                    free_after=plan.scatter_free(
+                        free0, presult.free_after, layout=mesh
+                    ),
                     ok_global=presult.ok_global,
                 )
     if result is None:
@@ -824,6 +923,17 @@ def solve(
                 free0, capacity, sched, node_domain_id, jbatch, params,
                 ok_global,
                 coarse_dmax=cdmax, donate=bool(donate and free is not None),
+                layout=mesh,
+            )
+        elif mesh is not None:
+            free_s, cap_s, sched_s, ndid_s, jbatch_s, okg_s = (
+                mesh.shard_solve_args(
+                    free0, capacity, sched, node_domain_id, jbatch, ok_global
+                )
+            )
+            result = sharded_solve_fn(mesh)(
+                free_s, cap_s, sched_s, ndid_s, jbatch_s, params, okg_s,
+                coarse_dmax=cdmax,
             )
         else:
             result = solve_batch(

@@ -108,6 +108,12 @@ class DrainStats:
     # Pipeline depth (harvest="pipeline"): waves allowed in flight before
     # the host blocks on the oldest. 0 for the other modes.
     depth: int = 0
+    # Mesh-sharded solve (parallel/mesh.py): node-axis device count the
+    # drain's solves ran across (0 = unsharded), and layout-negotiation
+    # fallbacks observed during this drain (a requested mesh that solved
+    # unsharded — never silent; also on WarmPath.stats shardFallbacks).
+    shard_devices: int = 0
+    shard_fallbacks: int = 0
     # Waves journaled to a flight recorder, in commit order (monotonic ids).
     journaled_waves: int = 0
     # Wave/pipeline modes only: (gangs admitted in wave, seconds since drain
@@ -223,7 +229,9 @@ class _WavePipeline:
         wave_prefix: str = "drain",
         record_stamps: bool = False,
         on_commit=None,  # fn(members, wave_bindings, stamp_s) at each commit
+        layout=None,  # parallel.mesh.SolveLayout: mesh-sharded solves
     ) -> None:
+        import jax
         import jax.numpy as jnp
 
         self.pods_by_name = pods_by_name
@@ -239,12 +247,17 @@ class _WavePipeline:
         self.wave_prefix = wave_prefix
         self.record_stamps = record_stamps
         self.on_commit = on_commit
+        # Mesh-sharded solve: every wave's executable is the layout-keyed
+        # sharded variant; the free carry chains node-sharded between waves
+        # (out-sharding pinned), so the pipeline never reshards.
+        self.layout = layout if self.use_exec_cache else None
         # Entering free/ok_global carries are retained per wave for the
         # exactness-escalation re-solves and for journaling the exact
         # entering state; a donated buffer would be dead.
         self.retain_carries = pruning is not None or self.recorder is not None
         self.donate = bool(donate and self.use_exec_cache and not self.retain_carries)
         stats.donated = self.donate
+        stats.shard_devices = self.layout.node_devices if self.layout else 0
 
         self.gidx = {g.name: i for i, g in enumerate(gangs)}
         self.capacity = jnp.asarray(snapshot.capacity)
@@ -255,6 +268,19 @@ class _WavePipeline:
         # free tensor.
         self.free = jnp.asarray(snapshot.free)
         self.ok_g = jnp.zeros((len(gangs),), dtype=bool)
+        if self.layout is not None:
+            # Statics placed once per drain; the free/ok_g carry starts in
+            # layout position and STAYS there (solve outputs are constrained).
+            lay = self.layout
+            self.capacity = jax.device_put(self.capacity, lay.free_sharding())
+            self.schedulable = jax.device_put(
+                self.schedulable, lay.node_sharding(0, 1)
+            )
+            self.node_domain_id = jax.device_put(
+                self.node_domain_id, lay.node_sharding(1, 2)
+            )
+            self.free = jax.device_put(self.free, lay.free_sharding())
+            self.ok_g = jax.device_put(self.ok_g, lay.replicated())
         self.dmax = coarse_dmax_of(snapshot)
         self.epoch = snapshot.encode_epoch()
 
@@ -307,20 +333,34 @@ class _WavePipeline:
         from grove_tpu.solver.pruning import plan_candidates
 
         t0p = time.perf_counter()
-        plan = plan_candidates(self.snapshot, batch, self.pruning)
+        plan = plan_candidates(
+            self.snapshot, batch, self.pruning,
+            mesh_axis=self.layout.node_devices if self.layout else 1,
+        )
         self.stats.prune_s += time.perf_counter() - t0p
         return plan
 
     def pruned_inputs(self, plan, batch):
         """(jnp batch on the candidate axis, capacity, schedulable,
         node_domain_id) — static tensors ride the content-digest device
-        cache, so repeated waves of one class upload once."""
+        cache, so repeated waves of one class upload once (the sharded
+        copies cache under their layout key, sharding included)."""
         import jax.numpy as jnp
 
+        lay = self.layout
         pbatch = plan.gather_batch(batch)
-        cap_p = self.wp.device.device_array(plan.capacity, jnp.float32)
-        sched_p = self.wp.device.device_array(plan.schedulable)
-        ndid_p = self.wp.device.device_array(plan.node_domain_id, jnp.int32)
+        cap_p = self.wp.device.device_array(
+            plan.capacity, jnp.float32,
+            sharding=lay.free_sharding() if lay else None,
+        )
+        sched_p = self.wp.device.device_array(
+            plan.schedulable,
+            sharding=lay.node_sharding(0, 1) if lay else None,
+        )
+        ndid_p = self.wp.device.device_array(
+            plan.node_domain_id, jnp.int32,
+            sharding=lay.node_sharding(1, 2) if lay else None,
+        )
         return pbatch, cap_p, sched_p, ndid_p
 
     def warm_shape(self, ws) -> bool:
@@ -352,6 +392,7 @@ class _WavePipeline:
                 zeros_okg,
                 coarse_dmax=warm_plan.coarse_dmax(),
                 donate=self.donate,
+                layout=self.layout,
             )
         else:
             self.wp.executables.ensure_compiled(
@@ -364,6 +405,7 @@ class _WavePipeline:
                 zeros_okg,
                 coarse_dmax=self.dmax,
                 donate=self.donate,
+                layout=self.layout,
             )
         return True
 
@@ -377,10 +419,14 @@ class _WavePipeline:
             plan = rec["plan"]
             wb, cap_p, sched_p, ndid_p = rec["pruned_inputs"]
             result = self.wp.executables.solve(
-                plan.gather_free(free_in), cap_p, sched_p, ndid_p, wb,
+                plan.gather_free(free_in, layout=self.layout),
+                cap_p, sched_p, ndid_p, wb,
                 self.params, okg_in, coarse_dmax=plan.coarse_dmax(), donate=False,
+                layout=self.layout,
             )
-            free_out = plan.scatter_free(free_in, result.free_after)
+            free_out = plan.scatter_free(
+                free_in, result.free_after, layout=self.layout
+            )
         elif self.use_exec_cache:
             # Donated wave carry: free/ok_g are forfeited to the solve and
             # immediately rebound to the result — the capacity update is an
@@ -391,6 +437,7 @@ class _WavePipeline:
                 free_in, self.capacity, self.schedulable, self.node_domain_id,
                 rec["batch"], self.params, okg_in, coarse_dmax=self.dmax,
                 donate=self.donate,
+                layout=self.layout,
             )
             free_out = result.free_after
         else:
@@ -489,6 +536,7 @@ class _WavePipeline:
                     rec["free_in"], self.capacity, self.schedulable,
                     self.node_domain_id, rec["batch"], self.params,
                     rec["okg_in"], coarse_dmax=self.dmax, donate=False,
+                    layout=self.layout,
                 )
                 dense_ok = np.asarray(dense.ok)
                 if not bool(np.all(dense_ok == rec["ok_np"])):
@@ -619,6 +667,7 @@ class _WavePipeline:
                 candidates=(
                     rec["plan"].idx.tolist() if rec["plan"] is not None else None
                 ),
+                mesh=self.layout.fingerprint() if self.layout else None,
             )
             if journaled:
                 self.stats.journaled_waves += 1
@@ -648,6 +697,7 @@ def drain_backlog(
     depth: int = 2,  # harvest="pipeline": waves in flight before blocking
     pruning=None,  # solver.pruning.PruningConfig; None/disabled = dense
     recorder=None,  # trace.recorder.TraceRecorder; journals committed waves
+    mesh=None,  # None | parallel.mesh.SolveLayout | parallel.mesh.MeshConfig
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
 
@@ -687,6 +737,17 @@ def drain_backlog(
     `recorder` (single-variant drains only): journal every committed wave to
     the flight recorder with monotonic wave ids in commit order, carrying
     the exact closure for bitwise standalone replay (trace/replay.py).
+
+    `mesh` (a parallel.mesh.SolveLayout, or a MeshConfig to negotiate here):
+    every wave's solve shards its node/candidate axis across the device
+    mesh — the free carry chains node-sharded between waves with zero
+    resharding, the AOT cache keys on the mesh shape, and journaled waves
+    record the mesh fingerprint so replay can rebuild the layout. Sharded
+    solves are bitwise-equal to unsharded ones (tests/test_mesh.py), so
+    bindings are identical either way. A negotiation fallback (no divisible
+    layout) solves unsharded and is COUNTED (DrainStats.shard_fallbacks,
+    WarmPath shardFallbacks) — never silent. Portfolio drains ignore it
+    (they negotiate their own (portfolio, node) mesh).
     """
     import jax
     import jax.numpy as jnp
@@ -708,6 +769,15 @@ def drain_backlog(
         pruning = None  # portfolio solves own the node-axis layout
     if donate is None:
         donate = warm_mod.donation_default()
+    layout = None
+    shard_fallback = 0
+    if mesh is not None and portfolio == 1:
+        from grove_tpu.parallel.mesh import MeshConfig, resolve_layout
+
+        layout = resolve_layout(mesh, int(snapshot.free.shape[0]))
+        requested = not isinstance(mesh, MeshConfig) or mesh.enabled
+        if layout is None and requested:
+            shard_fallback = 1  # requested a mesh, solving unsharded
     solver = None
     if portfolio > 1:
         # Per-wave portfolio: every wave solved under P weight variants, the
@@ -734,6 +804,7 @@ def drain_backlog(
         gangs=len(gangs),
         harvest=harvest,
         depth=depth if harvest == "pipeline" else 0,
+        shard_fallbacks=shard_fallback,
     )
     if not gangs:
         return {}, stats
@@ -758,6 +829,7 @@ def drain_backlog(
         recorder=recorder,
         wave_prefix="drain",
         record_stamps=harvest in ("wave", "pipeline"),
+        layout=layout,
     )
 
     if warm:

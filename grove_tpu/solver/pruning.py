@@ -127,13 +127,17 @@ class CandidatePlan:
 
     # ---- gather ------------------------------------------------------------
 
-    def gather_free(self, free):
+    def gather_free(self, free, layout=None):
         """Fleet free [N, R] -> candidate free [pad, R] (pad rows zero).
-        Works on numpy (host path) and jax arrays (device-chained drain)."""
+        Works on numpy (host path) and jax arrays (device-chained drain).
+        `layout` (parallel.mesh.SolveLayout) keeps a sharded fleet carry
+        sharded through the gather (out_shardings-pinned jit)."""
         if isinstance(free, np.ndarray):
             out = np.zeros((self.pad, free.shape[1]), dtype=np.float32)
             out[: self.count] = free[self.idx]
             return out
+        if layout is not None:
+            return layout.gather_rows(free, self._padded_idx())
         import jax.numpy as jnp
 
         idx = jnp.asarray(self._padded_idx())
@@ -141,7 +145,7 @@ class CandidatePlan:
         # phantom row concat per wave on the chained device carry.
         return free.at[idx].get(mode="fill", fill_value=0.0)
 
-    def scatter_free(self, fleet_free, pruned_free):
+    def scatter_free(self, fleet_free, pruned_free, layout=None):
         """Write the pruned solve's free_after back into the fleet axis
         (device op; pad rows drop via out-of-range scatter)."""
         idx = self._padded_idx()
@@ -149,6 +153,8 @@ class CandidatePlan:
             out = np.array(fleet_free, copy=True)
             out[self.idx] = np.asarray(pruned_free)[: self.count]
             return out
+        if layout is not None:
+            return layout.scatter_rows(fleet_free, idx, pruned_free)
         import jax.numpy as jnp
 
         return fleet_free.at[jnp.asarray(idx)].set(
@@ -227,16 +233,34 @@ class CandidatePlan:
         return max(int(self.num_domains[:-1].max()), 1)
 
 
-def candidate_pad(count: int, cfg: PruningConfig) -> Optional[int]:
+def candidate_pad(
+    count: int, cfg: PruningConfig, mesh_axis: int = 1
+) -> Optional[int]:
     """Smallest ladder bucket holding `count` candidates PLUS the cap-anchor
-    pad row; None when no ladder entry fits."""
+    pad row; None when no ladder entry fits.
+
+    `mesh_axis` > 1 (mesh-sharded solve, parallel/mesh.py) rounds the bucket
+    up to a mesh-divisible size — NamedSharding needs the candidate axis
+    divisible by the node-axis device count, and negotiating that HERE (in
+    the pad, once) is what keeps `solve_layout_for` from silently falling
+    back to one device at bench scale. Pow2 buckets with pow2 device counts
+    are already divisible, so the round-up only moves exotic combinations
+    (and pads with zero rows, which the solver masks anyway)."""
     need = count + 1
     if cfg.pad_ladder:
         for v in sorted(int(x) for x in cfg.pad_ladder):
             if v >= need:
-                return v
+                return _mesh_pad(v, mesh_axis)
         return None
-    return next_pow2(max(need, cfg.min_pad))
+    return _mesh_pad(next_pow2(max(need, cfg.min_pad)), mesh_axis)
+
+
+def _mesh_pad(pad: int, mesh_axis: int) -> int:
+    if mesh_axis <= 1 or pad % mesh_axis == 0:
+        return pad
+    from grove_tpu.parallel.mesh import mesh_divisible_pad
+
+    return mesh_divisible_pad(pad, mesh_axis)
 
 
 def _eligible_nodes(
@@ -352,7 +376,7 @@ def _domain_useful(
 
 
 def plan_candidates(
-    snapshot, batch: GangBatch, cfg: PruningConfig
+    snapshot, batch: GangBatch, cfg: PruningConfig, mesh_axis: int = 1
 ) -> Optional[CandidatePlan]:
     """Cut the candidate axis for one batch against `snapshot`'s CURRENT
     free state (or any state whose free is <= it — a drain computes plans
@@ -383,7 +407,7 @@ def plan_candidates(
     count = int(cand.shape[0])
     if count == 0:
         return None  # nothing can place; the dense solve rejects cheaply
-    pad = candidate_pad(count, cfg)
+    pad = candidate_pad(count, cfg, mesh_axis)
     if pad is None or pad >= n:
         return None
 
@@ -469,16 +493,18 @@ def _assemble_plan(
 
 
 def plan_from_indices(
-    snapshot, indices, cfg: PruningConfig, n_gangs: int
+    snapshot, indices, cfg: PruningConfig, n_gangs: int, mesh_axis: int = 1
 ) -> CandidatePlan:
     """Rebuild a CandidatePlan from a journaled candidate-node list
     (trace/replay.py): live plans are cut against the free state at DISPATCH
     time, which a wave record does not carry — replaying with the recorded
     list reproduces the exact gather the recorded solve ran on. The lossy
     witness is moot at replay (the recorded verdicts already absorbed any
-    escalation), so it is all-False."""
+    escalation), so it is all-False. `mesh_axis` must be the RECORDED mesh's
+    node-axis size (the wave record's mesh fingerprint) so the rebuilt pad
+    matches the pad the live solve ran with."""
     cand = np.asarray(indices, dtype=np.int32)
-    pad = candidate_pad(int(cand.shape[0]), cfg)
+    pad = candidate_pad(int(cand.shape[0]), cfg, mesh_axis)
     if pad is None:
         raise ValueError(
             f"recorded candidate list ({cand.shape[0]} nodes) does not fit "

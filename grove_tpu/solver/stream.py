@@ -116,6 +116,7 @@ class StreamStats:
             "depth": self.depth,
             "mode": self.mode,
             "paced": self.paced,
+            "shardDevices": self.drain.shard_devices,
         }
         pct = self.bind_percentiles((50.0, 99.0))
         if pct is not None:
@@ -137,6 +138,7 @@ def drain_stream(
     pipeline: bool = True,  # False = wave-serial baseline
     pace: bool = False,  # True = honor arrival offsets in wall time
     donate: bool | None = None,
+    mesh=None,  # None | parallel.mesh.SolveLayout | parallel.mesh.MeshConfig
 ) -> tuple[dict[str, dict[str, str]], StreamStats]:
     """Admit a live arrival trace; returns ({gang: {pod: node}}, StreamStats).
 
@@ -152,6 +154,10 @@ def drain_stream(
     concurrently running prewarm thread). Everything else — executable
     cache, encode-row reuse, candidate pruning with exactness escalation,
     flight-recorder journaling — behaves exactly as in drain_backlog.
+
+    `mesh`: mesh-sharded solves, same semantics as drain_backlog — the
+    engine's free carry chains node-sharded between waves, fallbacks are
+    counted, journaled waves record the mesh fingerprint.
     """
     from grove_tpu.solver import warm as warm_mod
 
@@ -166,6 +172,15 @@ def drain_stream(
         raise ValueError(f"streaming depth must be >= 1, got {cfg.depth}")
     if cfg.wave_size < 1:
         raise ValueError(f"streaming waveSize must be >= 1, got {cfg.wave_size}")
+    layout = None
+    shard_fallback = 0
+    if mesh is not None:
+        from grove_tpu.parallel.mesh import MeshConfig, resolve_layout
+
+        layout = resolve_layout(mesh, int(snapshot.free.shape[0]))
+        requested = not isinstance(mesh, MeshConfig) or mesh.enabled
+        if layout is None and requested:
+            shard_fallback = 1
 
     gangs_all = [g for _, g in arrivals]
     stats = StreamStats(
@@ -178,6 +193,7 @@ def drain_stream(
     dstats.gangs = len(gangs_all)
     dstats.harvest = "pipeline" if pipeline else "wave"
     dstats.depth = stats.depth
+    dstats.shard_fallbacks = shard_fallback
     if not gangs_all:
         return {}, stats
 
@@ -205,6 +221,7 @@ def drain_stream(
         wave_prefix="stream",
         record_stamps=True,
         on_commit=on_commit,
+        layout=layout,
     )
     engine_box.append(engine)
 

@@ -65,9 +65,17 @@ _JITTED: dict[bool, Any] = {}
 _JITTED_LOCK = threading.Lock()
 
 
-def _jitted_solve(donate: bool):
+def _jitted_solve(donate: bool, layout=None):
     import jax
 
+    if layout is not None:
+        # Mesh-sharded variant: the same traced solve_batch_impl with its
+        # outputs pinned to the layout (free carry node-sharded, verdicts
+        # replicated). core.sharded_solve_fn memoizes per (donate, layout
+        # key) process-wide, exactly like _JITTED does for dense.
+        from grove_tpu.solver.core import sharded_solve_fn
+
+        return sharded_solve_fn(layout, donate)
     key = bool(donate)
     with _JITTED_LOCK:
         if key not in _JITTED:
@@ -89,10 +97,19 @@ def donation_default() -> bool:
     return jax.default_backend() != "cpu"
 
 
-def _canon(free0, capacity, schedulable, node_domain_id, batch, params, ok_global):
+def _canon(
+    free0, capacity, schedulable, node_domain_id, batch, params, ok_global,
+    layout=None,
+):
     """Normalize every leaf to a committed, strongly-typed device array so
     the cache key (and the compiled executable's input avals) never depend on
-    whether the caller passed numpy, python floats, or device arrays."""
+    whether the caller passed numpy, python floats, or device arrays.
+
+    With `layout` (parallel.mesh.SolveLayout), every leaf is additionally
+    device_put with its layout sharding — a no-op for arrays already
+    resident in that layout (the drain's chained carry, the content-digest
+    device cache), so steady-state sharded solves upload nothing."""
+    import jax
     import jax.numpy as jnp
 
     free0 = jnp.asarray(free0, jnp.float32)
@@ -103,25 +120,40 @@ def _canon(free0, capacity, schedulable, node_domain_id, batch, params, ok_globa
     params = SolverParams(*(jnp.asarray(w, jnp.float32) for w in params))
     if ok_global is not None:
         ok_global = jnp.asarray(ok_global, bool)
+    if layout is not None:
+        free0, capacity, schedulable, node_domain_id, batch, ok_global = (
+            layout.shard_solve_args(
+                free0, capacity, schedulable, node_domain_id, batch, ok_global
+            )
+        )
+        rep = layout.replicated()
+        params = SolverParams(*(jax.device_put(w, rep) for w in params))
     return free0, capacity, schedulable, node_domain_id, batch, params, ok_global
 
 
-def _exec_key(args: tuple, coarse_dmax: Optional[int], donate: bool) -> tuple:
+def _exec_key(
+    args: tuple, coarse_dmax: Optional[int], donate: bool, layout=None
+) -> tuple:
     """Full executable identity: pytree structure (covers optional-feature
     presence) + every leaf's (shape, dtype) (covers node pad, gang pad,
-    bucket dims, global-table width, portfolio width) + the statics."""
+    bucket dims, global-table width, portfolio width) + the statics + the
+    mesh layout (a sharded executable demands its input layout — an
+    unsharded solve of the same shapes must never alias to it)."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(args)
     return (
         bool(donate),
         coarse_dmax,
+        None if layout is None else layout.key(),
         str(treedef),
         tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
     )
 
 
-def _exec_desc(args: tuple, coarse_dmax: Optional[int], donate: bool) -> Optional[dict]:
+def _exec_desc(
+    args: tuple, coarse_dmax: Optional[int], donate: bool, layout=None
+) -> Optional[dict]:
     """JSON-able shape-bucket descriptor (the prewarm history record); None
     for signatures prewarm cannot reconstruct (portfolio-stacked params)."""
     free0, _, _, node_domain_id, batch, params, ok_global = args
@@ -129,6 +161,9 @@ def _exec_desc(args: tuple, coarse_dmax: Optional[int], donate: bool) -> Optiona
         return None  # portfolio-stacked weights ride the legacy jit path
     n, r = free0.shape
     return {
+        "mesh": None
+        if layout is None
+        else [layout.portfolio_devices, layout.node_devices],
         "n": int(n),
         "r": int(r),
         "levels": int(node_domain_id.shape[0]),
@@ -146,46 +181,82 @@ def _exec_desc(args: tuple, coarse_dmax: Optional[int], donate: bool) -> Optiona
     }
 
 
-def _args_from_desc(desc: dict) -> tuple:
+def _layout_from_desc(desc: dict):
+    """Rebuild a recorded mesh layout for prewarm, or None for dense
+    descriptors. Raises when the current runtime cannot host the recorded
+    mesh (fewer devices than the history was written on) — the prewarm loop
+    skips such entries instead of compiling a wrong-layout executable."""
+    mesh_shape = desc.get("mesh")
+    if not mesh_shape:
+        return None
+    import jax
+
+    from grove_tpu.parallel.mesh import solve_layout_for
+
+    p, k = int(mesh_shape[0]), int(mesh_shape[1])
+    if p != 1:
+        raise ValueError(f"unsupported prewarm mesh shape {mesh_shape}")
+    if len(jax.devices()) < k:
+        raise ValueError(
+            f"recorded mesh needs {k} devices, have {len(jax.devices())}"
+        )
+    layout = solve_layout_for(
+        desc["n"], jax.devices()[:k], count_fallback=False
+    )
+    if layout is None or layout.node_devices != k:
+        raise ValueError(f"cannot rebuild {k}-device layout for n={desc['n']}")
+    return layout
+
+
+def _args_from_desc(desc: dict, layout=None) -> tuple:
     """Descriptor -> abstract (ShapeDtypeStruct) solver arguments, good for
-    `jit.lower(...)` without any concrete data."""
+    `jit.lower(...)` without any concrete data. With `layout`, node-axis
+    avals carry their NamedShardings so the prewarmed executable is the
+    sharded one, byte-for-byte the key a live sharded solve will look up."""
     import jax
     import jax.numpy as jnp
 
-    S = jax.ShapeDtypeStruct
+    def S(shape, dtype, sharding=None):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
     f32, i32, b = jnp.float32, jnp.int32, jnp.bool_
     n, r, lv = desc["n"], desc["r"], desc["levels"]
     g, mg, ms, mp = desc["g"], desc["mg"], desc["ms"], desc["mp"]
+    rep = None if layout is None else layout.replicated()
+
+    def nsh(axis_index, ndim):
+        return None if layout is None else layout.node_sharding(axis_index, ndim)
+
     batch = GangBatch(
-        group_req=S((g, mg, r), f32),
-        group_total=S((g, mg), i32),
-        group_required=S((g, mg), i32),
-        group_valid=S((g, mg), b),
-        set_member=S((g, ms, mg), b),
-        set_req_level=S((g, ms), i32),
-        set_pref_level=S((g, ms), i32),
-        set_valid=S((g, ms), b),
-        set_pinned=S((g, ms), i32),
-        pod_group=S((g, mp), i32),
-        pod_rank=S((g, mp), i32),
-        gang_valid=S((g,), b),
-        group_order=S((g, mg), i32),
-        depends_on=S((g,), i32),
-        global_index=S((g,), i32),
-        depends_global=S((g,), i32),
-        reuse_nodes=S((g, n), b) if desc["reuse"] else None,
-        group_node_ok=S((g, mg, n), b) if desc["node_ok"] else None,
-        spread_level=S((g,), i32) if desc["spread"] else None,
-        spread_family=S((g,), i32) if desc["spread"] else None,
-        spread_avoid=S((g, n), b) if desc["spread"] else None,
+        group_req=S((g, mg, r), f32, rep),
+        group_total=S((g, mg), i32, rep),
+        group_required=S((g, mg), i32, rep),
+        group_valid=S((g, mg), b, rep),
+        set_member=S((g, ms, mg), b, rep),
+        set_req_level=S((g, ms), i32, rep),
+        set_pref_level=S((g, ms), i32, rep),
+        set_valid=S((g, ms), b, rep),
+        set_pinned=S((g, ms), i32, rep),
+        pod_group=S((g, mp), i32, rep),
+        pod_rank=S((g, mp), i32, rep),
+        gang_valid=S((g,), b, rep),
+        group_order=S((g, mg), i32, rep),
+        depends_on=S((g,), i32, rep),
+        global_index=S((g,), i32, rep),
+        depends_global=S((g,), i32, rep),
+        reuse_nodes=S((g, n), b, nsh(1, 2)) if desc["reuse"] else None,
+        group_node_ok=S((g, mg, n), b, nsh(2, 3)) if desc["node_ok"] else None,
+        spread_level=S((g,), i32, rep) if desc["spread"] else None,
+        spread_family=S((g,), i32, rep) if desc["spread"] else None,
+        spread_avoid=S((g, n), b, nsh(1, 2)) if desc["spread"] else None,
     )
-    params = SolverParams(*(S((), f32) for _ in SolverParams._fields))
-    ok_global = None if desc["t"] is None else S((desc["t"],), b)
+    params = SolverParams(*(S((), f32, rep) for _ in SolverParams._fields))
+    ok_global = None if desc["t"] is None else S((desc["t"],), b, rep)
     return (
-        S((n, r), f32),
-        S((n, r), f32),
-        S((n,), b),
-        S((lv, n), i32),
+        S((n, r), f32, nsh(0, 2)),
+        S((n, r), f32, nsh(0, 2)),
+        S((n,), b, nsh(0, 1)),
+        S((lv, n), i32, nsh(1, 2)),
         batch,
         params,
         ok_global,
@@ -238,13 +309,18 @@ class ExecutableCache:
         *,
         coarse_dmax: Optional[int] = None,
         donate: bool = False,
+        layout=None,  # parallel.mesh.SolveLayout: mesh-sharded executable
     ) -> SolveResult:
         """solve_batch through the AOT cache. With donate=True the caller
-        forfeits `free0` and `ok_global` after the call (wave carry)."""
+        forfeits `free0` and `ok_global` after the call (wave carry). With
+        `layout`, the executable is the mesh-sharded variant (inputs placed
+        per layout, free carry returned node-sharded) and the cache keys on
+        the mesh shape in addition to the shape bucket."""
         args = _canon(
-            free0, capacity, schedulable, node_domain_id, batch, params, ok_global
+            free0, capacity, schedulable, node_domain_id, batch, params,
+            ok_global, layout=layout,
         )
-        compiled = self._get_or_compile(args, coarse_dmax, donate)
+        compiled = self._get_or_compile(args, coarse_dmax, donate, layout)
         return compiled(*args)
 
     def ensure_compiled(
@@ -259,19 +335,21 @@ class ExecutableCache:
         *,
         coarse_dmax: Optional[int] = None,
         donate: bool = False,
+        layout=None,
     ) -> bool:
         """Compile-only warm-up (no execution, no device traffic beyond the
         constant upload XLA does at compile). Returns True when this call
         paid a lowering, False on a cache hit."""
         before = self.lowerings
         args = _canon(
-            free0, capacity, schedulable, node_domain_id, batch, params, ok_global
+            free0, capacity, schedulable, node_domain_id, batch, params,
+            ok_global, layout=layout,
         )
-        self._get_or_compile(args, coarse_dmax, donate)
+        self._get_or_compile(args, coarse_dmax, donate, layout)
         return self.lowerings != before
 
-    def _get_or_compile(self, args: tuple, coarse_dmax, donate: bool):
-        key = _exec_key(args, coarse_dmax, donate)
+    def _get_or_compile(self, args: tuple, coarse_dmax, donate: bool, layout=None):
+        key = _exec_key(args, coarse_dmax, donate, layout)
         while True:
             with self._lock:
                 compiled = self._entries.get(key)
@@ -282,7 +360,7 @@ class ExecutableCache:
                         self._inflight[key] = threading.Event()
             if compiled is not None:
                 self.hits += 1
-                self._record(args, coarse_dmax, donate, new=False)
+                self._record(args, coarse_dmax, donate, layout, new=False)
                 return compiled
             if pending is None:
                 break
@@ -294,7 +372,7 @@ class ExecutableCache:
         try:
             self.lowerings += 1
             compiled = (
-                _jitted_solve(donate)
+                _jitted_solve(donate, layout)
                 .lower(*args, coarse_dmax=coarse_dmax)
                 .compile()
             )
@@ -306,15 +384,17 @@ class ExecutableCache:
                 ev = self._inflight.pop(key, None)
             if ev is not None:
                 ev.set()
-        self._record(args, coarse_dmax, donate, new=True)
+        self._record(args, coarse_dmax, donate, layout, new=True)
         return compiled
 
     # ---- shape history + prewarm -------------------------------------------
 
-    def _record(self, args: tuple, coarse_dmax, donate: bool, new: bool) -> None:
+    def _record(
+        self, args: tuple, coarse_dmax, donate: bool, layout=None, *, new: bool
+    ) -> None:
         if not self.history_path:
             return
-        desc = _exec_desc(args, coarse_dmax, donate)
+        desc = _exec_desc(args, coarse_dmax, donate, layout)
         if desc is None:
             return
         hkey = json.dumps(desc, sort_keys=True)
@@ -372,8 +452,12 @@ class ExecutableCache:
             if not isinstance(desc, dict) or desc.get("portfolio", 1) != 1:
                 continue
             try:
-                args = _args_from_desc(desc)
-                key = _exec_key(args, desc.get("coarse_dmax"), desc.get("donate", False))
+                layout = _layout_from_desc(desc)
+                args = _args_from_desc(desc, layout)
+                key = _exec_key(
+                    args, desc.get("coarse_dmax"), desc.get("donate", False),
+                    layout,
+                )
                 with self._lock:
                     if key in self._entries:
                         continue
@@ -391,7 +475,7 @@ class ExecutableCache:
                 try:
                     self.lowerings += 1
                     exe = (
-                        _jitted_solve(bool(desc.get("donate", False)))
+                        _jitted_solve(bool(desc.get("donate", False)), layout)
                         .lower(*args, coarse_dmax=desc.get("coarse_dmax"))
                         .compile()
                     )
@@ -459,9 +543,12 @@ class SnapshotDeviceCache:
         self.hits = 0
         self.misses = 0
 
-    def device_array(self, arr, dtype=None):
+    def device_array(self, arr, dtype=None, sharding=None):
         """Device-put `arr` (numpy), memoized by content digest; a jax.Array
-        input passes through untouched (already resident)."""
+        input passes through untouched (already resident). `sharding` (a
+        NamedSharding) is part of the key: a mesh-sharded drain caches the
+        SHARDED copy of each static tensor, so repeated waves neither
+        re-upload nor reshard."""
         import jax
         import jax.numpy as jnp
 
@@ -471,6 +558,7 @@ class SnapshotDeviceCache:
         key = (
             arr.shape,
             str(arr.dtype),
+            sharding,
             hashlib.blake2b(
                 np.ascontiguousarray(arr).tobytes(), digest_size=16
             ).digest(),
@@ -481,6 +569,8 @@ class SnapshotDeviceCache:
             self.hits += 1
             return cached
         dev = jnp.asarray(arr, dtype)
+        if sharding is not None:
+            dev = jax.device_put(dev, sharding)
         self._cache[key] = dev
         while len(self._cache) > self._max:
             self._cache.popitem(last=False)
@@ -656,6 +746,16 @@ class WarmPath:
         out.update(self.encode_rows.stats())
         out.update(self.device.stats())
         out.update(self.prune.stats())
+        # Mesh-shard fallbacks (parallel/mesh.py ledger): solves that wanted
+        # a multi-device layout but ran unsharded. Process-wide by design —
+        # the fallback happens in layout negotiation, before any WarmPath is
+        # in hand.
+        try:
+            from grove_tpu.parallel.mesh import shard_fallbacks
+
+            out["shardFallbacks"] = shard_fallbacks()
+        except Exception:  # noqa: BLE001 — stats must never fail a scrape
+            pass
         out.update(self.last_drain)
         return out
 

@@ -230,6 +230,7 @@ class TraceRecorder:
         allocated_override=None,  # np [N, R]: allocation ENTERING this wave
         free_rows: dict | None = None,  # node -> exact entering free row
         candidates: list | None = None,  # pruned waves: fixed candidate list
+        mesh: dict | None = None,  # mesh fingerprint {portfolio, node} | None
     ) -> bool:
         """Journal one solve wave — the full encode+solve input closure plus
         the resulting plan. Serde-encoding here IS the synchronous deep copy;
@@ -309,6 +310,20 @@ class TraceRecorder:
                 "params": [float(w) for w in params],
                 "portfolio": int(portfolio),
                 "escalatePortfolio": int(escalate_portfolio),
+                # Mesh fingerprint (parallel/mesh.SolveLayout.fingerprint):
+                # the device-mesh layout the recorded solve ran under. The
+                # sharded solve is bitwise-equal to the unsharded one, so a
+                # replay host with fewer devices (a 1-device mesh replaying
+                # an 8-device plan) still replays bitwise — but the pruning
+                # candidate pad is negotiated mesh-divisible, so replay
+                # needs the recorded node-axis size to rebuild the exact
+                # executable shape (trace/replay.py).
+                "mesh": None
+                if not mesh or int(mesh.get("node", 1)) <= 1
+                else {
+                    "portfolio": int(mesh.get("portfolio", 1)),
+                    "node": int(mesh["node"]),
+                },
                 # Candidate-pruning fingerprint: replay must route through
                 # the same pruned path (pruned placements legitimately
                 # differ from dense ones) for bitwise equivalence.

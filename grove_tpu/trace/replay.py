@@ -133,6 +133,21 @@ def solve_wave_record(
     gangs = [serde.decode(d) for d in wave["gangs"]]
     pods = {n: serde.decode(d) for n, d in wave["pods"].items()}
     cfg = wave["solver"]
+    # Recorded mesh fingerprint: rebuild the layout when this runtime can
+    # host it (exercising the recorded sharded configuration); otherwise
+    # replay unsharded — the sharded solve is bitwise-equal to the unsharded
+    # one (tests/test_mesh.py), so a 1-device replay of an 8-device plan
+    # still reproduces it bitwise. The fingerprint's node-axis size is
+    # ALWAYS honored for the pruning candidate pad below (the executable
+    # shape depends on it, devices or not).
+    mesh_fp = cfg.get("mesh")
+    mesh_layout = None
+    if mesh_fp:
+        from grove_tpu.parallel.mesh import layout_from_fingerprint
+
+        mesh_layout = layout_from_fingerprint(
+            mesh_fp, int(np.asarray(snapshot.capacity).shape[0])
+        )
     pruning = None
     pr = cfg.get("pruning")
     if pr and pr.get("enabled"):
@@ -187,12 +202,24 @@ def solve_wave_record(
         # verdicts equal the pruned solve's.
         import jax.numpy as jnp
 
-        from grove_tpu.solver.core import SolveResult, solve_batch
+        from grove_tpu.solver.core import (
+            SolveResult,
+            sharded_solve_fn,
+            solve_batch,
+        )
         from grove_tpu.solver.encode import GangBatch
         from grove_tpu.solver.pruning import plan_from_indices
 
         plan = plan_from_indices(
-            snapshot, candidates, pruning, int(np.asarray(batch.gang_valid).shape[0])
+            snapshot,
+            candidates,
+            pruning,
+            int(np.asarray(batch.gang_valid).shape[0]),
+            # Recorded candidate pad: mesh-divisibility was negotiated into
+            # the pad at record time, so the rebuilt plan must use the
+            # RECORDED node-axis size even when replay itself runs
+            # unsharded (executable shape identity).
+            mesh_axis=int(mesh_fp.get("node", 1)) if mesh_fp else 1,
         )
         free_np = (
             free_override
@@ -206,17 +233,30 @@ def solve_wave_record(
             )
         )
         params_ = params if params is not None else SolverParams(*cfg["params"])
-        solver_fn = warm.executables.solve if warm is not None else solve_batch
-        presult = solver_fn(
+        pruned_args = (
             jnp.asarray(plan.gather_free(free_np)),
             jnp.asarray(plan.capacity),
             jnp.asarray(plan.schedulable),
             jnp.asarray(plan.node_domain_id),
             jpbatch,
-            params_,
-            None,
-            coarse_dmax=plan.coarse_dmax(),
         )
+        if warm is not None:
+            presult = warm.executables.solve(
+                *pruned_args, params_, None,
+                coarse_dmax=plan.coarse_dmax(), layout=mesh_layout,
+            )
+        elif mesh_layout is not None:
+            f_s, c_s, s_s, nd_s, b_s, _ = mesh_layout.shard_solve_args(
+                *pruned_args, None
+            )
+            presult = sharded_solve_fn(mesh_layout)(
+                f_s, c_s, s_s, nd_s, b_s, params_, None,
+                coarse_dmax=plan.coarse_dmax(),
+            )
+        else:
+            presult = solve_batch(
+                *pruned_args, params_, None, coarse_dmax=plan.coarse_dmax()
+            )
         result = SolveResult(
             assigned=plan.remap_assigned(np.asarray(presult.assigned)),
             ok=presult.ok,
@@ -237,6 +277,7 @@ def solve_wave_record(
             ),
             warm=warm,
             pruning=pruning,
+            mesh=mesh_layout,
         )
     plan = decode_assignments(result, decode, snapshot)
     elapsed = time.perf_counter() - t0

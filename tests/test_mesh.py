@@ -1,0 +1,466 @@
+"""Mesh-sharded solve (parallel/mesh.py SolveLayout + solver wiring).
+
+Tier-1 multi-device coverage rides the suite-wide 8-virtual-CPU-device mesh
+(tests/conftest.py forces `--xla_force_host_platform_device_count=8` before
+first backend use — the session fixture below guards that this file never
+silently runs single-device). The contract under test, strongest first:
+
+1. BITWISE EQUIVALENCE — the node-sharded solve reproduces the unsharded
+   solve bit-for-bit (verdicts, assignments, scores, free carry) on the
+   tier-1 scenarios. Everything else (admitted-set parity, replay of
+   sharded-recorded journals on hosts WITHOUT the recorded mesh) follows
+   from this, so it is pinned directly.
+2. CACHE KEYING — sharded executables key on the mesh shape: a sharded and
+   an unsharded solve of the same shape bucket are distinct entries, the
+   second sharded solve of a shape pays ZERO new lowerings, and prewarm
+   from shape history rebuilds the sharded executable.
+3. NEGOTIATION — layout negotiation never wedges: 1 device, prime device
+   counts, portfolio > devices, candidate pads smaller than the node axis
+   all resolve to a valid layout or a COUNTED fallback, never an error and
+   never a silent one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.parallel import mesh as mesh_mod
+from grove_tpu.parallel.mesh import (
+    MeshConfig,
+    SolveLayout,
+    factor_devices,
+    layout_from_fingerprint,
+    mesh_divisible_pad,
+    resolve_layout,
+    shard_fallbacks,
+    solve_layout_for,
+    solver_mesh_for,
+)
+from grove_tpu.sim.workloads import (
+    bench_topology,
+    contended_backlog,
+    contended_cluster,
+    mixed_backlog,
+    quality_cluster,
+    synthetic_backlog,
+    synthetic_cluster,
+)
+from grove_tpu.solver.core import SolverParams, solve
+from grove_tpu.solver.drain import drain_backlog
+from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.solver.pruning import PruningConfig, candidate_pad
+from grove_tpu.solver.warm import WarmPath
+from grove_tpu.state import build_snapshot
+
+TOPO = bench_topology()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def eight_device_mesh():
+    """Guard the tier-1 multi-device contract: this module's coverage is
+    meaningless on one device, and conftest's virtual-device forcing is
+    load-bearing — fail loudly if it ever regresses."""
+    assert len(jax.devices()) == 8, (
+        "tests/conftest.py must force 8 virtual CPU devices "
+        f"(have {len(jax.devices())})"
+    )
+    yield
+
+
+def _expand(backlog):
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, TOPO)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    return gangs, pods
+
+
+def _layout(k=8):
+    layout = solve_layout_for(1024, jax.devices()[:k])
+    assert layout is not None and layout.node_devices == k
+    return layout
+
+
+# --- negotiation edge cases ---------------------------------------------------
+
+
+def test_factor_devices_edge_cases():
+    assert factor_devices(1) == (1, 1)
+    assert factor_devices(2) == (2, 1)
+    assert factor_devices(7) == (7, 1)  # prime: node axis degenerates to 1
+    assert factor_devices(13) == (13, 1)
+    assert factor_devices(8) == (4, 2)
+    assert factor_devices(12) == (4, 3)
+
+
+def test_solver_mesh_for_edge_cases():
+    devs = jax.devices()
+    # 1 device: never a mesh (and never a counted fallback — nothing to
+    # distribute).
+    before = shard_fallbacks()
+    assert solver_mesh_for(4, 16, devs[:1]) is None
+    assert shard_fallbacks() == before
+    # Prime device count: portfolio must absorb the whole axis.
+    m = solver_mesh_for(7, 16, devs[:7])
+    assert m is not None and dict(m.shape) == {"portfolio": 7, "node": 1}
+    # portfolio > devices and divisible: portfolio axis takes all devices.
+    m = solver_mesh_for(16, 10, devs[:8])
+    assert m is not None and dict(m.shape) == {"portfolio": 8, "node": 1}
+    # No divisible split: None, and the fallback ledger moves.
+    before = shard_fallbacks()
+    assert solver_mesh_for(3, 5, devs[:8]) is None
+    assert shard_fallbacks() == before + 1
+
+
+def test_solve_layout_for_edge_cases():
+    devs = jax.devices()
+    assert solve_layout_for(1024, devs[:1]) is None  # 1 device
+    # Largest dividing k wins.
+    assert solve_layout_for(1024, devs).node_devices == 8
+    assert solve_layout_for(12, devs).node_devices == 6
+    # Prime node axis bigger than any divisor <= nd: counted fallback.
+    before = shard_fallbacks()
+    assert solve_layout_for(13, devs) is None
+    assert shard_fallbacks() == before + 1
+    # max_devices clamps; min_nodes floors (counted).
+    assert solve_layout_for(1024, devs, max_devices=4).node_devices == 4
+    before = shard_fallbacks()
+    assert solve_layout_for(64, devs, min_nodes=512) is None
+    assert shard_fallbacks() == before + 1
+
+
+def test_mesh_divisible_pad():
+    assert mesh_divisible_pad(64, 1) == 64
+    assert mesh_divisible_pad(64, 8) == 64
+    assert mesh_divisible_pad(64, 3) == 66
+    assert mesh_divisible_pad(4, 8) == 8  # pad smaller than the axis
+    assert mesh_divisible_pad(9, 8) == 16
+
+
+def test_candidate_pad_mesh_axis():
+    cfg = PruningConfig(min_pad=4)
+    # Candidate pad smaller than the node axis is bumped up to it.
+    assert candidate_pad(2, cfg) == 4
+    assert candidate_pad(2, cfg, mesh_axis=8) == 8
+    # Pow2 pads with pow2 axes are untouched.
+    assert candidate_pad(60, cfg, mesh_axis=8) == 64
+    # Explicit ladders bump too (the executable shape follows the pad).
+    assert candidate_pad(10, PruningConfig(pad_ladder=(12, 48)), mesh_axis=8) == 16
+    # Ladder exhausted stays None regardless of the axis.
+    assert candidate_pad(100, PruningConfig(pad_ladder=(32,)), mesh_axis=8) is None
+
+
+def test_mesh_config_and_resolve_layout():
+    assert resolve_layout(None, 1024) is None
+    assert resolve_layout(MeshConfig(enabled=False), 1024) is None
+    layout = resolve_layout(MeshConfig(enabled=True, min_nodes=64), 1024)
+    assert isinstance(layout, SolveLayout) and layout.node_devices == 8
+    assert resolve_layout(layout, 1024) is layout
+    with pytest.raises(TypeError):
+        resolve_layout(object(), 1024)
+
+
+def test_solver_mesh_config_block_validated():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {"solver": {"mesh": {"enabled": True, "minNodes": 64, "maxDevices": 4}}}
+    )
+    assert not errors
+    mc = cfg.solver.mesh_config()
+    assert mc == MeshConfig(enabled=True, min_nodes=64, max_devices=4)
+    # Defaults: disabled, negotiation floor at 512.
+    cfg, errors = parse_operator_config({})
+    assert not errors and cfg.solver.mesh_config() == MeshConfig()
+    for bad, msg in (
+        ({"solver": {"mesh": {"enable": True}}}, "unknown field"),
+        ({"solver": {"mesh": {"enabled": 1}}}, "must be a boolean"),
+        ({"solver": {"mesh": {"minNodes": -1}}}, "int >= 0"),
+        ({"solver": {"mesh": {"maxDevices": True}}}, "int >= 0"),
+    ):
+        _, errors = parse_operator_config(bad)
+        assert errors and any(msg in e for e in errors), (bad, errors)
+
+
+def test_layout_from_fingerprint():
+    fp = _layout().fingerprint()
+    assert fp == {"portfolio": 1, "node": 8}
+    rebuilt = layout_from_fingerprint(fp, 1024)
+    assert rebuilt is not None and rebuilt.key() == _layout().key()
+    # Unhostable fingerprints degrade to None (replay solves unsharded —
+    # bitwise-equal by the equivalence contract, test below).
+    assert layout_from_fingerprint({"portfolio": 1, "node": 16}, 1024) is None
+    assert layout_from_fingerprint({"portfolio": 1, "node": 8}, 1023) is None
+    assert layout_from_fingerprint(None, 1024) is None
+    assert layout_from_fingerprint({"portfolio": 1, "node": 1}, 1024) is None
+
+
+# --- bitwise equivalence ------------------------------------------------------
+
+
+def _assert_bitwise(a, b):
+    for name in ("ok", "assigned", "placement_score", "free_after"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)),
+            np.asarray(getattr(b, name)),
+            err_msg=f"sharded vs unsharded diverged on {name}",
+        )
+
+
+def test_sharded_solve_bitwise_matches_unsharded_mixed():
+    """The load-bearing contract: replay on any device count and admitted-
+    set parity both reduce to this."""
+    gangs, pods = _expand(mixed_backlog())
+    snap = build_snapshot(quality_cluster(), TOPO)
+    batch, _ = encode_gangs(gangs, pods, snap)
+    wp = WarmPath()
+    layout = solve_layout_for(int(snap.free.shape[0]))
+    base = solve(snap, batch, SolverParams(), warm=wp)
+    sharded = solve(snap, batch, SolverParams(), warm=wp, mesh=layout)
+    _assert_bitwise(base, sharded)
+    assert sharded.free_after.sharding.spec == layout.free_sharding().spec
+
+
+def test_sharded_solve_bitwise_matches_unsharded_contended():
+    nodes, squatters = contended_cluster()
+    gangs, pods = _expand(contended_backlog(n_gangs=24))
+    snap = build_snapshot(nodes, TOPO, bound_pods=squatters)
+    batch, _ = encode_gangs(gangs, pods, snap)
+    wp = WarmPath()
+    layout = solve_layout_for(int(snap.free.shape[0]))
+    base = solve(snap, batch, SolverParams(), warm=wp)
+    sharded = solve(snap, batch, SolverParams(), warm=wp, mesh=layout)
+    _assert_bitwise(base, sharded)
+
+
+# --- cache keying -------------------------------------------------------------
+
+
+def test_sharded_executables_key_on_mesh_and_warm_once():
+    """A sharded and an unsharded solve of one shape bucket are DISTINCT
+    executables; the second sharded solve pays zero lowerings."""
+    gangs, pods = _expand(mixed_backlog())
+    snap = build_snapshot(quality_cluster(), TOPO)
+    batch, _ = encode_gangs(gangs, pods, snap)
+    wp = WarmPath()
+    layout = solve_layout_for(int(snap.free.shape[0]))
+    solve(snap, batch, SolverParams(), warm=wp)
+    after_dense = wp.executables.lowerings
+    solve(snap, batch, SolverParams(), warm=wp, mesh=layout)
+    assert wp.executables.lowerings == after_dense + 1  # new (mesh-keyed) entry
+    solve(snap, batch, SolverParams(), warm=wp, mesh=layout)
+    assert wp.executables.lowerings == after_dense + 1  # zero new lowerings
+    # A different node-axis width is another executable again.
+    solve(snap, batch, SolverParams(), warm=wp,
+          mesh=solve_layout_for(int(snap.free.shape[0]), jax.devices()[:4]))
+    assert wp.executables.lowerings == after_dense + 2
+
+
+def test_sharded_prewarm_from_history(tmp_path):
+    """Shape history records the mesh shape; a fresh process-analog cache
+    prewarms the SHARDED executable and the live sharded solve then pays
+    zero lowerings."""
+    gangs, pods = _expand(mixed_backlog())
+    snap = build_snapshot(quality_cluster(), TOPO)
+    batch, _ = encode_gangs(gangs, pods, snap)
+    history = str(tmp_path / "shapes.json")
+    wp = WarmPath()
+    wp.executables.history_path = history
+    layout = solve_layout_for(int(snap.free.shape[0]))
+    solve(snap, batch, SolverParams(), warm=wp, mesh=layout)
+
+    wp2 = WarmPath()
+    wp2.executables.history_path = history
+    compiled = wp2.executables.prewarm_from_history(top_k=4)
+    assert compiled >= 1
+    before = wp2.executables.lowerings
+    solve(snap, batch, SolverParams(), warm=wp2, mesh=layout)
+    assert wp2.executables.lowerings == before
+
+
+# --- drains -------------------------------------------------------------------
+
+
+def _drain_problem():
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=2, racks_per_block=4)
+    gangs, pods = _expand(synthetic_backlog(n_disagg=14, n_agg=10, n_frontend=10))
+    return gangs, pods, build_snapshot(nodes, TOPO)
+
+
+def test_sharded_drain_identical_bindings_all_harvests():
+    gangs, pods, snap = _drain_problem()
+    wp = WarmPath()
+    base, s0 = drain_backlog(gangs, pods, snap, wave_size=16, warm_path=wp)
+    assert s0.shard_devices == 0
+    for harvest in ("chained", "wave", "pipeline"):
+        b, s = drain_backlog(
+            gangs, pods, snap, wave_size=16, warm_path=wp, harvest=harvest,
+            mesh=MeshConfig(enabled=True, min_nodes=64),
+        )
+        assert b == base, f"sharded {harvest} drain changed bindings"
+        assert s.shard_devices == 8
+        assert s.shard_fallbacks == 0
+
+
+def test_sharded_drain_second_run_zero_lowerings():
+    gangs, pods, snap = _drain_problem()
+    wp = WarmPath()
+    cfg = MeshConfig(enabled=True, min_nodes=64)
+    drain_backlog(gangs, pods, snap, wave_size=16, warm_path=wp, mesh=cfg)
+    _, s2 = drain_backlog(gangs, pods, snap, wave_size=16, warm_path=wp, mesh=cfg)
+    assert s2.lowerings == 0
+    assert s2.exec_cache_misses == 0
+
+
+def test_sharded_pruned_drain_parity_and_pad_divisibility():
+    """Pruned waves on the sharded path: candidate pads negotiate mesh-
+    divisible, bindings match the unsharded pruned drain, carry chains
+    stay green through escalation-capable retirement."""
+    gangs, pods, snap = _drain_problem()
+    pruning = PruningConfig(enabled=True, max_candidates=120, min_fleet=16, min_pad=8)
+    wp = WarmPath()
+    base, s0 = drain_backlog(
+        gangs, pods, snap, wave_size=16, warm_path=wp, pruning=pruning,
+        harvest="pipeline",
+    )
+    b, s = drain_backlog(
+        gangs, pods, snap, wave_size=16, warm_path=wp, pruning=pruning,
+        harvest="pipeline", mesh=MeshConfig(enabled=True, min_nodes=64),
+    )
+    assert s0.pruned_waves > 0 and s.pruned_waves > 0
+    assert b == base
+    assert s.candidate_pad % 8 == 0
+    assert s.shard_devices == 8
+
+
+def test_sharded_drain_fallback_counted_not_silent():
+    gangs, pods, snap = _drain_problem()
+    wp = WarmPath()
+    before = shard_fallbacks()
+    # minNodes above the fleet: the mesh is requested but cannot engage.
+    _, s = drain_backlog(
+        gangs, pods, snap, wave_size=16, warm_path=wp,
+        mesh=MeshConfig(enabled=True, min_nodes=1 << 20),
+    )
+    assert s.shard_devices == 0
+    assert s.shard_fallbacks == 1
+    assert shard_fallbacks() == before + 1
+    assert wp.stats()["shardFallbacks"] == shard_fallbacks()
+
+
+# --- streaming ----------------------------------------------------------------
+
+
+def test_sharded_stream_parity_with_serial():
+    from grove_tpu.sim.workloads import arrival_process, expand_arrivals
+    from grove_tpu.solver.stream import StreamConfig, drain_stream
+
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=2, racks_per_block=4)
+    snap = build_snapshot(nodes, TOPO)
+    events = arrival_process(7, duration_s=6.0, base_rate=6.0)
+    arrivals, pods = expand_arrivals(events, TOPO)
+    cfg = StreamConfig(depth=2, wave_size=16)
+    wp = WarmPath()
+    b_serial, _ = drain_stream(
+        arrivals, pods, snap, config=cfg, warm_path=wp, pipeline=False
+    )
+    b_mesh, s_mesh = drain_stream(
+        arrivals, pods, snap, config=cfg, warm_path=wp, pipeline=True,
+        mesh=MeshConfig(enabled=True, min_nodes=64),
+    )
+    assert b_mesh == b_serial
+    assert s_mesh.drain.shard_devices == 8
+    assert s_mesh.to_doc()["shardDevices"] == 8
+
+
+@pytest.mark.slow
+def test_shard_soak_bench_scale_parity():
+    """Long-soak tier (bench-shard-soak analog, excluded from tier-1): the
+    bench-scale fleet drains sharded with bindings identical to unsharded,
+    and the sharded repeat run keeps the executable cache stable."""
+    nodes = synthetic_cluster(racks_per_block=16)  # the 5120-host bench fleet
+    gangs, pods = _expand(
+        synthetic_backlog(n_disagg=88, n_agg=62, n_frontend=75)
+    )
+    snap = build_snapshot(nodes, TOPO)
+    wp = WarmPath()
+    cfg = MeshConfig(enabled=True, min_nodes=64)
+    base, _ = drain_backlog(gangs, pods, snap, wave_size=64, warm_path=wp)
+    b, s = drain_backlog(
+        gangs, pods, snap, wave_size=64, warm_path=wp, mesh=cfg
+    )
+    assert b == base and s.shard_devices == 8
+    _, s2 = drain_backlog(
+        gangs, pods, snap, wave_size=64, warm_path=wp, mesh=cfg
+    )
+    assert s2.lowerings == 0, "sharded steady state re-lowered"
+
+
+# --- flight-recorder replay ---------------------------------------------------
+
+
+def test_sharded_recorded_journal_replays_bitwise(tmp_path, monkeypatch):
+    """A journal recorded from the SHARDED (and pruned) drain replays with
+    zero divergences twice over: once rebuilding the recorded 8-device mesh
+    from the wave records' fingerprint, and once with the mesh forced
+    unavailable — the 1-device-replay-host contract from the bitwise
+    equivalence above."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    gangs, pods, snap = _drain_problem()
+    pruning = PruningConfig(enabled=True, max_candidates=120, min_fleet=16, min_pad=8)
+    wp = WarmPath()
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    try:
+        _, s = drain_backlog(
+            gangs, pods, snap, wave_size=16, warm_path=wp, pruning=pruning,
+            harvest="pipeline", recorder=rec,
+            mesh=MeshConfig(enabled=True, min_nodes=64),
+        )
+    finally:
+        rec.stop()
+    assert s.journaled_waves > 0 and s.pruned_waves > 0
+    records = read_journal(str(tmp_path / "journal"))
+    fps = [
+        r["solver"].get("mesh") for r in records if r.get("kind") == "wave"
+    ]
+    assert fps and all(fp == {"portfolio": 1, "node": 8} for fp in fps)
+
+    assert replay_journal(records).divergence_count == 0
+
+    # Replay-host-without-the-mesh: every fingerprint resolves to None, the
+    # waves re-solve unsharded (recorded candidate pads preserved), still
+    # bitwise.
+    monkeypatch.setattr(
+        mesh_mod, "layout_from_fingerprint", lambda fp, n: None
+    )
+    assert replay_journal(records).divergence_count == 0
+
+
+def test_sharded_dense_journal_replays_bitwise(tmp_path):
+    """Same contract without pruning: dense sharded waves journal their
+    fingerprint and replay clean."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    gangs, pods, snap = _drain_problem()
+    wp = WarmPath()
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    try:
+        _, s = drain_backlog(
+            gangs, pods, snap, wave_size=16, warm_path=wp, harvest="pipeline",
+            recorder=rec, mesh=MeshConfig(enabled=True, min_nodes=64),
+        )
+    finally:
+        rec.stop()
+    assert s.journaled_waves > 0
+    records = read_journal(str(tmp_path / "journal"))
+    assert replay_journal(records).divergence_count == 0
