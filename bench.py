@@ -770,8 +770,45 @@ def run_scale_bench() -> dict:
                 "escalations_adopted": s_pruned.escalations_adopted,
                 "pruned_lowerings": wp_pruned.executables.lowerings - lower0,
                 "prune_s": round(s_pruned.prune_s, 3),
+                # Host-stage ledger of the pruned drain at this scale: the
+                # per-wave host tax (encode/prefilter/decode/bind) that must
+                # stay flat as the fleet axis grows.
+                "host_stages": s_pruned.host_stages(),
             }
         )
+        last_snapshot = snapshot
+    # Host hot-path A/B at the top scale: one pruned drain per side through
+    # the SAME warm path — executables AND encode-row caches warm, i.e. the
+    # steady-state wave loop every recurring tick/drain pays (the cold
+    # first-pass encode is covered by the parity tests and the stream
+    # scenario's fresh-arrival windows). harvest="wave" so host stages are
+    # timed while the device is idle — the chained drain overlaps every
+    # solve with every encode on this one core, which pollutes both sides'
+    # host clocks with stolen XLA time. GROVE_HOST_REFERENCE=1 routes the
+    # reference side through the retained loop implementations (loop decode,
+    # loop pre-filter, per-gang row copies, un-memoized digests); admitted
+    # sets are gated identical across all three runs.
+    b_vec, s_vec = drain_backlog(
+        gangs, pods, last_snapshot, wave_size=wave_size,
+        params=SolverParams(), warm_path=wp_pruned, pruning=pruning,
+        harvest="wave",
+    )
+    ref_prev = os.environ.get("GROVE_HOST_REFERENCE")
+    os.environ["GROVE_HOST_REFERENCE"] = "1"
+    try:
+        b_ref, s_ref = drain_backlog(
+            gangs, pods, last_snapshot, wave_size=wave_size,
+            params=SolverParams(), warm_path=wp_pruned, pruning=pruning,
+            harvest="wave",
+        )
+    finally:
+        if ref_prev is None:
+            os.environ.pop("GROVE_HOST_REFERENCE", None)
+        else:
+            os.environ["GROVE_HOST_REFERENCE"] = ref_prev
+    ref_parity = set(b_ref) == set(b_pruned) == set(b_vec)
+    vec_hot = s_vec.host_stages()["hostHotPathS"]
+    ref_hot = s_ref.host_stages()["hostHotPathS"]
     top = points[-1]
     # Cache-key independence: after the FIRST pruned scale, later scales
     # must re-use the candidate-bucket executables byte-for-byte.
@@ -798,6 +835,17 @@ def run_scale_bench() -> dict:
         "max_candidates": pruning.max_candidates,
         "admitted_parity": parity,
         "exec_reuse_across_scales": reuse_ok,
+        # Vectorized-vs-reference host hot path at the top scale (encode+
+        # prefilter+decode+bind; the >= 2x acceptance measurement). Both
+        # sides ran cold encode-row caches over warm executables.
+        "host_stages_vectorized": s_vec.host_stages(),
+        "host_stages_reference": s_ref.host_stages(),
+        "host_hot_path_vec_s": vec_hot,
+        "host_hot_path_ref_s": ref_hot,
+        "host_hot_path_speedup": round(ref_hot / vec_hot, 2)
+        if vec_hot > 0
+        else None,
+        "host_reference_parity": ref_parity,
         "points": points,
     }
 
@@ -995,6 +1043,24 @@ def run_stream_bench() -> dict:
     _, s_paced = _run(True, pace=True)
     paced_pct = s_paced.bind_percentiles((50.0, 99.0)) or {}
 
+    # Host hot-path A/B: the SAME serial run once more through the retained
+    # loop implementations (GROVE_HOST_REFERENCE=1 — decode, pre-filter,
+    # encode fill), warm caches and executables shared, admitted set gated
+    # identical. The hot-path ratio (encode+prefilter+decode+bind) is the
+    # recorded evidence for the vectorization speedup on THIS machine.
+    ref_prev = os.environ.get("GROVE_HOST_REFERENCE")
+    os.environ["GROVE_HOST_REFERENCE"] = "1"
+    try:
+        b_ref, s_ref = _run(False)
+    finally:
+        if ref_prev is None:
+            os.environ.pop("GROVE_HOST_REFERENCE", None)
+        else:
+            os.environ["GROVE_HOST_REFERENCE"] = ref_prev
+    ref_parity = set(b_ref) == set(b_serial)
+    vec_hot = s_serial.drain.host_stages()["hostHotPathS"]
+    ref_hot = s_ref.drain.host_stages()["hostHotPathS"]
+
     target_speedup = 1.3
     out = {
         "scenario": "stream",
@@ -1032,6 +1098,20 @@ def run_stream_bench() -> dict:
         "pipeline_dispatch_s": round(s_pipe.drain.dispatch_s, 3),
         "pipeline_harvest_s": round(s_pipe.drain.harvest_s, 3),
         "pipeline_decode_s": round(s_pipe.drain.decode_s, 3),
+        # Host-stage timing ledger (DrainStats.host_stages) per run, and the
+        # vectorized-vs-reference hot-path A/B — the host-time budget the
+        # acceptance criterion gates on (>= 2x on encode+prefilter+decode+
+        # bind, admitted sets identical).
+        "host_stages_serial": s_serial.drain.host_stages(),
+        "host_stages_pipeline": s_pipe.drain.host_stages(),
+        "host_stages_paced": s_paced.drain.host_stages(),
+        "host_stages_reference_serial": s_ref.drain.host_stages(),
+        "host_hot_path_vec_s": vec_hot,
+        "host_hot_path_ref_s": ref_hot,
+        "host_hot_path_speedup": round(ref_hot / vec_hot, 2)
+        if vec_hot > 0
+        else None,
+        "host_reference_parity": ref_parity,
         # Host time spent BLOCKED on verdict fetches — the quantity the
         # pipeline exists to hide. On a single-core host this is the
         # pipeline's observable effect (see the docstring caveat).

@@ -201,6 +201,13 @@ def _get_table(client: GroveClient, kind: str) -> str:
             ["mesh." + k, v]
             for k, v in sorted(solver_doc.get("mesh", {}).items())
         ]
+        # Host-stage timing: the serving path's per-pass encode/solve/decode
+        # split, then the drain/stream ledgers (host* rows inside lastDrain/
+        # lastStream carry the per-stage host seconds).
+        rows += [
+            ["hostStages." + k, v]
+            for k, v in sorted(solver_doc.get("hostStages", {}).items())
+        ]
         rows += [
             ["lastDrain." + k, v]
             for k, v in sorted(solver_doc.get("lastDrain", {}).items())

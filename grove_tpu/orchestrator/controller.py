@@ -144,6 +144,11 @@ class GroveController:
     # (GREP-244 metrics direction) — the manager drains this into the
     # grove_placement_score histogram each reconcile.
     last_admission_scores: list = field(default_factory=list)
+    # Host-stage split of the last solve pass (wall seconds): encode (host
+    # dense encode incl. row-cache traffic), solve (device dispatch+wait),
+    # decode (batch binding decode) — the serving-path slice of the drain's
+    # host-stage ledger (/statusz solver.hostStages, `get solver` rows).
+    last_host_stages: dict = field(default_factory=dict)
     # Placement-quality view of serving solves (quality/report.py
     # discipline): the last NON-EMPTY wave's aggregate — admitted ratio over
     # the solver-valid gangs it saw, mean PlacementScore of the admitted —
@@ -795,6 +800,7 @@ class GroveController:
         t_solve0 = time.perf_counter()
         epoch = snapshot.encode_epoch()
         row_keys = [(d, epoch) for d in sub_digests]
+        t_encode0 = time.perf_counter()
         batch, decode = encode_gangs(
             sub_gangs,
             pods_by_name,
@@ -810,6 +816,7 @@ class GroveController:
             row_cache=self.warm.encode_rows,
             row_keys=row_keys,
         )
+        encode_s = time.perf_counter() - t_encode0
         esc = self.portfolio_escalation
         esc_fp = None
         if esc > self.portfolio:
@@ -841,8 +848,21 @@ class GroveController:
             # split across the device mesh, bitwise-equal to unsharded.
             mesh=mesh_layout,
         )
+        t_decode0 = time.perf_counter()
         bindings = decode_assignments(result, decode, snapshot)
+        decode_s = time.perf_counter() - t_decode0
         solve_seconds = time.perf_counter() - t_solve0
+        # Serving-path host-stage split (the drain's ledger, per-tick view):
+        # solveS is the device dispatch+wait remainder between the two host
+        # stages. Rendered by /statusz solver.hostStages and `get solver`.
+        self.last_host_stages = {
+            "encodeS": round(encode_s, 6),
+            "solveS": round(
+                max(solve_seconds - encode_s - decode_s, 0.0), 6
+            ),
+            "decodeS": round(decode_s, 6),
+            "gangs": len(sub_gangs),
+        }
 
         admitted = 0
         import numpy as np

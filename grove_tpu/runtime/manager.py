@@ -748,6 +748,16 @@ class Manager:
             "grove_stream_time_to_bind_seconds",
             "Per-gang enqueue->bound seconds under streaming admission",
         )
+        # Host-stage timing ledger (solver/drain.DrainStats.host_stages):
+        # per-stage host seconds of the last drain/stream — the measurable
+        # side of the host hot-path vectorization (encode/prefilter/decode/
+        # bind must stay flat as the fleet grows).
+        self._m_host_stage = self.metrics.gauge(
+            "grove_host_stage_seconds",
+            "Host seconds by stage of the last drain/stream "
+            "(encode|prefilter|dispatch|harvest|decode|bind|journal|"
+            "total|hotPath)",
+        )
         # Every (queue, resource) series ever emitted — re-zeroed each pass
         # when usage disappears (gauge values persist otherwise).
         self._queue_metric_keys: dict[str, set] = {}
@@ -1104,6 +1114,11 @@ class Manager:
             doc["lastStream"] = dict(self.controller.warm.last_stream)
         if self.controller.warm.last_drain:
             doc["lastDrain"] = dict(self.controller.warm.last_drain)
+        # Serving-path host-stage split of the last solve pass (encode /
+        # solve / decode wall seconds) — the per-tick slice of the drain's
+        # host-stage ledger.
+        if self.controller.last_host_stages:
+            doc["hostStages"] = dict(self.controller.last_host_stages)
         return doc
 
     def trace_status(self) -> dict:
@@ -1708,6 +1723,26 @@ class Manager:
             self._m_stream_gps.set(
                 float(warm.last_stream.get("gangsPerSec", 0.0))
             )
+        # Host-stage ledger gauges, cut from the last recorded run (streams
+        # take precedence when both surfaces are populated — the always-on
+        # serving shape; drain_backlog fills last_drain in batch recovery).
+        stage_src = warm.last_stream or warm.last_drain
+        if stage_src:
+            for stage, key in (
+                ("encode", "hostEncodeS"),
+                ("prefilter", "hostPrefilterS"),
+                ("dispatch", "hostDispatchS"),
+                ("harvest", "hostHarvestS"),
+                ("decode", "hostDecodeS"),
+                ("bind", "hostBindS"),
+                ("journal", "hostJournalS"),
+                ("total", "hostTotalS"),
+                ("hotPath", "hostHotPathS"),
+            ):
+                if key in stage_src:
+                    self._m_host_stage.set(
+                        float(stage_src[key]), stage=stage
+                    )
         samples = warm.stream_bind_samples
         if samples:
             # Drain-once: the deque is the warm path's hand-off buffer; each

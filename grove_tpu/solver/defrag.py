@@ -244,6 +244,10 @@ class MigrationPlan:
     efficiency: float  # capacity_recovered / pods_migrated
     solve_s: float  # wall seconds spent in candidate solves
     lowerings: int  # XLA lowerings paid planning (0 on warm shapes)
+    # Host-stage split of the planning loop (the drain ledger's defrag
+    # slice): dense encode vs batch binding decode, across all candidates.
+    encode_s: float = 0.0
+    decode_s: float = 0.0
 
     def to_doc(self) -> dict:
         return {
@@ -258,6 +262,8 @@ class MigrationPlan:
             "scoreAfter": round(self.score_after, 4),
             "efficiency": round(self.efficiency, 4),
             "planSolveSeconds": round(self.solve_s, 4),
+            "planEncodeSeconds": round(self.encode_s, 6),
+            "planDecodeSeconds": round(self.decode_s, 6),
             "lowerings": self.lowerings,
         }
 
@@ -350,6 +356,8 @@ def plan_migrations(
     sizes = candidate_sizes or candidate_ladder(len(movable), max_moves)
     best_plan: Optional[MigrationPlan] = None
     solve_s = 0.0
+    encode_s = 0.0
+    decode_s = 0.0
     lowerings0 = warm.executables.lowerings if warm is not None else 0
     evaluated = 0
     for k in sizes:
@@ -377,6 +385,7 @@ def plan_migrations(
 
             row_cache = warm.encode_rows
             row_keys = [(gang_row_digest(s, pods_by_name), epoch) for s in subs]
+        t_enc = time.perf_counter()
         batch, decode = encode_gangs(
             subs,
             pods_by_name,
@@ -385,9 +394,12 @@ def plan_migrations(
             row_cache=row_cache,
             row_keys=row_keys,
         )
+        encode_s += time.perf_counter() - t_enc
         t0 = time.perf_counter()
         result = solve(snap_k, batch, params, warm=warm, pruning=pruning)
+        t_dec = time.perf_counter()
         new_bindings = decode_assignments(result, decode, snap_k)
+        decode_s += time.perf_counter() - t_dec
         solve_s += time.perf_counter() - t0
         evaluated += 1
 
@@ -451,6 +463,8 @@ def plan_migrations(
     if best_plan is not None:
         best_plan.candidates_evaluated = evaluated
         best_plan.solve_s = solve_s
+        best_plan.encode_s = encode_s
+        best_plan.decode_s = decode_s
         best_plan.lowerings = (
             warm.executables.lowerings - lowerings0 if warm is not None else 0
         )

@@ -72,6 +72,11 @@ class DrainStats:
     dispatch_s: float = 0.0  # async enqueue of all solves
     harvest_s: float = 0.0  # host time blocked fetching verdicts
     decode_s: float = 0.0  # host decode of all bindings
+    # Host-stage ledger companions (see host_stages): committing decoded
+    # bindings (scores, binding table, stamps, commit callbacks) and the
+    # flight-recorder capture — both pure host work on the wave loop.
+    bind_s: float = 0.0
+    journal_s: float = 0.0
     total_s: float = 0.0  # timed section: encode+dispatch+harvest+decode
     waves: int = 0
     gangs: int = 0
@@ -119,6 +124,43 @@ class DrainStats:
     # Wave/pipeline modes only: (gangs admitted in wave, seconds since drain
     # start at which the wave's verdicts were host-visible), in commit order.
     wave_latencies: list = field(default_factory=list)
+
+    def host_stages(self) -> dict:
+        """The host-stage timing ledger: per-drain host seconds by stage,
+        the number that must stay flat as G and MP grow (the per-gang
+        Python tax the vectorized decode/pre-filter/encode paths remove).
+
+        - hostTotalS sums every stage the HOST computes (encode, prefilter
+          = candidate-plan cutting, dispatch enqueue, decode, bind,
+          journal); harvest is device wait and is reported but excluded.
+        - hostHotPathS is the vectorization target the acceptance criterion
+          gates on: encode + prefilter + decode + bind.
+        - hostPerWaveMs normalizes hostTotalS by waves — the per-decision
+          control-plane overhead that must not grow with the fleet.
+        """
+        host_total = (
+            self.encode_s
+            + self.prune_s
+            + self.dispatch_s
+            + self.decode_s
+            + self.bind_s
+            + self.journal_s
+        )
+        hot = self.encode_s + self.prune_s + self.decode_s + self.bind_s
+        doc = {
+            "hostEncodeS": round(self.encode_s, 6),
+            "hostPrefilterS": round(self.prune_s, 6),
+            "hostDispatchS": round(self.dispatch_s, 6),
+            "hostHarvestS": round(self.harvest_s, 6),
+            "hostDecodeS": round(self.decode_s, 6),
+            "hostBindS": round(self.bind_s, 6),
+            "hostJournalS": round(self.journal_s, 6),
+            "hostTotalS": round(host_total, 6),
+            "hostHotPathS": round(hot, 6),
+        }
+        if self.waves:
+            doc["hostPerWaveMs"] = round(1000.0 * host_total / self.waves, 4)
+        return doc
 
     def latency_percentiles(self, qs=(50.0, 99.0)) -> dict | None:
         """Measured per-gang bind-latency percentiles from `wave_latencies`
@@ -323,11 +365,13 @@ class _WavePipeline:
             row_keys=row_keys,
         )
 
-    def cut_plan(self, batch):
+    def cut_plan(self, batch, count: bool = True):
         """Candidate plan for one wave's batch (None = solve dense).
         Plans are cut against the INITIAL snapshot free — free only shrinks
         while draining, so the initial candidates are a superset of every
-        later wave's eligible set (solver/pruning.py)."""
+        later wave's eligible set (solver/pruning.py). `count=False` (the
+        warm pre-pass) keeps the cut out of `prune_s` — the host-stage
+        ledger must reflect the TIMED drain section, not compile warm-up."""
         if self.pruning is None or not self.use_exec_cache:
             return None
         from grove_tpu.solver.pruning import plan_candidates
@@ -337,7 +381,8 @@ class _WavePipeline:
             self.snapshot, batch, self.pruning,
             mesh_axis=self.layout.node_devices if self.layout else 1,
         )
-        self.stats.prune_s += time.perf_counter() - t0p
+        if count:
+            self.stats.prune_s += time.perf_counter() - t0p
         return plan
 
     def pruned_inputs(self, plan, batch):
@@ -379,7 +424,7 @@ class _WavePipeline:
         # hits: the timed encodes populate the cache).
         warm_batch, _ = self.encode_wave(ws, reuse_rows=False)
         zeros_okg = jnp.zeros_like(self.ok_g)
-        warm_plan = self.cut_plan(warm_batch)
+        warm_plan = self.cut_plan(warm_batch, count=False)
         if warm_plan is not None:
             wb, cap_p, sched_p, ndid_p = self.pruned_inputs(warm_plan, warm_batch)
             self.wp.executables.ensure_compiled(
@@ -571,17 +616,23 @@ class _WavePipeline:
             rec["ok_np"], asg, rec["decode"], self.snapshot
         )
         stats.decode_s += time.perf_counter() - td
+        tb = time.perf_counter()
         stats.scores.extend(rec["score_np"][rec["ok_np"]].tolist())
         for gang_name, pod_bindings in wave_bindings.items():
             self.bindings[gang_name] = pod_bindings
             stats.admitted += 1
             stats.pods_bound += len(pod_bindings)
+        stats.bind_s += time.perf_counter() - tb
         if self.recorder is not None:
+            tj = time.perf_counter()
             self._journal(rec, wave_bindings)
+            stats.journal_s += time.perf_counter() - tj
+        tb = time.perf_counter()
         self.scheduled_admitted.update(wave_bindings)
         self.commit_seq += 1
         if self.on_commit is not None:
             self.on_commit(rec["members"], wave_bindings, stamp)
+        stats.bind_s += time.perf_counter() - tb
 
     def flush(self) -> None:
         """Retire everything still in flight. Chained mode harvests with ONE

@@ -21,7 +21,8 @@ and placement is count allocation, not per-pod assignment.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import os
+from dataclasses import dataclass, field
 from typing import NamedTuple
 
 import numpy as np
@@ -29,6 +30,16 @@ import numpy as np
 from grove_tpu.api.pod import Pod
 from grove_tpu.api.podgang import PodGang
 from grove_tpu.state.cluster import ClusterSnapshot, pod_request_vector
+
+
+def host_vectorized() -> bool:
+    """Selects the vectorized host hot path (decode / pre-filter / encode
+    fill). GROVE_HOST_REFERENCE=1 routes through the retained loop
+    implementations instead — the bench A/B switch that turns the host-stage
+    speedup into a recorded number (and the oracle the parity tests pin the
+    vectorized paths against, tests/test_hostpath.py). Read per call: the
+    bench flips it mid-process."""
+    return os.environ.get("GROVE_HOST_REFERENCE", "0") != "1"
 
 
 class GangBatch(NamedTuple):
@@ -95,6 +106,30 @@ class GangDecodeInfo:
     # per gang, per pod slot: pod name ("" for padding)
     pod_names: list[list[str]]
     group_names: list[list[str]]
+    # Lazily-built batch-decode index arrays (see slot_arrays); cached so a
+    # decode_info consulted more than once (escalated re-decode, replay
+    # diffing) pays the build exactly once.
+    _slots: tuple | None = field(default=None, repr=False, compare=False)
+
+    def slot_arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(slot_gang i32 [S], slot_col i32 [S], slot_pod object [S]) over the
+        NON-EMPTY pod-name slots, row-major (sorted by gang). One C-level
+        pass over the [G, MP] name table replaces the per-slot Python walk
+        the loop decode paid per wave; decode_bindings gathers admitted
+        (gang, slot) pairs against these."""
+        if self._slots is None:
+            if self.pod_names:
+                pod_arr = np.asarray(self.pod_names, dtype=object)  # [G, MP]
+                gi, sj = np.nonzero(pod_arr != "")
+                self._slots = (
+                    gi.astype(np.int32),
+                    sj.astype(np.int32),
+                    pod_arr[gi, sj],
+                )
+            else:
+                empty = np.zeros((0,), dtype=np.int32)
+                self._slots = (empty, empty, np.zeros((0,), dtype=object))
+        return self._slots
 
 
 def _level_index(snapshot: ClusterSnapshot, label_key: str | None) -> int:
@@ -115,6 +150,18 @@ def next_pow2(v: int) -> int:
 
 
 _BLOCKING_EFFECTS = ("NoSchedule", "NoExecute")
+
+# Shared rank table for the vectorized pod-slot fill: slicing a prebuilt
+# arange is ~10x cheaper than allocating one per group, and group sizes are
+# bounded by the pod bucket. Grown on demand for outsized gangs.
+_RANKS = np.arange(4096, dtype=np.int32)
+
+
+def _ranks(n: int) -> np.ndarray:
+    global _RANKS
+    if n > _RANKS.shape[0]:
+        _RANKS = np.arange(max(n, 2 * _RANKS.shape[0]), dtype=np.int32)
+    return _RANKS[:n]
 
 
 def _tolerates(tolerations: list[dict], taint: dict) -> bool:
@@ -347,16 +394,41 @@ def encode_gangs(
         if len(row_keys) != len(gangs):
             raise ValueError("row_keys length must match gangs")
         for gi, gang in enumerate(gangs):
-            bound_sig = tuple(
-                sorted(
-                    (grp, tuple(idxs))
-                    for grp, idxs in bound_map.get(gang.name, {}).items()
+            bound = bound_map.get(gang.name)
+            # () == tuple(sorted(...)) of an empty map — the common unbound
+            # case skips the generator machinery, key value unchanged.
+            bound_sig = (
+                tuple(
+                    sorted((grp, tuple(idxs)) for grp, idxs in bound.items())
                 )
+                if bound
+                else ()
             )
             row_full_keys[gi] = (row_keys[gi], r, bound_sig)
             row_entries[gi] = row_cache.peek(row_full_keys[gi])
+    # _sets_of memo per (spec digest, snapshot epoch) — exactly the caller's
+    # row key, which already folds in everything _sets_of reads (constraint
+    # tree from the spec, level resolution from the snapshot). A gang whose
+    # full-row entry was demoted (bucket drift) or never stored still skips
+    # the constraint walk when its spec+snapshot recur.
+    vectorized = host_vectorized()
+    sets_memo_peek = sets_memo_put = None
+    if vectorized and row_cache is not None and row_keys is not None:
+        sets_memo_peek = getattr(row_cache, "peek_sets", None)
+        sets_memo_put = getattr(row_cache, "put_sets", None)
+
+    def _sets_resolve(gi: int, gang: PodGang):
+        if sets_memo_peek is not None:
+            hit = sets_memo_peek(row_keys[gi])
+            if hit is not None:
+                return hit
+        out = _sets_of(gang)
+        if sets_memo_put is not None:
+            sets_memo_put(row_keys[gi], out)
+        return out
+
     sets_and_ok = [
-        None if row_entries[gi] is not None else _sets_of(g)
+        None if row_entries[gi] is not None else _sets_resolve(gi, g)
         for gi, g in enumerate(gangs)
     ]
     ms = max_sets or max(
@@ -373,7 +445,7 @@ def encode_gangs(
     for gi in range(len(gangs)):
         if row_entries[gi] is not None and row_entries[gi]["dims"] != (mg, ms, mp):
             row_entries[gi] = None
-            sets_and_ok[gi] = _sets_of(gangs[gi])
+            sets_and_ok[gi] = _sets_resolve(gi, gangs[gi])
     all_sets = [None if s is None else s[0] for s in sets_and_ok]
     sets_resolvable = [None if s is None else s[1] for s in sets_and_ok]
 
@@ -416,9 +488,18 @@ def encode_gangs(
     # in the drain's host encode (8x-scale profile).
     tainted_idx = snapshot.tainted_node_indices(_BLOCKING_EFFECTS)
     # Normalize per resource before summing — raw units are incomparable
-    # (cpu cores ~1 vs memory bytes ~1e10 vs TPU chips ~4).
-    cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
+    # (cpu cores ~1 vs memory bytes ~1e10 vs TPU chips ~4). Memoized on the
+    # snapshot (immutable capacity): one O(N) column max per snapshot, not
+    # one per wave.
+    cap_scale = snapshot.cap_scale()
 
+    # Row-cache hits applied BATCHED (vectorized path): one stacked fancy
+    # assignment per field over all hit gangs, instead of |fields| numpy row
+    # copies per gang — the hit path is the steady-state encode, so its
+    # per-gang Python floor is what the wave loop pays forever. Misses store
+    # their rows the same way (miss_puts, extracted after the loop).
+    hit_rows: list[tuple[int, dict]] = []
+    miss_puts: list[tuple] = []
     for gi, gang in enumerate(gangs):
         entry = row_entries[gi]
         if entry is not None:
@@ -427,11 +508,21 @@ def encode_gangs(
             # them in and skip the Python spec walk.
             row_cache.hits += 1
             decode.gang_names.append(gang.name)
-            decode.pod_names.append(list(entry["pod_names"]))
-            decode.group_names.append(list(entry["group_names"]))
+            if vectorized:
+                # The entry's name lists are private to the cache (built
+                # fresh at put) and every consumer reads decode info —
+                # share them instead of copying per hit.
+                decode.pod_names.append(entry["pod_names"])
+                decode.group_names.append(entry["group_names"])
+            else:
+                decode.pod_names.append(list(entry["pod_names"]))
+                decode.group_names.append(list(entry["group_names"]))
             batch.gang_valid[gi] = entry["resolvable"]
-            for fname in _ROW_FIELDS:
-                getattr(batch, fname)[gi] = entry[fname]
+            if vectorized:
+                hit_rows.append((gi, entry))
+            else:
+                for fname in _ROW_FIELDS:
+                    getattr(batch, fname)[gi] = entry[fname]
             if entry["sel_rows"]:
                 if selector_masks is None:
                     selector_masks = np.ones(
@@ -528,11 +619,21 @@ def encode_gangs(
                         row = row & tol_row
                     selector_masks[gi, k] = row
                     miss_sel_rows[k] = row
-            for rank, ref in enumerate(refs):
-                batch.pod_group[gi, slot] = k
-                batch.pod_rank[gi, slot] = rank
-                pod_names.append(ref)
-                slot += 1
+            if vectorized:
+                # Per-pod slot fill as two numpy slice writes: the per-pod
+                # Python loop was the dominant miss-path term for big gangs
+                # (cost grew with MP, the heavy-tailed train-gang axis).
+                nr = len(refs)
+                batch.pod_group[gi, slot : slot + nr] = k
+                batch.pod_rank[gi, slot : slot + nr] = _ranks(nr)
+                pod_names.extend(refs)
+                slot += nr
+            else:
+                for rank, ref in enumerate(refs):
+                    batch.pod_group[gi, slot] = k
+                    batch.pod_rank[gi, slot] = rank
+                    pod_names.append(ref)
+                    slot += 1
         if len(all_sets[gi]) > ms:
             raise ValueError(
                 f"gang {gang.name}: {len(all_sets[gi])} pack-sets > bucket {ms}"
@@ -560,10 +661,19 @@ def encode_gangs(
                             break
                     if batch.set_pinned[gi, si] >= 0:
                         break
-        demand = [
-            float(batch.group_total[gi, k] * (batch.group_req[gi, k] / cap_scale).sum())
-            for k in range(mg)
-        ]
+        if vectorized:
+            # One row-wise reduction: elementwise ops and the per-row sum
+            # order are identical to the per-group loop, so the sort keys
+            # (and therefore group_order) are bitwise-unchanged.
+            demand = (
+                batch.group_total[gi]
+                * (batch.group_req[gi] / cap_scale[None, :]).sum(axis=1)
+            ).tolist()
+        else:
+            demand = [
+                float(batch.group_total[gi, k] * (batch.group_req[gi, k] / cap_scale).sum())
+                for k in range(mg)
+            ]
         batch.group_order[gi] = np.array(
             sorted(range(mg), key=lambda k: (k not in req_constrained, -demand[k])),
             dtype=np.int32,
@@ -572,16 +682,78 @@ def encode_gangs(
         decode.pod_names.append(pod_names)
         decode.group_names.append(group_names)
         if row_cache is not None and row_full_keys[gi] is not None:
-            rows = {
-                fname: getattr(batch, fname)[gi].copy() for fname in _ROW_FIELDS
-            }
+            if vectorized:
+                # Deferred: the rows of every miss gang are extracted with
+                # ONE stacked fancy-index copy per field after the loop,
+                # instead of |fields| numpy row copies per gang here.
+                miss_puts.append(
+                    (gi, len(all_sets[gi]), pod_names, group_names, miss_sel_rows)
+                )
+            else:
+                rows = {
+                    fname: getattr(batch, fname)[gi].copy() for fname in _ROW_FIELDS
+                }
+                rows.update(
+                    dims=(mg, ms, mp),
+                    n_sets=len(all_sets[gi]),
+                    resolvable=bool(sets_resolvable[gi]),
+                    pod_names=list(pod_names),
+                    group_names=list(group_names),
+                    sel_rows=miss_sel_rows,
+                )
+                row_cache.put(row_full_keys[gi], rows)
+
+    if hit_rows:
+        # Entries written by the batched put path carry their shared field
+        # stacks (_stacks/_row): hits grouped by stack identity apply with
+        # ONE fancy-index gather+assign per (group, field) — re-encoding a
+        # recurring wave is 12 slab copies, not 12 copies per gang. Entries
+        # from the loop put path (reference mode) fall back to np.stack.
+        groups: dict[int, tuple] = {}
+        loose: list[tuple[int, dict]] = []
+        for gi, entry in hit_rows:
+            sd = entry.get("_stacks")
+            if sd is None:
+                loose.append((gi, entry))
+                continue
+            rec = groups.setdefault(id(sd), (sd, [], []))
+            rec[1].append(gi)
+            rec[2].append(entry["_row"])
+        for sd, gis, js in groups.values():
+            gi_arr = np.asarray(gis, dtype=np.intp)
+            j_arr = np.asarray(js, dtype=np.intp)
+            for fname in _ROW_FIELDS:
+                getattr(batch, fname)[gi_arr] = sd[fname][j_arr]
+        if loose:
+            idx = np.fromiter(
+                (gi for gi, _ in loose), dtype=np.intp, count=len(loose)
+            )
+            for fname in _ROW_FIELDS:
+                getattr(batch, fname)[idx] = np.stack(
+                    [entry[fname] for _, entry in loose]
+                )
+    if miss_puts:
+        midx = np.fromiter(
+            (m[0] for m in miss_puts), dtype=np.intp, count=len(miss_puts)
+        )
+        # One contiguous copy per field for ALL miss gangs; each stored row
+        # is a view into the stack (the stack is owned by the entries
+        # collectively and never written after this point).
+        stacks = {f: getattr(batch, f)[midx].copy() for f in _ROW_FIELDS}
+        for j, (gi, n_sets, pod_names_j, group_names_j, sel_rows_j) in enumerate(
+            miss_puts
+        ):
+            rows = {f: stacks[f][j] for f in _ROW_FIELDS}
             rows.update(
                 dims=(mg, ms, mp),
-                n_sets=len(all_sets[gi]),
+                n_sets=n_sets,
                 resolvable=bool(sets_resolvable[gi]),
-                pod_names=list(pod_names),
-                group_names=list(group_names),
-                sel_rows=miss_sel_rows,
+                pod_names=list(pod_names_j),
+                group_names=list(group_names_j),
+                sel_rows=sel_rows_j,
+                # Shared-stack handle for the grouped hit application.
+                _stacks=stacks,
+                _row=j,
             )
             row_cache.put(row_full_keys[gi], rows)
 

@@ -56,9 +56,45 @@ from typing import Optional
 
 import numpy as np
 
-from grove_tpu.solver.encode import GangBatch, next_pow2
+from grove_tpu.solver.encode import GangBatch, host_vectorized, next_pow2
 
 _EPS = 1e-6
+
+
+def _level_domain_free(
+    sched_free: np.ndarray, node_domain_id: np.ndarray, lvl: int
+) -> np.ndarray:
+    """f64 [D, R] aggregate schedulable free per domain ordinal at `lvl`.
+
+    np.bincount per resource column instead of the oracle's np.add.at:
+    bincount's C loop walks the data in the same sequential order add.at
+    does, so the f64 accumulation is BITWISE-identical (pinned in
+    tests/test_hostpath.py) at ~10x less cost — ufunc.at is unbuffered and
+    pays per-element dispatch."""
+    dom = node_domain_id[lvl]
+    d = int(dom.max(initial=-1)) + 1
+    r = sched_free.shape[1]
+    acc = np.zeros((d, r), dtype=np.float64)
+    if d > 0:
+        valid = dom >= 0
+        dv = dom[valid]
+        sf = sched_free[valid]
+        for j in range(r):
+            acc[:, j] = np.bincount(dv, weights=sf[:, j], minlength=d)[:d]
+    return acc
+
+
+def _grow_mask(acc: np.ndarray, shape: tuple) -> np.ndarray:
+    """Zero-padded growth for the pre-filter's per-level accumulator.
+
+    np.resize TILES the old values when growing — a recycled True would mark
+    an arbitrary domain feasible and silently widen the candidate set (a
+    correctness-preserving but policy-wrong keep). The padded tail must be
+    False: a domain nobody proved feasible is not feasible.
+    tests/test_hostpath.py pins the regression."""
+    grown = np.zeros(shape, dtype=bool)
+    grown[: acc.shape[0]] = acc
+    return grown
 
 
 @dataclass(frozen=True)
@@ -298,7 +334,103 @@ def _domain_useful(
     pack-set disable the filter (their pods may land on any eligible node).
     Conservative by construction — aggregate feasibility over-approximates
     the solver's joint checks, so this can only keep too many nodes, never
-    too few."""
+    too few.
+
+    Vectorized over [G, MS]/[G, D, R]: broadest-required-set selection is a
+    masked argmin, member floor demand one broadcast reduction, and each
+    level's domain feasibility a single [G_l, D, R] comparison — no per-gang
+    Python in the wave loop. Bitwise-equal to the retained loop oracle
+    (_domain_useful_reference; GROVE_HOST_REFERENCE=1 routes through it,
+    tests/test_hostpath.py pins equality), so the conservative contract is
+    unchanged by construction."""
+    if not host_vectorized():
+        return _domain_useful_reference(free, schedulable, node_domain_id, batch)
+    g, ms = np.asarray(batch.set_valid).shape
+    n = free.shape[0]
+    gang_valid = np.asarray(batch.gang_valid)
+    set_valid = np.asarray(batch.set_valid)
+    set_req = np.asarray(batch.set_req_level)
+    set_pin = np.asarray(batch.set_pinned)
+    set_member = np.asarray(batch.set_member)
+    group_req = np.asarray(batch.group_req)
+    group_required = np.asarray(batch.group_required)
+    group_valid = np.asarray(batch.group_valid)
+    levels = node_domain_id.shape[0]
+    pin_lossy = np.zeros((g,), dtype=bool)
+
+    resolvable = set_valid & (set_req >= 0) & (set_req < levels)  # [G, MS]
+    has_req = resolvable.any(axis=1)
+    if bool((gang_valid & ~has_req).any()):
+        # Some valid gang has NO resolvable required set: filter disabled.
+        return np.ones((n,), dtype=bool), pin_lossy
+    active = gang_valid & has_req
+    if not bool(active.any()):
+        # No valid gang carried a resolvable required set: filter is moot.
+        return np.ones((n,), dtype=bool), pin_lossy
+
+    # Broadest required set per gang: first index of the minimum level among
+    # resolvable sets (argmin keeps the earliest on ties, matching the loop
+    # oracle's Python min over ascending set indices).
+    rows = np.arange(g)
+    keyed = np.where(resolvable, set_req, levels + 1)
+    si_sel = np.argmin(keyed, axis=1)  # [G]
+    lvl_sel = keyed[rows, si_sel]  # [G]; valid only where `active`
+    members = set_member[rows, si_sel] & group_valid  # [G, MG]
+    weights = (group_required * members).astype(np.float64)  # [G, MG]
+    # Member floor demand, one broadcast reduction over the group axis —
+    # the same elementwise products and per-gang summation order as the
+    # oracle's per-gang sum, so the aggregates are bitwise-identical.
+    demand = (group_req * weights[:, :, None]).sum(axis=1)  # [G, R] f64
+    pins = set_pin[rows, si_sel]  # [G]
+
+    sched_free = np.where(schedulable[:, None], np.maximum(free, 0.0), 0.0)
+    useful = np.zeros((n,), dtype=bool)
+    for lvl in np.unique(lvl_sel[active]).tolist():
+        lvl = int(lvl)
+        df = _level_domain_free(sched_free, node_domain_id, lvl)  # [D, R]
+        d = df.shape[0]
+        sel = active & (lvl_sel == lvl)
+        # Single [K, D, R] feasibility reduction at this level, over the
+        # UNIQUE demand rows (clone gangs share one row; the comparison per
+        # unique row is the exact comparison the per-gang form would run,
+        # so expanding through the inverse map is bitwise-identical).
+        uniq_dem, inv = np.unique(
+            demand[sel], axis=0, return_inverse=True
+        )
+        ok_ud = (df[None, :, :] + _EPS >= uniq_dem[:, None, :]).all(
+            axis=-1
+        )  # [K, D]
+        ok_gd = ok_ud[inv]  # [G_l, D]
+        p = pins[sel]
+        pinned = p >= 0
+        if bool(pinned.any()):
+            # A pinned set accepts only its pinned domain (a pin outside
+            # [0, D) matches no column — fails closed, like the oracle).
+            cols = np.arange(d)
+            ok_gd = np.where(
+                pinned[:, None], ok_gd & (cols[None, :] == p[:, None]), ok_gd
+            )
+        dom_ok = ok_gd.any(axis=0)  # [D] OR over this level's gangs
+        dom = node_domain_id[lvl]
+        valid = dom >= 0
+        hit = np.zeros((n,), dtype=bool)
+        hit[valid] = dom_ok[np.clip(dom[valid], 0, max(d - 1, 0))]
+        useful |= hit
+    return useful, pin_lossy
+
+
+def _domain_useful_reference(
+    free: np.ndarray,
+    schedulable: np.ndarray,
+    node_domain_id: np.ndarray,
+    batch: GangBatch,
+) -> tuple[np.ndarray, np.ndarray]:
+    """The retained per-gang loop pre-filter: the parity oracle for the
+    vectorized _domain_useful (and the GROVE_HOST_REFERENCE=1 bench
+    baseline). Semantics frozen — do not optimize. The one deliberate
+    divergence from the seed loop is the defensive accumulator-growth
+    branch: np.resize tiled old values into the grown tail (recycled Trues
+    marked arbitrary domains feasible); _grow_mask zero-pads instead."""
     g, ms = np.asarray(batch.set_valid).shape
     n = free.shape[0]
     gang_valid = np.asarray(batch.gang_valid)
@@ -315,6 +447,8 @@ def _domain_useful(
     dom_free: dict[int, np.ndarray] = {}
 
     def dom_free_at(lvl: int) -> np.ndarray:
+        # The seed's np.add.at aggregation, kept verbatim: the vectorized
+        # path's bincount aggregate is pinned bitwise-equal to this.
         if lvl not in dom_free:
             dom = node_domain_id[lvl]
             d = int(dom.max(initial=-1)) + 1
@@ -358,7 +492,7 @@ def _domain_useful(
             ok = mask
         acc = level_dom_ok.setdefault(lvl, np.zeros_like(ok))
         if acc.shape[0] < ok.shape[0]:  # defensive; same level, same D
-            acc = np.resize(acc, ok.shape)
+            acc = _grow_mask(acc, ok.shape)
             level_dom_ok[lvl] = acc
         level_dom_ok[lvl] = acc | ok
     if any_unconstrained:

@@ -118,6 +118,10 @@ class StreamStats:
             "paced": self.paced,
             "shardDevices": self.drain.shard_devices,
         }
+        # Host-stage timing ledger of the underlying engine run — the
+        # lastStream rows carry the same per-stage split as lastDrain, so
+        # streaming host overhead is a recorded number on /statusz too.
+        doc.update(self.drain.host_stages())
         pct = self.bind_percentiles((50.0, 99.0))
         if pct is not None:
             doc["bindP50S"] = round(pct[50.0], 4)

@@ -617,6 +617,14 @@ class EncodeRowCache:
         self._max = max_entries
         self.hits = 0
         self.misses = 0
+        # _sets_of memo (encode_gangs): constraint-tree walks keyed by the
+        # caller row key (spec digest + snapshot epoch). Kept SEPARATE from
+        # the full-row entries: rows are additionally keyed by bucket dims
+        # and bound-node signature, so a bucket drift or fresh pin demotes
+        # the rows while the (dims-independent) set structure stays valid.
+        self._sets: OrderedDict[tuple, tuple] = OrderedDict()
+        self.sets_hits = 0
+        self.sets_misses = 0
 
     def peek(self, key: tuple) -> Optional[dict]:
         entry = self._rows.get(key)
@@ -630,12 +638,122 @@ class EncodeRowCache:
         while len(self._rows) > self._max:
             self._rows.popitem(last=False)
 
+    def peek_sets(self, key: tuple) -> Optional[tuple]:
+        entry = self._sets.get(key)
+        if entry is not None:
+            self._sets.move_to_end(key)
+            self.sets_hits += 1
+        else:
+            self.sets_misses += 1
+        return entry
+
+    def put_sets(self, key: tuple, entry: tuple) -> None:
+        self._sets[key] = entry
+        self._sets.move_to_end(key)
+        while len(self._sets) > self._max:
+            self._sets.popitem(last=False)
+
     def stats(self) -> dict:
         return {
             "encodeHits": self.hits,
             "encodeMisses": self.misses,
             "encodeEntries": len(self._rows),
+            "encodeSetsHits": self.sets_hits,
+            "encodeSetsMisses": self.sets_misses,
         }
+
+
+# Per-pod digest-signature memo (gang_row_digest): the signature walk
+# (total_requests + sorted selector/toleration tuples) was ~60% of the cold
+# drain encode at bench scale, and it recurs every tick/drain for pods whose
+# OBJECTS are stable (the store keeps Pod objects; only sub-GANG wrappers
+# are rebuilt per pass). Keyed by (id(pod), id(pod.spec)) with a weakref
+# guard: a dead pod's recycled id can never serve a stale signature, and a
+# replaced spec object misses by key. In-place mutation of a live spec's
+# containers/selector/tolerations would be invisible — nothing in the
+# codebase does that (specs are replaced wholesale), and the encode-row
+# cache already relies on the same convention via the digest.
+_POD_SIG_MEMO: dict[tuple, tuple] = {}
+_POD_SIG_MAX = 131072
+
+
+def _pod_sig(pod, memo: bool = True) -> tuple:
+    import weakref
+
+    spec = pod.spec
+    if memo:
+        key = (id(pod), id(spec))
+        hit = _POD_SIG_MEMO.get(key)
+        if hit is not None and hit[0]() is pod:
+            return hit[1]
+    sig = (
+        tuple(sorted(spec.total_requests().items())),
+        tuple(sorted((spec.node_selector or {}).items())),
+        tuple(tuple(sorted(t.items())) for t in (spec.tolerations or [])),
+    )
+    if memo:
+        try:
+            if len(_POD_SIG_MEMO) >= _POD_SIG_MAX:
+                _POD_SIG_MEMO.clear()
+            _POD_SIG_MEMO[key] = (weakref.ref(pod), sig)
+        except TypeError:
+            pass  # un-weakref-able pod stand-ins (tests): just recompute
+    return sig
+
+
+# Whole-gang digest memo: keyed by id(gang), guarded by a weakref on the
+# gang PLUS a cheap spec fingerprint covering every scalar the digest reads
+# (constraint tree, group names/floors) and identity stand-ins for the
+# expensive parts it skips (the pod_references list object + endpoints, the
+# first pod object + spec per group). The digest proper walks every pod
+# reference name — O(pods) per gang per call, a real per-drain tax once
+# everything else is vectorized — so the memo's job is to skip exactly that
+# walk while still honoring the SPEC-HASH contract: any in-place scalar or
+# structural spec mutation flips the guard and recomputes (test-pinned by
+# test_warm.test_gang_row_digest_tracks_spec_not_identity). The one
+# invisible mutation is replacing an INTERIOR element of the same
+# pod_references list object in place — nothing in the codebase edits ref
+# lists element-wise; expansion rebuilds them wholesale.
+_GANG_DIGEST_MEMO: dict[int, tuple] = {}
+_GANG_DIGEST_MAX = 65536
+
+
+def _pc_levels(obj):
+    tc = getattr(obj, "topology_constraint", None)
+    p = getattr(tc, "pack_constraint", None) if tc else None
+    return (p.required, p.preferred) if p else None
+
+
+def _digest_guard(gang, pods_by_name: dict) -> tuple:
+    """Cheap (O(groups)) fingerprint of everything gang_row_digest reads,
+    with identity stand-ins for its O(pods) parts."""
+    groups = []
+    for grp in gang.spec.pod_groups:
+        refs = grp.pod_references
+        pod = pods_by_name.get(refs[0].name) if refs else None
+        groups.append(
+            (
+                grp.name,
+                grp.min_replicas,
+                _pc_levels(grp),
+                len(refs),
+                id(refs),
+                id(refs[0]) if refs else None,
+                id(refs[-1]) if refs else None,
+                None if pod is None else (id(pod), id(pod.spec)),
+            )
+        )
+    return (
+        gang.name,
+        gang.base_podgang_name,
+        gang.spec.spread_key,
+        _pc_levels(gang.spec),
+        tuple(
+            (gc.name, tuple(gc.pod_group_names), _pc_levels(gc))
+            for gc in gang.spec.topology_constraint_group_configs
+        ),
+        tuple(groups),
+    )
 
 
 def gang_row_digest(gang, pods_by_name: dict) -> tuple:
@@ -645,43 +763,52 @@ def gang_row_digest(gang, pods_by_name: dict) -> tuple:
     share one template, so the first pod speaks for the group — exactly the
     encode's own rule). Spec hash, not object identity: the per-tick drivers
     rebuild sub-gang objects every pass, so identity is always 'dirty'."""
+    import weakref
 
-    def pc(obj):
-        tc = getattr(obj, "topology_constraint", None)
-        p = getattr(tc, "pack_constraint", None) if tc else None
-        return (p.required, p.preferred) if p else None
+    from grove_tpu.solver.encode import host_vectorized
+
+    memo = host_vectorized()  # hoisted: one env read per gang, not per pod
+    if memo:
+        mkey = id(gang)
+        guard = _digest_guard(gang, pods_by_name)
+        hit = _GANG_DIGEST_MEMO.get(mkey)
+        if hit is not None and hit[0]() is gang and hit[1] == guard:
+            return hit[2]
 
     def pod_sig(name: str):
         pod = pods_by_name.get(name)
         if pod is None:
             return None
-        spec = pod.spec
-        return (
-            tuple(sorted(spec.total_requests().items())),
-            tuple(sorted((spec.node_selector or {}).items())),
-            tuple(tuple(sorted(t.items())) for t in (spec.tolerations or [])),
-        )
+        return _pod_sig(pod, memo)
 
-    return (
+    digest = (
         gang.name,
         gang.base_podgang_name,
         gang.spec.spread_key,
-        pc(gang.spec),
+        _pc_levels(gang.spec),
         tuple(
-            (gc.name, tuple(gc.pod_group_names), pc(gc))
+            (gc.name, tuple(gc.pod_group_names), _pc_levels(gc))
             for gc in gang.spec.topology_constraint_group_configs
         ),
         tuple(
             (
                 grp.name,
                 grp.min_replicas,
-                pc(grp),
+                _pc_levels(grp),
                 tuple(r.name for r in grp.pod_references),
                 pod_sig(grp.pod_references[0].name) if grp.pod_references else None,
             )
             for grp in gang.spec.pod_groups
         ),
     )
+    if memo:
+        try:
+            if len(_GANG_DIGEST_MEMO) >= _GANG_DIGEST_MAX:
+                _GANG_DIGEST_MEMO.clear()
+            _GANG_DIGEST_MEMO[mkey] = (weakref.ref(gang), guard, digest)
+        except TypeError:
+            pass  # un-weakref-able gang stand-ins (tests): just recompute
+    return digest
 
 
 @dataclass
@@ -720,6 +847,10 @@ class WarmPath:
             "drainHarvest": stats.harvest,
             "drainTotalS": round(stats.total_s, 4),
         }
+        # Host-stage timing ledger (DrainStats.host_stages): per-stage host
+        # seconds of the last drain — /statusz warmPath, `get solver`
+        # lastDrain rows, and the grove_host_stage_seconds gauges read it.
+        doc.update(stats.host_stages())
         # Measured per-gang percentiles; None for chained drains, empty
         # drains, and drains in which no wave admitted anything (the
         # percentile helper owns the 0-/1-wave edge cases — a fabricated

@@ -72,10 +72,31 @@ class ClusterSnapshot:
     _tainted_idx: Optional[dict] = None
     # Memo for encode_epoch (same immutability argument).
     _encode_epoch: Optional[tuple] = None
+    # Memo for node_names_arr (same immutability argument).
+    _node_names_arr: Optional[np.ndarray] = None
+    # Memo for cap_scale (capacity is immutable for the snapshot's life).
+    _cap_scale: Optional[np.ndarray] = None
 
     @property
     def n_nodes(self) -> int:
         return len(self.node_names)
+
+    def cap_scale(self) -> np.ndarray:
+        """Per-resource capacity maxima (score normalization, encode group
+        ordering), memoized — an O(N) column max otherwise re-paid by every
+        encode against this snapshot."""
+        if self._cap_scale is None:
+            self._cap_scale = np.maximum(self.capacity.max(axis=0), 1e-9)
+        return self._cap_scale
+
+    def node_names_arr(self) -> np.ndarray:
+        """node_names as an object array, memoized — the batch decode
+        (solver/core.decode_bindings) gathers admitted pods' node names
+        through it, so the O(N) list->array conversion is paid once per
+        snapshot instead of once per wave."""
+        if self._node_names_arr is None:
+            self._node_names_arr = np.asarray(self.node_names, dtype=object)
+        return self._node_names_arr
 
     def tainted_node_indices(self, blocking_effects) -> list[int]:
         """Indices of nodes carrying scheduling-blocking taints; memoized
@@ -229,9 +250,33 @@ def build_snapshot(
     return snap
 
 
+# Request-vector memo: keyed by (id(pod), id(spec), resource axis) with a
+# weakref guard (a dead pod's recycled id can never serve a stale vector; a
+# replaced spec object misses by key). The cached array is READ-ONLY so an
+# accidental in-place mutation raises instead of corrupting every consumer.
+# Same object-stability convention as the encode-row digest (solver/warm.py
+# _pod_sig): live specs are replaced wholesale, never mutated in place.
+_REQ_VEC_MEMO: dict[tuple, tuple] = {}
+_REQ_VEC_MAX = 131072
+
+
 def pod_request_vector(pod: Pod, resource_names: tuple[str, ...]) -> np.ndarray:
+    import weakref
+
+    key = (id(pod), id(pod.spec), resource_names)
+    hit = _REQ_VEC_MEMO.get(key)
+    if hit is not None and hit[0]() is pod:
+        return hit[1]
     total = pod.spec.total_requests()
-    return np.array([total.get(res, 0.0) for res in resource_names], dtype=np.float32)
+    vec = np.array([total.get(res, 0.0) for res in resource_names], dtype=np.float32)
+    vec.setflags(write=False)
+    try:
+        if len(_REQ_VEC_MEMO) >= _REQ_VEC_MAX:
+            _REQ_VEC_MEMO.clear()
+        _REQ_VEC_MEMO[key] = (weakref.ref(pod), vec)
+    except TypeError:
+        pass  # un-weakref-able pod stand-ins: just recompute per call
+    return vec
 
 
 def apply_binding(snap: ClusterSnapshot, pod: Pod) -> None:

@@ -1,0 +1,140 @@
+"""cProfile the drain's HOST hot path and write the top frames to evidence/.
+
+`make profile-host` runs a short synthetic backlog drain (pipeline harvest,
+pruning enabled when the fleet clears `--prune-min-fleet`) under cProfile —
+AFTER a warm-up drain has paid XLA and populated the warm-path caches, so
+the profile shows the steady-state host loop (encode / prefilter / dispatch
+/ decode / bind), not compilation. Output is one JSON document with the
+host-stage ledger (DrainStats.host_stages) and the top-N frames by
+cumulative time, written under evidence/ (and echoed to stdout) so a
+regression in the per-gang Python tax is a diffable artifact, not a hunch.
+
+Knobs (flags, env-free so the harness composes with the bench env):
+  --racks N        racks per block for the synthetic fleet (default 16)
+  --backlog-frac F scales the gang backlog (default 0.5)
+  --wave-size N    drain wave size (default 256)
+  --top N          frames to keep (default 40)
+  --out PATH       output JSON (default evidence/profile_host_<utc>.json)
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import datetime
+import io
+import json
+import os
+import pathlib
+import pstats
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def _build_problem(racks: int, backlog_frac: float):
+    from grove_tpu.orchestrator import expand_podcliqueset
+    from grove_tpu.sim.workloads import (
+        bench_topology,
+        synthetic_backlog,
+        synthetic_cluster,
+    )
+    from grove_tpu.state import build_snapshot
+
+    topo = bench_topology()
+    backlog = synthetic_backlog(
+        n_disagg=max(1, round(350 * backlog_frac)),
+        n_agg=max(1, round(250 * backlog_frac)),
+        n_frontend=max(1, round(300 * backlog_frac)),
+    )
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, topo)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    nodes = synthetic_cluster(racks_per_block=max(1, racks))
+    return gangs, pods, build_snapshot(nodes, topo)
+
+
+def _top_frames(pr: cProfile.Profile, top: int) -> list[dict]:
+    stats = pstats.Stats(pr, stream=io.StringIO())
+    stats.sort_stats("cumulative")
+    frames = []
+    for func, (cc, nc, tt, ct, _callers) in sorted(
+        stats.stats.items(), key=lambda kv: -kv[1][3]
+    )[:top]:
+        fname, line, name = func
+        frames.append(
+            {
+                "file": fname.replace(str(REPO_ROOT) + os.sep, ""),
+                "line": line,
+                "func": name,
+                "ncalls": nc,
+                "tottime_s": round(tt, 6),
+                "cumtime_s": round(ct, 6),
+            }
+        )
+    return frames
+
+
+def main(argv: list[str] | None = None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--racks", type=int, default=16)
+    ap.add_argument("--backlog-frac", type=float, default=0.5)
+    ap.add_argument("--wave-size", type=int, default=256)
+    ap.add_argument("--prune-min-fleet", type=int, default=256)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+
+    from grove_tpu.solver.core import SolverParams
+    from grove_tpu.solver.drain import drain_backlog
+    from grove_tpu.solver.pruning import PruningConfig
+    from grove_tpu.solver.warm import WarmPath
+
+    gangs, pods, snapshot = _build_problem(args.racks, args.backlog_frac)
+    pruning = PruningConfig(enabled=True, min_fleet=args.prune_min_fleet)
+    wp = WarmPath()
+    # Warm-up: pays XLA + populates row caches so the profiled drain is the
+    # steady-state host loop.
+    drain_backlog(
+        gangs, pods, snapshot, wave_size=args.wave_size,
+        params=SolverParams(), warm_path=wp, pruning=pruning,
+        harvest="pipeline",
+    )
+    pr = cProfile.Profile()
+    pr.enable()
+    _, stats = drain_backlog(
+        gangs, pods, snapshot, wave_size=args.wave_size,
+        params=SolverParams(), warm_path=wp, pruning=pruning,
+        harvest="pipeline",
+    )
+    pr.disable()
+
+    doc = {
+        "kind": "profile_host",
+        "generated_utc": datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y%m%dT%H%M%SZ"),
+        "racks": args.racks,
+        "backlog_frac": args.backlog_frac,
+        "wave_size": args.wave_size,
+        "gangs": len(gangs),
+        "nodes": int(snapshot.capacity.shape[0]),
+        "admitted": stats.admitted,
+        "host_stages": stats.host_stages(),
+        "top_frames": _top_frames(pr, args.top),
+    }
+    out = args.out or os.path.join(
+        "evidence", f"profile_host_{doc['generated_utc']}.json"
+    )
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps({k: v for k, v in doc.items() if k != "top_frames"}))
+    print(f"wrote {out}", file=sys.stderr)
+    return doc
+
+
+if __name__ == "__main__":
+    main()

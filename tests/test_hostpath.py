@@ -1,0 +1,453 @@
+"""Host hot-path vectorization (PR 8): the retained loop implementations are
+the ORACLES and the vectorized paths must match them bitwise.
+
+What is pinned here, strongest first:
+
+1. DECODE PARITY — core.decode_bindings (batch masks + cached slot arrays)
+   equals core._decode_bindings_reference on randomized (ok, assigned,
+   decode-info) triples: invalid gangs, empty waves, pow2 pad edges, both
+   sides of the small-table crossover.
+2. PRE-FILTER PARITY — pruning._domain_useful (broadcast [G, D, R]) equals
+   pruning._domain_useful_reference bitwise on randomized batches incl.
+   pins, invalid gangs, unconstrained gangs; the bincount domain aggregate
+   equals the oracle's np.add.at accumulation bitwise.
+3. ENCODE PARITY — encode_gangs under GROVE_HOST_REFERENCE=0 and =1
+   produces identical batches + decode infos: cold (miss path, vectorized
+   pod fill), warm (row-cache hits, grouped stack application), scaled
+   gangs, pad edges.
+4. The np.resize accumulator regression (_grow_mask zero-pads; resize
+   TILED) and the host-stage timing ledger surfaces.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.sim.workloads import (
+    bench_topology,
+    synthetic_backlog,
+    synthetic_cluster,
+)
+from grove_tpu.solver.core import (
+    SolverParams,
+    _decode_bindings_reference,
+    decode_bindings,
+)
+from grove_tpu.solver.drain import DrainStats, drain_backlog
+from grove_tpu.solver.encode import GangDecodeInfo, encode_gangs
+from grove_tpu.solver.pruning import (
+    _domain_useful,
+    _domain_useful_reference,
+    _grow_mask,
+    _level_domain_free,
+)
+from grove_tpu.solver.warm import EncodeRowCache, WarmPath, gang_row_digest
+from grove_tpu.state import build_snapshot
+
+TOPO = bench_topology()
+
+
+def _expand(backlog):
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, TOPO)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    return gangs, pods
+
+
+def _setup(racks=2, nd=6, na=4, nf=5):
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=racks)
+    gangs, pods = _expand(
+        synthetic_backlog(n_disagg=nd, n_agg=na, n_frontend=nf)
+    )
+    return gangs, pods, build_snapshot(nodes, TOPO)
+
+
+class _FakeSnap:
+    def __init__(self, n):
+        self.node_names = [f"node-{i}" for i in range(n)]
+        self._arr = None
+
+    def node_names_arr(self):
+        if self._arr is None:
+            self._arr = np.asarray(self.node_names, dtype=object)
+        return self._arr
+
+
+# --- 1. decode parity ---------------------------------------------------------
+
+
+def _random_decode_case(rng, g_real, g_pad, mp, n, fill_frac, ok_frac):
+    names = [f"gang-{i}" for i in range(g_real)]
+    pod_names = []
+    for i in range(g_real):
+        n_real = int(rng.integers(0, mp + 1)) if fill_frac is None else int(
+            round(mp * fill_frac)
+        )
+        pod_names.append(
+            [f"g{i}-p{j}" for j in range(n_real)] + [""] * (mp - n_real)
+        )
+    ok = rng.random(g_pad) < ok_frac
+    assigned = np.where(
+        rng.random((g_pad, mp)) < 0.9,
+        rng.integers(0, n, (g_pad, mp)),
+        -1,
+    ).astype(np.int32)
+    di = GangDecodeInfo(gang_names=names, pod_names=pod_names, group_names=[])
+    return ok, assigned, di
+
+
+@pytest.mark.parametrize(
+    "g_real,g_pad,mp",
+    [
+        (0, 4, 8),  # empty wave
+        (3, 4, 8),  # small table: crossover routes to the loop
+        (7, 8, 16),
+        (64, 64, 32),  # big table: batch path
+        (100, 128, 64),  # pow2 pad edge: padded gang rows beyond g_real
+        (31, 32, 256),  # heavy-tailed pod axis
+    ],
+)
+def test_decode_bindings_matches_reference(g_real, g_pad, mp):
+    rng = np.random.default_rng(g_real * 1000 + g_pad + mp)
+    snap = _FakeSnap(512)
+    for ok_frac in (0.0, 0.6, 1.0):
+        ok, assigned, di = _random_decode_case(
+            rng, g_real, g_pad, mp, 512, None, ok_frac
+        )
+        vec = decode_bindings(ok, assigned, di, snap)
+        ref = _decode_bindings_reference(ok, assigned, di, snap)
+        assert vec == ref
+
+
+def test_decode_bindings_slot_arrays_cached():
+    """The batch-decode index arrays build once per decode info."""
+    rng = np.random.default_rng(7)
+    ok, assigned, di = _random_decode_case(rng, 64, 64, 32, 64, 0.5, 1.0)
+    a1 = di.slot_arrays()
+    a2 = di.slot_arrays()
+    assert a1 is a2
+    # Row-major by gang — the contract the per-gang segment cuts rely on.
+    assert (np.diff(a1[0]) >= 0).all()
+
+
+def test_decode_bindings_admitted_gang_with_no_pods_present():
+    """An admitted gang with zero bound pods still appears with {} (the
+    reference loop's contract; callers count admissions from the keys)."""
+    di = GangDecodeInfo(
+        gang_names=["a", "b"],
+        pod_names=[["a-p0"] + [""] * 63, [""] * 64],
+        group_names=[],
+    )
+    ok = np.array([True, True])
+    assigned = np.full((2, 64), -1, dtype=np.int32)
+    assigned[0, 0] = 3
+    snap = _FakeSnap(8)
+    for fn in (decode_bindings, _decode_bindings_reference):
+        out = fn(ok, assigned, di, snap)
+        assert out == {"a": {"a-p0": "node-3"}, "b": {}}
+
+
+# --- 2. pre-filter parity -----------------------------------------------------
+
+
+class _FakeBatch:
+    """Duck-typed GangBatch slice: exactly the fields _domain_useful reads."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def _random_prefilter_case(rng, g, ms, mg, n, r, levels, *, pins, unconstrained):
+    node_domain_id = np.stack(
+        [rng.integers(-1, max(2, n // (3 ** (levels - li))), n) for li in range(levels)]
+    ).astype(np.int32)
+    free = (rng.random((n, r)) * 8).astype(np.float32)
+    schedulable = rng.random(n) < 0.9
+    set_req = rng.integers(-1, levels + 1, (g, ms)).astype(np.int32)
+    set_valid = rng.random((g, ms)) < 0.8
+    set_member = rng.random((g, ms, mg)) < 0.6
+    set_pin = np.where(
+        rng.random((g, ms)) < (0.3 if pins else 0.0),
+        rng.integers(0, n, (g, ms)),
+        -1,
+    ).astype(np.int32)
+    gang_valid = rng.random(g) < 0.85
+    group_valid = rng.random((g, mg)) < 0.9
+    group_req = (rng.random((g, mg, r)) * 4).astype(np.float32)
+    group_required = rng.integers(0, 5, (g, mg)).astype(np.int32)
+    if not unconstrained:
+        # Give every valid gang at least one resolvable required set so the
+        # filter actually engages (the unconstrained early-out is tested
+        # separately).
+        set_valid[:, 0] = True
+        set_req[:, 0] = np.clip(set_req[:, 0], 0, levels - 1)
+    batch = _FakeBatch(
+        gang_valid=gang_valid,
+        set_valid=set_valid,
+        set_req_level=set_req,
+        set_pinned=set_pin,
+        set_member=set_member,
+        group_req=group_req,
+        group_required=group_required,
+        group_valid=group_valid,
+    )
+    return free, schedulable, node_domain_id, batch
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_domain_useful_matches_reference_randomized(seed):
+    rng = np.random.default_rng(seed)
+    g, ms, mg, n, r, levels = (
+        int(rng.integers(1, 40)),
+        int(rng.integers(1, 4)),
+        int(rng.integers(1, 4)),
+        int(rng.integers(8, 200)),
+        int(rng.integers(1, 5)),
+        int(rng.integers(1, 4)),
+    )
+    for pins in (False, True):
+        for unconstrained in (False, True):
+            free, sched, ndid, batch = _random_prefilter_case(
+                rng, g, ms, mg, n, r, levels,
+                pins=pins, unconstrained=unconstrained,
+            )
+            vec_useful, vec_lossy = _domain_useful(free, sched, ndid, batch)
+            ref_useful, ref_lossy = _domain_useful_reference(
+                free, sched, ndid, batch
+            )
+            assert np.array_equal(vec_useful, ref_useful), (
+                pins, unconstrained, g, ms, mg, n, r, levels,
+            )
+            assert np.array_equal(vec_lossy, ref_lossy)
+
+
+def test_domain_useful_all_gangs_invalid_filter_moot():
+    rng = np.random.default_rng(3)
+    free, sched, ndid, batch = _random_prefilter_case(
+        rng, 6, 2, 2, 32, 2, 2, pins=False, unconstrained=False
+    )
+    batch.gang_valid = np.zeros_like(batch.gang_valid)
+    for fn in (_domain_useful, _domain_useful_reference):
+        useful, lossy = fn(free, sched, ndid, batch)
+        assert useful.all() and not lossy.any()
+
+
+def test_level_domain_free_bincount_matches_add_at_bitwise():
+    """The vectorized path's bincount aggregation accumulates in the same
+    sequential data order as the oracle's np.add.at — bitwise equal."""
+    rng = np.random.default_rng(11)
+    n, r = 3000, 4
+    sched_free = (rng.random((n, r)) * 1e3).astype(np.float32)
+    # Adversarial values: many magnitudes, so order-dependent rounding
+    # would surface immediately.
+    sched_free[rng.random((n, r)) < 0.3] *= 1e-6
+    dom = rng.integers(-1, 37, n).astype(np.int32)
+    ndid = dom[None, :]
+    fast = _level_domain_free(sched_free, ndid, 0)
+    d = int(dom.max(initial=-1)) + 1
+    acc = np.zeros((d + 1, r), dtype=np.float64)
+    valid = dom >= 0
+    np.add.at(acc, dom[valid], sched_free[valid])
+    assert np.array_equal(fast, acc[:d])
+
+
+def test_grow_mask_zero_pads_never_tiles():
+    """Regression for the np.resize accumulator bug: resize TILES the old
+    values when growing, recycling a True into the new tail — which would
+    mark an arbitrary domain feasible. _grow_mask must zero-pad."""
+    acc = np.array([True, False])
+    grown = _grow_mask(acc, (5,))
+    assert grown.tolist() == [True, False, False, False, False]
+    # The exact np.resize behavior this replaces (tiling) — pinned so the
+    # bug class stays visible if anyone "simplifies" _grow_mask back.
+    tiled = np.resize(acc, (5,))
+    assert tiled.tolist() == [True, False, True, False, True]
+
+
+# --- 3. encode parity ---------------------------------------------------------
+
+
+def _encode_both(gangs, pods, snap, monkeypatch, **kw):
+    """encode_gangs under vectorized and reference modes, fresh caches."""
+    outs = []
+    for mode in ("0", "1"):
+        monkeypatch.setenv("GROVE_HOST_REFERENCE", mode)
+        rc = EncodeRowCache()
+        keys = [(gang_row_digest(g, pods), ("epoch",)) for g in gangs]
+        outs.append(
+            encode_gangs(
+                gangs, pods, snap, row_cache=rc, row_keys=keys, **kw
+            )
+            + (rc, keys)
+        )
+    monkeypatch.delenv("GROVE_HOST_REFERENCE", raising=False)
+    return outs
+
+
+def _assert_batches_equal(bv, br):
+    for f in bv._fields:
+        a, b = getattr(bv, f), getattr(br, f)
+        if a is None or b is None:
+            assert a is None and b is None, f
+            continue
+        assert np.array_equal(a, b), f
+
+
+def test_encode_cold_and_warm_match_reference(monkeypatch):
+    gangs, pods, snap = _setup()
+    (bv, dv, rcv, kv), (br, dr, rcr, kr) = _encode_both(
+        gangs, pods, snap, monkeypatch
+    )
+    _assert_batches_equal(bv, br)
+    assert dv.gang_names == dr.gang_names
+    assert dv.pod_names == dr.pod_names
+    assert dv.group_names == dr.group_names
+    # Warm second encode (row-cache hits; vec applies grouped stacks, ref
+    # copies per gang) must also match — and match the cold batch.
+    for mode, rc, keys, cold in (("0", rcv, kv, bv), ("1", rcr, kr, br)):
+        monkeypatch.setenv("GROVE_HOST_REFERENCE", mode)
+        b2, d2 = encode_gangs(gangs, pods, snap, row_cache=rc, row_keys=keys)
+        _assert_batches_equal(b2, cold)
+        assert d2.pod_names == dv.pod_names
+    assert rcv.hits > 0 and rcr.hits > 0
+
+
+def test_encode_pad_edges_and_scaled_gangs_match_reference(monkeypatch):
+    # Scaled gangs ride along in synthetic backlogs (base deps + ranks);
+    # pad the gang axis past the pow2 edge so padded rows are exercised.
+    gangs, pods, snap = _setup(nd=3, na=2, nf=3)
+    pad = 1 << (len(gangs)).bit_length()
+    (bv, dv, *_), (br, dr, *_) = _encode_both(
+        gangs, pods, snap, monkeypatch, pad_gangs_to=pad
+    )
+    _assert_batches_equal(bv, br)
+    assert bv.gang_valid.shape[0] == pad
+    assert dv.pod_names == dr.pod_names
+
+
+def test_encode_mixed_mode_row_cache_interop(monkeypatch):
+    """Entries stored by the reference put path must hit cleanly under the
+    vectorized apply (loose fallback), and vice versa (stacked entries read
+    per-field by the reference hit loop)."""
+    gangs, pods, snap = _setup(nd=2, na=2, nf=2)
+    rc = EncodeRowCache()
+    keys = [(gang_row_digest(g, pods), ("epoch",)) for g in gangs]
+    monkeypatch.setenv("GROVE_HOST_REFERENCE", "1")
+    b_ref, _ = encode_gangs(gangs, pods, snap, row_cache=rc, row_keys=keys)
+    monkeypatch.setenv("GROVE_HOST_REFERENCE", "0")
+    b_vec_hit, _ = encode_gangs(gangs, pods, snap, row_cache=rc, row_keys=keys)
+    _assert_batches_equal(b_vec_hit, b_ref)
+    rc2 = EncodeRowCache()
+    b_vec, _ = encode_gangs(gangs, pods, snap, row_cache=rc2, row_keys=keys)
+    monkeypatch.setenv("GROVE_HOST_REFERENCE", "1")
+    b_ref_hit, _ = encode_gangs(gangs, pods, snap, row_cache=rc2, row_keys=keys)
+    _assert_batches_equal(b_ref_hit, b_vec)
+
+
+def test_gang_digest_memo_guards_pod_replacement():
+    """The whole-gang digest memo must miss when a referenced pod object is
+    replaced (changed requests => different digest, not a stale hit)."""
+    import copy
+
+    gangs, pods, _snap = _setup(nd=1, na=1, nf=1)
+    gang = gangs[0]
+    d1 = gang_row_digest(gang, pods)
+    assert gang_row_digest(gang, pods) == d1  # memo hit, same value
+    first_ref = gang.spec.pod_groups[0].pod_references[0].name
+    replacement = copy.deepcopy(pods[first_ref])
+    for c in replacement.spec.containers:
+        c.requests = {k: v + 1 for k, v in c.requests.items()}
+    pods2 = dict(pods)
+    pods2[first_ref] = replacement
+    d2 = gang_row_digest(gang, pods2)
+    assert d2 != d1
+
+
+# --- 4. host-stage ledger -----------------------------------------------------
+
+
+def test_drain_host_stage_ledger_populated():
+    gangs, pods, snap = _setup()
+    _, stats = drain_backlog(
+        gangs, pods, snap, wave_size=8, warm_path=WarmPath(),
+        params=SolverParams(), harvest="pipeline",
+    )
+    doc = stats.host_stages()
+    for key in (
+        "hostEncodeS", "hostPrefilterS", "hostDispatchS", "hostHarvestS",
+        "hostDecodeS", "hostBindS", "hostJournalS", "hostTotalS",
+        "hostHotPathS", "hostPerWaveMs",
+    ):
+        assert key in doc, key
+    assert doc["hostEncodeS"] > 0
+    assert doc["hostBindS"] > 0
+    assert doc["hostTotalS"] == pytest.approx(
+        doc["hostEncodeS"] + doc["hostPrefilterS"] + doc["hostDispatchS"]
+        + doc["hostDecodeS"] + doc["hostBindS"] + doc["hostJournalS"],
+        abs=1e-5,
+    )
+    assert doc["hostHotPathS"] <= doc["hostTotalS"] + 1e-9
+
+
+def test_drain_stats_host_stages_zero_waves():
+    doc = DrainStats().host_stages()
+    assert doc["hostTotalS"] == 0.0
+    assert "hostPerWaveMs" not in doc  # never fabricated for 0-wave drains
+
+
+def test_warm_last_drain_carries_host_stages():
+    gangs, pods, snap = _setup(nd=2, na=2, nf=2)
+    wp = WarmPath()
+    drain_backlog(
+        gangs, pods, snap, wave_size=8, warm_path=wp, params=SolverParams()
+    )
+    assert "hostTotalS" in wp.last_drain
+    assert "hostHotPathS" in wp.stats()
+
+
+def test_stream_doc_carries_host_stages():
+    from grove_tpu.solver.stream import StreamStats
+
+    stats = StreamStats()
+    stats.drain.encode_s = 0.25
+    stats.drain.waves = 2
+    doc = stats.to_doc()
+    assert doc["hostEncodeS"] == 0.25
+    assert doc["hostTotalS"] == 0.25
+    assert doc["hostPerWaveMs"] == pytest.approx(125.0)
+
+
+# --- 5. profile-host harness --------------------------------------------------
+
+
+def test_profile_host_smoke(tmp_path):
+    import scripts.profile_host as ph
+
+    out = tmp_path / "profile.json"
+    doc = ph.main(
+        [
+            "--racks", "1", "--backlog-frac", "0.02", "--wave-size", "8",
+            "--top", "5", "--out", str(out),
+        ]
+    )
+    assert out.exists()
+    assert doc["host_stages"]["hostTotalS"] >= 0
+    assert 0 < len(doc["top_frames"]) <= 5
+    for frame in doc["top_frames"]:
+        assert {"file", "func", "cumtime_s"} <= frame.keys()
+
+
+@pytest.mark.slow
+def test_profile_host_full_run(tmp_path):
+    """The default-size harness (what `make profile-host` runs), slow tier."""
+    import scripts.profile_host as ph
+
+    out = tmp_path / "profile_full.json"
+    doc = ph.main(["--out", str(out)])
+    assert out.exists()
+    assert doc["host_stages"]["hostHotPathS"] > 0
+    assert len(doc["top_frames"]) == 40
