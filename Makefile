@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak profile-host dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak profile-host dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -92,6 +92,18 @@ bench-shard:     ## mesh-sharded solve: device-count ladder, parity + per-device
 bench-shard-soak: ## shard ladder at the 4x acceptance fleet (20480 nodes; slow)
 	@mkdir -p evidence
 	GROVE_BENCH_SCENARIO=shard GROVE_FORCE_CPU=1 GROVE_BENCH_BUDGET_S=5000 GROVE_BENCH_SHARD_SCALE=4 GROVE_BENCH_SHARD_STEP_TIMEOUT_S=1200 $(PY) bench.py | tee evidence/bench_shard_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+# Config-sweep scenario: the batched K-config trace replay (grove_tpu/tuning)
+# vs single-config and serial-per-config baselines in one process. Evidence
+# JSON tee'd under evidence/; the soak variant lengthens the recorded trace
+# (slow test tier, excluded from tier-1).
+bench-sweep:     ## config-sweep replay: K=16 sweep vs single replay vs serial baseline
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=sweep GROVE_FORCE_CPU=1 $(PY) bench.py | tee evidence/bench_sweep_cpu_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+bench-sweep-soak: ## sweep scenario over a longer recorded trace (slow)
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=sweep GROVE_FORCE_CPU=1 GROVE_BENCH_SWEEP_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_sweep_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
 
 # Host hot-path profile: cProfile a warm steady-state drain, top cumulative
 # frames + the host-stage ledger as JSON under evidence/.

@@ -44,6 +44,13 @@ serial vs double-buffered pipelined streaming drain over one deterministic
 arrival trace. GROVE_BENCH_STREAM_{DURATION_S,RATE,SEED,DEPTH,WAVE} shape
 the trace and the pipeline; GROVE_BENCH_STREAM_SOAK=1 runs the long-soak
 variant (slow test tier, excluded from tier-1).
+
+Sweep scenario (GROVE_BENCH_SCENARIO=sweep, `make bench-sweep`): the
+batched config-sweep replay (grove_tpu/tuning) vs single-replay and
+serial-per-config baselines over one recorded stream trace, winner
+validation gates included. GROVE_BENCH_SWEEP_{DURATION_S,RATE,SEED,K,
+RUNGS,RACKS,HOSTS} shape it; GROVE_BENCH_SWEEP_SOAK=1 lengthens the trace
+(slow tier analog: tests/test_tuning.py soak).
 """
 
 from __future__ import annotations
@@ -1272,6 +1279,186 @@ def run_shard_worker() -> int:
     return 0
 
 
+def run_sweep_bench() -> dict:
+    """Config-sweep scenario (`make bench-sweep` / GROVE_BENCH_SCENARIO=sweep):
+    the batched config-sweep replay (grove_tpu/tuning) measured against its
+    two baselines IN THE SAME PROCESS — an honest A/B on one recorded trace:
+
+      1. record a stream trace (live arrival traffic through the pipelined
+         streaming drain, journaled by the flight recorder);
+      2. single-config replay wall (warm) — the unit of the headline ratio;
+      3. serial per-config baseline: the K=16 grid replayed one config at a
+         time (what naive tuning costs — ~Kx);
+      4. the K=16 sweep with successive halving (the product), then the full
+         `recommend` pass whose winner must survive BOTH validation gates
+         (bitwise agreement with its standalone replay, exact-audit admitted
+         ratio >= incumbent).
+
+    Headline: sweep wall / single replay wall, acceptance <= 3.0 (vs ~16x
+    serial). vs_baseline = 3.0 / ratio, so > 1.0 beats the target."""
+    import shutil
+    import tempfile
+
+    from grove_tpu.sim.workloads import (
+        arrival_process,
+        bench_topology,
+        expand_arrivals,
+        synthetic_cluster,
+    )
+    from grove_tpu.solver.stream import StreamConfig, drain_stream
+    from grove_tpu.solver.warm import WarmPath
+    from grove_tpu.state import build_snapshot
+    from grove_tpu.trace.recorder import (
+        journal_stats,
+        read_journal,
+        TraceRecorder,
+    )
+    from grove_tpu.trace.replay import (
+        replay_journal,
+        snapshot_from_wave,
+        solve_wave_record,
+    )
+    from grove_tpu.tuning import (
+        default_grid,
+        incumbent_config,
+        recommend,
+        successive_halving,
+    )
+
+    soak = os.environ.get("GROVE_BENCH_SWEEP_SOAK", "") == "1"
+    duration = float(
+        os.environ.get("GROVE_BENCH_SWEEP_DURATION_S", "30" if soak else "10")
+    )
+    rate = float(os.environ.get("GROVE_BENCH_SWEEP_RATE", "3.0"))
+    k = int(os.environ.get("GROVE_BENCH_SWEEP_K", "16"))
+    rungs = int(os.environ.get("GROVE_BENCH_SWEEP_RUNGS", "4"))
+    seed = int(os.environ.get("GROVE_BENCH_SWEEP_SEED", "7"))
+    racks = int(os.environ.get("GROVE_BENCH_SWEEP_RACKS", "4"))
+    hosts = int(os.environ.get("GROVE_BENCH_SWEEP_HOSTS", "8"))
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=racks, hosts_per_rack=hosts
+    )
+    snapshot = build_snapshot(nodes, topo)
+    evs = arrival_process(seed, duration_s=duration, base_rate=rate)
+    arrivals, pods = expand_arrivals(evs)
+
+    journal_dir = tempfile.mkdtemp(prefix="grove-sweep-bench-")
+    recorder = TraceRecorder(journal_dir, max_records_per_file=64)
+    recorder.start()
+    try:
+        _bindings, sstats = drain_stream(
+            arrivals,
+            pods,
+            snapshot,
+            config=StreamConfig(depth=2, wave_size=8),
+            recorder=recorder,
+        )
+    finally:
+        recorder.stop()
+    records = read_journal(journal_dir)
+    jstats = journal_stats(journal_dir)
+    shutil.rmtree(journal_dir, ignore_errors=True)
+    waves = sum(1 for r in records if r.get("kind") == "wave")
+
+    # ONE warm path for every phase: the serial baseline and single replay
+    # share warmed single-config executables (so serial is measured at its
+    # best), and the sweep reuses them for escalation-fallback rows — only
+    # the stacked (shape, K) executables are new work for it.
+    wp = WarmPath()
+    replay_journal(records, warm_path=wp)  # cold: pays single-config XLA
+    t0 = time.perf_counter()
+    rep = replay_journal(records, warm_path=wp)
+    t_single = time.perf_counter() - t0
+    replay_clean = rep.divergence_count == 0
+
+    incumbent = incumbent_config(records)
+    grid = default_grid(incumbent, k)
+
+    def _serial_replay(config) -> None:
+        fleets: dict = {}
+        for r in records:
+            if r.get("kind") == "fleet":
+                fleets[r["digest"]] = r
+            elif r.get("kind") == "wave":
+                snap_w = snapshot_from_wave(r, fleets[r["fleet"]])
+                solve_wave_record(
+                    r,
+                    snap_w,
+                    warm=wp,
+                    params=config.solver_params(),
+                    portfolio=config.portfolio,
+                    escalate_portfolio=config.escalate_portfolio,
+                )
+
+    t0 = time.perf_counter()
+    for cfg in grid:
+        _serial_replay(cfg)
+    t_serial = time.perf_counter() - t0
+
+    # Sweep: cold pass pays the stacked (bucket, K) lowerings, warm pass is
+    # the steady-state number the headline uses (both recorded).
+    t0 = time.perf_counter()
+    successive_halving(records, default_grid(incumbent, k), rungs=rungs, warm_path=wp)
+    t_sweep_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    engine, schedule = successive_halving(
+        records, default_grid(incumbent, k), rungs=rungs, warm_path=wp
+    )
+    t_sweep = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    rec_doc = recommend(records, k=k, rungs=rungs, warm_path=wp)
+    t_recommend = time.perf_counter() - t0
+
+    ratio = t_sweep / t_single if t_single > 0 else float("inf")
+    serial_speedup = t_serial / t_sweep if t_sweep > 0 else None
+    ok = ratio <= 3.0 and rec_doc["valid"] and replay_clean
+    return {
+        "scenario": "sweep",
+        "metric": "sweep_vs_single_replay",
+        "unit": "x",
+        "value": round(ratio, 3),
+        "vs_baseline": round(3.0 / ratio, 3) if ratio > 0 else 0.0,
+        "gate_pass": ok,
+        "soak": soak,
+        "k": k,
+        "rungs": rungs,
+        "trace": {
+            "duration_s": duration,
+            "rate": rate,
+            "seed": seed,
+            "nodes": len(nodes),
+            "gangs_offered": sstats.offered,
+            "gangs_admitted": sstats.admitted,
+            "waves": waves,
+            "journal_records": len(records),
+            "recorder_dropped": jstats["dropped"],
+        },
+        "single_replay_s": round(t_single, 3),
+        "serial_grid_s": round(t_serial, 3),
+        "sweep_cold_s": round(t_sweep_cold, 3),
+        "sweep_s": round(t_sweep, 3),
+        "recommend_s": round(t_recommend, 3),
+        "serial_vs_sweep": round(serial_speedup, 3) if serial_speedup else None,
+        "replay_divergences": rep.divergence_count,
+        "sweep_stacked_solves": engine.stacked_solves,
+        "sweep_fallback_solves": engine.fallback_solves,
+        "survivors_per_rung": [len(r["configs"]) for r in schedule],
+        "winner": rec_doc["winner"]["name"],
+        "winner_valid": rec_doc["valid"],
+        "winner_bitwise_divergences": rec_doc["validation"]["bitwiseReplay"][
+            "divergences"
+        ],
+        "journal_replay_divergences": rec_doc["validation"][
+            "journalReplayDivergences"
+        ],
+        "exact_audit": rec_doc["validation"]["exactAudit"],
+        "host_cpus": os.cpu_count(),
+    }
+
+
 def run_shard_bench() -> dict:
     """Mesh-shard scenario (`make bench-shard` / GROVE_BENCH_SCENARIO=shard):
     the batched solve distributed across the device mesh, swept over a
@@ -1388,6 +1575,7 @@ SCENARIOS: dict[str, tuple[str, str, object]] = {
     "scale": ("scale_pruned_speedup", "x", run_scale_bench),
     "stream": ("stream_pipeline_speedup", "x", run_stream_bench),
     "shard": ("shard_solve_speedup", "x", run_shard_bench),
+    "sweep": ("sweep_vs_single_replay", "x", run_sweep_bench),
 }
 
 

@@ -13,13 +13,17 @@ API via the typed client. Commands:
   validate -f <file.yaml>                       dry-run admission check
   events [--tail N]                             recent control-plane events
   trace info|replay|whatif [--path DIR]         flight-recorder journal tools
+  tune sweep [--path DIR] [--k N]               offline config tuning from traces
 
-`trace` operates on the journal directory on local disk (the recorder's
-trace.path — run it on the operator host or a copied journal), not over the
-HTTP API: replay re-solves every journaled wave, which needs the solver, not
-the server. `trace replay` exits 1 on any divergence (a solver-
-nondeterminism regression); `trace whatif --add-racks N` scores the recorded
-window against a counterfactual fleet.
+`trace` and `tune` operate on the journal directory on local disk (the
+recorder's trace.path — run them on the operator host or a copied journal),
+not over the HTTP API: replay re-solves every journaled wave, which needs
+the solver, not the server. `trace replay` exits 1 on any divergence (a
+solver-nondeterminism regression); `trace whatif --add-racks N` scores the
+recorded window against a counterfactual fleet, and repeated `--variant`
+flags score N solver-config overrides in ONE batched replay pass. `tune
+sweep` replays the journal once under a K-config grid (successive halving)
+and emits a validated recommended config (exit 1 when validation fails).
 
 Exit codes: 0 ok, 1 API/transport error, 2 usage error (cli.go:35-45 shape).
 """
@@ -426,6 +430,8 @@ def _trace_cmd(args) -> int:
         return 1
 
     if args.verb == "info":
+        from grove_tpu.trace.recorder import journal_stats
+
         kinds: dict[str, int] = {}
         actions: dict[str, int] = {}
         times = []
@@ -443,12 +449,19 @@ def _trace_cmd(args) -> int:
                 waves += 1
                 admitted += sum(1 for v in rec.get("ok", {}).values() if v)
                 rejections += len(rec.get("rejections", {}))
+        jstats = journal_stats(args.path)
         rows = [["records", len(records)]]
         rows += [[f"records.{k}", v] for k, v in sorted(kinds.items())]
         rows += [
             ["waves", waves],
             ["gangsAdmitted", admitted],
             ["gangsRejected", rejections],
+            # Writer-side drop counter recovered from the segments: > 0
+            # means this journal is TRUNCATED (records lost under queue
+            # pressure — grove_trace_dropped_total fired), not a quiet day.
+            # Replay/sweep consumers need to know before trusting it.
+            ["recorderDropped", jstats["dropped"]],
+            ["recorderRecorded", jstats["recorded"]],
         ]
         if times:
             rows += [
@@ -456,6 +469,13 @@ def _trace_cmd(args) -> int:
             ]
         rows += [[f"actions.{k}", v] for k, v in sorted(actions.items())]
         print(_table(rows, ["FIELD", "VALUE"]))
+        if jstats["dropped"]:
+            print(
+                f"warning: recorder dropped {jstats['dropped']} record(s) — "
+                "journal is truncated, replay/sweep may fail on missing "
+                "fleets",
+                file=sys.stderr,
+            )
         return 0
 
     if args.verb == "replay":
@@ -494,9 +514,18 @@ def _trace_cmd(args) -> int:
     # whatif
     from grove_tpu.trace.whatif import whatif_journal
 
+    variants = [_parse_variant(v, i) for i, v in enumerate(args.variant or [])]
+    # --variant implies a config-only what-if; --add-racks keeps its default
+    # of 1 otherwise (the historical +1-rack counterfactual).
+    add_racks = args.add_racks
+    if add_racks is None:
+        add_racks = 0 if variants else 1
     try:
         report = whatif_journal(
-            records, add_rack_count=args.add_racks, portfolio=args.portfolio
+            records,
+            add_rack_count=add_racks,
+            portfolio=args.portfolio,
+            variants=variants or None,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -506,6 +535,32 @@ def _trace_cmd(args) -> int:
         print(json.dumps(doc, indent=2))
         return 0
     rows = [["waves", doc["waves"]]]
+    if "variants" in doc:
+        # Config-override sweep shape: incumbent row + per-variant deltas,
+        # one batched replay pass (trace/whatif.whatif_configs).
+        rows += [[f"recorded.{k}", v] for k, v in sorted(doc["recorded"].items())]
+        for v in doc["variants"]:
+            name = v["config"]["name"]
+            rows += [
+                [f"{name}.admitted", v["admitted"]],
+                [f"{name}.admittedRatio", v["admittedRatio"]],
+                [f"{name}.meanPlacementScore", v["meanPlacementScore"]],
+                [f"{name}.delta.admitted", v["delta"]["admitted"]],
+                [f"{name}.delta.admittedRatio", v["delta"]["admittedRatio"]],
+            ]
+        rows += [
+            ["replayDivergences", doc["replayDivergences"]],
+            ["solveSeconds", doc["solveSeconds"]],
+        ]
+        print(_table(rows, ["FIELD", "VALUE"]))
+        if doc["replayDivergences"]:
+            print(
+                "warning: incumbent replay diverged from the journal "
+                f"({doc['replayDivergences']} divergence(s)) — what-if "
+                "deltas are measuring noise",
+                file=sys.stderr,
+            )
+        return 0
     rows += [[f"edits.{k}", v] for k, v in sorted(doc["edits"].items()) if v]
     for side in ("recorded", "counterfactual"):
         rows += [[f"{side}.{k}", v] for k, v in sorted(doc[side].items())]
@@ -515,6 +570,120 @@ def _trace_cmd(args) -> int:
         ["counterfactualSolveSeconds", doc["counterfactualSolveSeconds"]],
     ]
     print(_table(rows, ["FIELD", "VALUE"]))
+    return 0
+
+
+_VARIANT_WEIGHT_KEYS = ("wTight", "wPref", "wReuse", "wReserve", "wSpread")
+
+
+def _parse_variant(text: str, index: int) -> dict:
+    """--variant 'wTight=2.0,escalatePortfolio=1,name=aggressive' -> the
+    whatif_configs override spec."""
+    spec: dict = {}
+    weights: dict = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(
+                f"--variant[{index}]: {part!r} is not key=value"
+            )
+        key, val = part.split("=", 1)
+        key = key.strip()
+        if key in _VARIANT_WEIGHT_KEYS:
+            weights[key] = float(val)
+        elif key in ("portfolio", "escalatePortfolio"):
+            spec[key] = int(val)
+        elif key == "name":
+            spec["name"] = val.strip()
+        else:
+            raise SystemExit(
+                f"--variant[{index}]: unknown key {key!r} (weights "
+                f"{'/'.join(_VARIANT_WEIGHT_KEYS)}, portfolio, "
+                "escalatePortfolio, name)"
+            )
+    if weights:
+        spec["weights"] = weights
+    if not spec:
+        raise SystemExit(f"--variant[{index}]: empty spec")
+    return spec
+
+
+def _tune_cmd(args) -> int:
+    """`grove-tpu tune sweep` — batched config-sweep replay over a local
+    journal: K candidate configs ride one replay pass (successive halving
+    between trace chunks), and the winner is emitted as a recommended-config
+    JSON only if it passes the bitwise-replay and exact-audit gates
+    (exit 1 otherwise, like `trace replay` on divergence)."""
+    from grove_tpu.trace.recorder import (
+        TraceSchemaError,
+        journal_stats,
+        read_journal,
+    )
+
+    try:
+        records = read_journal(args.path)
+    except (FileNotFoundError, TraceSchemaError, OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    jstats = journal_stats(args.path)
+    if jstats["dropped"]:
+        print(
+            f"warning: recorder dropped {jstats['dropped']} record(s) — "
+            "sweeping a truncated journal",
+            file=sys.stderr,
+        )
+
+    from grove_tpu.tuning import recommend
+
+    try:
+        doc = recommend(
+            records,
+            k=args.k,
+            rungs=args.rungs,
+            spread=args.spread,
+            seed=args.seed,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    doc["journal"] = {"path": args.path, **jstats}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        rows = [
+            ["waves", doc["sweep"]["waves"]],
+            ["grid", doc["grid"]],
+            ["winner", doc["winner"]["name"]],
+            ["winner.admittedRatio", doc["winnerTally"]["admittedRatio"]],
+            ["winner.meanPlacementScore", doc["winnerTally"]["meanPlacementScore"]],
+            ["incumbent.admittedRatio", doc["incumbentTally"]["admittedRatio"]],
+            ["incumbent.meanPlacementScore", doc["incumbentTally"]["meanPlacementScore"]],
+            ["replayDivergences", doc["validation"]["journalReplayDivergences"]],
+            ["bitwiseDivergences", doc["validation"]["bitwiseReplay"]["divergences"]],
+            ["exactAudit.winner", doc["validation"]["exactAudit"]["winner"]["admittedRatio"]],
+            ["exactAudit.incumbent", doc["validation"]["exactAudit"]["incumbent"]["admittedRatio"]],
+            ["valid", doc["valid"]],
+        ]
+        for w in doc["winner"]["weights"]:
+            rows.append([f"winner.weights.{w}", round(doc["winner"]["weights"][w], 4)])
+        print(_table(rows, ["FIELD", "VALUE"]))
+    if not doc["valid"]:
+        print(
+            "recommendation FAILED validation gates: "
+            + ", ".join(doc.get("failedGates", [])),
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"recommended config {doc['winner']['name']!r} validated "
+        "(bitwise replay + exact audit)"
+    )
     return 0
 
 
@@ -599,8 +768,9 @@ def main(argv=None) -> int:
     p_tr.add_argument(
         "--add-racks",
         type=int,
-        default=1,
-        help="whatif: clone N racks of the recorded SKU into the fleet",
+        default=None,
+        help="whatif: clone N racks of the recorded SKU into the fleet "
+        "(default 1, or 0 when --variant is given)",
     )
     p_tr.add_argument(
         "--portfolio",
@@ -609,6 +779,49 @@ def main(argv=None) -> int:
         help="whatif: override the recorded portfolio width",
     )
     p_tr.add_argument(
+        "--variant",
+        action="append",
+        default=None,
+        metavar="SPEC",
+        help="whatif: config-override variant 'wTight=2.0,escalatePortfolio=1"
+        ",name=x' (repeatable; all variants ride ONE batched replay pass)",
+    )
+    p_tr.add_argument(
+        "--json", action="store_true", help="emit the full report as JSON"
+    )
+
+    p_tu = sub.add_parser(
+        "tune",
+        help="offline solver tuning from a local trace journal",
+    )
+    p_tu.add_argument("verb", choices=["sweep"])
+    p_tu.add_argument(
+        "--path",
+        default=RUNTIME_STATE_DIR + "/trace",
+        help="journal directory (the operator's trace.path)",
+    )
+    p_tu.add_argument(
+        "--k", type=int, default=16, help="config-grid size (incumbent + K-1)"
+    )
+    p_tu.add_argument(
+        "--rungs",
+        type=int,
+        default=3,
+        help="successive-halving rungs over the trace (1 = no halving)",
+    )
+    p_tu.add_argument(
+        "--spread",
+        type=float,
+        default=0.5,
+        help="log-normal weight perturbation spread for the grid",
+    )
+    p_tu.add_argument(
+        "--seed", type=int, default=0, help="grid generation seed"
+    )
+    p_tu.add_argument(
+        "--out", default=None, help="write the recommended-config JSON here"
+    )
+    p_tu.add_argument(
         "--json", action="store_true", help="emit the full report as JSON"
     )
 
@@ -616,6 +829,8 @@ def main(argv=None) -> int:
 
     if args.cmd == "trace":
         return _trace_cmd(args)
+    if args.cmd == "tune":
+        return _tune_cmd(args)
 
     try:
         token = None

@@ -15,7 +15,20 @@ over admission subsets AND placements that maximizes
 
 on instances small enough to enumerate (<= MAX_GANGS gangs, <= MAX_NODES
 nodes — the Tesserae evaluation regime: compare policies against computable
-optima on small instances, arXiv:2508.04953).
+optima on small instances, arXiv:2508.04953). Two admissible bounds keep
+instances near the caps tractable (they prune work, never answers):
+
+  - **admitted-count fathom**: admitting gang i is worth at most
+    (1 + schedulable-suffix, same + 1.0 each) — once the reject branch (or
+    an earlier placement) already attains that bound, the remaining
+    placements of gang i cannot beat the incumbent and are not enumerated.
+    In uncontended regions this collapses the search to one placement per
+    gang; it is what lifts the practical budget from the original
+    <=10 gangs x <=16 nodes to roughly double (the slow-marked audit tier,
+    tests/test_quality_optimal.py).
+  - **capacity pre-check**: a gang whose floor demand exceeds the remaining
+    TOTAL free in any resource cannot be admitted from this state — its
+    placement enumeration (domain choices x allocations) is skipped whole.
 
 Semantics mirror the production encode exactly because the gang model IS the
 production encode: every gang is run through `encode_gangs` and the search
@@ -42,8 +55,8 @@ import numpy as np
 
 from grove_tpu.solver.encode import encode_gangs
 
-MAX_GANGS = 10
-MAX_NODES = 16
+MAX_GANGS = 20
+MAX_NODES = 32
 _EPS = 1e-6
 
 
@@ -285,6 +298,20 @@ def exact_pack(
         yield from domain_choices(0, [])
 
     memo: dict = {}
+    # Admitted-count fathom inputs: how many gangs from i on COULD still be
+    # admitted (schedulable ones), and each gang's summed floor demand (the
+    # capacity pre-check). Scores are <= 1.0 per gang, so the value of any
+    # branch that admits gang i is bounded by (1 + suffix, 1.0 * (1 +
+    # suffix)) — admissible, prunes work never answers.
+    sched_suffix = [0] * (len(models) + 1)
+    for i in range(len(models) - 1, -1, -1):
+        sched_suffix[i] = sched_suffix[i + 1] + (1 if models[i].schedulable else 0)
+    floor_demand = []
+    for model in models:
+        dem = np.zeros((free0.shape[1],), dtype=np.float64)
+        for req, floor, _eligible, _names in model.groups:
+            dem += req * floor
+        floor_demand.append(dem)
 
     def best_from(i: int, free) -> tuple:
         """((admitted, score_sum), choice) for gangs[i:] against `free`.
@@ -298,12 +325,19 @@ def exact_pack(
         # Branch A: reject gang i.
         best_v, best_c = best_from(i + 1, free)[0], None
         model = models[i]
-        if model.schedulable:
+        feasible = model.schedulable and bool(
+            (free.sum(axis=0) + _EPS >= floor_demand[i]).all()
+        )
+        if feasible:
+            ub_count = 1 + sched_suffix[i + 1]
+            ub = (ub_count, float(ub_count))
             for counts, f_done, score in placements(model, free):
                 sub_v, _ = best_from(i + 1, f_done)
                 v = (sub_v[0] + 1, sub_v[1] + score)
                 if v > best_v:
                     best_v, best_c = v, ([c.copy() for c in counts], score)
+                if best_v >= ub:
+                    break  # fathomed: no remaining placement can beat this
         memo[key] = (best_v, best_c)
         return memo[key]
 

@@ -340,6 +340,31 @@ class TraceConfig:
 
 
 @dataclass
+class TuningConfig:
+    """Offline config-sweep tuning (grove_tpu/tuning): `grove-tpu tune
+    sweep` replays a recorded journal once while K candidate solver configs
+    ride the solver's variant axis (one AOT executable per (wave shape
+    bucket, K)), prunes losers by successive halving between trace chunks,
+    and emits a recommended config validated two ways — bitwise agreement
+    with a plain single-config replay, and admitted-ratio parity against
+    the exact B&B reference on the seeded audit instances. This block only
+    parameterizes the sweep driver; nothing in the serving path reads it."""
+
+    # Config-grid size: the incumbent (recorded) config + gridK-1 candidates.
+    grid_k: int = 16
+    # Successive-halving rungs over the trace (1 = score the whole grid on
+    # the whole trace, no halving).
+    halving_rungs: int = 3
+    # Log-normal weight-perturbation spread for the generated grid.
+    spread: float = 0.5
+    # Grid generation seed (the sweep is deterministic given the journal).
+    seed: int = 0
+    # Exact-audit instance seeds for winner validation; [] = the default
+    # tier-1 audit set (quality/audit.AUDIT_SEEDS).
+    audit_seeds: list = field(default_factory=list)
+
+
+@dataclass
 class BackendConfig:
     """Scheduler-backend sidecar (GREP-375 boundary)."""
 
@@ -433,6 +458,7 @@ class OperatorConfiguration:
     solver: SolverConfig = field(default_factory=SolverConfig)
     defrag: DefragConfig = field(default_factory=DefragConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    tuning: TuningConfig = field(default_factory=TuningConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
@@ -469,6 +495,7 @@ _SECTION_TYPES = {
     "solver": ("solver", SolverConfig),
     "defrag": ("defrag", DefragConfig),
     "trace": ("trace", TraceConfig),
+    "tuning": ("tuning", TuningConfig),
     "backend": ("backend", BackendConfig),
     "persistence": ("persistence", PersistenceConfig),
     "cluster": ("cluster", ClusterConfig),
@@ -498,6 +525,9 @@ _CAMEL_FIELDS = {
     "healEventDedupeSeconds": "heal_event_dedupe_seconds",
     "maxRecordsPerFile": "max_records_per_file",
     "maxFiles": "max_files",
+    "gridK": "grid_k",
+    "halvingRungs": "halving_rungs",
+    "auditSeeds": "audit_seeds",
     "queueSize": "queue_size",
     "flushIntervalSeconds": "flush_interval_seconds",
     "exemptActors": "exempt_actors",
@@ -842,6 +872,25 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
         tr.flush_interval_seconds, bool
     ) or tr.flush_interval_seconds <= 0:
         errors.append("trace.flushIntervalSeconds: must be > 0")
+    tu = cfg.tuning
+    for tu_name, tu_val in (
+        ("tuning.gridK", tu.grid_k),
+        ("tuning.halvingRungs", tu.halving_rungs),
+    ):
+        if not isinstance(tu_val, int) or isinstance(tu_val, bool) or tu_val < 1:
+            errors.append(f"{tu_name}: must be an int >= 1")
+    import math as _tmath
+
+    if not isinstance(tu.spread, (int, float)) or isinstance(
+        tu.spread, bool
+    ) or not _tmath.isfinite(float(tu.spread)) or tu.spread <= 0:
+        errors.append("tuning.spread: must be a finite number > 0")
+    if not isinstance(tu.seed, int) or isinstance(tu.seed, bool) or tu.seed < 0:
+        errors.append("tuning.seed: must be an int >= 0")
+    if not isinstance(tu.audit_seeds, list) or any(
+        not isinstance(s, int) or isinstance(s, bool) for s in tu.audit_seeds
+    ):
+        errors.append("tuning.auditSeeds: must be a list of ints")
     eb = cfg.controllers.events_buffer
     if not isinstance(eb, int) or isinstance(eb, bool) or eb < 1:
         errors.append("controllers.eventsBuffer: must be an int >= 1")

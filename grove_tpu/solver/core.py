@@ -647,6 +647,51 @@ def solve_batch_impl(
 solve_batch = partial(jax.jit, static_argnames=("coarse_dmax",))(solve_batch_impl)
 
 
+def stacked_solve_batch_impl(
+    free0: jax.Array,
+    capacity: jax.Array,
+    schedulable: jax.Array,
+    node_domain_id: jax.Array,
+    batch: GangBatch,
+    params_stack: SolverParams,  # each leaf [K]
+    coarse_dmax: int | None = None,
+) -> SolveResult:
+    """Solve the SAME wave under K weight variants at once; every SolveResult
+    leaf gains a leading [K] axis (assigned [K, G, MP], ok [K, G], ...).
+
+    This is the config-sweep workhorse (grove_tpu/tuning): unlike
+    `portfolio_solve_batch` it keeps ALL K results instead of selecting a
+    winner — the offline sweep scores each variant independently against the
+    recorded trace. Row k is BITWISE-identical to a single `solve_batch` call
+    with `params_stack` row k (vmap batches the identical op sequence; the
+    sweep's replay-agreement contract rests on this, pinned in
+    tests/test_tuning.py), so sweep verdicts can never diverge from what the
+    production solver would have done under that config.
+
+    `ok_global` is deliberately absent: the sweep replays journaled waves,
+    and replay resolves cross-wave dependencies on the host exactly like
+    trace/replay.py (scheduled_gangs in the encode closure)."""
+    axes = SolverParams(*(0 for _ in SolverParams._fields))
+    return jax.vmap(
+        lambda p: solve_batch_impl(
+            free0,
+            capacity,
+            schedulable,
+            node_domain_id,
+            batch,
+            p,
+            None,
+            coarse_dmax=coarse_dmax,
+        ),
+        in_axes=(axes,),
+    )(params_stack)
+
+
+stacked_solve_batch = partial(jax.jit, static_argnames=("coarse_dmax",))(
+    stacked_solve_batch_impl
+)
+
+
 # Mesh-sharded solve entries, one jitted variant per (donate, layout): the
 # SAME solve_batch_impl trace, with every output pinned by an explicit
 # sharding constraint — free_after stays node-sharded (the drain's wave

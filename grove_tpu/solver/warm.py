@@ -61,8 +61,27 @@ from grove_tpu.utils.fsio import atomic_write_json
 
 # jitted solve_batch variants, shared process-wide so every ExecutableCache
 # (controller, sidecar, drain) lowers through the same traced function.
-_JITTED: dict[bool, Any] = {}
+# Keys: bool (donate flag, single-config) | "stacked" (K-variant sweep).
+_JITTED: dict[Any, Any] = {}
 _JITTED_LOCK = threading.Lock()
+
+
+def _jitted_stacked():
+    """jitted stacked_solve_batch_impl (the K-variant config-sweep solve),
+    memoized process-wide like the single-config variants. Never donated
+    (the sweep owns no wave carry) and never mesh-sharded (the offline sweep
+    runs on whatever host replays the journal)."""
+    import jax
+
+    from grove_tpu.solver.core import stacked_solve_batch_impl
+
+    key = "stacked"
+    with _JITTED_LOCK:
+        if key not in _JITTED:
+            _JITTED[key] = jax.jit(
+                stacked_solve_batch_impl, static_argnames=("coarse_dmax",)
+            )
+        return _JITTED[key]
 
 
 def _jitted_solve(donate: bool, layout=None):
@@ -132,18 +151,22 @@ def _canon(
 
 
 def _exec_key(
-    args: tuple, coarse_dmax: Optional[int], donate: bool, layout=None
+    args: tuple, coarse_dmax: Optional[int], donate: bool, layout=None,
+    stacked: bool = False,
 ) -> tuple:
     """Full executable identity: pytree structure (covers optional-feature
     presence) + every leaf's (shape, dtype) (covers node pad, gang pad,
-    bucket dims, global-table width, portfolio width) + the statics + the
-    mesh layout (a sharded executable demands its input layout — an
-    unsharded solve of the same shapes must never alias to it)."""
+    bucket dims, global-table width, portfolio width — and, for the sweep's
+    stacked variant, K via the params leaf shapes) + the statics + the mesh
+    layout (a sharded executable demands its input layout — an unsharded
+    solve of the same shapes must never alias to it) + the stacked flag (a
+    K-stacked solve and a portfolio-shaped single solve must never alias)."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(args)
     return (
         bool(donate),
+        bool(stacked),
         coarse_dmax,
         None if layout is None else layout.key(),
         str(treedef),
@@ -323,6 +346,32 @@ class ExecutableCache:
         compiled = self._get_or_compile(args, coarse_dmax, donate, layout)
         return compiled(*args)
 
+    def solve_stacked(
+        self,
+        free0,
+        capacity,
+        schedulable,
+        node_domain_id,
+        batch: GangBatch,
+        params_stack: SolverParams,  # each leaf [K]
+        *,
+        coarse_dmax: Optional[int] = None,
+    ) -> SolveResult:
+        """core.stacked_solve_batch through the AOT cache: one wave solved
+        under K weight variants, every result leaf gaining a leading [K]
+        axis. The executable keys on (wave shape bucket, K) — the K rides in
+        on the params leaf shapes — so a config sweep amortizes ONE lowering
+        per (shape bucket, surviving-config count) across the whole trace,
+        exactly like the single-config warm path does per shape bucket."""
+        args = _canon(
+            free0, capacity, schedulable, node_domain_id, batch, params_stack,
+            None,
+        )[:6]  # stacked signature carries no ok_global
+        compiled = self._get_or_compile(
+            args, coarse_dmax, False, None, stacked=True
+        )
+        return compiled(*args)
+
     def ensure_compiled(
         self,
         free0,
@@ -348,8 +397,11 @@ class ExecutableCache:
         self._get_or_compile(args, coarse_dmax, donate, layout)
         return self.lowerings != before
 
-    def _get_or_compile(self, args: tuple, coarse_dmax, donate: bool, layout=None):
-        key = _exec_key(args, coarse_dmax, donate, layout)
+    def _get_or_compile(
+        self, args: tuple, coarse_dmax, donate: bool, layout=None,
+        stacked: bool = False,
+    ):
+        key = _exec_key(args, coarse_dmax, donate, layout, stacked)
         while True:
             with self._lock:
                 compiled = self._entries.get(key)
@@ -360,7 +412,8 @@ class ExecutableCache:
                         self._inflight[key] = threading.Event()
             if compiled is not None:
                 self.hits += 1
-                self._record(args, coarse_dmax, donate, layout, new=False)
+                if not stacked:
+                    self._record(args, coarse_dmax, donate, layout, new=False)
                 return compiled
             if pending is None:
                 break
@@ -371,10 +424,9 @@ class ExecutableCache:
             pending.wait()
         try:
             self.lowerings += 1
+            jitted = _jitted_stacked() if stacked else _jitted_solve(donate, layout)
             compiled = (
-                _jitted_solve(donate, layout)
-                .lower(*args, coarse_dmax=coarse_dmax)
-                .compile()
+                jitted.lower(*args, coarse_dmax=coarse_dmax).compile()
             )
             with self._lock:
                 self._entries.setdefault(key, compiled)
@@ -384,7 +436,8 @@ class ExecutableCache:
                 ev = self._inflight.pop(key, None)
             if ev is not None:
                 ev.set()
-        self._record(args, coarse_dmax, donate, layout, new=True)
+        if not stacked:
+            self._record(args, coarse_dmax, donate, layout, new=True)
         return compiled
 
     # ---- shape history + prewarm -------------------------------------------

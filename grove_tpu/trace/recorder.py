@@ -373,7 +373,18 @@ class TraceRecorder:
             if segment:
                 atomic_write_json(
                     os.path.join(self.path, f"segment-{seq:06d}.json"),
-                    {"version": SCHEMA_VERSION, "records": segment},
+                    {
+                        "version": SCHEMA_VERSION,
+                        "records": segment,
+                        # Recorder-state counters AT WRITE TIME (cumulative
+                        # for this process): lets an offline reader
+                        # (`grove-tpu trace info`, the tuning sweep) tell a
+                        # truncated journal — records dropped under queue
+                        # pressure — from a genuinely quiet day. Additive
+                        # field: replay ignores it, old segments read as 0.
+                        "recorderDropped": self.dropped,
+                        "recorderRecorded": self.recorded,
+                    },
                 )
                 self.segments_written += 1
             dirty = False
@@ -453,6 +464,30 @@ class TraceRecorder:
             "segmentsWritten": self.segments_written,
             "queueDepth": self._queue.qsize(),
         }
+
+
+def journal_stats(path: str) -> dict:
+    """Writer-side counters recovered from the segment files themselves:
+    {"dropped", "recorded", "segments"}. `dropped` > 0 means the journal is
+    TRUNCATED — records were lost under queue pressure — which a sweep or
+    replay consumer must surface (a wave referencing a dropped fleet fails
+    replay outright, but dropped WAVES are silent without this). Counters
+    are cumulative per writer process, so the max across segments is the
+    final count; segments written before the field existed read as 0."""
+    files = [path] if os.path.isfile(path) else sorted(
+        glob.glob(os.path.join(path, _SEGMENT_GLOB))
+    )
+    dropped = 0
+    recorded = 0
+    for p in files:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        dropped = max(dropped, int(doc.get("recorderDropped", 0) or 0))
+        recorded = max(recorded, int(doc.get("recorderRecorded", 0) or 0))
+    return {"dropped": dropped, "recorded": recorded, "segments": len(files)}
 
 
 def read_journal(path: str) -> list[dict]:

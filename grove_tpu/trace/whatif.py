@@ -14,6 +14,16 @@ Scope: each wave replays against its own RECORDED pre-solve allocated state
 the counterfactual adds do not cascade into later waves' allocated state —
 that would require re-simulating the whole control loop, which the sim
 harness does; this tool scores the recorded decision points.
+
+Config-override what-ifs (no fleet edit) route through the batched sweep
+engine (grove_tpu/tuning/sweep.py): the N override variants AND the
+incumbent config stack onto the solver's variant axis, so N counterfactuals
+cost ~one replay instead of N — and the incumbent row, being diffed against
+the journal, yields the replay-divergence count for free
+(`replayDivergences` in the summary; `trace replay` exits 1 on divergence,
+and a what-if over a diverging journal is measuring noise). Fleet-edit
+what-ifs keep the per-wave re-solve (the edited snapshot cannot share the
+recorded encode) and report `replayDivergences: null` — not measured.
 """
 
 from __future__ import annotations
@@ -129,6 +139,10 @@ class WhatIfReport:
                     cf["meanPlacementScore"] - rec["meanPlacementScore"], 4
                 ),
             },
+            # Fleet-edit path: divergence is NOT measurable without an extra
+            # replay (the counterfactual legitimately differs). The
+            # config-override path (WhatIfConfigsReport) measures it free.
+            "replayDivergences": None,
             "recordedSolveSeconds": round(
                 sum(w.recorded_solve_s for w in self.waves), 4
             ),
@@ -138,6 +152,116 @@ class WhatIfReport:
         }
 
 
+@dataclass
+class WhatIfConfigsReport:
+    """Config-override what-if via the batched sweep: every variant scored
+    from ONE replay pass, deltas against the incumbent (recorded-config)
+    row, plus the incumbent row's journal divergence count."""
+
+    waves: int
+    incumbent: dict  # incumbent row's tally doc (tuning ConfigTally.to_doc)
+    variants: list  # per-variant tally docs, sweep rank order
+    replay_divergences: int
+    solve_s: float
+
+    def to_doc(self) -> dict:
+        rec = self.incumbent
+
+        def delta(v):
+            return {
+                "admitted": v["admitted"] - rec["admitted"],
+                "admittedRatio": round(
+                    v["admittedRatio"] - rec["admittedRatio"], 4
+                ),
+                "meanPlacementScore": round(
+                    v["meanPlacementScore"] - rec["meanPlacementScore"], 4
+                ),
+            }
+
+        return {
+            "edits": {"variants": [v["config"] for v in self.variants]},
+            "waves": self.waves,
+            "recorded": {
+                k: rec[k]
+                for k in (
+                    "gangs", "admitted", "admittedRatio", "meanPlacementScore",
+                )
+            },
+            "variants": [dict(v, delta=delta(v)) for v in self.variants],
+            "replayDivergences": self.replay_divergences,
+            "solveSeconds": round(self.solve_s, 4),
+        }
+
+
+_WEIGHT_KEYS = {
+    "wTight": "w_tight",
+    "wPref": "w_pref",
+    "wReuse": "w_reuse",
+    "wReserve": "w_reserve",
+    "wSpread": "w_spread",
+}
+
+
+def _variant_config(incumbent, spec: dict, index: int):
+    """One override spec ({"weights": {...}, "portfolio": N,
+    "escalatePortfolio": N, "name": s}) -> SweepConfig based on the
+    incumbent; unknown keys are errors (the config-validation stance)."""
+    from grove_tpu.solver.core import SolverParams
+    from grove_tpu.tuning.sweep import SweepConfig
+
+    allowed = {"weights", "portfolio", "escalatePortfolio", "name"}
+    unknown = set(spec) - allowed
+    if unknown:
+        raise ValueError(f"variant {index}: unknown keys {sorted(unknown)}")
+    weights = {
+        f: float(w) for f, w in zip(SolverParams._fields, incumbent.weights)
+    }
+    for key, val in (spec.get("weights") or {}).items():
+        snake = _WEIGHT_KEYS.get(key, key)
+        if snake not in weights:
+            raise ValueError(f"variant {index}: unknown weight {key!r}")
+        weights[snake] = float(val)
+    return SweepConfig(
+        name=str(spec.get("name") or f"variant-{index}"),
+        weights=tuple(weights[f] for f in SolverParams._fields),
+        portfolio=int(spec.get("portfolio") or incumbent.portfolio),
+        escalate_portfolio=int(
+            spec.get("escalatePortfolio") or incumbent.escalate_portfolio
+        ),
+    )
+
+
+def whatif_configs(
+    records: list, variants: list, *, warm_path=None
+) -> WhatIfConfigsReport:
+    """Score N config-override variants against the recorded trace in ONE
+    sweep pass (incumbent + variants stacked on the solver's variant axis).
+    The incumbent row doubles as the replay-divergence probe."""
+    from grove_tpu.tuning.sweep import incumbent_config, sweep_journal
+
+    if not variants:
+        raise ValueError("whatif_configs needs at least one variant")
+    incumbent = incumbent_config(records)
+    configs = [incumbent] + [
+        _variant_config(incumbent, spec, i) for i, spec in enumerate(variants)
+    ]
+    names = [c.name for c in configs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate variant names: {names}")
+    engine = sweep_journal(records, configs, warm_path=warm_path)
+    inc_tally = engine.tallies["incumbent"]
+    variant_docs = [
+        engine.tallies[c.name].to_doc() for c in configs[1:]
+    ]
+    return WhatIfConfigsReport(
+        waves=engine.waves_seen,
+        incumbent=inc_tally.to_doc(),
+        variants=variant_docs,
+        replay_divergences=inc_tally.divergences,
+        solve_s=sum(t.solve_s for t in engine.tallies.values()),
+    )
+
+
 def whatif_journal(
     records: list[dict],
     *,
@@ -145,12 +269,46 @@ def whatif_journal(
     params: SolverParams | None = None,
     portfolio: int | None = None,
     escalate_portfolio: int | None = None,
+    variants: list | None = None,
     warm_path=None,
-) -> WhatIfReport:
+):
     """Score every recorded wave against the counterfactual world. At least
     one edit (fleet or solver config) should be given — with none this
-    degenerates to a scored replay."""
+    degenerates to a scored replay.
+
+    Config-only edits (no fleet change) return a WhatIfConfigsReport from
+    ONE batched sweep pass — `variants` carries N override specs at ~1x
+    replay cost, and the single params/portfolio/escalate overrides are
+    folded into one variant the same way. Fleet edits (add_rack_count > 0)
+    keep the per-wave re-solve path and may combine with a config override
+    (the counterfactual then changes both)."""
     from grove_tpu.solver.warm import WarmPath
+
+    if variants is not None and add_rack_count:
+        raise ValueError(
+            "config-override variants cannot combine with fleet edits — "
+            "the sweep shares the RECORDED encode across variants"
+        )
+    if add_rack_count == 0:
+        specs = list(variants or [])
+        if not specs and (
+            params is not None
+            or portfolio is not None
+            or escalate_portfolio is not None
+        ):
+            spec: dict = {}
+            if params is not None:
+                spec["weights"] = {
+                    f: float(w)
+                    for f, w in zip(SolverParams._fields, params)
+                }
+            if portfolio is not None:
+                spec["portfolio"] = int(portfolio)
+            if escalate_portfolio is not None:
+                spec["escalatePortfolio"] = int(escalate_portfolio)
+            specs = [spec]
+        if specs:
+            return whatif_configs(records, specs, warm_path=warm_path)
 
     warm = warm_path if warm_path is not None else WarmPath()
     fleets: dict[str, dict] = {}

@@ -1,0 +1,26 @@
+"""Offline solver tuning from flight-recorder traces (ROADMAP item 3).
+
+`sweep` replays a recorded journal ONCE per wave while scoring K candidate
+solver configs stacked on the solver's variant axis; `search` drives a
+successive-halving schedule over a config grid and emits a validated
+recommended-config document. See docs/design.md "Offline tuning".
+"""
+
+from grove_tpu.tuning.search import recommend, successive_halving
+from grove_tpu.tuning.sweep import (
+    SweepConfig,
+    SweepEngine,
+    default_grid,
+    incumbent_config,
+    sweep_journal,
+)
+
+__all__ = [
+    "SweepConfig",
+    "SweepEngine",
+    "default_grid",
+    "incumbent_config",
+    "recommend",
+    "successive_halving",
+    "sweep_journal",
+]

@@ -1,0 +1,236 @@
+"""Successive-halving search over a solver-config grid, from one trace.
+
+The sweep engine (tuning/sweep.py) makes scoring K configs cost ~one replay;
+halving makes the K axis SHRINK while the trace plays: after each rung (a
+contiguous chunk of the journal's segments) the bottom half of the surviving
+grid is dropped, so late segments — where per-wave cost is K-proportional on
+the stacked axis — run at K/2, K/4, ... The incumbent (recorded) config
+never halves away: it is the safety baseline every candidate must beat AND
+the replay-divergence probe (its row must reproduce the journal bitwise).
+
+The winner is validated two ways before it is recommended:
+
+1. **Bitwise replay agreement** (the PR 4 contract, extended): the winner's
+   sweep-row plans must equal a plain single-config replay of the same
+   journal under the winner config — a sweep solve that diverges from the
+   production solve is a bug, not a recommendation.
+2. **Exact-reference audit** (quality/audit.py): the winner's admitted ratio
+   against the exact branch-and-bound optimum on the seeded tier-1 audit
+   instances must be >= the incumbent's — tuning cannot trade admitted
+   ratio for placement score.
+
+A recommendation that fails either gate is emitted with `"valid": false`
+and the failing gate named; callers (the `tune sweep` CLI, `make
+bench-sweep`) treat that as exit 1.
+"""
+
+from __future__ import annotations
+
+import math
+
+from grove_tpu.trace.replay import diff_wave, snapshot_from_wave, solve_wave_record
+from grove_tpu.tuning.sweep import (
+    SweepConfig,
+    SweepEngine,
+    default_grid,
+    incumbent_config,
+)
+
+
+def _wave_count(records: list) -> int:
+    return sum(1 for r in records if r.get("kind") == "wave")
+
+
+def _chunk_records(records: list, rungs: int) -> list[list]:
+    """Split a flat record list into `rungs` contiguous chunks of roughly
+    equal WAVE counts (fleet records ride with the chunk they precede; the
+    engine caches fleets across chunks, so boundaries are safe)."""
+    total = _wave_count(records)
+    if total == 0:
+        raise ValueError("journal contains no wave records — nothing to sweep")
+    rungs = max(1, min(rungs, total))
+    per = math.ceil(total / rungs)
+    chunks: list[list] = [[]]
+    waves_in_chunk = 0
+    for rec in records:
+        if waves_in_chunk >= per and rec.get("kind") == "wave" and len(chunks) < rungs:
+            chunks.append([])
+            waves_in_chunk = 0
+        chunks[-1].append(rec)
+        if rec.get("kind") == "wave":
+            waves_in_chunk += 1
+    return chunks
+
+
+def successive_halving(
+    records: list,
+    grid: list,
+    *,
+    rungs: int = 3,
+    min_configs: int = 2,
+    warm_path=None,
+) -> tuple[SweepEngine, list]:
+    """Sweep `records` under `grid`, halving the surviving set between
+    rungs. Returns (engine, schedule) where schedule is one doc per rung:
+    the survivors that entered it and their standing when it closed."""
+    engine = SweepEngine(grid, warm_path=warm_path)
+    chunks = _chunk_records(records, rungs)
+    schedule: list[dict] = []
+    for ri, chunk in enumerate(chunks):
+        entered = [c.name for c in engine.configs]
+        engine.consume(chunk)
+        ranked = sorted(
+            (engine.tallies[n] for n in entered),
+            key=lambda t: t.rank_key(),
+            reverse=True,
+        )
+        schedule.append(
+            {
+                "rung": ri,
+                "waves": _wave_count(chunk),
+                "configs": entered,
+                "ranking": [
+                    {
+                        "name": t.config.name,
+                        "admitted": t.admitted,
+                        "admittedRatio": round(t.admitted_ratio, 4),
+                        "meanPlacementScore": round(t.mean_score, 4),
+                    }
+                    for t in ranked
+                ],
+            }
+        )
+        if ri < len(chunks) - 1 and len(entered) > min_configs:
+            keep_n = max(min_configs, math.ceil(len(entered) / 2))
+            survivors = {t.config.name for t in ranked[:keep_n]}
+            survivors.add("incumbent")  # the baseline never halves away
+            survivors &= set(entered)
+            engine.keep(survivors)
+    return engine, schedule
+
+
+def _validate_bitwise(records: list, winner, tally, warm) -> dict:
+    """Gate 1: a plain single-config replay of the journal under the winner
+    config must reproduce the winner's sweep-row plans bitwise."""
+    fleets: dict[str, dict] = {}
+    divergences = 0
+    waves = 0
+    diverged: list = []
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "fleet":
+            fleets[rec["digest"]] = rec
+            continue
+        if kind != "wave":
+            continue
+        snapshot = snapshot_from_wave(rec, fleets[rec["fleet"]])
+        plan, ok, scores, _s = solve_wave_record(
+            rec,
+            snapshot,
+            warm=warm,
+            params=winner.solver_params(),
+            portfolio=winner.portfolio,
+            escalate_portfolio=winner.escalate_portfolio,
+        )
+        sweep_plan, sweep_ok, sweep_scores = tally.plans[waves]
+        pseudo = {"ok": sweep_ok, "plan": sweep_plan, "scores": sweep_scores}
+        diffs = diff_wave(pseudo, plan, ok, scores)
+        if diffs:
+            divergences += len(diffs)
+            if len(diverged) < 3:
+                diverged.append({"wave": waves, "diffs": diffs})
+        waves += 1
+    out = {"waves": waves, "divergences": divergences}
+    if diverged:
+        out["diverged"] = diverged
+    return out
+
+
+def _validate_exact(winner, incumbent, seeds=None) -> dict:
+    """Gate 2: winner admitted ratio vs the exact optimum must not fall
+    below the incumbent's on the seeded audit instances."""
+    from grove_tpu.quality.audit import AUDIT_SEEDS, audit_config
+
+    seeds = tuple(seeds) if seeds else AUDIT_SEEDS
+
+    def run(cfg):
+        return audit_config(
+            cfg.weights,
+            portfolio=cfg.portfolio,
+            escalate_portfolio=cfg.escalate_portfolio,
+            seeds=seeds,
+        )
+
+    w = run(winner)
+    inc = run(incumbent) if winner.name != incumbent.name else w
+    return {
+        "seeds": list(seeds),
+        "winner": w.to_doc(),
+        "incumbent": inc.to_doc(),
+        "admittedPass": w.admitted >= inc.admitted,
+    }
+
+
+def recommend(
+    records: list,
+    *,
+    grid: list | None = None,
+    k: int = 16,
+    rungs: int = 3,
+    spread: float = 0.5,
+    seed: int = 0,
+    audit_seeds=None,
+    warm_path=None,
+) -> dict:
+    """Full tuning pass: grid -> halving sweep -> validated recommendation.
+
+    Returns the recommended-config JSON document (see module docstring for
+    the gates). `grid` overrides the default grid (row 0 must then be the
+    incumbent-named baseline)."""
+    from grove_tpu.solver.warm import WarmPath
+
+    warm = warm_path if warm_path is not None else WarmPath()
+    incumbent = incumbent_config(records)
+    if grid is None:
+        grid = default_grid(incumbent, k, spread=spread, seed=seed)
+    engine, schedule = successive_halving(
+        records, grid, rungs=rungs, warm_path=warm
+    )
+    finalists = [engine.tallies[c.name] for c in engine.configs]
+    winner_tally = max(finalists, key=lambda t: t.rank_key())
+    winner = winner_tally.config
+    incumbent_tally = engine.tallies["incumbent"]
+
+    bitwise = _validate_bitwise(records, winner, winner_tally, warm)
+    exact = _validate_exact(winner, incumbent, seeds=audit_seeds)
+    replay_divergences = incumbent_tally.divergences
+    valid = (
+        bitwise["divergences"] == 0
+        and exact["admittedPass"]
+        and replay_divergences == 0
+    )
+    failed = []
+    if bitwise["divergences"]:
+        failed.append("bitwiseReplay")
+    if not exact["admittedPass"]:
+        failed.append("exactAudit")
+    if replay_divergences:
+        failed.append("journalReplay")
+    doc = {
+        "winner": winner.to_doc(),
+        "incumbent": incumbent.to_doc(),
+        "valid": valid,
+        "grid": len(grid),
+        "rungs": schedule,
+        "sweep": engine.to_doc(),
+        "winnerTally": winner_tally.to_doc(),
+        "incumbentTally": incumbent_tally.to_doc(),
+        "validation": {
+            "bitwiseReplay": bitwise,
+            "journalReplayDivergences": replay_divergences,
+            "exactAudit": exact,
+        },
+    }
+    if failed:
+        doc["failedGates"] = failed
+    return doc

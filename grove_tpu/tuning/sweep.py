@@ -1,0 +1,525 @@
+"""Batched config-sweep replay: score K solver configs from one trace pass.
+
+Naive offline tuning replays the journal once per candidate config — K full
+replays, K re-encodes, K solve dispatches per wave. This engine replays each
+wave ONCE: the encode closure is rebuilt a single time from the wave record
+(exactly as trace/replay.py does), and the K candidate weight vectors ride
+the solver's existing variant axis (`core.stacked_solve_batch`, the same
+vmap-over-SolverParams the portfolio path uses) through ONE warm-path AOT
+executable keyed on (wave shape bucket, K). Per-config verdict planes come
+back as a leading [K] axis and decode through the batched
+`core.decode_bindings`.
+
+Exactness contract (what lets sweep results be trusted as production
+predictions): row k of the stacked solve is BITWISE-identical to a
+single-config solve under config k — vmap batches the identical op sequence
+(pinned in tests/test_tuning.py). Paths the stacked solve cannot express
+bitwise fall back to the production `core.solve` for the affected row only:
+
+  - portfolio > 1 configs (already multi-variant themselves),
+  - portfolio-escalation rows (a row's base solve left valid gangs
+    rejected and its config escalates — production would re-solve wider),
+  - candidate-pruned rows whose lossy witness fired on a rejection
+    (production re-solves dense before the rejection stands).
+
+Those fallbacks run the exact code production runs, so every row's verdicts
+equal what a plain single-config replay of the journal would produce — the
+PR 4 contract extended to counterfactual configs. The row matching the
+RECORDED solver fingerprint is additionally diffed against the journal's
+plans: its divergence count is the replay-divergence gate (`trace replay`
+exits 1 on it), surfaced so a sweep over a corrupt journal cannot quietly
+recommend garbage.
+
+Pruned waves journaled from the pipelined drain carry their candidate list;
+the sweep rebuilds the exact gather (`pruning.plan_from_indices`) once and
+shares it across all K rows — candidate selection is config-independent, so
+the gather cost does not scale with K either.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from grove_tpu.solver.core import SolverParams, decode_bindings, solve
+from grove_tpu.solver.encode import encode_gangs
+from grove_tpu.trace.replay import diff_wave, nodes_from_fleet, snapshot_from_wave
+from grove_tpu.utils import serde
+
+_N_WEIGHTS = len(SolverParams._fields)
+
+
+@dataclass(frozen=True)
+class SweepConfig:
+    """One candidate solver config in the sweep grid."""
+
+    name: str
+    weights: tuple  # floats, SolverParams field order
+    portfolio: int = 1
+    escalate_portfolio: int = 1
+
+    def solver_params(self) -> SolverParams:
+        return SolverParams(*(float(w) for w in self.weights))
+
+    def matches_fingerprint(self, cfg: dict) -> bool:
+        """True iff this config IS the recorded solver fingerprint — its
+        sweep row must then reproduce the journal bitwise."""
+        return (
+            [float(w) for w in self.weights] == [float(w) for w in cfg["params"]]
+            and self.portfolio == int(cfg["portfolio"])
+            and self.escalate_portfolio == int(cfg["escalatePortfolio"])
+        )
+
+    def to_doc(self) -> dict:
+        return {
+            "name": self.name,
+            "weights": {
+                f: float(w) for f, w in zip(SolverParams._fields, self.weights)
+            },
+            "portfolio": self.portfolio,
+            "escalatePortfolio": self.escalate_portfolio,
+        }
+
+
+def incumbent_config(records: list) -> SweepConfig:
+    """The recorded solver fingerprint as a SweepConfig (from the first wave
+    record — the journal's production config). Raises on a journal with no
+    waves: there is nothing to tune against."""
+    for rec in records:
+        if rec.get("kind") == "wave":
+            cfg = rec["solver"]
+            return SweepConfig(
+                name="incumbent",
+                weights=tuple(float(w) for w in cfg["params"]),
+                portfolio=int(cfg["portfolio"]),
+                escalate_portfolio=int(cfg["escalatePortfolio"]),
+            )
+    raise ValueError("journal contains no wave records — nothing to sweep")
+
+
+def default_grid(
+    incumbent: SweepConfig,
+    k: int,
+    *,
+    spread: float = 0.5,
+    seed: int = 0,
+) -> list[SweepConfig]:
+    """K-config grid around the incumbent: row 0 is the incumbent itself
+    (the safety baseline AND the replay-divergence probe), the rest are
+    deterministic log-normal weight perturbations with packing-polarity
+    diversity (odd rows flip w_tight's sign — the portfolio population's
+    worst-fit trick, parallel/portfolio.py) and an escalation axis (every
+    fourth row disables portfolio escalation, pricing the escalation knob
+    against its admitted-ratio payoff)."""
+    if k < 1:
+        raise ValueError(f"grid size {k} < 1")
+    rng = np.random.default_rng(seed)
+    factors = np.exp(
+        rng.normal(0.0, spread, size=(k, _N_WEIGHTS))
+    ).astype(np.float64)
+    factors[0, :] = 1.0
+    base = np.asarray([float(w) for w in incumbent.weights], dtype=np.float64)
+    stack = factors * base[None, :]
+    tight_i = SolverParams._fields.index("w_tight")
+    stack[1::2, tight_i] *= -1.0
+    grid = [
+        SweepConfig(
+            name="incumbent",
+            weights=incumbent.weights,
+            portfolio=incumbent.portfolio,
+            escalate_portfolio=incumbent.escalate_portfolio,
+        )
+    ]
+    for i in range(1, k):
+        esc = 1 if i % 4 == 3 else incumbent.escalate_portfolio
+        grid.append(
+            SweepConfig(
+                name=f"cand-{i:02d}",
+                weights=tuple(float(x) for x in stack[i]),
+                portfolio=incumbent.portfolio,
+                escalate_portfolio=esc,
+            )
+        )
+    return grid
+
+
+@dataclass
+class ConfigTally:
+    """One config's accumulated outcome over the waves it has seen."""
+
+    config: SweepConfig
+    waves: int = 0
+    gangs: int = 0  # solver-valid gangs offered
+    admitted: int = 0
+    score_sum: float = 0.0  # placement score over admitted gangs
+    solve_s: float = 0.0  # attributed share of the stacked wave cost
+    escalations: int = 0  # production-semantics fallback rows (this config)
+    divergences: int = 0  # vs recorded plans (fingerprint-matching rows only)
+    # Per wave, in consumption order: (plan, ok_by_name, scores_by_name) —
+    # retained for winner validation (bitwise vs a standalone replay).
+    plans: list = field(default_factory=list)
+
+    @property
+    def admitted_ratio(self) -> float:
+        return self.admitted / self.gangs if self.gangs else 0.0
+
+    @property
+    def mean_score(self) -> float:
+        return self.score_sum / self.admitted if self.admitted else 0.0
+
+    def rank_key(self) -> tuple:
+        """Halving/winner order: admitted first (the gang contract), quality
+        tie-break, then name for determinism."""
+        return (self.admitted, self.score_sum, self.config.name)
+
+    def to_doc(self) -> dict:
+        return {
+            "config": self.config.to_doc(),
+            "waves": self.waves,
+            "gangs": self.gangs,
+            "admitted": self.admitted,
+            "admittedRatio": round(self.admitted_ratio, 4),
+            "meanPlacementScore": round(self.mean_score, 4),
+            "solveSeconds": round(self.solve_s, 4),
+            "escalations": self.escalations,
+            "divergences": self.divergences,
+        }
+
+
+class SweepEngine:
+    """Replays journal records once, scoring every active config per wave.
+
+    Feed it record batches (whole journal, or segment-by-segment for the
+    halving driver) via `consume`; drop losing configs between batches with
+    `keep`. Fleet records are cached across batches, so segment-by-segment
+    consumption works on flat record lists too."""
+
+    def __init__(self, configs: list, *, warm_path=None) -> None:
+        from grove_tpu.solver.warm import WarmPath
+
+        if not configs:
+            raise ValueError("sweep needs at least one config")
+        names = [c.name for c in configs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate config names in grid: {names}")
+        self.configs = list(configs)
+        self.warm = warm_path if warm_path is not None else WarmPath()
+        self.tallies: dict[str, ConfigTally] = {
+            c.name: ConfigTally(c) for c in configs
+        }
+        self.waves_seen = 0
+        self.stacked_solves = 0
+        self.fallback_solves = 0  # production-semantics per-row re-solves
+        self._fleets: dict[str, dict] = {}
+        self._fleet_nodes: dict[str, list] = {}
+
+    # ---- grid management ---------------------------------------------------
+
+    def keep(self, names: set) -> None:
+        """Restrict the active grid to `names` (halving): eliminated configs
+        keep their tallies' aggregates for the report but stop accruing."""
+        survivors = [c for c in self.configs if c.name in names]
+        if not survivors:
+            raise ValueError("halving eliminated every config")
+        self.configs = survivors
+
+    def _param_stack(self) -> SolverParams:
+        stack = np.asarray(
+            [[float(w) for w in c.weights] for c in self.configs],
+            dtype=np.float32,
+        )  # [K, W]
+        return SolverParams(*(stack[:, i] for i in range(_N_WEIGHTS)))
+
+    # ---- consumption -------------------------------------------------------
+
+    def consume(self, records: list) -> None:
+        """Process one batch of journal records (fleets + waves)."""
+        for rec in records:
+            kind = rec.get("kind")
+            if kind == "fleet":
+                self._fleets[rec["digest"]] = rec
+                continue
+            if kind != "wave":
+                continue
+            fleet = self._fleets.get(rec["fleet"])
+            if fleet is None:
+                raise ValueError(
+                    f"wave {self.waves_seen} references fleet {rec['fleet']!r} "
+                    "missing from this journal — cannot sweep (recorder drops? "
+                    "check `grove-tpu trace info` recorderDropped)"
+                )
+            self._wave(rec, fleet)
+            self.waves_seen += 1
+
+    def _wave(self, rec: dict, fleet: dict) -> None:
+        t0 = time.perf_counter()
+        gangs = [serde.decode(d) for d in rec["gangs"]]
+        pods = {n: serde.decode(d) for n, d in rec["pods"].items()}
+        nodes = self._fleet_nodes.get(rec["fleet"])
+        if nodes is None:
+            nodes = self._fleet_nodes[rec["fleet"]] = nodes_from_fleet(fleet)
+        snapshot = snapshot_from_wave(rec, fleet, nodes=nodes)
+        cfg = rec["solver"]
+
+        # One encode for all K rows — the same closure replay rebuilds.
+        batch, decode = encode_gangs(
+            gangs,
+            pods,
+            snapshot,
+            max_groups=rec.get("maxGroups"),
+            max_sets=rec.get("maxSets"),
+            max_pods=rec.get("maxPods"),
+            pad_gangs_to=rec.get("padGangsTo"),
+            scheduled_gangs=set(rec.get("scheduled", [])),
+            bound_nodes_by_group=rec.get("boundNodes") or None,
+            reuse_nodes_by_gang=rec.get("reuseNodes") or None,
+            spread_avoid_by_gang=rec.get("spreadAvoid") or None,
+        )
+        valid_np = np.asarray(batch.gang_valid, dtype=bool)
+
+        free_override = None
+        if rec.get("freeRows"):
+            free_override = np.array(
+                snapshot.capacity, dtype=np.float32, copy=True
+            )
+            for name, row in rec["freeRows"].items():
+                if name in snapshot.node_index_map:
+                    free_override[snapshot.node_index(name)] = np.asarray(
+                        row, np.float32
+                    )
+
+        pruning = None
+        pr = cfg.get("pruning")
+        if pr and pr.get("enabled"):
+            from grove_tpu.solver.pruning import PruningConfig
+
+            pruning = PruningConfig(
+                enabled=True,
+                max_candidates=int(pr.get("maxCandidates", 8191)),
+                pad_ladder=tuple(pr.get("padLadder", ())),
+                min_pad=int(pr.get("minPad", 64)),
+                min_fleet=int(pr.get("minFleet", 256)),
+            )
+        mesh_fp = cfg.get("mesh")
+
+        rows = self._solve_rows(
+            rec, snapshot, batch, valid_np, free_override, pruning, mesh_fp
+        )
+        elapsed = time.perf_counter() - t0
+
+        per_cfg = elapsed / max(len(self.configs), 1)
+        for config, (ok_row, assigned_row, score_row) in zip(self.configs, rows):
+            plan = decode_bindings(ok_row, assigned_row, decode, snapshot)
+            ok = dict(
+                zip(decode.gang_names, (bool(x) for x in np.asarray(ok_row)))
+            )
+            scores = dict(
+                zip(
+                    decode.gang_names,
+                    (float(x) for x in np.asarray(score_row)),
+                )
+            )
+            tally = self.tallies[config.name]
+            tally.waves += 1
+            tally.gangs += int(valid_np.sum())
+            ok_arr = np.asarray(ok_row, dtype=bool)[: len(decode.gang_names)]
+            tally.admitted += int(ok_arr.sum())
+            tally.score_sum += float(
+                np.asarray(score_row)[: len(decode.gang_names)][ok_arr].sum()
+            )
+            tally.solve_s += per_cfg
+            tally.plans.append((plan, ok, scores))
+            if config.matches_fingerprint(cfg):
+                tally.divergences += len(diff_wave(rec, plan, ok, scores))
+
+    # ---- the per-wave K-row solve ------------------------------------------
+
+    def _solve_rows(
+        self, rec, snapshot, batch, valid_np, free_override, pruning, mesh_fp
+    ) -> list:
+        """One wave under every active config: [(ok [G], assigned [G, MP],
+        score [G])] in config order, each row bitwise-equal to the
+        production solve under that config."""
+        import jax.numpy as jnp
+
+        from grove_tpu.solver.encode import GangBatch
+
+        cfg = rec["solver"]
+        g = int(valid_np.shape[0])
+        rows: list = [None] * len(self.configs)
+
+        candidates = rec.get("candidates")
+        if candidates is not None:
+            # Recorded-candidate waves replay single-variant regardless of
+            # portfolio (trace/replay.py's candidates branch does the same:
+            # the recorded gather fixes the sub-fleet and the verdicts were
+            # journaled post-escalation) — every row stacks.
+            stackable = list(range(len(self.configs)))
+        else:
+            stackable = [
+                i for i, c in enumerate(self.configs) if c.portfolio == 1
+            ]
+        pplan = None
+        if candidates is not None and pruning is not None:
+            # Pipelined pruned wave: rebuild the exact recorded gather once;
+            # it is config-independent, so all K rows share it. Escalation is
+            # moot (trace/replay.py): a wave whose dense re-solve changed a
+            # verdict was journaled AS dense.
+            from grove_tpu.solver.pruning import plan_from_indices
+
+            pplan = plan_from_indices(
+                snapshot,
+                candidates,
+                pruning,
+                g,
+                mesh_axis=int(mesh_fp.get("node", 1)) if mesh_fp else 1,
+            )
+        elif (
+            pruning is not None
+            and free_override is None
+            and stackable
+        ):
+            # Snapshot-state pruned wave (controller path): re-cut the
+            # candidate plan exactly as core.solve would — same inputs, same
+            # plan — shared across rows. The recorded mesh fingerprint
+            # negotiates the pad (executable shape identity with replay).
+            from grove_tpu.solver.pruning import plan_candidates
+
+            mesh_axis = 1
+            if mesh_fp:
+                from grove_tpu.parallel.mesh import layout_from_fingerprint
+
+                layout = layout_from_fingerprint(
+                    mesh_fp, int(np.asarray(snapshot.capacity).shape[0])
+                )
+                mesh_axis = layout.node_devices if layout is not None else 1
+            pplan = plan_candidates(
+                snapshot, batch, pruning, mesh_axis=mesh_axis
+            )
+
+        if stackable:
+            pstack_full = self._param_stack()
+            sel = np.asarray(stackable, dtype=np.int64)
+            pstack = SolverParams(*(np.asarray(w)[sel] for w in pstack_full))
+            free_np = (
+                free_override
+                if free_override is not None
+                else np.asarray(snapshot.free, np.float32)
+            )
+            if pplan is not None:
+                pbatch = pplan.gather_batch(batch)
+                jpbatch = GangBatch(
+                    *(None if x is None else jnp.asarray(x) for x in pbatch)
+                )
+                result = self.warm.executables.solve_stacked(
+                    jnp.asarray(pplan.gather_free(free_np)),
+                    jnp.asarray(pplan.capacity),
+                    jnp.asarray(pplan.schedulable),
+                    jnp.asarray(pplan.node_domain_id),
+                    jpbatch,
+                    pstack,
+                    coarse_dmax=pplan.coarse_dmax(),
+                )
+                assigned_k = pplan.remap_assigned(np.asarray(result.assigned))
+            else:
+                from grove_tpu.solver.core import coarse_dmax_of
+
+                jbatch = GangBatch(
+                    *(None if x is None else jnp.asarray(x) for x in batch)
+                )
+                result = self.warm.executables.solve_stacked(
+                    jnp.asarray(free_np),
+                    jnp.asarray(snapshot.capacity),
+                    jnp.asarray(snapshot.schedulable),
+                    jnp.asarray(snapshot.node_domain_id),
+                    jbatch,
+                    pstack,
+                    coarse_dmax=coarse_dmax_of(snapshot),
+                )
+                assigned_k = np.asarray(result.assigned)
+            self.stacked_solves += 1
+            ok_k = np.asarray(result.ok, dtype=bool)
+            score_k = np.asarray(result.placement_score)
+            recut_pruned = pplan is not None and candidates is None
+            for j, i in enumerate(stackable):
+                config = self.configs[i]
+                needs_fallback = False
+                if recut_pruned:
+                    # Production would escalate a lossy pruned rejection to a
+                    # dense re-solve; mirror it through core.solve itself.
+                    from grove_tpu.solver.pruning import lossy_rejections
+
+                    if lossy_rejections(pplan, valid_np, ok_k[j]).any():
+                        needs_fallback = True
+                if (
+                    candidates is None
+                    and config.escalate_portfolio > config.portfolio
+                    and bool(np.any(valid_np & ~ok_k[j]))
+                ):
+                    # Portfolio escalation would fire in production.
+                    needs_fallback = True
+                if needs_fallback:
+                    rows[i] = self._solve_row_production(
+                        rec, snapshot, batch, free_override, pruning, config
+                    )
+                    tally = self.tallies[config.name]
+                    tally.escalations += 1
+                else:
+                    rows[i] = (ok_k[j], assigned_k[j], score_k[j])
+
+        for i, config in enumerate(self.configs):
+            if rows[i] is None:
+                # portfolio > 1 rows: already multi-variant, not stackable —
+                # production semantics straight through core.solve.
+                rows[i] = self._solve_row_production(
+                    rec, snapshot, batch, free_override, pruning, config
+                )
+        return rows
+
+    def _solve_row_production(
+        self, rec, snapshot, batch, free_override, pruning, config: SweepConfig
+    ):
+        """The guaranteed-bitwise fallback: the production `core.solve` under
+        this config, exactly as a standalone replay would run it (the
+        candidates branch never lands here — see _solve_rows)."""
+        self.fallback_solves += 1
+        result = solve(
+            snapshot,
+            batch,
+            config.solver_params(),
+            free=free_override,
+            portfolio=config.portfolio,
+            escalate_portfolio=config.escalate_portfolio,
+            warm=self.warm,
+            pruning=pruning,
+        )
+        return (
+            np.asarray(result.ok, dtype=bool),
+            np.asarray(result.assigned),
+            np.asarray(result.placement_score),
+        )
+
+    # ---- reporting ---------------------------------------------------------
+
+    def to_doc(self) -> dict:
+        ranked = sorted(
+            self.tallies.values(), key=lambda t: t.rank_key(), reverse=True
+        )
+        return {
+            "waves": self.waves_seen,
+            "stackedSolves": self.stacked_solves,
+            "fallbackSolves": self.fallback_solves,
+            "configs": [t.to_doc() for t in ranked],
+        }
+
+
+def sweep_journal(
+    records: list, configs: list, *, warm_path=None
+) -> SweepEngine:
+    """One-shot sweep of a whole journal under a fixed grid (no halving) —
+    the what-if multi-override entry (trace/whatif.py)."""
+    engine = SweepEngine(configs, warm_path=warm_path)
+    engine.consume(records)
+    return engine

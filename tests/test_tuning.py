@@ -1,0 +1,459 @@
+"""Batched config-sweep replay (grove_tpu/tuning) + its satellites.
+
+The contract stack, strongest first:
+
+1. STACKED BITWISE — row k of `core.stacked_solve_batch` is bit-identical to
+   a single `solve_batch` under config k. Everything the sweep claims rests
+   on this (sweep verdicts ARE production verdicts for that config).
+2. JOURNAL BITWISE — the sweep row matching the recorded solver fingerprint
+   reproduces the journaled plans with zero divergence, INCLUDING journals
+   recorded with candidate pruning and mesh sharding enabled (the K-stacked
+   solve rides the recorded candidate gather; sharded solves are
+   bitwise-equal to unsharded, so the fingerprint row replays bitwise on
+   any host).
+3. SEARCH — successive halving shrinks the grid between trace chunks, never
+   drops the incumbent, and `recommend`'s winner passes (or correctly
+   fails) the bitwise + exact-audit validation gates.
+4. WHAT-IF — config-override what-ifs ride ONE sweep pass and surface the
+   replay-divergence count; the tier-1 smoke pins the K=4 / 3-wave sweep
+   under the 30s CPU budget.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.sim.workloads import (
+    bench_topology,
+    synthetic_backlog,
+    synthetic_cluster,
+)
+from grove_tpu.solver.core import (
+    SolverParams,
+    solve_batch,
+    stacked_solve_batch,
+)
+from grove_tpu.solver.encode import GangBatch, encode_gangs
+from grove_tpu.solver.pruning import PruningConfig
+from grove_tpu.solver.warm import WarmPath
+from grove_tpu.state import build_snapshot
+from grove_tpu.trace.recorder import TraceRecorder, journal_stats, read_journal
+from grove_tpu.tuning import (
+    SweepConfig,
+    default_grid,
+    incumbent_config,
+    recommend,
+    successive_halving,
+    sweep_journal,
+)
+
+TOPO = bench_topology()
+
+
+def _expand(backlog):
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, TOPO)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    return gangs, pods
+
+
+def _problem(racks_per_block=4, n_disagg=10, n_agg=8, n_frontend=8):
+    nodes = synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=racks_per_block
+    )
+    gangs, pods = _expand(
+        synthetic_backlog(
+            n_disagg=n_disagg, n_agg=n_agg, n_frontend=n_frontend
+        )
+    )
+    return gangs, pods, build_snapshot(nodes, TOPO)
+
+
+def _record_drain(tmp_path, *, wave_size=16, pruning=None, mesh=None,
+                  harvest="pipeline", **problem_kw):
+    """Record a drain into a journal; returns (records, bindings, stats)."""
+    from grove_tpu.solver.drain import drain_backlog
+
+    gangs, pods, snap = _problem(**problem_kw)
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    try:
+        bindings, stats = drain_backlog(
+            gangs, pods, snap, wave_size=wave_size, warm_path=WarmPath(),
+            pruning=pruning, harvest=harvest, recorder=rec, mesh=mesh,
+        )
+    finally:
+        rec.stop()
+    return read_journal(str(tmp_path / "journal")), bindings, stats
+
+
+def _stack(k, seed=0, base=(1.0, 4.0, 2.0, 8.0, 1.5)):
+    rng = np.random.default_rng(seed)
+    stack = np.exp(rng.normal(0.0, 0.5, size=(k, 5))).astype(np.float32)
+    stack[0] = 1.0
+    return stack * np.asarray(base, np.float32)[None, :]
+
+
+# --- 1. the stacked-solve bitwise contract -----------------------------------------
+
+
+def test_stacked_rows_bitwise_equal_single_solves():
+    """Every row of the K-stacked solve equals the single-config solve under
+    that row's weights, bitwise across all four result planes."""
+    gangs, pods, snap = _problem(racks_per_block=2, n_disagg=6, n_agg=4,
+                                 n_frontend=4)
+    batch, _ = encode_gangs(gangs, pods, snap)
+    jbatch = GangBatch(*(None if x is None else jnp.asarray(x) for x in batch))
+    args = (
+        jnp.asarray(snap.free),
+        jnp.asarray(snap.capacity),
+        jnp.asarray(snap.schedulable),
+        jnp.asarray(snap.node_domain_id),
+        jbatch,
+    )
+    stack = _stack(5)
+    pstack = SolverParams(*(jnp.asarray(stack[:, i]) for i in range(5)))
+    stacked = stacked_solve_batch(*args, pstack, coarse_dmax=None)
+    for k in range(stack.shape[0]):
+        params = SolverParams(*(jnp.asarray(stack[k, i]) for i in range(5)))
+        single = solve_batch(*args, params, None, coarse_dmax=None)
+        for plane in ("assigned", "ok", "placement_score", "free_after"):
+            a = np.asarray(getattr(stacked, plane)[k])
+            b = np.asarray(getattr(single, plane))
+            assert np.array_equal(a, b), f"row {k} {plane} diverged"
+
+
+def test_stacked_executable_keys_on_k_and_reuses():
+    """The AOT cache keys the stacked solve on (shape bucket, K): same K =
+    zero new lowerings, a different K is a distinct executable."""
+    gangs, pods, snap = _problem(racks_per_block=2, n_disagg=6, n_agg=4,
+                                 n_frontend=4)
+    batch, _ = encode_gangs(gangs, pods, snap)
+    wp = WarmPath()
+    args = (
+        snap.free, snap.capacity, snap.schedulable, snap.node_domain_id, batch,
+    )
+
+    def pstack(k):
+        s = _stack(k)
+        return SolverParams(*(s[:, i] for i in range(5)))
+
+    wp.executables.solve_stacked(*args, pstack(4))
+    low0 = wp.executables.lowerings
+    wp.executables.solve_stacked(*args, pstack(4))
+    assert wp.executables.lowerings == low0, "same (bucket, K) re-lowered"
+    wp.executables.solve_stacked(*args, pstack(2))
+    assert wp.executables.lowerings == low0 + 1, "new K must be a new executable"
+
+
+# --- 2. journal bitwise through the sweep ------------------------------------------
+
+
+def test_sweep_incumbent_row_reproduces_recorded_plans(tmp_path):
+    """Tier-1 smoke (the <30s CPU gate): sweep K=4 configs over a >=3-wave
+    journal; the fingerprint-matching row must reproduce every recorded
+    plan bitwise while counterfactual rows score the same trace."""
+    t0 = time.perf_counter()
+    records, _, stats = _record_drain(tmp_path, wave_size=8)
+    waves = [r for r in records if r.get("kind") == "wave"]
+    assert len(waves) >= 3, "smoke needs a >=3-wave journal"
+    grid = default_grid(incumbent_config(records), 4)
+    engine = sweep_journal(records, grid, warm_path=WarmPath())
+    inc = engine.tallies["incumbent"]
+    assert inc.waves == len(waves)
+    assert inc.divergences == 0, "incumbent sweep row diverged from journal"
+    recorded_admitted = sum(1 for w in waves for v in w["ok"].values() if v)
+    assert inc.admitted == recorded_admitted
+    # Counterfactual rows saw the same trace through the same stacked solves.
+    for cfg in grid[1:]:
+        assert engine.tallies[cfg.name].waves == len(waves)
+    assert engine.stacked_solves > 0
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 30.0, (
+        f"K=4 / {len(waves)}-wave sweep smoke took {elapsed:.1f}s (>=30s)"
+    )
+
+
+def test_sweep_bitwise_on_pruned_and_mesh_sharded_journal(tmp_path):
+    """The satellite pin: a journal recorded with candidate pruning AND mesh
+    sharding enabled replays through the K-stacked sweep path with the
+    matching config row bitwise-equal to the recorded single-config plans
+    (recorded candidate gathers rebuilt once, shared across rows)."""
+    from grove_tpu.parallel.mesh import MeshConfig
+
+    pruning = PruningConfig(
+        enabled=True, max_candidates=120, min_fleet=16, min_pad=8
+    )
+    records, _, stats = _record_drain(
+        tmp_path,
+        wave_size=16,
+        pruning=pruning,
+        mesh=MeshConfig(enabled=True, min_nodes=64),
+        n_disagg=14, n_agg=10, n_frontend=10,
+    )
+    assert stats.journaled_waves > 0 and stats.pruned_waves > 0
+    fps = [r["solver"].get("mesh") for r in records if r.get("kind") == "wave"]
+    assert fps and all(fp == {"portfolio": 1, "node": 8} for fp in fps), (
+        "journal must be mesh-recorded (8-device tier-1 mesh)"
+    )
+    assert any(
+        r.get("candidates") is not None
+        for r in records
+        if r.get("kind") == "wave"
+    ), "journal must carry pruned candidate lists"
+    grid = default_grid(incumbent_config(records), 4)
+    engine = sweep_journal(records, grid, warm_path=WarmPath())
+    inc = engine.tallies["incumbent"]
+    assert inc.divergences == 0, (
+        "K-stacked sweep diverged from the pruned+sharded recording"
+    )
+    assert inc.admitted == sum(
+        1
+        for r in records
+        if r.get("kind") == "wave"
+        for v in r["ok"].values()
+        if v
+    )
+
+
+def test_sweep_escalation_fallback_matches_production(tmp_path):
+    """Waves whose config would portfolio-escalate in production (valid
+    gangs rejected, escalatePortfolio > 1) fall back to the production
+    solve per row — pinned by sweeping a journal RECORDED with escalation
+    (controller path journals escalatePortfolio=4) and checking the
+    incumbent row still reproduces it bitwise."""
+    from grove_tpu.orchestrator.controller import GroveController
+    from grove_tpu.orchestrator.store import Cluster
+    from grove_tpu.sim.simulator import Simulator
+    from grove_tpu.sim.workloads import _clique, _pcs
+
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=1, racks_per_block=2, hosts_per_rack=2,
+        cpu=8.0, tpu=0.0,
+    ):
+        cluster.nodes[n.name] = n
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    ctrl = GroveController(cluster=cluster, topology=TOPO, recorder=rec)
+    sim = Simulator(cluster=cluster, controller=ctrl)
+    for i in range(3):  # 3 rack-packed gangs on 2 racks: rejections
+        pcs = _pcs(
+            f"job{i}", cliques=[_clique("w", 2, "8")], constraint_domain="rack"
+        )
+        cluster.podcliquesets[pcs.metadata.name] = pcs
+    sim.run(30)
+    rec.stop()
+    records = read_journal(str(tmp_path / "journal"))
+    inc = incumbent_config(records)
+    assert inc.escalate_portfolio > 1, "journal must carry escalation"
+    assert any(
+        r.get("rejections") for r in records if r.get("kind") == "wave"
+    ), "journal must carry rejection waves to exercise the fallback"
+    engine = sweep_journal(
+        records, default_grid(inc, 4), warm_path=WarmPath()
+    )
+    assert engine.tallies["incumbent"].divergences == 0
+    assert engine.fallback_solves > 0, (
+        "escalation waves must route through the production fallback"
+    )
+
+
+# --- 3. search: halving + validation gates -----------------------------------------
+
+
+def test_successive_halving_shrinks_grid_and_keeps_incumbent(tmp_path):
+    records, _, _ = _record_drain(tmp_path, wave_size=8)
+    grid = default_grid(incumbent_config(records), 8)
+    engine, schedule = successive_halving(
+        records, grid, rungs=3, warm_path=WarmPath()
+    )
+    sizes = [len(r["configs"]) for r in schedule]
+    assert sizes[0] == 8
+    assert sizes == sorted(sizes, reverse=True) and sizes[-1] < sizes[0], (
+        f"halving never shrank the grid: {sizes}"
+    )
+    for rung in schedule:
+        assert "incumbent" in rung["configs"], "incumbent halved away"
+    # Survivors saw every wave; the eliminated stopped early.
+    total = sum(r["waves"] for r in schedule)
+    for cfg in engine.configs:
+        assert engine.tallies[cfg.name].waves == total
+
+
+def test_recommend_emits_validated_winner(tmp_path):
+    records, _, _ = _record_drain(tmp_path, wave_size=8)
+    doc = recommend(records, k=4, rungs=2, warm_path=WarmPath())
+    assert doc["valid"], doc.get("failedGates")
+    assert doc["validation"]["bitwiseReplay"]["divergences"] == 0
+    assert doc["validation"]["journalReplayDivergences"] == 0
+    audit = doc["validation"]["exactAudit"]
+    assert audit["admittedPass"]
+    assert audit["winner"]["admittedRatio"] >= audit["incumbent"]["admittedRatio"]
+    assert doc["winner"]["name"] in {t["config"]["name"] for t in doc["sweep"]["configs"]}
+
+
+def test_recommend_fails_closed_on_forged_journal(tmp_path):
+    """A journal whose recorded plans cannot be reproduced (forged binding)
+    must fail the journalReplay gate — a sweep over a diverging journal is
+    measuring noise and must not recommend anything."""
+    records, _, _ = _record_drain(tmp_path, wave_size=8)
+    for rec in records:
+        if rec.get("kind") == "wave" and rec["plan"]:
+            gang, bindings = next(iter(rec["plan"].items()))
+            pod = next(iter(bindings))
+            bindings[pod] = "node-that-never-was"
+            break
+    doc = recommend(records, k=2, rungs=1, warm_path=WarmPath())
+    assert not doc["valid"]
+    assert "journalReplay" in doc["failedGates"]
+    assert doc["validation"]["journalReplayDivergences"] >= 1
+
+
+# --- 4. what-if integration + journal drop counters --------------------------------
+
+
+def test_whatif_variants_ride_one_sweep_pass(tmp_path):
+    from grove_tpu.trace.whatif import whatif_journal
+
+    records, _, _ = _record_drain(tmp_path, wave_size=8)
+    report = whatif_journal(
+        records,
+        variants=[
+            {"weights": {"wTight": 2.0}, "name": "tight2"},
+            {"escalatePortfolio": 1, "name": "noesc"},
+        ],
+    )
+    doc = report.to_doc()
+    assert doc["replayDivergences"] == 0
+    names = [v["config"]["name"] for v in doc["variants"]]
+    assert set(names) == {"tight2", "noesc"}
+    waves = sum(1 for r in records if r.get("kind") == "wave")
+    assert doc["waves"] == waves
+    for v in doc["variants"]:
+        assert set(v["delta"]) == {
+            "admitted", "admittedRatio", "meanPlacementScore",
+        }
+
+
+def test_whatif_single_config_override_routes_through_sweep(tmp_path):
+    """portfolio/escalation overrides with no fleet edit ride the sweep too
+    (one pass, divergence surfaced) — the legacy per-wave path is reserved
+    for fleet edits, whose report says divergence was NOT measured."""
+    from grove_tpu.trace.whatif import whatif_journal
+
+    records, _, _ = _record_drain(tmp_path, wave_size=8)
+    doc = whatif_journal(records, escalate_portfolio=2).to_doc()
+    assert "variants" in doc and doc["replayDivergences"] == 0
+    legacy = whatif_journal(records, add_rack_count=1).to_doc()
+    assert legacy["replayDivergences"] is None
+    assert "counterfactual" in legacy
+
+
+def test_whatif_variants_reject_fleet_edit_combination(tmp_path):
+    from grove_tpu.trace.whatif import whatif_journal
+
+    records, _, _ = _record_drain(tmp_path, wave_size=8)
+    with pytest.raises(ValueError, match="fleet edits"):
+        whatif_journal(
+            records, add_rack_count=1, variants=[{"weights": {"wTight": 2.0}}]
+        )
+
+
+def test_journal_segments_carry_drop_counters(tmp_path):
+    """Segments persist the writer's cumulative drop counter so offline
+    consumers can tell a truncated journal from a quiet day; a clean
+    journal reports zero."""
+    records, _, _ = _record_drain(tmp_path, wave_size=8)
+    stats = journal_stats(str(tmp_path / "journal"))
+    assert stats["dropped"] == 0
+    assert stats["recorded"] >= len(records)
+    assert stats["segments"] >= 1
+
+    # A recorder wedged behind a full queue counts its drops into the next
+    # segment it manages to write.
+    rec = TraceRecorder(str(tmp_path / "j2"), queue_size=1)
+    rec.dropped = 7  # simulate drops observed before the flush
+    rec.start()
+    try:
+        rec.capture_action(1.0, "preempt", "g1")
+        rec.flush()
+    finally:
+        rec.stop()
+    stats2 = journal_stats(str(tmp_path / "j2"))
+    assert stats2["dropped"] >= 7
+
+
+def test_sweep_errors_on_missing_fleet_record(tmp_path):
+    records, _, _ = _record_drain(tmp_path, wave_size=8)
+    pruned = [r for r in records if r.get("kind") != "fleet"]
+    grid = default_grid(incumbent_config(pruned), 2)
+    with pytest.raises(ValueError, match="recorderDropped"):
+        sweep_journal(pruned, grid, warm_path=WarmPath())
+
+
+@pytest.mark.slow
+def test_sweep_soak_long_stream_trace():
+    """Long-soak tier (GROVE_BENCH_SWEEP_SOAK analog, excluded from
+    tier-1): a K=16 halving sweep over a long recorded stream trace stays
+    bitwise on the incumbent row and stops lowering new stacked
+    executables once every (shape bucket, K) pairing has been seen."""
+    import shutil
+    import tempfile
+
+    from grove_tpu.sim.workloads import arrival_process, expand_arrivals
+    from grove_tpu.solver.stream import StreamConfig, drain_stream
+
+    evs = arrival_process(5, duration_s=45.0, base_rate=4.0)
+    arrivals, pods = expand_arrivals(evs)
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=2, racks_per_block=4)
+    snap = build_snapshot(nodes, TOPO)
+    journal = tempfile.mkdtemp(prefix="grove-sweep-soak-")
+    rec = TraceRecorder(journal, max_records_per_file=64)
+    rec.start()
+    try:
+        drain_stream(
+            arrivals, pods, snap,
+            config=StreamConfig(depth=2, wave_size=8), recorder=rec,
+        )
+    finally:
+        rec.stop()
+    records = read_journal(journal)
+    shutil.rmtree(journal, ignore_errors=True)
+    wp = WarmPath()
+    grid = default_grid(incumbent_config(records), 16)
+    engine, schedule = successive_halving(records, grid, rungs=4, warm_path=wp)
+    assert engine.tallies["incumbent"].divergences == 0
+    assert [len(r["configs"]) for r in schedule] == [16, 8, 4, 2]
+    lower0 = wp.executables.lowerings
+    engine2, _ = successive_halving(
+        records, default_grid(incumbent_config(records), 16), rungs=4,
+        warm_path=wp,
+    )
+    assert wp.executables.lowerings == lower0, "second sweep re-lowered"
+    assert engine2.tallies["incumbent"].divergences == 0
+
+
+def test_default_grid_shape_and_determinism():
+    inc = SweepConfig(
+        name="incumbent", weights=(1.0, 4.0, 2.0, 8.0, 1.5),
+        portfolio=1, escalate_portfolio=4,
+    )
+    g1 = default_grid(inc, 8, seed=3)
+    g2 = default_grid(inc, 8, seed=3)
+    assert [c.to_doc() for c in g1] == [c.to_doc() for c in g2]
+    assert g1[0].name == "incumbent" and g1[0].weights == inc.weights
+    assert len({c.name for c in g1}) == 8
+    # Polarity diversity: some candidate explores worst-fit packing.
+    assert any(c.weights[0] < 0 for c in g1[1:])
+    # Escalation axis: every 4th candidate prices escalation off.
+    assert any(c.escalate_portfolio == 1 for c in g1[1:])
+    assert any(c.escalate_portfolio == 4 for c in g1[1:])
