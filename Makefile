@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak profile-host dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak bench-chaos bench-chaos-soak profile-host dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -104,6 +104,19 @@ bench-sweep:     ## config-sweep replay: K=16 sweep vs single replay vs serial b
 bench-sweep-soak: ## sweep scenario over a longer recorded trace (slow)
 	@mkdir -p evidence
 	GROVE_BENCH_SCENARIO=sweep GROVE_FORCE_CPU=1 GROVE_BENCH_SWEEP_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_sweep_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+# Chaos-soak scenario: the streaming drain under the standard deterministic
+# fault schedule with the degradation ladder armed — asserts zero lost /
+# double-bound gangs, every injected fault journaled, bounded bind-p99
+# inflation, and ladder recovery to the fast path. Evidence JSON tee'd
+# under evidence/; the soak variant lengthens the trace (slow tier).
+bench-chaos:     ## chaos soak: streaming drain under injected faults + degradation ladder
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=chaos GROVE_FORCE_CPU=1 $(PY) bench.py | tee evidence/bench_chaos_cpu_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+bench-chaos-soak: ## chaos soak over a longer arrival trace (slow)
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=chaos GROVE_FORCE_CPU=1 GROVE_BENCH_CHAOS_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_chaos_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
 
 # Host hot-path profile: cProfile a warm steady-state drain, top cumulative
 # frames + the host-stage ledger as JSON under evidence/.

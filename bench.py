@@ -1134,6 +1134,224 @@ def run_stream_bench() -> dict:
     return out
 
 
+def run_chaos_bench() -> dict:
+    """Chaos-soak scenario (`make bench-chaos` / GROVE_BENCH_SCENARIO=chaos):
+    the streaming drain under a STANDARD deterministic fault schedule, with
+    the degradation ladder armed — the failure-domain acceptance gate.
+
+    Three phases over one warm path:
+
+      1. BASELINE: the arrival trace streamed fault-free (pipelined,
+         pruning on) — the admitted set and bind p99 the chaos run is
+         held to.
+      2. CHAOS: the SAME trace with injected `solver.dispatch` errors and
+         `solver.harvest` hangs (seed-driven, count-limited — the schedule
+         replays bit-for-bit), a flight recorder journaling every wave AND
+         every injected fault, and the ladder stepping the loop down
+         (pruned->dense, pipelined->serial) and back up on probation.
+      3. RECORDER DEGRADE: a dedicated injector fires one ENOSPC into a
+         separate recorder's segment write — the writer must survive in
+         counting-drops mode and stamp the episode into later segments
+         (kept out of phase 2 so its journal stays complete for the
+         fault-accounting gate).
+
+    Gates (vs_baseline is 1.0 only when ALL hold):
+      - zero lost gangs and zero double-bound pods: the chaos run admits
+        exactly the baseline's gang set (the ladder rungs are admitted-set-
+        preserving by construction — this measures that it stays true under
+        live fault traffic), and no pod is bound twice;
+      - every injected fault matched by a journaled action record;
+      - every step-down followed by a step-up: the ladder must END fully
+        closed (fast path restored within the probation window);
+      - bind p99 inflation bounded (<= GROVE_BENCH_CHAOS_P99_CAP, default
+        10x on a timeshared CPU host — chaos may cost latency, never
+        placements).
+
+    GROVE_BENCH_CHAOS_SOAK=1 lengthens the trace (slow tier)."""
+    import tempfile
+
+    from grove_tpu.faults import FaultInjector, SiteSpec
+    from grove_tpu.sim.workloads import (
+        arrival_process,
+        bench_topology,
+        expand_arrivals,
+        synthetic_cluster,
+    )
+    from grove_tpu.solver.pruning import PruningConfig
+    from grove_tpu.solver.resilience import (
+        DegradationLadder,
+        ResilienceConfig,
+    )
+    from grove_tpu.solver.stream import StreamConfig, drain_stream
+    from grove_tpu.solver.warm import WarmPath
+    from grove_tpu.state import build_snapshot
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+
+    soak = os.environ.get("GROVE_BENCH_CHAOS_SOAK", "0") == "1"
+    duration = float(
+        os.environ.get("GROVE_BENCH_CHAOS_DURATION_S", "40" if soak else "12")
+    )
+    rate = float(os.environ.get("GROVE_BENCH_CHAOS_RATE", "8"))
+    seed = int(os.environ.get("GROVE_BENCH_CHAOS_SEED", "20260804"))
+    p99_cap = float(os.environ.get("GROVE_BENCH_CHAOS_P99_CAP", "10"))
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=8, hosts_per_rack=12
+    )
+    snapshot = build_snapshot(nodes, topo)
+    events = arrival_process(seed, duration_s=duration, base_rate=rate)
+    arrivals, pods = expand_arrivals(events, topo)
+    cfg = StreamConfig(depth=2, wave_size=32)
+    pruning = PruningConfig(enabled=True, min_fleet=64)
+    wp = WarmPath()
+
+    def _run(**kw):
+        return drain_stream(
+            arrivals, pods, snapshot, config=cfg, warm_path=wp,
+            pruning=pruning, pipeline=True, **kw,
+        )
+
+    _run()  # warm-up: pays XLA for every shape in the trace
+    b_base, s_base = _run()
+
+    # The standard fault schedule: early dispatch failures deep enough to
+    # defeat the engine's immediate retry (rate 1.0, count-limited), then
+    # harvest hangs mid-trace. Counts are sized so the ladder absorbs the
+    # storm with rungs to spare and the tail of the trace runs clean —
+    # which is what lets the recovery gate demand a fully-closed ladder.
+    injector = FaultInjector(
+        {
+            "solver.dispatch": SiteSpec(kind="error", rate=1.0, count=4, after=2),
+            "solver.harvest": SiteSpec(kind="timeout", rate=1.0, count=3, after=6),
+        },
+        seed=seed,
+    )
+    ladder = DegradationLadder(
+        ResilienceConfig(
+            enabled=True,
+            watchdog_seconds=30.0,
+            max_wave_retries=1,
+            breaker_threshold=2,
+            breaker_window_seconds=300.0,
+            # Saturated replay compresses the whole trace into well under a
+            # second of wall time — probation must be a fraction of THAT
+            # (it still spans many waves; the step-up is a real trial).
+            probation_seconds=0.02,
+        )
+    )
+    trace_dir = tempfile.mkdtemp(prefix="grove-chaos-trace-")
+    recorder = TraceRecorder(trace_dir)
+    recorder.start()
+    injector.recorder = recorder  # injected faults journal as action records
+    try:
+        b_chaos, s_chaos = _run(
+            faults=injector, resilience=ladder, recorder=recorder
+        )
+        recorder.flush()
+    finally:
+        recorder.stop()
+
+    # ---- gates -------------------------------------------------------------
+    lost = sorted(set(b_base) - set(b_chaos))
+    extra = sorted(set(b_chaos) - set(b_base))
+    pod_binds: dict[str, int] = {}
+    for gang_bindings in b_chaos.values():
+        for pod_name in gang_bindings:
+            pod_binds[pod_name] = pod_binds.get(pod_name, 0) + 1
+    double_bound = sorted(p for p, n in pod_binds.items() if n > 1)
+    records = read_journal(trace_dir)
+    journaled_faults = sum(
+        1
+        for r in records
+        if r.get("kind") == "action" and r.get("action") == "fault.injected"
+    )
+    fired = injector.total_fired()
+    counters = ladder.counters()
+    step_downs = sum(c["stepDowns"] for c in counters.values())
+    step_ups = sum(c["stepUps"] for c in counters.values())
+    recovered = ladder.fully_closed() and (step_downs == 0 or step_ups > 0)
+    pct_base = s_base.bind_percentiles((99.0,)) or {}
+    pct_chaos = s_chaos.bind_percentiles((99.0,)) or {}
+    p99_base = pct_base.get(99.0, 0.0)
+    p99_chaos = pct_chaos.get(99.0, 0.0)
+    inflation = (p99_chaos / p99_base) if p99_base > 0 else None
+
+    # Phase 3: recorder ENOSPC survival (its own injector + recorder so the
+    # phase-2 journal stays complete for the fault-accounting gate above).
+    enospc_dir = tempfile.mkdtemp(prefix="grove-chaos-enospc-")
+    enospc_inj = FaultInjector(
+        {"recorder.write": SiteSpec(kind="enospc", rate=1.0, count=1)},
+        seed=seed,
+    )
+    rec2 = TraceRecorder(enospc_dir, max_records_per_file=4)
+    import grove_tpu.faults as faults_mod
+
+    faults_mod.install(enospc_inj)
+    try:
+        rec2.start()
+        for k in range(12):
+            rec2.capture_action(float(k), "chaos.probe", f"obj-{k}")
+        rec2.flush()
+    finally:
+        rec2.stop()
+        faults_mod.install(None)
+    from grove_tpu.trace.recorder import journal_stats
+
+    enospc_stats = journal_stats(enospc_dir)
+    recorder_survived = (
+        rec2.write_errors >= 1
+        and rec2.dropped >= 1
+        and enospc_stats["writeErrors"] >= 1
+        and enospc_stats["degraded"]
+    )
+
+    gates = {
+        "zero_lost_gangs": not lost and not extra,
+        "zero_double_bound_pods": not double_bound,
+        "faults_journaled": journaled_faults == fired and fired > 0,
+        "ladder_recovered": recovered and step_downs > 0,
+        "p99_inflation_bounded": inflation is not None and inflation <= p99_cap,
+        "recorder_counting_drops": recorder_survived,
+    }
+    out = {
+        "scenario": "chaos",
+        "metric": "chaos_bind_p99_inflation",
+        "unit": "x",
+        "value": round(inflation, 3) if inflation is not None else None,
+        "vs_baseline": 1.0 if all(gates.values()) else 0.0,
+        "gates": gates,
+        "soak": soak,
+        "host_cpus": len(os.sched_getaffinity(0)),
+        "nodes": len(nodes),
+        "trace_duration_s": duration,
+        "trace_seed": seed,
+        "gangs_offered": s_chaos.offered,
+        "baseline_admitted": s_base.admitted,
+        "chaos_admitted": s_chaos.admitted,
+        "lost_gangs": lost[:8],
+        "double_bound_pods": double_bound[:8],
+        "faults_fired": fired,
+        "faults_journaled": journaled_faults,
+        "fault_sites": injector.stats()["sites"],
+        "wave_retries": s_chaos.drain.wave_retries,
+        "watchdog_timeouts": s_chaos.drain.watchdog_timeouts,
+        "waves_cancelled": s_chaos.drain.waves_cancelled,
+        "wave_redispatches": s_chaos.drain.wave_redispatches,
+        "ladder": ladder.stats(),
+        "step_downs": step_downs,
+        "step_ups": step_ups,
+        "baseline_bind_p99_s": round(p99_base, 4),
+        "chaos_bind_p99_s": round(p99_chaos, 4),
+        "p99_cap": p99_cap,
+        "baseline_wall_s": round(s_base.wall_s, 3),
+        "chaos_wall_s": round(s_chaos.wall_s, 3),
+        "recorder_write_errors": rec2.write_errors,
+        "recorder_dropped": rec2.dropped,
+    }
+    return out
+
+
 def _shard_worker_problem():
     """The shard scenario's fixed (fleet, backlog): every ladder step solves
     the IDENTICAL problem, so admitted sets must match across device counts
@@ -1576,6 +1794,7 @@ SCENARIOS: dict[str, tuple[str, str, object]] = {
     "stream": ("stream_pipeline_speedup", "x", run_stream_bench),
     "shard": ("shard_solve_speedup", "x", run_shard_bench),
     "sweep": ("sweep_vs_single_replay", "x", run_sweep_bench),
+    "chaos": ("chaos_bind_p99_inflation", "x", run_chaos_bench),
 }
 
 

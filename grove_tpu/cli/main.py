@@ -3,7 +3,7 @@
 Talks to a running manager (`python -m grove_tpu.runtime`) over its object
 API via the typed client. Commands:
 
-  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag|quality   table listing
+  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag|quality|resilience   table listing
   get <kind> <name>                             full object as JSON
   describe <kind> <name>                        human detail + object events
   apply -f <file.yaml>                          admit a PodCliqueSet
@@ -68,6 +68,7 @@ KIND_ALIASES = {
     "solver": "solver",
     "defrag": "defrag",
     "quality": "quality",
+    "resilience": "resilience",
 }
 
 
@@ -255,6 +256,52 @@ def _get_table(client: GroveClient, kind: str) -> str:
                 ["lastPlan.solveSeconds", plan.get("planSolveSeconds", 0)],
             ]
         rows += [[f"counts.{k}", v] for k, v in sorted(counts.items())]
+        return _table(rows, ["METRIC", "VALUE"])
+    if kind == "resilience":
+        # Failure-domain state at a glance: ladder breaker states + step
+        # counters, the bind-path hardening counters, injected-fault ledger,
+        # watch reconnects, recorder counting-drops flag — from /statusz
+        # (the same doc the grove_degradation_* metrics are cut from).
+        doc = client.statusz().get("resilience", {})
+        rows = [["enabled", "yes" if doc.get("enabled") else "no"]]
+        ladder = doc.get("ladder", {})
+        for sub, state in sorted(ladder.get("subsystems", {}).items()):
+            rows.append(
+                [
+                    f"ladder.{sub}",
+                    f"{state.get('state', '?')} "
+                    f"(down {state.get('stepDowns', 0)}, "
+                    f"up {state.get('stepUps', 0)})",
+                ]
+            )
+        if ladder:
+            rows += [
+                ["ladder.waveFailures", ladder.get("waveFailures", 0)],
+                ["ladder.waveSuccesses", ladder.get("waveSuccesses", 0)],
+            ]
+        rows += [
+            [f"binds.{k}", v] for k, v in sorted(doc.get("binds", {}).items())
+        ]
+        rows += [
+            [f"watch.{k}", v] for k, v in sorted(doc.get("watch", {}).items())
+        ]
+        rec = doc.get("recorder")
+        if rec:
+            rows += [
+                ["recorder.degraded", "yes" if rec.get("degraded") else "no"],
+                ["recorder.writeErrors", rec.get("writeErrors", 0)],
+            ]
+        fdoc = doc.get("faults")
+        if fdoc:
+            rows.append(["faults.seed", fdoc.get("seed", 0)])
+            for site, s in sorted(fdoc.get("sites", {}).items()):
+                rows.append(
+                    [
+                        f"faults.{site}",
+                        f"{s.get('kind')} fired {s.get('fired', 0)}/"
+                        f"{s.get('evaluated', 0)} evals",
+                    ]
+                )
         return _table(rows, ["METRIC", "VALUE"])
     if kind == "quality":
         # Placement quality at a glance: the last solve wave's aggregate +
@@ -462,6 +509,11 @@ def _trace_cmd(args) -> int:
             # Replay/sweep consumers need to know before trusting it.
             ["recorderDropped", jstats["dropped"]],
             ["recorderRecorded", jstats["recorded"]],
+            # Counting-drops mode (ENOSPC survival): the writer dropped
+            # whole SEGMENTS to failed disk writes. degraded=True means the
+            # journal has a hole even if the queue never overflowed.
+            ["recorderWriteErrors", jstats["writeErrors"]],
+            ["degraded", jstats["degraded"]],
         ]
         if times:
             rows += [
@@ -474,6 +526,12 @@ def _trace_cmd(args) -> int:
                 f"warning: recorder dropped {jstats['dropped']} record(s) — "
                 "journal is truncated, replay/sweep may fail on missing "
                 "fleets",
+                file=sys.stderr,
+            )
+        if jstats["degraded"]:
+            print(
+                f"warning: recorder degraded — {jstats['writeErrors']} "
+                "segment write(s) failed (ENOSPC/IO); the journal has holes",
                 file=sys.stderr,
             )
         return 0

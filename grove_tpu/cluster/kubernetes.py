@@ -46,7 +46,9 @@ from typing import Callable, Optional
 
 from grove_tpu.api import constants as api_constants
 from grove_tpu.api.quantity import parse_quantity
-from grove_tpu.cluster.watch import EventType, WatchEvent
+from grove_tpu.cluster.watch import EventType, WatchEvent, WatchRetryPolicy
+from grove_tpu import faults as faults_mod
+from grove_tpu.utils.backoff import Backoff
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -350,6 +352,11 @@ class _ResourceWatch:
     # the condition once, not per retry.
     missing_backoff_s: float = 1.0
     _missing_logged: bool = False
+    # Disconnect handling: capped decorrelated-jitter resubscribe pacing +
+    # resync accounting (cluster/watch.py WatchRetryPolicy). Replaces the
+    # old fixed 1s sleep — a flapping apiserver sees spread-out reconnects,
+    # and every reconnect/forced-resync is COUNTED (grove_watch_* metrics).
+    retry: WatchRetryPolicy = field(default_factory=WatchRetryPolicy)
 
 
 class KubernetesWatchSource:
@@ -372,6 +379,10 @@ class KubernetesWatchSource:
         initc_kube_tokens: bool = False,
         qps: float = 50.0,  # ClientConnectionConfiguration.QPS (0 = unlimited)
         burst: int = 100,  # ClientConnectionConfiguration.Burst
+        bind_retry_attempts: int = 1,  # in-call bind retries (resilience.*)
+        transport_retries: int = 1,  # per-request reconnect attempts
+        backoff_base_s: float = 0.05,  # shared decorrelated-jitter pacing
+        backoff_cap_s: float = 2.0,
     ):
         if pod_label_selector is None:
             pod_label_selector = DEFAULT_POD_LABEL_SELECTOR
@@ -423,6 +434,16 @@ class KubernetesWatchSource:
                         missing_backoff_s=30.0,
                     )
                 )
+        # Bind retry (resilience.bindMaxAttempts): attempts per observe_
+        # binding call, decorrelated-jitter paced; 1 = one shot, the
+        # WatchDriver's cross-tick retry set is the outer loop either way.
+        self.bind_retry_attempts = max(1, int(bind_retry_attempts))
+        self.transport_retries = max(0, int(transport_retries))
+        self._backoff_base_s = float(backoff_base_s)
+        self._backoff_cap_s = float(backoff_cap_s)
+        # Monotonic fault-recovery counters (manager -> grove_bind_retries_
+        # total; watch reconnect/resync counters live per _ResourceWatch).
+        self.bind_retries = 0
         # Wire-visible error log (last few), surfaced via statusz/tests.
         self.errors: list[str] = []
         # Managed Services mirrored to the cluster: name -> last manifest.
@@ -477,8 +498,26 @@ class KubernetesWatchSource:
         there), then POST the binding subresource — the scheduler-side bind
         call that turns a solver assignment into a kubelet start.
 
-        Returns False on any API failure so the WatchDriver keeps the pod in
-        its retry set (a transient 500 must not orphan the placement)."""
+        Retry discipline (resilience.bindMaxAttempts): the whole
+        create+bind sequence retries in-call with decorrelated-jitter
+        pacing — both halves are idempotent (409 on create = already there,
+        409 on bind = already bound), so a retry after an ambiguous
+        transport failure converges instead of double-binding. Exhaustion
+        returns False so the WatchDriver keeps the pod in its cross-tick
+        retry set (a transient 500 must not orphan the placement)."""
+        backoff = Backoff(self._backoff_base_s, self._backoff_cap_s)
+        attempt = 0
+        while True:
+            ok = self._observe_binding_once(pod_name, node_name)
+            if ok:
+                return True
+            attempt += 1
+            if attempt >= self.bind_retry_attempts:
+                return False
+            self.bind_retries += 1
+            backoff.sleep()
+
+    def _observe_binding_once(self, pod_name: str, node_name: str) -> bool:
         manifest = (
             self.pod_manifest_for(pod_name) if self.pod_manifest_for else None
         )
@@ -507,6 +546,15 @@ class KubernetesWatchSource:
             self._record_error(f"bind pod {pod_name} -> {node_name}: {e}")
             return False
         return True
+
+    def watch_stats(self) -> dict:
+        """Fault-recovery view of the informer loops (manager /statusz
+        resilience.watch + grove_watch_* metrics)."""
+        return {
+            "reconnects": sum(rw.retry.reconnects for rw in self._watches),
+            "resyncs": sum(rw.retry.resyncs for rw in self._watches),
+            "bindRetries": self.bind_retries,
+        }
 
     def sync_services(self, services: list) -> bool:
         """Mirror the store's HeadlessService objects into real cluster
@@ -1076,6 +1124,7 @@ class KubernetesWatchSource:
             try:
                 rv, names = self._list(rw, known)
                 rw._missing_logged = False
+                rw.retry.note_healthy()
                 known = names
                 while not self._stop.is_set():
                     rv = self._stream_watch(rw, rv, known)
@@ -1094,8 +1143,16 @@ class KubernetesWatchSource:
                     if self._stop.wait(rw.missing_backoff_s):
                         return
                     continue
+                if isinstance(e, KubeApiError) and e.status == 410:
+                    # resourceVersion expired while we were away: the
+                    # relist above IS the full resync (ghost DELETEDs
+                    # synthesized); count it — silent resyncs hide a
+                    # chronically-lagging informer.
+                    rw.retry.note_resync()
                 self._record_error(f"{rw.kind} watch: {e}")
-                if self._stop.wait(1.0):
+                # Capped decorrelated-jitter resubscribe (counted): fast
+                # after one blip, spread out under a flapping apiserver.
+                if self._stop.wait(rw.retry.next_delay()):
                     return
 
     def _list(self, rw: _ResourceWatch, known: set[str]) -> tuple[str, set[str]]:
@@ -1128,6 +1185,14 @@ class KubernetesWatchSource:
             qs["resourceVersion"] = rv
         if rw.selector:
             qs["labelSelector"] = rw.selector
+        # Fault site: a dropped watch stream (network partition, apiserver
+        # restart) surfaces as OSError here; the informer loop resubscribes
+        # with capped backoff and resyncs on 410 — the path this site tests.
+        faults_mod.active().maybe_raise(
+            "watch.disconnect",
+            resource=rw.kind,
+            exc_factory=lambda s: KubeApiError(s, "injected watch fault"),
+        )
         # Stream initiation counts against the bucket (long-lived reads do
         # not — the server's timeoutSeconds already paces re-establishment).
         self.limiter.acquire()
@@ -1201,10 +1266,22 @@ class KubernetesWatchSource:
     ):
         """One apiserver call over a thread-confined persistent connection
         (binding an N-pod gang is 2N calls per tick — a fresh TLS handshake
-        each would tax both sides). A dead cached connection gets exactly
-        one reconnect-and-retry; real API errors propagate as KubeApiError.
-        Every call pays the QPS/Burst token bucket first."""
-        self.limiter.acquire()
+        each would tax both sides). Transport failures retry up to
+        `transport_retries` times paced by decorrelated-jitter backoff
+        (utils/backoff — the shared policy; the first retry is immediate-ish
+        for the common stale-keep-alive case); real API errors propagate as
+        KubeApiError — write idempotency is the CALLER's contract (binding
+        treats 409 as success, deletes treat 404 as success), so blind
+        status-code retries here would be unsafe. Every attempt pays the
+        QPS/Burst token bucket first. The `kube.request` fault site injects
+        409/5xx/transport errors at the top — the whole retry/rollback
+        machinery above this call is exercised by it."""
+        faults_mod.active().maybe_raise(
+            "kube.request",
+            method=method,
+            path=path.split("?")[0],
+            exc_factory=lambda s: KubeApiError(s, "injected apiserver fault"),
+        )
         if query:
             path = f"{path}?{urllib.parse.urlencode(query)}"
         headers = self._headers()
@@ -1212,7 +1289,10 @@ class KubernetesWatchSource:
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
-        for attempt in (0, 1):
+        backoff = Backoff(self._backoff_base_s, self._backoff_cap_s)
+        attempt = 0
+        while True:
+            self.limiter.acquire()
             conn = getattr(self._local, "conn", None)
             if conn is None:
                 conn = self._connect(timeout=self._request_timeout_s)
@@ -1224,9 +1304,14 @@ class KubernetesWatchSource:
             except (OSError, http.client.HTTPException):
                 conn.close()
                 self._local.conn = None
-                if attempt:
+                if attempt >= self.transport_retries:
                     raise
-                continue  # stale keep-alive; one fresh-connection retry
+                attempt += 1
+                if attempt > 1:
+                    # First retry immediate (stale keep-alive is the common
+                    # case and a fresh connection fixes it); later ones pace.
+                    backoff.sleep()
+                continue
             if resp.status >= 300:
                 raise KubeApiError(resp.status, raw[:2048].decode("utf-8", "replace"))
             return json.loads(raw) if raw else None

@@ -33,6 +33,51 @@ from grove_tpu.api.pod import PodPhase
 from grove_tpu.state.cluster import Node
 
 
+@dataclass
+class WatchRetryPolicy:
+    """Resubscribe pacing + resync accounting for an informer loop.
+
+    The reference informer contract under churn: a dropped watch stream
+    RESUBSCRIBES from the last-seen resourceVersion after a capped backoff
+    (decorrelated jitter — a flapping apiserver must not see every informer
+    reconnect in lockstep), and a 410 Gone (resourceVersion expired while
+    we were away) forces a FULL RESYNC (relist + synthesized DELETEDs for
+    ghosts). Both transitions are counted — a cluster whose watches flap is
+    a cluster whose operator should know (grove_watch_* metrics).
+
+    One policy instance per resource watch; `note_healthy()` after a
+    successful list resets the backoff so the next episode starts fast."""
+
+    base_s: float = 0.5
+    cap_s: float = 30.0
+    seed: int | None = None
+    # Monotonic counters (read by the source's stats and the manager).
+    reconnects: int = 0
+    resyncs: int = 0
+    _backoff: object = None
+
+    def _ensure(self):
+        if self._backoff is None:
+            from grove_tpu.utils.backoff import Backoff
+
+            self._backoff = Backoff(self.base_s, self.cap_s, seed=self.seed)
+        return self._backoff
+
+    def next_delay(self) -> float:
+        """Backoff before the next resubscribe attempt (counts a reconnect)."""
+        self.reconnects += 1
+        return self._ensure().next_delay() or self.cap_s
+
+    def note_resync(self) -> None:
+        """A 410 Gone forced a full relist."""
+        self.resyncs += 1
+
+    def note_healthy(self) -> None:
+        """List/watch re-established: next failure episode backs off from
+        the fast first retry again."""
+        self._ensure().reset()
+
+
 class EventType(str, enum.Enum):
     ADDED = "ADDED"
     MODIFIED = "MODIFIED"

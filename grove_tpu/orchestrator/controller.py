@@ -200,6 +200,23 @@ class GroveController:
     # what-if counterfactuals. Tracing is observability: a recorder failure
     # must never break serving, so every hook is exception-contained.
     recorder: object | None = None
+    # Graceful-degradation ladder (solver/resilience.DegradationLadder),
+    # shared with the manager/stream drivers: per-tick solves consult the
+    # breaker states (portfolio -> single, mesh -> unsharded, pruned ->
+    # dense), a failed solve retries once fully degraded and charges the
+    # ladder, and the bind commit path gains retire-time stale-plan
+    # revalidation + all-or-nothing gang bind with rollback. None = the
+    # pre-resilience behavior exactly.
+    resilience: object | None = None
+    # Fault-recovery counters the manager exports (grove_bind_rollbacks_
+    # total etc.); monotonic, delta-exported like defrag_counts.
+    resilience_counts: dict = field(
+        default_factory=lambda: {
+            "bind_rollbacks": 0,
+            "stale_plan_requeues": 0,
+            "solve_degraded_retries": 0,
+        }
+    )
     # Gangs mid-migration (name -> start time); a migration completes when
     # every pod of the gang is scheduled and Ready again. This set IS the
     # disruption budget's denominator.
@@ -831,23 +848,77 @@ class GroveController:
             mesh_layout = resolve_layout(
                 self.mesh_cfg, int(snapshot.free.shape[0])
             )
-        result = solve(
-            snapshot,
-            batch,
-            self.solver_params,
-            portfolio=self.portfolio,
-            escalate_portfolio=esc,
-            # AOT executable cache + device-resident node tensors: a tick
-            # whose shapes recur never re-lowers, and unchanged capacity/
-            # topology/free tensors skip the per-tick host->device upload.
-            warm=self.warm,
-            # Candidate pruning (solver.pruning config): solve on the
-            # gathered sub-fleet; lossy rejections escalate dense.
-            pruning=self.pruning,
-            # Mesh-sharded solve (solver.mesh config): node/candidate axis
-            # split across the device mesh, bitwise-equal to unsharded.
-            mesh=mesh_layout,
-        )
+        # Degradation ladder (solver/resilience.py): open rungs step this
+        # pass down BEFORE solving — portfolio -> single (escalation off),
+        # mesh -> unsharded, pruned -> dense. Every rung is admitted-set-
+        # preserving (the PR 5-7 equivalence family), so a degraded pass
+        # admits the same gangs, just slower.
+        pf, pruning_eff = self.portfolio, self.pruning
+        ladder = self.resilience
+        if ladder is not None:
+            if pf > 1 and not ladder.allows("portfolio"):
+                pf, esc = 1, 1
+            if mesh_layout is not None and not ladder.allows("mesh"):
+                mesh_layout = None
+            if pruning_eff is not None and not ladder.allows("pruning"):
+                pruning_eff = None
+        try:
+            result = solve(
+                snapshot,
+                batch,
+                self.solver_params,
+                portfolio=pf,
+                escalate_portfolio=esc,
+                # AOT executable cache + device-resident node tensors: a tick
+                # whose shapes recur never re-lowers, and unchanged capacity/
+                # topology/free tensors skip the per-tick host->device upload.
+                warm=self.warm,
+                # Candidate pruning (solver.pruning config): solve on the
+                # gathered sub-fleet; lossy rejections escalate dense.
+                pruning=pruning_eff,
+                # Mesh-sharded solve (solver.mesh config): node/candidate axis
+                # split across the device mesh, bitwise-equal to unsharded.
+                mesh=mesh_layout,
+            )
+            if ladder is not None:
+                ladder.record_success()
+        except Exception as e:  # noqa: BLE001 — degrade, never drop the pass
+            if ladder is None:
+                raise
+            # Attribute the failure to the richest optional subsystem that
+            # was actually in play, then retry ONCE fully degraded — dense,
+            # unsharded, single-variant: the configuration that only needs
+            # the device to run one program. A failure there too is real.
+            subsystem = (
+                "portfolio"
+                if pf > 1
+                else "mesh"
+                if mesh_layout is not None
+                else "pruning"
+                if pruning_eff is not None
+                else None
+            )
+            ladder.record_failure(subsystem)
+            self.resilience_counts["solve_degraded_retries"] += 1
+            self._journal_action(
+                now,
+                "resilience.solve_degraded",
+                "floors" if floors_only else "extras",
+                error=str(e)[:200],
+            )
+            result = solve(
+                snapshot,
+                batch,
+                self.solver_params,
+                portfolio=1,
+                escalate_portfolio=1,
+                warm=self.warm,
+                pruning=None,
+                mesh=None,
+            )
+            # The journaled wave must fingerprint the config that actually
+            # solved, or replay rebuilds the wrong executable.
+            pf, esc, pruning_eff, mesh_layout = 1, 1, None, None
         t_decode0 = time.perf_counter()
         bindings = decode_assignments(result, decode, snapshot)
         decode_s = time.perf_counter() - t_decode0
@@ -894,9 +965,9 @@ class GroveController:
                     max_pods=self.max_pods,
                     pad_gangs_to=pad_to,
                     params=self.solver_params,
-                    portfolio=self.portfolio,
+                    portfolio=pf,
                     escalate_portfolio=esc,
-                    pruning=self.pruning,
+                    pruning=pruning_eff,
                     plan=bindings,
                     ok_by_name=ok_by_name,
                     valid_by_name=valid_by_name,
@@ -973,13 +1044,11 @@ class GroveController:
             self._solve_skip_memo.pop(floors_only, None)
         for gang_name, pod_bindings in bindings.items():
             gang = c.podgangs[gang_name]
-            for pod_name, node_name in pod_bindings.items():
-                pod = c.pods.get(pod_name)
-                if pod is None:
-                    continue
-                pod.node_name = node_name
-                pod.scheduling_gates = []
-                pod.phase = PodPhase.PENDING
+            if not self._bind_gang(gang_name, pod_bindings, now):
+                # Stale plan or mid-gang commit failure: the gang's pods are
+                # untouched (still gated), so the next pass re-solves it
+                # against the current fleet — requeued, never half-bound.
+                continue
             if gang_name not in scheduled_names and gang_name not in self._admitted_this_pass:
                 # First admission only: extras top-ups of an already-admitted
                 # gang must not re-emit the admission event, inflate the
@@ -1029,6 +1098,85 @@ class GroveController:
             self.recorder.capture_action(now, action, obj, **fields)
         except Exception:  # noqa: BLE001
             pass
+
+    def _bind_gang(self, gang_name: str, pod_bindings: dict, now: float) -> bool:
+        """Commit one admitted gang's bindings all-or-nothing.
+
+        Two failure domains the solve itself cannot see land here:
+
+        - RETIRE-TIME STALE-PLAN REVALIDATION: between the snapshot and this
+          commit, a target node may have died or been cordoned (a watch
+          event pumped mid-pass, sim chaos, a drain-driven flow). Binding
+          into a dead node would strand the whole gang until status rollup
+          notices; instead the gang is REQUEUED untouched — its pods stay
+          gated and the next pass re-solves against the live fleet.
+        - ALL-OR-NOTHING COMMIT WITH ROLLBACK: a commit that fails mid-gang
+          (injected `bind.commit` fault; any real store error) restores
+          every already-mutated pod to its exact prior (gates, node, phase)
+          — the defrag make-before-break discipline: the new placement
+          holds only when the WHOLE gang lands. A half-bound gang is the
+          one state the gang-semantics machine must never enter.
+
+        Both paths are counted (resilience_counts -> grove_bind_* metrics),
+        journaled, and evented — never silent. True = committed."""
+        c = self.cluster
+        from grove_tpu import faults as faults_mod
+
+        revalidate = (
+            self.resilience is None
+            or self.resilience.config.stale_plan_revalidation
+        )
+        if revalidate:
+            dead = sorted(
+                node
+                for node in set(pod_bindings.values())
+                if (n := c.nodes.get(node)) is None or not n.schedulable
+            )
+            if dead:
+                self.resilience_counts["stale_plan_requeues"] += 1
+                self._journal_action(
+                    now, "resilience.stale_plan_requeue", gang_name, nodes=dead
+                )
+                c.record_event(
+                    now,
+                    gang_name,
+                    f"bind requeued: target node(s) {', '.join(dead)} died "
+                    "or were cordoned after the solve",
+                )
+                return False
+        injector = faults_mod.active()
+        bound: list = []  # (pod, prior node_name, prior gates, prior phase)
+        try:
+            for pod_name, node_name in pod_bindings.items():
+                pod = c.pods.get(pod_name)
+                if pod is None:
+                    continue
+                if injector.enabled:
+                    injector.maybe_raise(
+                        "bind.commit", gang=gang_name, pod=pod_name
+                    )
+                bound.append(
+                    (pod, pod.node_name, list(pod.scheduling_gates), pod.phase)
+                )
+                pod.node_name = node_name
+                pod.scheduling_gates = []
+                pod.phase = PodPhase.PENDING
+        except Exception as e:  # noqa: BLE001 — roll back, requeue, surface
+            for pod, prior_node, prior_gates, prior_phase in bound:
+                pod.node_name = prior_node
+                pod.scheduling_gates = prior_gates
+                pod.phase = prior_phase
+            self.resilience_counts["bind_rollbacks"] += 1
+            self._journal_action(
+                now, "resilience.bind_rollback", gang_name, error=str(e)[:200]
+            )
+            c.record_event(
+                now,
+                gang_name,
+                f"gang bind rolled back ({len(bound)} pods restored): {e}",
+            )
+            return False
+        return True
 
     def _sub_digest(self, sub: PodGang) -> tuple:
         """Hashable digest of ONE pending subgang — everything encode reads

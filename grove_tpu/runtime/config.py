@@ -365,6 +365,77 @@ class TuningConfig:
 
 
 @dataclass
+class FaultsConfig:
+    """Deterministic fault injection (grove_tpu/faults): named sites
+    threaded through the stack — solver dispatch/harvest, bind commit, the
+    kube wire client, the watch stream, the recorder's segment writes, sim
+    node death — fire on a seed-driven schedule so chaos runs replay
+    bit-for-bit. Off by default and off in production; the `GROVE_FAULTS`
+    env override ("site=kind:rate[:count[:after]];...") wins over this
+    block outright. Every injected fault is journaled as a flight-recorder
+    action record and counted (/statusz resilience.faults)."""
+
+    enabled: bool = False
+    # Site-schedule derivation seed (per-site streams are independent).
+    seed: int = 0
+    # site -> {kind, rate, count, after}; see faults.SITES / faults.KINDS.
+    sites: dict = field(default_factory=dict)
+
+
+@dataclass
+class ResilienceConfig:
+    """Graceful-degradation ladder + failure-domain hardening
+    (solver/resilience.py). When enabled: a watchdog cancels and
+    re-dispatches in-flight solve waves that hang; per-subsystem circuit
+    breakers step the solve loop down mesh-sharded->unsharded,
+    pruned->dense, pipelined->serial, portfolio->single (each rung
+    admitted-set-preserving by the PR 5-7 equivalence pins) and step back
+    up after probation; kube binds retry with decorrelated-jitter backoff;
+    gang binds commit all-or-nothing with rollback; and stale plans
+    (target node died between solve and bind) requeue instead of binding.
+    Every step-down/step-up is counted (grove_degradation_*), journaled,
+    and surfaced on /statusz resilience + `grove-tpu get resilience` —
+    never silent."""
+
+    enabled: bool = False
+    # In-flight wave watchdog: hung-solve deadline and re-dispatch budget.
+    watchdog_seconds: float = 30.0
+    max_wave_retries: int = 2
+    # Circuit breakers: failures within the window that open a rung, and
+    # how long it stays open before a half-open (trial) probe.
+    breaker_threshold: int = 3
+    breaker_window_seconds: float = 60.0
+    probation_seconds: float = 30.0
+    # Kube bind push: in-call retry attempts with decorrelated jitter
+    # (utils/backoff.py; 1 = single shot, cross-tick retry set still applies).
+    bind_max_attempts: int = 3
+    backoff_base_seconds: float = 0.05
+    backoff_cap_seconds: float = 2.0
+    # Retire-time stale-plan revalidation (bind into live nodes only).
+    stale_plan_revalidation: bool = True
+
+    def resilience_config(self):
+        """-> solver.resilience.ResilienceConfig (the solver-side value
+        object; always returns one — the enabled bit rides it)."""
+        from grove_tpu.solver.resilience import (
+            ResilienceConfig as SolverResilienceConfig,
+        )
+
+        return SolverResilienceConfig(
+            enabled=bool(self.enabled),
+            watchdog_seconds=float(self.watchdog_seconds),
+            max_wave_retries=int(self.max_wave_retries),
+            breaker_threshold=int(self.breaker_threshold),
+            breaker_window_seconds=float(self.breaker_window_seconds),
+            probation_seconds=float(self.probation_seconds),
+            bind_max_attempts=int(self.bind_max_attempts),
+            backoff_base_seconds=float(self.backoff_base_seconds),
+            backoff_cap_seconds=float(self.backoff_cap_seconds),
+            stale_plan_revalidation=bool(self.stale_plan_revalidation),
+        )
+
+
+@dataclass
 class BackendConfig:
     """Scheduler-backend sidecar (GREP-375 boundary)."""
 
@@ -459,6 +530,8 @@ class OperatorConfiguration:
     defrag: DefragConfig = field(default_factory=DefragConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     tuning: TuningConfig = field(default_factory=TuningConfig)
+    faults: FaultsConfig = field(default_factory=FaultsConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
@@ -496,6 +569,8 @@ _SECTION_TYPES = {
     "defrag": ("defrag", DefragConfig),
     "trace": ("trace", TraceConfig),
     "tuning": ("tuning", TuningConfig),
+    "faults": ("faults", FaultsConfig),
+    "resilience": ("resilience", ResilienceConfig),
     "backend": ("backend", BackendConfig),
     "persistence": ("persistence", PersistenceConfig),
     "cluster": ("cluster", ClusterConfig),
@@ -527,6 +602,16 @@ _CAMEL_FIELDS = {
     "maxFiles": "max_files",
     "gridK": "grid_k",
     "halvingRungs": "halving_rungs",
+    "watchdogSeconds": "watchdog_seconds",
+    "maxWaveRetries": "max_wave_retries",
+    "breakerThreshold": "breaker_threshold",
+    "breakerWindowSeconds": "breaker_window_seconds",
+    "probationSeconds": "probation_seconds",
+    "bindMaxAttempts": "bind_max_attempts",
+    "backoffBaseSeconds": "backoff_base_seconds",
+    "backoffCapSeconds": "backoff_cap_seconds",
+    "stalePlanRevalidation": "stale_plan_revalidation",
+    "sites": "sites",
     "auditSeeds": "audit_seeds",
     "queueSize": "queue_size",
     "flushIntervalSeconds": "flush_interval_seconds",
@@ -891,6 +976,54 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
         not isinstance(s, int) or isinstance(s, bool) for s in tu.audit_seeds
     ):
         errors.append("tuning.auditSeeds: must be a list of ints")
+    fa = cfg.faults
+    if not isinstance(fa.seed, int) or isinstance(fa.seed, bool) or fa.seed < 0:
+        errors.append("faults.seed: must be an int >= 0")
+    if not isinstance(fa.sites, dict):
+        errors.append("faults.sites: must be a mapping of site -> schedule")
+    else:
+        # Site names and schedule shapes validate through the injector's
+        # own parser — the chaos rig and the config cannot drift.
+        from grove_tpu.faults import SITES, parse_spec_entry
+
+        for site, doc in fa.sites.items():
+            if site not in SITES:
+                errors.append(
+                    f"faults.sites.{site}: unknown site; one of "
+                    + "|".join(SITES)
+                )
+                continue
+            try:
+                parse_spec_entry(site, doc)
+            except ValueError as e:
+                errors.append(f"faults.sites.{e}")
+    rs = cfg.resilience
+    for rname, rval, lo in (
+        ("resilience.watchdogSeconds", rs.watchdog_seconds, 0.0),
+        ("resilience.breakerWindowSeconds", rs.breaker_window_seconds, 0.0),
+        ("resilience.probationSeconds", rs.probation_seconds, 0.0),
+        ("resilience.backoffBaseSeconds", rs.backoff_base_seconds, 0.0),
+    ):
+        if not isinstance(rval, (int, float)) or isinstance(rval, bool) or rval <= lo:
+            errors.append(f"{rname}: must be a number > {lo:g}")
+    for rname, rval, lo in (
+        ("resilience.maxWaveRetries", rs.max_wave_retries, 0),
+        ("resilience.breakerThreshold", rs.breaker_threshold, 1),
+        ("resilience.bindMaxAttempts", rs.bind_max_attempts, 1),
+    ):
+        if not isinstance(rval, int) or isinstance(rval, bool) or rval < lo:
+            errors.append(f"{rname}: must be an int >= {lo}")
+    if not isinstance(rs.backoff_cap_seconds, (int, float)) or isinstance(
+        rs.backoff_cap_seconds, bool
+    ) or (
+        isinstance(rs.backoff_base_seconds, (int, float))
+        and not isinstance(rs.backoff_base_seconds, bool)
+        and rs.backoff_cap_seconds < rs.backoff_base_seconds
+    ):
+        errors.append(
+            "resilience.backoffCapSeconds: must be a number >= "
+            "backoffBaseSeconds"
+        )
     eb = cfg.controllers.events_buffer
     if not isinstance(eb, int) or isinstance(eb, bool) or eb < 1:
         errors.append("controllers.eventsBuffer: must be an int >= 1")

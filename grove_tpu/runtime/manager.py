@@ -547,6 +547,39 @@ class Manager:
                 flush_interval_seconds=config.trace.flush_interval_seconds,
             )
             self.controller.recorder = self.trace_recorder
+        # Deterministic fault injection (config section `faults`, env
+        # override GROVE_FAULTS): installed process-wide at start() so the
+        # named sites across the stack see it; every fire is journaled as a
+        # flight-recorder action record and counted.
+        from grove_tpu import faults as faults_mod
+
+        self.fault_injector = faults_mod.from_config(
+            config.faults, recorder=self.trace_recorder
+        )
+        # Graceful-degradation ladder (config section `resilience`): shared
+        # control-plane state — the per-tick solves, the bind commit path,
+        # and any stream/drain driver handed controller.resilience all see
+        # the same breaker states. Transitions journal + log (never silent).
+        self.resilience_ladder = None
+        if config.resilience.enabled:
+            from grove_tpu.solver.resilience import DegradationLadder
+
+            def _ladder_event(event: str, subsystem: str) -> None:
+                self.log.info(
+                    f"degradation ladder {event}", subsystem=subsystem
+                )
+                if self.trace_recorder is not None:
+                    try:
+                        self.trace_recorder.capture_action(
+                            time.time(), f"resilience.{event}", subsystem
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            self.resilience_ladder = DegradationLadder(
+                config.resilience.resilience_config(), on_event=_ladder_event
+            )
+            self.controller.resilience = self.resilience_ladder
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._http_servers: list[http.server.ThreadingHTTPServer] = []
@@ -758,6 +791,61 @@ class Manager:
             "(encode|prefilter|dispatch|harvest|decode|bind|journal|"
             "total|hotPath)",
         )
+        # Failure-domain hardening observability (faults + resilience
+        # sections): ladder transitions per subsystem, injected faults,
+        # bind rollbacks / stale-plan requeues / bind push retries, watch
+        # reconnects+resyncs, recorder write failures. All real Counters,
+        # delta-exported each reconcile against the underlying monotonic
+        # sources — same discipline as the solve-pass counters.
+        self._m_degradation_down = self.metrics.counter(
+            "grove_degradation_step_downs_total",
+            "Degradation-ladder rungs stepped down (breaker opened)",
+        )
+        self._m_degradation_up = self.metrics.counter(
+            "grove_degradation_step_ups_total",
+            "Degradation-ladder rungs stepped back up (probation passed)",
+        )
+        self._degradation_exported: dict = {}
+        self._m_faults_injected = self.metrics.counter(
+            "grove_faults_injected_total",
+            "Faults fired by the deterministic injection registry",
+        )
+        self._faults_exported = 0
+        self._m_bind_rollbacks = self.metrics.counter(
+            "grove_bind_rollbacks_total",
+            "Gang binds rolled back (all-or-nothing commit failed mid-gang)",
+        )
+        self._m_stale_requeues = self.metrics.counter(
+            "grove_stale_plan_requeues_total",
+            "Gangs requeued at bind time because a target node died",
+        )
+        self._resilience_exported = {
+            "bind_rollbacks": 0,
+            "stale_plan_requeues": 0,
+            "solve_degraded_retries": 0,
+        }
+        self._m_solve_degraded = self.metrics.counter(
+            "grove_solve_degraded_retries_total",
+            "Serving solves retried fully degraded after a solve failure",
+        )
+        self._m_watch_reconnects = self.metrics.counter(
+            "grove_watch_reconnects_total",
+            "Watch streams resubscribed after a disconnect",
+        )
+        self._m_watch_resyncs = self.metrics.counter(
+            "grove_watch_resyncs_total",
+            "Full watch resyncs forced by resourceVersion expiry (410)",
+        )
+        self._m_bind_push_retries = self.metrics.counter(
+            "grove_bind_retries_total",
+            "Kube bind pushes retried in-call with backoff",
+        )
+        self._watch_exported = {"reconnects": 0, "resyncs": 0, "bindRetries": 0}
+        self._m_recorder_write_errors = self.metrics.counter(
+            "grove_recorder_write_errors_total",
+            "Flight-recorder segment writes that failed (counting-drops mode)",
+        )
+        self._recorder_write_errors_exported = 0
         # Every (queue, resource) series ever emitted — re-zeroed each pass
         # when usage disappears (gauge values persist otherwise).
         self._queue_metric_keys: dict[str, set] = {}
@@ -1046,6 +1134,12 @@ class Manager:
             # records written/dropped, queue depth — what `grove-tpu trace
             # info` points at and the grove_trace_* metrics are cut from.
             "trace": self.trace_status(),
+            # Failure-domain hardening state (faults + resilience sections):
+            # ladder breaker states + step counters, injected-fault ledger,
+            # bind rollback/stale-requeue counts, watch reconnects — what
+            # `grove-tpu get resilience` renders and the grove_degradation_*
+            # metrics are cut from.
+            "resilience": self.resilience_status(),
             # The effective ClusterTopology (config TAS levels + auto host
             # level) — what `grove-tpu get topology` renders (kubectl get
             # clustertopology analog; the kubernetes source also syncs it
@@ -1119,6 +1213,31 @@ class Manager:
         # host-stage ledger.
         if self.controller.last_host_stages:
             doc["hostStages"] = dict(self.controller.last_host_stages)
+        return doc
+
+    def resilience_status(self) -> dict:
+        """JSON-able failure-domain view for /statusz "resilience" and
+        `grove-tpu get resilience`: the degradation ladder's breaker states
+        and step counters, the fault injector's per-site fire ledger, the
+        bind-path hardening counters, the watch reconnect/resync counters,
+        and the recorder's counting-drops state."""
+        doc: dict = {"enabled": self.resilience_ladder is not None}
+        if self.resilience_ladder is not None:
+            cfg = self.config.resilience
+            doc["watchdogSeconds"] = float(cfg.watchdog_seconds)
+            doc["probationSeconds"] = float(cfg.probation_seconds)
+            doc["ladder"] = self.resilience_ladder.stats()
+        doc["binds"] = dict(self.controller.resilience_counts)
+        if self.fault_injector is not None:
+            doc["faults"] = self.fault_injector.stats()
+        ws = getattr(self._kube_source, "watch_stats", None)
+        if ws is not None:
+            doc["watch"] = ws()
+        if self.trace_recorder is not None:
+            doc["recorder"] = {
+                "degraded": self.trace_recorder.degraded,
+                "writeErrors": self.trace_recorder.write_errors,
+            }
         return doc
 
     def trace_status(self) -> dict:
@@ -1209,6 +1328,18 @@ class Manager:
             # journal segments); stop() joins it after a final flush.
             self.trace_recorder.start()
             self.log.info("trace recorder started", path=cfg.trace.path)
+        if self.fault_injector is not None:
+            # Process-wide install: the named sites (solver dispatch, bind
+            # commit, kube wire, watch stream, recorder writes) all consult
+            # faults.active(). stop() clears it.
+            from grove_tpu import faults as faults_mod
+
+            faults_mod.install(self.fault_injector)
+            self.log.info(
+                "FAULT INJECTION ACTIVE",
+                sites=",".join(sorted(self.fault_injector.specs)),
+                seed=self.fault_injector.seed,
+            )
         if cfg.leader_election.enabled:
             if cfg.cluster.source == "kubernetes":
                 # Apiserver-backed Lease: the only store EVERY replica of a
@@ -1310,6 +1441,16 @@ class Manager:
                 initc_kube_tokens=cfg.cluster.initc_mode == "kubernetes",
                 qps=cfg.cluster.kube_qps,
                 burst=cfg.cluster.kube_burst,
+                # Bind retry + shared backoff pacing (resilience.* block):
+                # in-call decorrelated-jitter retries on the bind push; the
+                # WatchDriver's cross-tick retry set remains the outer loop.
+                bind_retry_attempts=(
+                    cfg.resilience.bind_max_attempts
+                    if cfg.resilience.enabled
+                    else 1
+                ),
+                backoff_base_s=cfg.resilience.backoff_base_seconds,
+                backoff_cap_s=cfg.resilience.backoff_cap_seconds,
             )
             source.start()
             self._kube_source = source
@@ -1785,6 +1926,55 @@ class Manager:
             if delta > 0:
                 self._m_kube_throttled.inc(float(delta))
                 self._kube_throttled_exported = limiter.throttled
+        # Failure-domain counters (ladder, injector, bind path, watch,
+        # recorder) — delta-exported like every other monotonic source.
+        if self.resilience_ladder is not None:
+            for subsystem, counts in self.resilience_ladder.counters().items():
+                prev = self._degradation_exported.setdefault(
+                    subsystem, {"stepDowns": 0, "stepUps": 0}
+                )
+                for key, metric in (
+                    ("stepDowns", self._m_degradation_down),
+                    ("stepUps", self._m_degradation_up),
+                ):
+                    delta = counts[key] - prev[key]
+                    if delta > 0:
+                        metric.inc(float(delta), subsystem=subsystem)
+                        prev[key] = counts[key]
+        if self.fault_injector is not None:
+            fired = self.fault_injector.total_fired()
+            if fired > self._faults_exported:
+                self._m_faults_injected.inc(float(fired - self._faults_exported))
+                self._faults_exported = fired
+        rc = self.controller.resilience_counts
+        for key, metric in (
+            ("bind_rollbacks", self._m_bind_rollbacks),
+            ("stale_plan_requeues", self._m_stale_requeues),
+            ("solve_degraded_retries", self._m_solve_degraded),
+        ):
+            delta = rc[key] - self._resilience_exported[key]
+            if delta > 0:
+                metric.inc(float(delta))
+                self._resilience_exported[key] = rc[key]
+        watch_stats = getattr(self._kube_source, "watch_stats", None)
+        if watch_stats is not None:
+            wstats = watch_stats()
+            for key, metric in (
+                ("reconnects", self._m_watch_reconnects),
+                ("resyncs", self._m_watch_resyncs),
+                ("bindRetries", self._m_bind_push_retries),
+            ):
+                delta = wstats[key] - self._watch_exported[key]
+                if delta > 0:
+                    metric.inc(float(delta))
+                    self._watch_exported[key] = wstats[key]
+        if self.trace_recorder is not None:
+            we = self.trace_recorder.write_errors
+            if we > self._recorder_write_errors_exported:
+                self._m_recorder_write_errors.inc(
+                    float(we - self._recorder_write_errors_exported)
+                )
+                self._recorder_write_errors_exported = we
         qtree = self.controller.queue_tree
         if qtree is not None:
             # Per-queue usage gauges (GREP-244 metrics direction): refreshed
@@ -1841,6 +2031,12 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.fault_injector is not None:
+            # Clear the process-wide injector so a later manager (tests run
+            # several per process) starts fault-free unless it asks.
+            from grove_tpu import faults as faults_mod
+
+            faults_mod.install(None)
         if self.trace_recorder is not None:
             # Final flush + join BEFORE servers go down, so a stop-triggered
             # journal read (tests, postmortems) sees every record.

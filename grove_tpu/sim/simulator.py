@@ -29,18 +29,81 @@ class SimConfig:
     startup_gate: str = "agent"
 
 
+@dataclass(frozen=True)
+class ScriptedFault:
+    """One schedulable chaos action: at sim-time `at`, apply `action` to
+    `target`. Actions are the simulator's own fault methods (kill_node,
+    cordon, uncordon, fail_pod, crash_pod), so a script entry journals and
+    behaves exactly like a hand-driven fault — but the schedule is DATA,
+    shippable with a chaos scenario and replayable run after run."""
+
+    at: float
+    action: str
+    target: str
+
+
 @dataclass
 class Simulator:
     cluster: Cluster
     controller: GroveController
     config: SimConfig = field(default_factory=SimConfig)
     now: float = 0.0
+    # Deterministic chaos script: ScriptedFault entries (or (at, action,
+    # target) tuples) executed when sim time reaches them — BEFORE the
+    # reconcile pass, so a node killed at t lands between the previous
+    # pass's bind and this pass's solve (the mid-wave death window the
+    # stale-plan revalidation exists for). Order within one step follows
+    # the schedule order.
+    fault_script: list = field(default_factory=list)
     _bound_at: dict[str, float] = field(default_factory=dict)
     _running_at: dict[str, float] = field(default_factory=dict)
 
+    _SCRIPT_ACTIONS = ("kill_node", "cordon", "uncordon", "fail_pod", "crash_pod")
+
+    def schedule_fault(self, at: float, action: str, target: str) -> None:
+        """Append one scripted fault (validated; keeps the script sorted)."""
+        if action not in self._SCRIPT_ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r}; one of "
+                + "|".join(self._SCRIPT_ACTIONS)
+            )
+        self.fault_script.append(ScriptedFault(float(at), action, target))
+        self.fault_script.sort(key=lambda f: f.at)
+
+    def _run_script(self) -> None:
+        """Execute (and consume) scripted faults due at or before `now`;
+        entries scheduled in the past fire on the next step."""
+        while self.fault_script:
+            entry = self.fault_script[0]
+            if not isinstance(entry, ScriptedFault):
+                entry = ScriptedFault(*entry)
+            if entry.at > self.now:
+                break
+            self.fault_script.pop(0)
+            getattr(self, entry.action)(entry.target)
+        # Injector-driven node death (site sim.node_death): kills the first
+        # schedulable node in name order — deterministic under the seeded
+        # schedule, no script needed.
+        from grove_tpu import faults as faults_mod
+
+        inj = faults_mod.active()
+        if inj.enabled and inj.should_fire("sim.node_death") is not None:
+            victim = next(
+                (
+                    name
+                    for name in sorted(self.cluster.nodes)
+                    if self.cluster.nodes[name].schedulable
+                ),
+                None,
+            )
+            if victim is not None:
+                self.kill_node(victim)
+
     def step(self, dt: float = 1.0) -> None:
-        """Advance time, run pod lifecycle, then one reconcile pass."""
+        """Advance time, run scripted chaos, pod lifecycle, then one
+        reconcile pass."""
         self.now += dt
+        self._run_script()
         self._lifecycle()
         self.controller.reconcile(self.now)
         self._lifecycle()  # let fresh bindings from this pass register

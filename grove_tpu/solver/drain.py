@@ -63,6 +63,22 @@ from grove_tpu.solver.encode import encode_gangs, gang_shape, next_pow2
 HARVEST_MODES = ("chained", "wave", "pipeline")
 
 
+class WaveFault(RuntimeError):
+    """A wave failed past its retry budget. `in_flight` tells the driver
+    whether the wave is still queued in the engine (a retirement failure —
+    do NOT resubmit) or never made it in (a dispatch failure — resubmit
+    after stepping the ladder down). Drivers without a resilience ladder
+    see this propagate like any other error."""
+
+    def __init__(self, message: str, *, in_flight: bool, fatal: bool = False):
+        super().__init__(message)
+        self.in_flight = in_flight
+        # fatal: the engine's carry chain can no longer be trusted (an
+        # escalation re-chain died past its retry budget mid-adoption); the
+        # driver must surface the error, not degrade around it.
+        self.fatal = fatal
+
+
 @dataclass
 class DrainStats:
     """Phase breakdown of one drain (wall seconds unless noted)."""
@@ -121,6 +137,24 @@ class DrainStats:
     shard_fallbacks: int = 0
     # Waves journaled to a flight recorder, in commit order (monotonic ids).
     journaled_waves: int = 0
+    # Resilience ledger (solver/resilience.py wiring): dispatch retries paid
+    # inside the engine, watchdog timeouts observed on in-flight waves,
+    # waves cancelled (timeout -> cancel -> re-dispatch), and the re-
+    # dispatches themselves. Zero on a healthy run; never silent otherwise.
+    wave_retries: int = 0
+    watchdog_timeouts: int = 0
+    waves_cancelled: int = 0
+    wave_redispatches: int = 0
+
+    def resilience_doc(self) -> dict:
+        """The fault-recovery counters of this run (surfaced on lastDrain/
+        lastStream and the chaos bench evidence)."""
+        return {
+            "waveRetries": self.wave_retries,
+            "watchdogTimeouts": self.watchdog_timeouts,
+            "wavesCancelled": self.waves_cancelled,
+            "waveRedispatches": self.wave_redispatches,
+        }
     # Wave/pipeline modes only: (gangs admitted in wave, seconds since drain
     # start at which the wave's verdicts were host-visible), in commit order.
     wave_latencies: list = field(default_factory=list)
@@ -272,9 +306,16 @@ class _WavePipeline:
         record_stamps: bool = False,
         on_commit=None,  # fn(members, wave_bindings, stamp_s) at each commit
         layout=None,  # parallel.mesh.SolveLayout: mesh-sharded solves
+        faults=None,  # faults.FaultInjector; None = the process-installed one
+        watchdog_s: float | None = None,  # in-flight wave timeout (None = off)
+        max_wave_retries: int = 0,  # re-dispatches per wave before WaveFault
+        clock=None,  # injectable for watchdog tests (default perf_counter)
+        watchdog_poll_s: float = 0.001,
     ) -> None:
         import jax
         import jax.numpy as jnp
+
+        from grove_tpu import faults as faults_mod
 
         self.pods_by_name = pods_by_name
         self.snapshot = snapshot
@@ -289,14 +330,30 @@ class _WavePipeline:
         self.wave_prefix = wave_prefix
         self.record_stamps = record_stamps
         self.on_commit = on_commit
+        # Fault injection (grove_tpu/faults): the process-installed injector
+        # unless the driver passed one; normalized to None when disabled so
+        # the per-wave check is a single `is not None`.
+        inj = faults if faults is not None else faults_mod.active()
+        self.faults = inj if inj.enabled else None
+        self.watchdog_s = watchdog_s
+        self.max_wave_retries = int(max_wave_retries)
+        self.clock = clock if clock is not None else time.perf_counter
+        self.watchdog_poll_s = watchdog_poll_s
         # Mesh-sharded solve: every wave's executable is the layout-keyed
         # sharded variant; the free carry chains node-sharded between waves
         # (out-sharding pinned), so the pipeline never reshards.
         self.layout = layout if self.use_exec_cache else None
         # Entering free/ok_global carries are retained per wave for the
-        # exactness-escalation re-solves and for journaling the exact
-        # entering state; a donated buffer would be dead.
-        self.retain_carries = pruning is not None or self.recorder is not None
+        # exactness-escalation re-solves, for journaling the exact entering
+        # state, AND for the watchdog's cancel->re-dispatch path; a donated
+        # buffer would be dead in all three.
+        self.retain_carries = (
+            pruning is not None
+            or self.recorder is not None
+            or self.faults is not None
+            or self.watchdog_s is not None
+            or self.max_wave_retries > 0
+        )
         self.donate = bool(donate and self.use_exec_cache and not self.retain_carries)
         stats.donated = self.donate
         stats.shard_devices = self.layout.node_devices if self.layout else 0
@@ -456,10 +513,19 @@ class _WavePipeline:
 
     # ---- dispatch ----------------------------------------------------------------
 
-    def _dispatch(self, rec: dict) -> None:
-        """Dispatch (or re-dispatch) one wave from the current carry; updates
-        the record in place and advances the carry."""
-        free_in, okg_in = self.free, self.ok_g
+    def _dispatch(
+        self, rec: dict, *, free_in=None, okg_in=None, advance: bool = True
+    ) -> None:
+        """Dispatch (or re-dispatch) one wave; updates the record in place.
+        Default: solve from the current carry and advance it. The watchdog's
+        in-place re-dispatch passes the wave's RETAINED entering carry and
+        advance=False — downstream waves already chained off the original
+        output buffers, and the solve is deterministic, so the recomputed
+        outputs hold bitwise the same values."""
+        if self.faults is not None:
+            self.faults.maybe_raise("solver.dispatch", wave=rec.get("seq", -1))
+        if free_in is None:
+            free_in, okg_in = self.free, self.ok_g
         if rec["plan"] is not None:
             plan = rec["plan"]
             wb, cap_p, sched_p, ndid_p = rec["pruned_inputs"]
@@ -498,15 +564,101 @@ class _WavePipeline:
             ok_np=None,  # host copy; fetched at retirement
             free_in=free_in if self.retain_carries else None,
             okg_in=okg_in if self.retain_carries else None,
+            dispatched_at=self.clock(),
+            cancelled=False,
         )
-        self.free, self.ok_g = free_out, result.ok_global
+        if advance:
+            self.free, self.ok_g = free_out, result.ok_global
 
-    def submit(self, ws) -> None:
-        """Encode + dispatch one planned wave, then retire down to the
-        pipeline depth. Keeps only what decode needs per wave — retaining
-        full SolveResults would pin every wave's chaining buffers in device
-        memory. (Carry-retaining drains additionally keep each wave's
-        ENTERING free/ok_global for escalation and journaling.)"""
+    def _dispatch_with_retry(self, rec: dict, *, in_flight: bool, **kw) -> None:
+        """Dispatch with up to `max_wave_retries` immediate retries (the
+        solve is deterministic — a transient dispatch failure retried from
+        the same carry reproduces the intended wave exactly). Exhaustion
+        raises WaveFault for the driver's degradation ladder."""
+        attempts = 0
+        while True:
+            try:
+                self._dispatch(rec, **kw)
+                return
+            except Exception as e:  # noqa: BLE001 — retry budget, then surface
+                if attempts >= self.max_wave_retries:
+                    if self.max_wave_retries == 0 and self.faults is None:
+                        raise  # resilience off: original behavior, raw error
+                    raise WaveFault(
+                        f"wave dispatch failed after {attempts} retries: {e}",
+                        in_flight=in_flight,
+                    ) from e
+                attempts += 1
+                self.stats.wave_retries += 1
+
+    # ---- watchdog: timeout -> cancel -> re-dispatch ------------------------------
+
+    def cancel_wave(self, rec: dict) -> bool:
+        """Cancel an in-flight wave: drop its (hung) host view so the next
+        fetch re-harvests the re-dispatched buffers. Double-cancel is a
+        no-op (False) — the watchdog and a racing retirement may both reach
+        for the same wave."""
+        if rec.get("cancelled"):
+            return False
+        rec["cancelled"] = True
+        rec["ok_np"] = None
+        self.stats.waves_cancelled += 1
+        return True
+
+    def _redispatch(self, rec: dict) -> None:
+        """Re-dispatch a cancelled wave in place from its retained entering
+        carry (carry NOT advanced — see _dispatch)."""
+        if rec.get("free_in") is None:
+            raise WaveFault(
+                "cannot re-dispatch: entering carry not retained", in_flight=True
+            )
+        self.stats.wave_redispatches += 1
+        self._dispatch_with_retry(
+            rec,
+            in_flight=True,
+            free_in=rec["free_in"],
+            okg_in=rec["okg_in"],
+            advance=False,
+        )
+
+    def _wave_hung(self, rec: dict) -> bool:
+        """Is this wave's solve hung past the watchdog deadline? A result
+        that turns ready while we poll — the timeout racing a normal
+        retirement — harvests normally (completed work is never discarded).
+        Injected `solver.harvest` timeouts simulate the hang without real
+        sleeps (the underlying computation is fine; the injector models the
+        failure the HOST would observe)."""
+        if self.faults is not None and self.faults.maybe_timeout(
+            "solver.harvest", wave=rec.get("seq", -1)
+        ):
+            return True
+        if self.watchdog_s is None:
+            return False
+        ready = getattr(rec["ok"], "is_ready", None)
+        if ready is None:
+            return False  # no readiness probe (portfolio closure): block
+        deadline = rec.get("dispatched_at", 0.0) + self.watchdog_s
+        while not ready():
+            if self.clock() >= deadline:
+                return True
+            time.sleep(self.watchdog_poll_s)
+        return False
+
+    def retire_due(self) -> bool:
+        """Waves past the pipeline depth, waiting to retire (drivers that
+        own their retirement loop — the resilient streaming driver — poll
+        this instead of letting submit retire)."""
+        return self.retire_lag is not None and len(self.inflight) > self.retire_lag
+
+    def submit(self, ws, retire: bool = True) -> None:
+        """Encode + dispatch one planned wave, then (by default) retire down
+        to the pipeline depth. Keeps only what decode needs per wave —
+        retaining full SolveResults would pin every wave's chaining buffers
+        in device memory. (Carry-retaining drains additionally keep each
+        wave's ENTERING free/ok_global for escalation and journaling.)
+        `retire=False` skips the retirement loop: a dispatch failure then
+        unambiguously means the wave was NOT enqueued, which is what the
+        resilient driver's resubmit logic needs."""
         stats = self.stats
         te = time.perf_counter()
         batch, decode = self.encode_wave(ws)
@@ -520,6 +672,7 @@ class _WavePipeline:
             "decode": decode,
             "plan": plan,
             "escalated": False,
+            "seq": stats.waves,
         }
         if plan is not None:
             rec["pruned_inputs"] = self.pruned_inputs(plan, batch)
@@ -527,11 +680,11 @@ class _WavePipeline:
             stats.candidate_nodes = max(stats.candidate_nodes, plan.count)
             stats.candidate_pad = max(stats.candidate_pad, plan.pad)
         ts = time.perf_counter()
-        self._dispatch(rec)
+        self._dispatch_with_retry(rec, in_flight=False)
         stats.dispatch_s += time.perf_counter() - ts
         stats.waves += 1
         self.inflight.append(rec)
-        if self.retire_lag is not None:
+        if retire and self.retire_lag is not None:
             while len(self.inflight) > self.retire_lag:
                 self._retire_next()
 
@@ -539,20 +692,44 @@ class _WavePipeline:
 
     def _fetch(self, rec: dict) -> None:
         """Make this wave's verdicts host-visible (blocks until its solve
-        completes; later waves keep computing — they are already enqueued)."""
+        completes; later waves keep computing — they are already enqueued).
+
+        Watchdog path: a wave hung past `watchdog_s` (or an injected
+        `solver.harvest` timeout) is CANCELLED and re-dispatched from its
+        retained entering carry, up to `max_wave_retries` times; exhaustion
+        raises WaveFault(in_flight=True) for the driver's ladder."""
         import numpy as np
 
         if rec.get("ok_np") is not None:
             return
         th = time.perf_counter()
-        rec["ok_np"] = np.asarray(rec["ok"])
-        rec["score_np"] = np.asarray(rec["score"])
-        rec["assigned_np"] = np.asarray(rec["assigned"])
-        self.stats.harvest_s += time.perf_counter() - th
+        try:
+            attempts = 0
+            while self._wave_hung(rec):
+                self.stats.watchdog_timeouts += 1
+                self.cancel_wave(rec)
+                if attempts >= self.max_wave_retries:
+                    raise WaveFault(
+                        f"wave hung past watchdog after {attempts} "
+                        "re-dispatches",
+                        in_flight=True,
+                    )
+                attempts += 1
+                self._redispatch(rec)
+            rec["ok_np"] = np.asarray(rec["ok"])
+            rec["score_np"] = np.asarray(rec["score"])
+            rec["assigned_np"] = np.asarray(rec["assigned"])
+        finally:
+            self.stats.harvest_s += time.perf_counter() - th
 
     def _retire_next(self) -> None:
-        rec = self.inflight.pop(0)
+        # Peek-fetch-pop: a WaveFault out of _fetch (watchdog exhaustion)
+        # leaves the wave at the queue head, so the driver can step the
+        # ladder down and the NEXT retirement attempt retries the fetch
+        # with fresh re-dispatch budget — the wave is never lost.
+        rec = self.inflight[0]
         self._fetch(rec)
+        self.inflight.pop(0)
         self._finalize(rec)
 
     def _finalize(self, rec: dict) -> None:
@@ -597,11 +774,35 @@ class _WavePipeline:
                     )
                     # Re-chain everything still in flight from the adopted
                     # carry; their inputs changed, so they re-verify (fresh
-                    # lossy check) at their own retirement.
-                    self.free, self.ok_g = dense.free_after, dense.ok_global
-                    for rec2 in self.inflight:
-                        rec2["escalated"] = False
-                        self._dispatch(rec2)
+                    # lossy check) at their own retirement. The loop is
+                    # restart-safe — each attempt resets the carry to the
+                    # adoption point and re-dispatches the whole tail — so
+                    # an injected dispatch fault mid-re-chain retries the
+                    # chain wholesale; exhaustion is FATAL (the carry chain
+                    # would be inconsistent, which no ladder rung can fix).
+                    adopt_carry = (dense.free_after, dense.ok_global)
+                    attempt = 0
+                    while True:
+                        self.free, self.ok_g = adopt_carry
+                        try:
+                            for rec2 in self.inflight:
+                                rec2["escalated"] = False
+                                self._dispatch(rec2)
+                            break
+                        except Exception as e:  # noqa: BLE001
+                            if attempt >= self.max_wave_retries and not (
+                                self.max_wave_retries == 0
+                                and self.faults is None
+                            ):
+                                raise WaveFault(
+                                    f"escalation re-chain failed: {e}",
+                                    in_flight=True,
+                                    fatal=True,
+                                ) from e
+                            if attempt >= self.max_wave_retries:
+                                raise
+                            attempt += 1
+                            stats.wave_retries += 1
 
         stamp = time.perf_counter() - self.t0
         if self.record_stamps:
@@ -654,6 +855,69 @@ class _WavePipeline:
                 rec["assigned_np"] = np.asarray(assigned)
         while self.inflight:
             self._retire_next()
+
+    # ---- degradation-ladder hooks (solver/resilience.py) -------------------------
+    #
+    # Each rung of the ladder maps to one engine mutation, applied BETWEEN
+    # waves by the driver. All three are admitted-set-preserving by the
+    # pinned equivalences: sharded == unsharded bitwise (tests/test_mesh),
+    # pruned == dense admitted-equal via escalation (solver/pruning), and
+    # retire_lag is a pure harvest-discipline choice (tests/test_drain).
+
+    def set_retire_lag(self, lag: int | None) -> None:
+        """pipeline <-> serial: where the host blocks, never what it binds."""
+        self.retire_lag = lag
+
+    def set_pruning(self, pruning) -> None:
+        """pruned <-> dense for waves submitted from now on. Stepping back
+        up is safe mid-drain: plans are cut against the INITIAL snapshot
+        free, which remains a superset of every later wave's eligible set
+        (free only shrinks while draining)."""
+        self.pruning = pruning if self.use_exec_cache else None
+
+    def strip_layout(self) -> None:
+        """mesh-sharded -> unsharded: retire everything in flight (their
+        carries chain on the sharded buffers), then fetch the carry and
+        statics to host and re-place them unsharded. Sharded and unsharded
+        solves are bitwise-equal, so the values — and every admitted set
+        downstream — are identical; only executables change. Counted on
+        shard_fallbacks (a degradation is a fallback that must not be
+        silent)."""
+        if self.layout is None:
+            return
+        import jax.numpy as jnp
+        import numpy as np
+
+        self.flush()
+        self.free = jnp.asarray(np.asarray(self.free))
+        self.ok_g = jnp.asarray(np.asarray(self.ok_g))
+        self.capacity = jnp.asarray(np.asarray(self.capacity))
+        self.schedulable = jnp.asarray(np.asarray(self.schedulable))
+        self.node_domain_id = jnp.asarray(np.asarray(self.node_domain_id))
+        self.layout = None
+        self.stats.shard_devices = 0
+        self.stats.shard_fallbacks += 1
+
+    def adopt_layout(self, layout) -> None:
+        """unsharded -> mesh-sharded (the ladder stepping back up after
+        probation): retire in-flight waves, then place carry + statics into
+        the layout's shardings — the exact inverse of strip_layout."""
+        if self.layout is not None or layout is None or not self.use_exec_cache:
+            return
+        import jax
+
+        self.flush()
+        self.capacity = jax.device_put(self.capacity, layout.free_sharding())
+        self.schedulable = jax.device_put(
+            self.schedulable, layout.node_sharding(0, 1)
+        )
+        self.node_domain_id = jax.device_put(
+            self.node_domain_id, layout.node_sharding(1, 2)
+        )
+        self.free = jax.device_put(self.free, layout.free_sharding())
+        self.ok_g = jax.device_put(self.ok_g, layout.replicated())
+        self.layout = layout
+        self.stats.shard_devices = layout.node_devices
 
     # ---- flight-recorder journaling ---------------------------------------------
 
@@ -749,6 +1013,8 @@ def drain_backlog(
     pruning=None,  # solver.pruning.PruningConfig; None/disabled = dense
     recorder=None,  # trace.recorder.TraceRecorder; journals committed waves
     mesh=None,  # None | parallel.mesh.SolveLayout | parallel.mesh.MeshConfig
+    faults=None,  # faults.FaultInjector; None = the process-installed one
+    resilience=None,  # None | ResilienceConfig | DegradationLadder
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
 
@@ -789,6 +1055,15 @@ def drain_backlog(
     the flight recorder with monotonic wave ids in commit order, carrying
     the exact closure for bitwise standalone replay (trace/replay.py).
 
+    `resilience` (a solver.resilience ResilienceConfig or a shared
+    DegradationLadder): arms the engine's in-flight wave watchdog (timeout
+    -> cancel -> re-dispatch from the retained entering carry) and per-wave
+    dispatch retries; open ladder rungs step the drain down at construction
+    (mesh off, pruning off, pipelined -> serial). The batch drain applies
+    the ladder once up front — the continuous reconcile loop lives in the
+    streaming driver (solver/stream.py). `faults` threads a deterministic
+    fault injector through the engine's named sites (grove_tpu/faults).
+
     `mesh` (a parallel.mesh.SolveLayout, or a MeshConfig to negotiate here):
     every wave's solve shards its node/candidate axis across the device
     mesh — the free carry chains node-sharded between waves with zero
@@ -816,6 +1091,24 @@ def drain_backlog(
     wp = warm_path if warm_path is not None else warm_mod.default_warm_path()
     if pruning is not None and not getattr(pruning, "enabled", False):
         pruning = None
+    from grove_tpu.solver.resilience import ladder_for
+
+    ladder = ladder_for(resilience)
+    watchdog_s = None
+    max_wave_retries = 0
+    if ladder is not None:
+        watchdog_s = ladder.config.watchdog_seconds
+        max_wave_retries = ladder.config.max_wave_retries
+        # Apply open rungs at construction (the batch drain's one ladder
+        # consult; step-downs mid-drain are the streaming driver's job).
+        if not ladder.allows("mesh"):
+            mesh = None
+        if not ladder.allows("pruning"):
+            pruning = None
+        if harvest == "pipeline" and not ladder.allows("pipeline"):
+            harvest = "wave"
+        if portfolio > 1 and not ladder.allows("portfolio"):
+            portfolio = 1
     if pruning is not None and portfolio > 1:
         pruning = None  # portfolio solves own the node-axis layout
     if donate is None:
@@ -881,6 +1174,9 @@ def drain_backlog(
         wave_prefix="drain",
         record_stamps=harvest in ("wave", "pipeline"),
         layout=layout,
+        faults=faults,
+        watchdog_s=watchdog_s,
+        max_wave_retries=max_wave_retries,
     )
 
     if warm:

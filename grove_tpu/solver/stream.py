@@ -48,7 +48,7 @@ import time
 from dataclasses import dataclass, field
 
 from grove_tpu.solver.core import SolverParams
-from grove_tpu.solver.drain import DrainStats, _WavePipeline, plan_waves
+from grove_tpu.solver.drain import DrainStats, WaveFault, _WavePipeline, plan_waves
 
 
 @dataclass(frozen=True)
@@ -126,6 +126,11 @@ class StreamStats:
         if pct is not None:
             doc["bindP50S"] = round(pct[50.0], 4)
             doc["bindP99S"] = round(pct[99.0], 4)
+        # Fault-recovery ledger: only present when something actually fired
+        # (a healthy stream's lastStream rows stay unchanged).
+        res = self.drain.resilience_doc()
+        if any(res.values()):
+            doc.update(res)
         return doc
 
 
@@ -143,6 +148,8 @@ def drain_stream(
     pace: bool = False,  # True = honor arrival offsets in wall time
     donate: bool | None = None,
     mesh=None,  # None | parallel.mesh.SolveLayout | parallel.mesh.MeshConfig
+    faults=None,  # faults.FaultInjector; None = the process-installed one
+    resilience=None,  # None | ResilienceConfig | DegradationLadder (shared)
 ) -> tuple[dict[str, dict[str, str]], StreamStats]:
     """Admit a live arrival trace; returns ({gang: {pod: node}}, StreamStats).
 
@@ -162,8 +169,25 @@ def drain_stream(
     `mesh`: mesh-sharded solves, same semantics as drain_backlog — the
     engine's free carry chains node-sharded between waves, fallbacks are
     counted, journaled waves record the mesh fingerprint.
+
+    `resilience`: the graceful-degradation ladder (solver/resilience.py).
+    The always-on loop is where the ladder EARNS its keep: between windows
+    the driver reconciles the engine against the breaker states — an open
+    `mesh` rung strips the layout (bitwise-equal unsharded), an open
+    `pruning` rung solves dense (admitted-equal by the escalation pin), an
+    open `pipeline` rung retires serially — and a wave failure past the
+    engine's own watchdog/retry budget charges the first active rung, so
+    repeated failures walk the loop down to the boring-but-correct
+    configuration and probation walks it back up. Admitted sets are
+    invariant across every rung (the PR 5-7 equivalence family), so chaos
+    changes latency, never placements. Pass a shared DegradationLadder to
+    let the controller/manager see (and export) the same breaker state.
+
+    `faults`: deterministic fault injector threaded through the engine's
+    named sites (grove_tpu/faults) — chaos runs replay bit-for-bit.
     """
     from grove_tpu.solver import warm as warm_mod
+    from grove_tpu.solver.resilience import ladder_for
 
     cfg = config or StreamConfig()
     params = params or SolverParams()
@@ -172,6 +196,7 @@ def drain_stream(
         pruning = None
     if donate is None:
         donate = warm_mod.donation_default()
+    ladder = ladder_for(resilience)
     if cfg.depth < 1:
         raise ValueError(f"streaming depth must be >= 1, got {cfg.depth}")
     if cfg.wave_size < 1:
@@ -211,6 +236,21 @@ def drain_stream(
             if g.name in wave_bindings:
                 stats.bind_latencies.append(max(0.0, wall - avail[g.name]))
 
+    # Ladder-effective starting configuration + engine watchdog/retry arms.
+    base_lag = cfg.depth if pipeline else 0
+    base_layout, base_pruning = layout, pruning
+    watchdog_s = None
+    max_wave_retries = 0
+    if ladder is not None:
+        watchdog_s = ladder.config.watchdog_seconds
+        max_wave_retries = ladder.config.max_wave_retries
+        if not ladder.allows("mesh"):
+            layout = None
+        if not ladder.allows("pruning"):
+            pruning = None
+        if not ladder.allows("pipeline"):
+            pass  # applied via retire_lag below
+
     engine = _WavePipeline(
         gangs=gangs_all,
         pods_by_name=pods_by_name,
@@ -220,14 +260,103 @@ def drain_stream(
         stats=dstats,
         pruning=pruning,
         donate=bool(donate),
-        retire_lag=cfg.depth if pipeline else 0,
+        retire_lag=(
+            base_lag
+            if ladder is None or ladder.allows("pipeline")
+            else 0
+        ),
         recorder=recorder,
         wave_prefix="stream",
         record_stamps=True,
         on_commit=on_commit,
         layout=layout,
+        faults=faults,
+        watchdog_s=watchdog_s,
+        max_wave_retries=max_wave_retries,
     )
     engine_box.append(engine)
+
+    def _active_rungs() -> tuple:
+        """The rungs currently at full config — the ones a new failure can
+        step down (ladder attribution order is resilience.SUBSYSTEMS)."""
+        active = []
+        if engine.layout is not None:
+            active.append("mesh")
+        if engine.pruning is not None:
+            active.append("pruning")
+        if engine.retire_lag != 0:
+            active.append("pipeline")
+        return tuple(active)
+
+    def _reconcile_ladder() -> None:
+        """Engine config <- breaker states: step open rungs down, step
+        probation-expired rungs back up (half-open trial — the next wave
+        runs at full config; its outcome closes or re-opens the breaker)."""
+        try:
+            # Layout transitions flush the in-flight waves first (their
+            # carries chain on the old buffers); a hung wave can block the
+            # transition — stay on the current layout this round and let
+            # the retirement path own retrying the hang.
+            if engine.layout is not None and not ladder.allows("mesh"):
+                engine.strip_layout()
+            elif (
+                engine.layout is None
+                and base_layout is not None
+                and ladder.allows("mesh")
+            ):
+                engine.adopt_layout(base_layout)
+        except WaveFault as e:
+            if e.fatal:
+                raise
+        engine.set_pruning(
+            base_pruning if ladder.allows("pruning") else None
+        )
+        engine.set_retire_lag(base_lag if ladder.allows("pipeline") else 0)
+
+    def _charge(e: WaveFault) -> None:
+        """A wave failed past the engine's own retry budget: charge the
+        first active rung, step the engine down, or give up when the ladder
+        has no rung left to sacrifice."""
+        if ladder is None or e.fatal:
+            raise e
+        if ladder.record_failure(active=_active_rungs()) is None:
+            raise e  # bottom of the ladder and still failing
+        _reconcile_ladder()
+
+    def _retire_down(to_lag: bool) -> None:
+        """Retire waves (down to the pipeline depth, or everything for the
+        final flush) under the ladder: a retirement failure leaves the wave
+        at the queue head, steps the ladder down, and retries with fresh
+        watchdog budget — a hung wave degrades the loop, it never loses a
+        gang."""
+        while engine.retire_due() if to_lag else engine.inflight:
+            try:
+                engine._retire_next()
+                if ladder is not None:
+                    ladder.record_success()
+            except WaveFault as e:
+                _charge(e)
+
+    def _submit(ws) -> None:
+        # Dispatch phase (retire=False: a failure here unambiguously means
+        # the wave was NOT enqueued, so the loop resubmits the SAME wave
+        # under the stepped-down config — arrivals are never dropped).
+        while True:
+            try:
+                if ladder is not None:
+                    _reconcile_ladder()
+                # Lazy AOT warm-up of first-seen shapes (compile-only; the
+                # executable cache + in-flight tracking dedupe process-wide).
+                tc = time.perf_counter()
+                if engine.warm_shape(ws):
+                    dstats.compile_s += time.perf_counter() - tc
+                engine.submit(ws, retire=False)
+                if ladder is not None:
+                    ladder.record_success()
+                break
+            except WaveFault as e:
+                _charge(e)
+        _retire_down(to_lag=True)
 
     t0 = time.perf_counter()
     engine.t0 = t0
@@ -255,21 +384,19 @@ def drain_stream(
             window, queue = queue[: cfg.wave_size], queue[cfg.wave_size :]
             stats.windows += 1
             for ws in plan_waves(window, cfg.wave_size):
-                # Lazy AOT warm-up of first-seen shapes (compile-only; the
-                # executable cache + in-flight tracking dedupe process-wide).
-                tc = time.perf_counter()
-                if engine.warm_shape(ws):
-                    dstats.compile_s += time.perf_counter() - tc
-                engine.submit(ws)
+                _submit(ws)
         elif pace:
             if engine.inflight:
                 # Host idle until the next arrival: retire the oldest
                 # in-flight wave now instead of sleeping on it later.
-                engine._retire_next()
+                try:
+                    engine._retire_next()
+                except WaveFault as e:
+                    _charge(e)
             else:
                 next_due = (t0 + arrivals[i][0]) if i < n else now
                 time.sleep(min(cfg.poll_s, max(0.0, next_due - now)))
-    engine.flush()
+    _retire_down(to_lag=False)
     stats.wall_s = time.perf_counter() - t0
     dstats.total_s = stats.wall_s
     stats.waves = dstats.waves

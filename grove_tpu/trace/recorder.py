@@ -136,6 +136,17 @@ class TraceRecorder:
         self.segments_written = 0
         self.waves = 0
         self.actions = 0
+        # Write-failure survival (ENOSPC, torn disk, injected recorder.write
+        # faults): the writer thread NEVER dies on an OSError — it drops the
+        # segment, counts every record in it as dropped, latches `degraded`,
+        # and keeps consuming the queue so the hot path stays unblocked. A
+        # later successful write clears `degraded` (disk recovered) but the
+        # cumulative write_errors counter persists — and is stamped into
+        # every subsequent segment so `trace info` can see the episode
+        # offline.
+        self.write_errors = 0
+        self.degraded = False
+        self._last_write_error: Optional[str] = None
         # fleet digests already enqueued this process (the writer re-emits
         # per segment from its own payload cache).
         self._announced: set[str] = set()
@@ -369,24 +380,49 @@ class TraceRecorder:
         last_flush = _time.monotonic()
 
         def write_segment() -> None:
-            nonlocal dirty, last_flush
+            nonlocal dirty, last_flush, segment, seg_digests
             if segment:
-                atomic_write_json(
-                    os.path.join(self.path, f"segment-{seq:06d}.json"),
-                    {
-                        "version": SCHEMA_VERSION,
-                        "records": segment,
-                        # Recorder-state counters AT WRITE TIME (cumulative
-                        # for this process): lets an offline reader
-                        # (`grove-tpu trace info`, the tuning sweep) tell a
-                        # truncated journal — records dropped under queue
-                        # pressure — from a genuinely quiet day. Additive
-                        # field: replay ignores it, old segments read as 0.
-                        "recorderDropped": self.dropped,
-                        "recorderRecorded": self.recorded,
-                    },
-                )
-                self.segments_written += 1
+                try:
+                    from grove_tpu import faults as faults_mod
+
+                    faults_mod.active().maybe_raise(
+                        "recorder.write", records=len(segment)
+                    )
+                    atomic_write_json(
+                        os.path.join(self.path, f"segment-{seq:06d}.json"),
+                        {
+                            "version": SCHEMA_VERSION,
+                            "records": segment,
+                            # Recorder-state counters AT WRITE TIME (cumulative
+                            # for this process): lets an offline reader
+                            # (`grove-tpu trace info`, the tuning sweep) tell a
+                            # truncated journal — records dropped under queue
+                            # pressure — from a genuinely quiet day. Additive
+                            # field: replay ignores it, old segments read as 0.
+                            "recorderDropped": self.dropped,
+                            "recorderRecorded": self.recorded,
+                            # Counting-drops mode ledger: segments the writer
+                            # could NOT persist (ENOSPC et al). > 0 tells an
+                            # offline reader the journal has a HOLE even when
+                            # the queue never overflowed.
+                            "recorderWriteErrors": self.write_errors,
+                        },
+                    )
+                    self.segments_written += 1
+                    self.degraded = False
+                except OSError as e:
+                    # Counting-drops mode: the journal is observability, the
+                    # solve loop is the product — a full disk must cost a
+                    # SEGMENT of records (counted), never the writer thread
+                    # (whose death would silently drop everything after) and
+                    # never a blocked solve. The segment buffer is released
+                    # so memory stays bounded while the disk is sick.
+                    self.write_errors += 1
+                    self.degraded = True
+                    self.dropped += len(segment)
+                    self._last_write_error = str(e)
+                    segment = []
+                    seg_digests = set()
             dirty = False
             last_flush = _time.monotonic()
 
@@ -455,7 +491,7 @@ class TraceRecorder:
 
     def stats(self) -> dict:
         """JSON-able recorder state for /statusz "trace" and the metrics."""
-        return {
+        doc = {
             "path": self.path,
             "recorded": self.recorded,
             "dropped": self.dropped,
@@ -463,22 +499,33 @@ class TraceRecorder:
             "actions": self.actions,
             "segmentsWritten": self.segments_written,
             "queueDepth": self._queue.qsize(),
+            "degraded": self.degraded,
+            "writeErrors": self.write_errors,
         }
+        if self._last_write_error:
+            doc["lastWriteError"] = self._last_write_error
+        return doc
 
 
 def journal_stats(path: str) -> dict:
     """Writer-side counters recovered from the segment files themselves:
-    {"dropped", "recorded", "segments"}. `dropped` > 0 means the journal is
-    TRUNCATED — records were lost under queue pressure — which a sweep or
-    replay consumer must surface (a wave referencing a dropped fleet fails
-    replay outright, but dropped WAVES are silent without this). Counters
-    are cumulative per writer process, so the max across segments is the
-    final count; segments written before the field existed read as 0."""
+    {"dropped", "recorded", "segments", "writeErrors", "degraded"}.
+    `dropped` > 0 means the journal is TRUNCATED — records were lost under
+    queue pressure or to failed segment writes — which a sweep or replay
+    consumer must surface (a wave referencing a dropped fleet fails replay
+    outright, but dropped WAVES are silent without this). `writeErrors` > 0
+    (stamped by the first segment successfully written AFTER a failed one)
+    means the writer spent time in counting-drops mode — the journal has a
+    hole even if the queue never overflowed; `degraded` mirrors it for
+    `trace info`. Counters are cumulative per writer process, so the max
+    across segments is the final count; segments written before the fields
+    existed read as 0."""
     files = [path] if os.path.isfile(path) else sorted(
         glob.glob(os.path.join(path, _SEGMENT_GLOB))
     )
     dropped = 0
     recorded = 0
+    write_errors = 0
     for p in files:
         try:
             with open(p) as f:
@@ -487,7 +534,16 @@ def journal_stats(path: str) -> dict:
             continue
         dropped = max(dropped, int(doc.get("recorderDropped", 0) or 0))
         recorded = max(recorded, int(doc.get("recorderRecorded", 0) or 0))
-    return {"dropped": dropped, "recorded": recorded, "segments": len(files)}
+        write_errors = max(
+            write_errors, int(doc.get("recorderWriteErrors", 0) or 0)
+        )
+    return {
+        "dropped": dropped,
+        "recorded": recorded,
+        "segments": len(files),
+        "writeErrors": write_errors,
+        "degraded": write_errors > 0,
+    }
 
 
 def read_journal(path: str) -> list[dict]:

@@ -1,0 +1,117 @@
+"""utils/backoff.py — decorrelated jitter, deadline awareness, determinism.
+
+The chaos suite replays fault schedules bit-for-bit, so the recovery
+pacing must be just as reproducible: same seed => same sleep sequence.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from grove_tpu.utils.backoff import Backoff, retry
+
+
+def test_first_delay_is_exactly_base():
+    b = Backoff(base_s=0.1, cap_s=5.0, seed=1)
+    assert b.next_delay() == 0.1
+
+
+def test_deterministic_under_fixed_seed():
+    a = Backoff(base_s=0.05, cap_s=10.0, seed=42)
+    b = Backoff(base_s=0.05, cap_s=10.0, seed=42)
+    seq_a = [a.next_delay() for _ in range(20)]
+    seq_b = [b.next_delay() for _ in range(20)]
+    assert seq_a == seq_b
+    c = Backoff(base_s=0.05, cap_s=10.0, seed=43)
+    assert [c.next_delay() for _ in range(20)] != seq_a
+
+
+def test_distribution_bounds_decorrelated():
+    """Every delay lies in [base, min(cap, 3*prev)] — the decorrelated-
+    jitter envelope — and the cap is an absolute ceiling."""
+    b = Backoff(base_s=0.1, cap_s=2.0, seed=7)
+    prev = b.next_delay()
+    for _ in range(200):
+        d = b.next_delay()
+        assert 0.1 <= d <= 2.0
+        assert d <= max(3.0 * prev, 0.1) + 1e-12
+        prev = d
+
+
+def test_delays_actually_grow_from_base():
+    """With a high cap the sequence must escalate beyond the base — a
+    backoff that never backs off is a fixed-sleep loop in disguise."""
+    b = Backoff(base_s=0.1, cap_s=100.0, seed=3)
+    seq = [b.next_delay() for _ in range(30)]
+    assert max(seq) > 1.0
+
+
+def test_deadline_clips_then_stops():
+    """A delay overshooting the deadline is clipped to land ON it; once the
+    deadline is spent, next_delay returns None (caller stops retrying)."""
+    now = [0.0]
+    b = Backoff(
+        base_s=1.0, cap_s=100.0, deadline_s=2.5, seed=0, clock=lambda: now[0]
+    )
+    assert b.next_delay() == 1.0
+    now[0] = 2.0
+    d = b.next_delay()
+    assert d == pytest.approx(0.5)  # clipped to the deadline
+    now[0] = 2.5
+    assert b.next_delay() is None
+    assert b.sleep() is False
+
+
+def test_reset_returns_to_fast_first_retry():
+    b = Backoff(base_s=0.2, cap_s=50.0, seed=5)
+    b.next_delay()
+    b.next_delay()
+    b.reset()
+    assert b.attempts == 0
+    assert b.next_delay() == 0.2
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        Backoff(base_s=0.0, cap_s=1.0)
+    with pytest.raises(ValueError):
+        Backoff(base_s=1.0, cap_s=0.5)
+
+
+def test_retry_succeeds_after_transients():
+    calls = {"n": 0}
+    slept: list[float] = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert (
+        retry(
+            flaky, attempts=5, base_s=0.01, cap_s=0.1, seed=1,
+            sleep=slept.append,
+        )
+        == "ok"
+    )
+    assert calls["n"] == 3
+    assert len(slept) == 2  # no real sleeping (injected sink)
+
+
+def test_retry_exhausts_and_reraises():
+    def always():
+        raise OSError("down")
+
+    slept: list[float] = []
+    with pytest.raises(OSError):
+        retry(always, attempts=3, base_s=0.01, cap_s=0.1, seed=1, sleep=slept.append)
+    assert len(slept) == 2  # attempts-1 sleeps
+
+
+def test_retry_respects_retry_on():
+    def boom():
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        retry(boom, attempts=5, retry_on=(OSError,), sleep=lambda _s: None)

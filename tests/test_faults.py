@@ -1,0 +1,365 @@
+"""grove_tpu/faults — deterministic injection registry, recorder ENOSPC
+survival, watch-retry policy, and the sim chaos script.
+
+The registry's contract is REPLAYABILITY: a chaos run is an input like any
+other, so the same spec+seed must produce the same fault schedule no matter
+how threads interleave across sites.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from grove_tpu import faults as faults_mod
+from grove_tpu.faults import (
+    FaultInjector,
+    InjectedFault,
+    SiteSpec,
+    parse_env,
+    parse_spec_entry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Never leak a process-wide injector across tests."""
+    yield
+    faults_mod.install(None)
+
+
+# ---- schedule determinism ---------------------------------------------------------
+
+
+def test_site_schedule_deterministic_and_interleaving_independent():
+    """Same (spec, seed) => identical fire pattern; another site being
+    evaluated in between must NOT shift the pattern (per-site RNG streams)."""
+    spec = {"a.site": SiteSpec(rate=0.5), "b.site": SiteSpec(rate=0.5)}
+    inj1 = FaultInjector(dict(spec), seed=7)
+    pattern1 = [inj1.should_fire("a.site") is not None for _ in range(64)]
+
+    inj2 = FaultInjector(dict(spec), seed=7)
+    pattern2 = []
+    for i in range(64):
+        if i % 3 == 0:
+            inj2.should_fire("b.site")  # interleaved traffic on another site
+        pattern2.append(inj2.should_fire("a.site") is not None)
+    assert pattern1 == pattern2
+    assert any(pattern1) and not all(pattern1)  # rate 0.5 actually mixes
+
+    inj3 = FaultInjector(dict(spec), seed=8)
+    assert [
+        inj3.should_fire("a.site") is not None for _ in range(64)
+    ] != pattern1
+
+
+def test_count_after_and_rate_edges():
+    inj = FaultInjector(
+        {"s": SiteSpec(rate=1.0, count=2, after=3)}, seed=0
+    )
+    fires = [inj.should_fire("s") is not None for _ in range(10)]
+    # Skips the first 3 evaluations, then fires exactly `count` times.
+    assert fires == [False, False, False, True, True, False, False, False, False, False]
+    assert inj.fired["s"] == 2 and inj.evaluated["s"] == 10
+
+    never = FaultInjector({"s": SiteSpec(rate=0.0)}, seed=0)
+    assert all(never.should_fire("s") is None for _ in range(32))
+    # Unknown site: free no-op.
+    assert inj.should_fire("unknown.site") is None
+
+
+# ---- raise/timeout surfaces -------------------------------------------------------
+
+
+def test_maybe_raise_kinds():
+    inj = FaultInjector(
+        {
+            "e": SiteSpec(kind="error"),
+            "n": SiteSpec(kind="enospc"),
+            "d": SiteSpec(kind="disconnect"),
+            "h": SiteSpec(kind="http503"),
+        },
+        seed=0,
+    )
+    with pytest.raises(InjectedFault):
+        inj.maybe_raise("e")
+    with pytest.raises(OSError) as ei:
+        inj.maybe_raise("n")
+    assert ei.value.errno == 28  # ENOSPC
+    with pytest.raises(OSError):
+        inj.maybe_raise("d")
+
+    class Fake(RuntimeError):
+        def __init__(self, status):
+            self.status = status
+
+    with pytest.raises(Fake) as hi:
+        inj.maybe_raise("h", exc_factory=Fake)
+    assert hi.value.status == 503
+
+
+def test_maybe_timeout():
+    inj = FaultInjector({"t": SiteSpec(kind="timeout", count=1)}, seed=0)
+    assert inj.maybe_timeout("t") is True
+    assert inj.maybe_timeout("t") is False  # count exhausted
+
+
+# ---- journaling + counters --------------------------------------------------------
+
+
+def test_fires_are_journaled_as_action_records():
+    captured = []
+
+    class FakeRecorder:
+        def capture_action(self, now, action, obj, **fields):
+            captured.append((action, obj, fields))
+
+    inj = FaultInjector(
+        {"solver.dispatch": SiteSpec(count=2)},
+        seed=0,
+        recorder=FakeRecorder(),
+        clock=lambda: 123.0,
+    )
+    for _ in range(5):
+        inj.should_fire("solver.dispatch", wave=9)
+    assert len(captured) == 2
+    action, obj, fields = captured[0]
+    assert action == "fault.injected" and obj == "solver.dispatch"
+    assert fields["faultKind"] == "error" and fields["wave"] == 9
+    assert inj.total_fired() == 2
+    stats = inj.stats()
+    assert stats["sites"]["solver.dispatch"]["fired"] == 2
+
+
+# ---- gating: install/active, config, env override ---------------------------------
+
+
+def test_active_defaults_disabled_and_install_roundtrip():
+    assert faults_mod.active().enabled is False
+    inj = FaultInjector({"s": SiteSpec()}, seed=1)
+    assert faults_mod.install(inj) is inj
+    assert faults_mod.active() is inj
+    faults_mod.install(None)
+    assert faults_mod.active().enabled is False
+
+
+def test_parse_env_syntax_and_errors():
+    specs, seed = parse_env(
+        "seed=9;solver.dispatch=error:0.5:3;recorder.write=enospc:1:2:4"
+    )
+    assert seed == 9
+    assert specs["solver.dispatch"] == SiteSpec("error", 0.5, 3, 0)
+    assert specs["recorder.write"] == SiteSpec("enospc", 1.0, 2, 4)
+    for bad in ("nonsense", "s=notakind:1", "s=error:2.0", "s=error:0.5:-1"):
+        with pytest.raises(ValueError):
+            parse_env(bad)
+
+
+def test_from_config_env_wins_over_config():
+    from grove_tpu.runtime.config import FaultsConfig
+
+    cfg = FaultsConfig(
+        enabled=True, seed=1, sites={"solver.dispatch": {"rate": 1.0}}
+    )
+    inj = faults_mod.from_config(cfg, env="")
+    assert inj is not None and "solver.dispatch" in inj.specs
+    inj2 = faults_mod.from_config(cfg, env="seed=5;recorder.write=enospc:1")
+    assert inj2 is not None
+    assert set(inj2.specs) == {"recorder.write"} and inj2.seed == 5
+    assert faults_mod.from_config(FaultsConfig(), env="") is None
+
+
+def test_parse_spec_entry_validation():
+    assert parse_spec_entry("s", {"kind": "timeout", "rate": 0.25}) == SiteSpec(
+        "timeout", 0.25, 0, 0
+    )
+    for bad in (
+        {"kind": "bogus"},
+        {"rate": 1.5},
+        {"count": -1},
+        {"unknownField": 1},
+        "not-a-mapping",
+    ):
+        with pytest.raises(ValueError):
+            parse_spec_entry("s", bad)
+
+
+# ---- recorder: ENOSPC -> counting-drops mode --------------------------------------
+
+
+def test_recorder_survives_enospc_in_counting_drops_mode(tmp_path):
+    """An injected segment-write failure must not kill the writer thread:
+    the segment's records are dropped AND counted, `degraded` latches until
+    a write succeeds, and the episode is stamped into later segments so
+    `trace info` (journal_stats) sees it offline."""
+    from grove_tpu.trace.recorder import TraceRecorder, journal_stats
+
+    faults_mod.install(
+        FaultInjector({"recorder.write": SiteSpec(kind="enospc", count=1)}, seed=0)
+    )
+    rec = TraceRecorder(str(tmp_path / "j"), max_records_per_file=4)
+    rec.start()
+    try:
+        for k in range(6):
+            rec.capture_action(float(k), "probe", f"obj-{k}")
+        assert rec.flush()
+        # First segment write fired ENOSPC -> 4 records dropped; writer
+        # alive and the remaining records landed in a later segment.
+        assert rec.write_errors == 1
+        assert rec.dropped >= 4
+        assert rec.degraded is False  # a later write succeeded
+        for k in range(4):
+            rec.capture_action(10.0 + k, "probe2", f"obj-{k}")
+        assert rec.flush()
+    finally:
+        rec.stop()
+    js = journal_stats(str(tmp_path / "j"))
+    assert js["writeErrors"] == 1 and js["degraded"] is True
+    assert js["dropped"] >= 4
+    # stats() carries the live degraded/writeErrors view for /statusz.
+    assert rec.stats()["writeErrors"] == 1
+
+
+def test_trace_info_cli_shows_degraded_flag(tmp_path, capsys):
+    """`grove-tpu trace info` renders the counting-drops episode."""
+    from grove_tpu.cli.main import main as cli_main
+    from grove_tpu.trace.recorder import TraceRecorder
+
+    faults_mod.install(
+        FaultInjector({"recorder.write": SiteSpec(kind="enospc", count=1)}, seed=0)
+    )
+    path = str(tmp_path / "j")
+    rec = TraceRecorder(path, max_records_per_file=2)
+    rec.start()
+    try:
+        for k in range(6):
+            rec.capture_action(float(k), "probe", f"o{k}")
+        rec.flush()
+    finally:
+        rec.stop()
+    faults_mod.install(None)
+    rc = cli_main(["trace", "info", "--path", path])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "degraded" in out.out and "True" in out.out
+    assert "recorder degraded" in out.err
+
+
+# ---- watch retry policy -----------------------------------------------------------
+
+
+def test_watch_retry_policy_counts_and_resets():
+    from grove_tpu.cluster.watch import WatchRetryPolicy
+
+    p = WatchRetryPolicy(base_s=0.5, cap_s=30.0, seed=4)
+    d1 = p.next_delay()
+    assert d1 == 0.5  # fast first retry
+    delays = [p.next_delay() for _ in range(10)]
+    assert all(0.5 <= d <= 30.0 for d in delays)
+    assert p.reconnects == 11
+    p.note_resync()
+    assert p.resyncs == 1
+    p.note_healthy()
+    assert p.next_delay() == 0.5  # reset -> fast again
+    assert p.reconnects == 12
+
+
+def test_kube_watch_reconnects_with_backoff_and_counts():
+    """Informer-loop integration: injected stream disconnects are survived
+    (resubscribe with the capped-backoff policy, COUNTED) and events keep
+    flowing afterward. Uses the wire-protocol fixture apiserver."""
+    from fixture_apiserver import FixtureApiServer, k8s_node
+
+    from grove_tpu.cluster.kubernetes import KubeContext, KubernetesWatchSource
+
+    api = FixtureApiServer()
+    try:
+        api.add_node(k8s_node("n1"))
+        src = KubernetesWatchSource(
+            KubeContext(server=api.url, namespace="default"),
+            watch_workloads=False,
+            watch_read_timeout_s=5.0,
+            qps=0.0,
+        )
+        # Shrink the retry pacing so the test never sleeps for real.
+        for rw in src._watches:
+            rw.retry.base_s, rw.retry.cap_s = 0.01, 0.02
+        faults_mod.install(
+            FaultInjector(
+                {"watch.disconnect": SiteSpec(kind="disconnect", rate=1.0, count=2)},
+                seed=0,
+            )
+        )
+        src.start()
+        import time as _time
+
+        t0 = _time.monotonic()
+        seen = set()
+        while _time.monotonic() - t0 < 20.0:
+            for ev in src.poll(0.0):
+                if ev.kind == "Node":
+                    seen.add(ev.name)
+            if "n1" in seen and src.watch_stats()["reconnects"] >= 1:
+                break
+            _time.sleep(0.01)
+        assert "n1" in seen
+        assert src.watch_stats()["reconnects"] >= 1
+        src.stop()
+    finally:
+        api.close()
+        faults_mod.install(None)
+
+
+# ---- sim chaos script -------------------------------------------------------------
+
+
+def _sim():
+    from tests.scenario_harness import e2e_nodes, e2e_topology
+
+    from grove_tpu.orchestrator.controller import GroveController
+    from grove_tpu.orchestrator.store import Cluster
+    from grove_tpu.sim.simulator import Simulator
+
+    cluster = Cluster()
+    for n in e2e_nodes(4):
+        cluster.nodes[n.name] = n
+    ctrl = GroveController(cluster=cluster, topology=e2e_topology())
+    return cluster, ctrl, Simulator(cluster=cluster, controller=ctrl)
+
+
+def test_sim_fault_script_fires_in_order_and_journals():
+    cluster, ctrl, sim = _sim()
+    captured = []
+
+    class FakeRecorder:
+        def capture_action(self, now, action, obj, **fields):
+            captured.append((now, action, obj))
+
+    ctrl.recorder = FakeRecorder()
+    sim.schedule_fault(3.0, "cordon", "w1")
+    sim.schedule_fault(1.0, "kill_node", "w0")
+    with pytest.raises(ValueError):
+        sim.schedule_fault(2.0, "not_an_action", "w0")
+    sim.run(4.0)
+    assert not cluster.nodes["w0"].schedulable  # killed at t=1
+    assert not cluster.nodes["w1"].schedulable  # cordoned at t=3
+    actions = [(t, a, o) for t, a, o in captured if a.startswith("chaos.")]
+    assert ("chaos.kill_node" in {a for _, a, _ in actions})
+    assert ("chaos.cordon" in {a for _, a, _ in actions})
+    kill_t = next(t for t, a, o in actions if a == "chaos.kill_node")
+    cordon_t = next(t for t, a, o in actions if a == "chaos.cordon" and o == "w1")
+    assert kill_t < cordon_t
+    assert not sim.fault_script  # consumed
+
+
+def test_sim_node_death_site_kills_deterministically():
+    cluster, ctrl, sim = _sim()
+    faults_mod.install(
+        FaultInjector({"sim.node_death": SiteSpec(rate=1.0, count=1)}, seed=0)
+    )
+    sim.run(2.0)
+    # First schedulable node in name order dies, exactly once.
+    assert not cluster.nodes["w0"].schedulable
+    assert all(cluster.nodes[n].schedulable for n in ("w1", "w2", "w3"))

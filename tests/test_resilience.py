@@ -1,0 +1,772 @@
+"""Failure-domain hardening: the degradation ladder (solver/resilience.py),
+the engine watchdog (solver/drain.py), chaos-under-stream parity, the
+controller's bind hardening, and the manager/CLI surfaces.
+
+The load-bearing invariant everywhere: every ladder rung is admitted-set-
+preserving (sharded==unsharded bitwise, pruned==dense via escalation,
+pipelined==serial by construction), so chaos changes LATENCY, never
+placements — the tests hold admitted sets equal to fault-free runs.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu import faults as faults_mod
+from grove_tpu.faults import FaultInjector, SiteSpec
+from grove_tpu.solver.drain import DrainStats, WaveFault, _WavePipeline, drain_backlog
+from grove_tpu.solver.resilience import (
+    CircuitBreaker,
+    DegradationLadder,
+    ResilienceConfig,
+    SUBSYSTEMS,
+    ladder_for,
+)
+from grove_tpu.solver.stream import StreamConfig, drain_stream
+
+SEED = 20260804
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    faults_mod.install(None)
+
+
+# ---- circuit breaker (fake clock, no sleeps) --------------------------------------
+
+
+def test_breaker_opens_after_threshold_within_window():
+    br = CircuitBreaker(threshold=3, window_s=10.0, probation_s=5.0)
+    assert br.record_failure(0.0) is False
+    assert br.record_failure(1.0) is False
+    assert br.record_failure(2.0) is True  # third within the window: OPEN
+    assert br.state == "open" and br.step_downs == 1
+    assert br.allow(3.0) is False  # still in probation
+
+
+def test_breaker_window_expires_old_failures():
+    br = CircuitBreaker(threshold=3, window_s=10.0)
+    br.record_failure(0.0)
+    br.record_failure(1.0)
+    # The first two fall out of the window; these two are not enough.
+    assert br.record_failure(20.0) is False
+    assert br.record_failure(21.0) is False
+    assert br.state == "closed"
+
+
+def test_breaker_half_open_trial_success_closes():
+    br = CircuitBreaker(threshold=1, probation_s=5.0)
+    br.record_failure(0.0)
+    assert br.state == "open"
+    assert br.allow(4.9) is False
+    assert br.allow(5.0) is True  # probation elapsed: half-open trial
+    assert br.state == "half-open"
+    assert br.record_success(5.1) is True  # trial passed: step-up
+    assert br.state == "closed" and br.step_ups == 1
+
+
+def test_breaker_half_open_trial_failure_reopens():
+    br = CircuitBreaker(threshold=1, probation_s=5.0)
+    br.record_failure(0.0)
+    br.allow(5.0)  # -> half-open
+    assert br.record_failure(5.1) is False  # re-open is NOT a new step-down
+    assert br.state == "open" and br.step_downs == 1
+    # Probation restarts from the failed trial.
+    assert br.allow(9.0) is False
+    assert br.allow(10.2) is True
+
+
+def test_breaker_success_in_closed_is_noop():
+    br = CircuitBreaker()
+    assert br.record_success(0.0) is False
+    assert br.state == "closed" and br.step_ups == 0
+
+
+# ---- degradation ladder -----------------------------------------------------------
+
+
+def _ladder(clock, **kw):
+    cfg = ResilienceConfig(
+        enabled=True,
+        breaker_threshold=kw.pop("threshold", 1),
+        probation_seconds=kw.pop("probation", 5.0),
+        breaker_window_seconds=60.0,
+        **kw,
+    )
+    events = []
+    lad = DegradationLadder(
+        cfg, clock=clock, on_event=lambda ev, s: events.append((ev, s))
+    )
+    return lad, events
+
+
+def test_unattributed_failures_walk_down_the_ladder_in_order():
+    now = [0.0]
+    lad, events = _ladder(lambda: now[0])
+    assert lad.record_failure() == "mesh"
+    assert lad.record_failure() == "pruning"
+    assert lad.record_failure() == "pipeline"
+    assert lad.record_failure() == "portfolio"
+    assert lad.record_failure() is None  # bottom: nothing left to charge
+    assert [e for e in events if e[0] == "step_down"] == [
+        ("step_down", s) for s in SUBSYSTEMS
+    ]
+    assert not lad.fully_closed()
+
+
+def test_active_filter_skips_inactive_rungs():
+    now = [0.0]
+    lad, _ = _ladder(lambda: now[0])
+    # A stream with no mesh and no pruning charges the pipeline directly.
+    assert lad.record_failure(active=("pipeline",)) == "pipeline"
+
+
+def test_ladder_probation_trial_and_step_up():
+    now = [0.0]
+    lad, events = _ladder(lambda: now[0], probation=5.0)
+    lad.record_failure("pruning")
+    assert not lad.allows("pruning")
+    now[0] = 6.0
+    assert lad.allows("pruning")  # half-open trial
+    assert ("trial", "pruning") in events
+    assert lad.record_success() == ["pruning"]
+    assert ("step_up", "pruning") in events
+    assert lad.fully_closed()
+    assert lad.counters()["pruning"] == {"stepDowns": 1, "stepUps": 1}
+
+
+def test_ladder_stats_shape():
+    lad, _ = _ladder(time.monotonic)
+    doc = lad.stats()
+    assert set(doc["subsystems"]) == set(SUBSYSTEMS)
+    assert {"state", "stepDowns", "stepUps", "recentFailures"} <= set(
+        doc["subsystems"]["mesh"]
+    )
+
+
+def test_ladder_for_normalization():
+    lad = DegradationLadder(ResilienceConfig(enabled=True))
+    assert ladder_for(lad) is lad
+    assert ladder_for(None) is None
+    assert ladder_for(ResilienceConfig(enabled=False)) is None
+    assert isinstance(ladder_for(ResilienceConfig(enabled=True)), DegradationLadder)
+    with pytest.raises(TypeError):
+        ladder_for("nope")
+
+
+# ---- watchdog edge cases (fake clock/futures; NO real sleeps) ---------------------
+
+
+def _bare_engine(**attrs):
+    eng = object.__new__(_WavePipeline)
+    eng.faults = None
+    eng.watchdog_s = None
+    eng.clock = time.perf_counter
+    eng.watchdog_poll_s = 0.0
+    eng.stats = DrainStats()
+    for k, v in attrs.items():
+        setattr(eng, k, v)
+    return eng
+
+
+class _FakeFuture:
+    def __init__(self, ready_after_polls: int):
+        self.polls_left = ready_after_polls
+
+    def is_ready(self):
+        if self.polls_left <= 0:
+            return True
+        self.polls_left -= 1
+        return False
+
+
+def test_watchdog_hung_future_times_out_without_sleeping():
+    """A dispatch that never completes: is_ready stays False, the fake
+    clock is already past the deadline — the watchdog reports a hang on
+    the first poll (no wall-clock waiting)."""
+    now = [100.0]
+    eng = _bare_engine(watchdog_s=5.0, clock=lambda: now[0])
+    rec = {"ok": _FakeFuture(ready_after_polls=10**9), "dispatched_at": 0.0}
+    assert eng._wave_hung(rec) is True
+
+
+def test_watchdog_timeout_racing_normal_retirement_prefers_the_result():
+    """The result turns ready exactly as the deadline passes: completed
+    work is never discarded — the wave harvests normally."""
+    now = [100.0]
+    eng = _bare_engine(watchdog_s=5.0, clock=lambda: now[0])
+    rec = {"ok": _FakeFuture(ready_after_polls=0), "dispatched_at": 0.0}
+    assert eng._wave_hung(rec) is False
+
+
+def test_watchdog_result_ready_after_a_few_polls_inside_deadline():
+    now = [0.0]
+    eng = _bare_engine(watchdog_s=5.0, clock=lambda: now[0])
+    rec = {"ok": _FakeFuture(ready_after_polls=3), "dispatched_at": 0.0}
+    assert eng._wave_hung(rec) is False
+
+
+def test_watchdog_no_readiness_probe_blocks_normally():
+    """A result object without is_ready (portfolio closure path) cannot be
+    watched — the watchdog declines rather than guessing."""
+    eng = _bare_engine(watchdog_s=0.001, clock=lambda: 1e9)
+    rec = {"ok": object(), "dispatched_at": 0.0}
+    assert eng._wave_hung(rec) is False
+
+
+def test_double_cancel_is_noop():
+    eng = _bare_engine()
+    rec = {"ok": None, "cancelled": False}
+    assert eng.cancel_wave(rec) is True
+    assert eng.cancel_wave(rec) is False  # second cancel: no-op, not double-counted
+    assert eng.stats.waves_cancelled == 1
+
+
+# ---- chaos under streaming: the tier-1 deterministic chaos test -------------------
+
+
+def _fleet(racks=2, hosts=6):
+    from grove_tpu.sim.workloads import bench_topology, synthetic_cluster
+    from grove_tpu.state import build_snapshot
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=racks, hosts_per_rack=hosts
+    )
+    return topo, build_snapshot(nodes, topo)
+
+
+def _trace(duration_s=6.0, rate=4.0):
+    from grove_tpu.sim.workloads import arrival_process, expand_arrivals
+
+    evs = arrival_process(SEED, duration_s=duration_s, base_rate=rate)
+    return expand_arrivals(evs)
+
+
+def _pruning(min_fleet=8):
+    from grove_tpu.solver.pruning import PruningConfig
+
+    return PruningConfig(enabled=True, min_fleet=min_fleet)
+
+
+def test_stream_chaos_parity_and_recovery(tmp_path):
+    """THE fast chaos gate (fixed fault schedule, tier-1): injected dispatch
+    errors and harvest hangs under the ladder must not change the admitted
+    set, every injected fault must land in the journal as an action record,
+    the journal must still replay bitwise, and the ladder must end fully
+    closed (step-down AND step-up observed)."""
+    from grove_tpu.solver.warm import WarmPath
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    topo, snapshot = _fleet()
+    arrivals, pods = _trace()
+    cfg = StreamConfig(depth=2, wave_size=16)
+    wp = WarmPath()
+    pruning = _pruning()
+
+    base_bindings, base_stats = drain_stream(
+        arrivals, pods, snapshot, config=cfg, warm_path=wp, pruning=pruning
+    )
+    assert base_stats.admitted > 0
+
+    injector = FaultInjector(
+        {
+            "solver.dispatch": SiteSpec(kind="error", rate=1.0, count=3, after=1),
+            "solver.harvest": SiteSpec(kind="timeout", rate=1.0, count=2, after=4),
+        },
+        seed=SEED,
+    )
+    ladder = DegradationLadder(
+        ResilienceConfig(
+            enabled=True,
+            max_wave_retries=1,
+            breaker_threshold=2,
+            breaker_window_seconds=300.0,
+            probation_seconds=0.01,
+        )
+    )
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    injector.recorder = rec
+    try:
+        chaos_bindings, chaos_stats = drain_stream(
+            arrivals, pods, snapshot, config=cfg, warm_path=wp,
+            pruning=pruning, faults=injector, resilience=ladder, recorder=rec,
+        )
+        rec.flush()
+    finally:
+        rec.stop()
+
+    # Chaos changed latency, never placements.
+    assert set(chaos_bindings) == set(base_bindings)
+    assert chaos_bindings == base_bindings  # same pods on same nodes, too
+    # The machinery actually fired (this is a chaos test, not a quiet run).
+    fired = injector.total_fired()
+    assert fired == 5
+    assert chaos_stats.drain.wave_retries > 0
+    assert chaos_stats.drain.watchdog_timeouts > 0
+    assert chaos_stats.drain.waves_cancelled > 0
+    # Every injected fault journaled as an action record.
+    records = read_journal(str(tmp_path / "journal"))
+    actions = [
+        r
+        for r in records
+        if r.get("kind") == "action" and r.get("action") == "fault.injected"
+    ]
+    assert len(actions) == fired
+    # Ladder: stepped down under the storm, recovered to the fast path.
+    counters = ladder.counters()
+    downs = sum(c["stepDowns"] for c in counters.values())
+    ups = sum(c["stepUps"] for c in counters.values())
+    assert downs > 0 and ups > 0
+    assert ladder.fully_closed()
+    # The chaos journal still replays bitwise (degraded waves journal their
+    # EFFECTIVE config, so replay rebuilds the right executables).
+    report = replay_journal(records, warm_path=wp)
+    assert report.divergence_count == 0
+
+
+def test_stream_harvest_hangs_absorbed_by_watchdog_alone():
+    """Hang faults within the engine's own re-dispatch budget never reach
+    the ladder: admitted set identical, zero ladder failures."""
+    from grove_tpu.solver.warm import WarmPath
+
+    topo, snapshot = _fleet()
+    arrivals, pods = _trace(duration_s=4.0)
+    cfg = StreamConfig(depth=2, wave_size=16)
+    wp = WarmPath()
+    base, _ = drain_stream(arrivals, pods, snapshot, config=cfg, warm_path=wp)
+    injector = FaultInjector(
+        {"solver.harvest": SiteSpec(kind="timeout", rate=1.0, count=2, after=2)},
+        seed=SEED,
+    )
+    ladder = DegradationLadder(ResilienceConfig(enabled=True, max_wave_retries=2))
+    chaos, stats = drain_stream(
+        arrivals, pods, snapshot, config=cfg, warm_path=wp,
+        faults=injector, resilience=ladder,
+    )
+    assert chaos == base
+    assert stats.drain.watchdog_timeouts == 2
+    assert stats.drain.wave_redispatches >= 1
+    assert ladder.fully_closed()
+    assert sum(c["stepDowns"] for c in ladder.counters().values()) == 0
+
+
+def test_stream_fault_without_ladder_raises_wavefault():
+    """No resilience attached = no silent recovery: an injected dispatch
+    failure surfaces as WaveFault (the pre-hardening contract, explicit)."""
+    from grove_tpu.solver.warm import WarmPath
+
+    topo, snapshot = _fleet()
+    arrivals, pods = _trace(duration_s=3.0)
+    injector = FaultInjector(
+        {"solver.dispatch": SiteSpec(kind="error", rate=1.0, count=1)}, seed=SEED
+    )
+    with pytest.raises(WaveFault):
+        drain_stream(
+            arrivals, pods, snapshot,
+            config=StreamConfig(depth=2, wave_size=16),
+            warm_path=WarmPath(), faults=injector,
+        )
+
+
+def test_stream_ladder_bottom_reraises():
+    """A fault that keeps firing at the maximally degraded config exhausts
+    the ladder and surfaces — degradation is bounded, not an infinite loop."""
+    from grove_tpu.solver.warm import WarmPath
+
+    topo, snapshot = _fleet()
+    arrivals, pods = _trace(duration_s=3.0)
+    injector = FaultInjector(
+        {"solver.dispatch": SiteSpec(kind="error", rate=1.0, count=0)},  # unlimited
+        seed=SEED,
+    )
+    ladder = DegradationLadder(
+        ResilienceConfig(
+            enabled=True, max_wave_retries=0, breaker_threshold=1,
+            probation_seconds=3600.0,
+        )
+    )
+    with pytest.raises(WaveFault):
+        drain_stream(
+            arrivals, pods, snapshot,
+            config=StreamConfig(depth=2, wave_size=16),
+            warm_path=WarmPath(), pruning=_pruning(),
+            faults=injector, resilience=ladder,
+        )
+    # It walked the whole ladder before giving up.
+    assert not ladder.fully_closed()
+
+
+def test_drain_backlog_applies_open_rungs_at_construction():
+    """The batch drain consults the ladder once up front: an open pruning
+    rung solves dense, an open pipeline rung harvests wave-serial — and the
+    admitted set matches the full-config drain (the rung equivalences)."""
+    from grove_tpu.solver.warm import WarmPath
+
+    from grove_tpu.solver.pruning import PruningConfig
+
+    topo, snapshot = _fleet()
+    arrivals, pods = _trace(duration_s=4.0)
+    gangs = [g for _, g in arrivals]
+    wp = WarmPath()
+    # A clip-tight budget forces real pruned waves on this small fleet
+    # (clipped candidates mark gangs lossy, so the escalation machinery
+    # keeps admitted sets dense-equal — exactly the rung equivalence).
+    pruning = PruningConfig(
+        enabled=True, min_fleet=8, min_pad=4, pad_ladder=(4, 8, 16),
+        max_candidates=8,
+    )
+    full, full_stats = drain_backlog(
+        gangs, pods, snapshot, wave_size=16, warm_path=wp,
+        pruning=pruning, harvest="pipeline",
+    )
+    assert full_stats.pruned_waves > 0
+
+    ladder = DegradationLadder(
+        ResilienceConfig(
+            enabled=True, breaker_threshold=1, probation_seconds=3600.0
+        )
+    )
+    ladder.record_failure("pruning")
+    ladder.record_failure("pipeline")
+    degraded, stats = drain_backlog(
+        gangs, pods, snapshot, wave_size=16, warm_path=wp,
+        pruning=pruning, harvest="pipeline", resilience=ladder,
+    )
+    assert stats.pruned_waves == 0  # dense
+    assert stats.harvest == "wave"  # serial
+    assert set(degraded) == set(full)
+
+
+# ---- controller: bind hardening ---------------------------------------------------
+
+
+def _controller_world(replicas=3):
+    from grove_tpu.orchestrator.controller import GroveController
+    from grove_tpu.orchestrator.store import Cluster
+    from grove_tpu.sim.simulator import Simulator
+    from grove_tpu.sim.workloads import _clique, _pcs, bench_topology, synthetic_cluster
+
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=1, racks_per_block=1, hosts_per_rack=4,
+        cpu=4.0, tpu=0.0,
+    ):
+        cluster.nodes[n.name] = n
+    ctrl = GroveController(cluster=cluster, topology=bench_topology())
+    cluster.podcliquesets["a"] = _pcs("a", cliques=[_clique("w", replicas, "2")])
+    return cluster, ctrl, Simulator(cluster=cluster, controller=ctrl)
+
+
+def test_bind_commit_fault_rolls_back_whole_gang_then_recovers():
+    cluster, ctrl, sim = _controller_world()
+    faults_mod.install(
+        FaultInjector({"bind.commit": SiteSpec(kind="error", count=1, after=1)}, seed=0)
+    )
+    ctrl.reconcile(1.0)
+    # All-or-nothing: the mid-gang failure restored every pod (none half-bound).
+    assert ctrl.resilience_counts["bind_rollbacks"] == 1
+    assert all(p.node_name is None for p in cluster.pods.values())
+    assert any("rolled back" in e[2] for e in cluster.recent_events())
+    # Fault exhausted: the next pass binds the whole gang cleanly.
+    ctrl.reconcile(2.0)
+    active = [p for p in cluster.pods.values() if p.is_active]
+    assert active and all(p.node_name for p in active)
+    faults_mod.install(None)
+
+
+def test_stale_plan_revalidation_requeues_instead_of_binding_dead_node():
+    cluster, ctrl, sim = _controller_world()
+    ctrl.reconcile(1.0)
+    gang_name = next(iter(cluster.podgangs))
+    pod = next(p for p in cluster.pods.values() if p.is_active)
+    # Target node vanished between solve and bind.
+    assert ctrl._bind_gang(gang_name, {pod.name: "no-such-node"}, 2.0) is False
+    assert ctrl.resilience_counts["stale_plan_requeues"] == 1
+    # Cordoned-after-solve is stale too.
+    some_node = next(iter(cluster.nodes))
+    cluster.nodes[some_node].schedulable = False
+    assert ctrl._bind_gang(gang_name, {pod.name: some_node}, 3.0) is False
+    assert ctrl.resilience_counts["stale_plan_requeues"] == 2
+    assert any("requeued" in e[2] for e in cluster.recent_events())
+
+
+def test_controller_solve_failure_retries_fully_degraded():
+    import grove_tpu.orchestrator.controller as ctrl_mod
+
+    cluster, ctrl, sim = _controller_world()
+    ladder = DegradationLadder(ResilienceConfig(enabled=True))
+    ctrl.resilience = ladder
+    real_solve = ctrl_mod.solve
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected solve failure")
+        return real_solve(*a, **kw)
+
+    try:
+        ctrl_mod.solve = flaky
+        ctrl.reconcile(1.0)
+    finally:
+        ctrl_mod.solve = real_solve
+    assert ctrl.resilience_counts["solve_degraded_retries"] == 1
+    # The degraded retry still admitted and bound the gang this same pass.
+    active = [p for p in cluster.pods.values() if p.is_active]
+    assert active and all(p.node_name for p in active)
+
+
+# ---- config / manager / CLI surfaces ----------------------------------------------
+
+
+def test_config_blocks_validated():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {
+            "resilience": {
+                "enabled": True,
+                "watchdogSeconds": 5.0,
+                "maxWaveRetries": 1,
+                "breakerThreshold": 2,
+                "probationSeconds": 1.0,
+                "bindMaxAttempts": 4,
+            },
+            "faults": {
+                "enabled": True,
+                "seed": 3,
+                "sites": {"solver.dispatch": {"kind": "error", "rate": 0.5}},
+            },
+        }
+    )
+    assert not errors, errors
+    rc = cfg.resilience.resilience_config()
+    assert rc.enabled and rc.watchdog_seconds == 5.0 and rc.bind_max_attempts == 4
+
+    _, errors = parse_operator_config(
+        {
+            "resilience": {"breakerThreshold": 0, "watchdogSeconds": 0},
+            "faults": {"sites": {"bogus": {}, "solver.dispatch": {"rate": 2}}},
+        }
+    )
+    assert any("breakerThreshold" in e for e in errors)
+    assert any("watchdogSeconds" in e for e in errors)
+    assert any("bogus" in e for e in errors)
+    assert any("rate" in e for e in errors)
+
+
+def test_manager_wires_ladder_injector_statusz_and_metrics():
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "solver": {"compilationCacheDir": "", "prewarmTopK": 0},
+            "resilience": {"enabled": True, "probationSeconds": 1.0},
+            "faults": {
+                "enabled": True,
+                "seed": 2,
+                "sites": {"bind.commit": {"kind": "error", "rate": 0.0}},
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    assert m.resilience_ladder is not None
+    assert m.controller.resilience is m.resilience_ladder
+    assert m.fault_injector is not None
+    doc = m.statusz()["resilience"]
+    assert doc["enabled"] is True
+    assert set(doc["ladder"]["subsystems"]) == set(SUBSYSTEMS)
+    assert doc["binds"] == {
+        "bind_rollbacks": 0,
+        "stale_plan_requeues": 0,
+        "solve_degraded_retries": 0,
+    }
+    assert "solver.dispatch" not in doc["faults"]["sites"]
+    # Ladder transitions export as labeled counters (delta discipline).
+    for _ in range(3):
+        m.resilience_ladder.record_failure("mesh")
+    m.controller.resilience_counts["bind_rollbacks"] += 2
+    m.reconcile_once(time.time())
+    text = m.metrics.render_text()
+    assert 'grove_degradation_step_downs_total{subsystem="mesh"} 1' in text
+    assert "grove_bind_rollbacks_total 2" in text
+    m.reconcile_once(time.time())  # second pass must not re-export
+    text = m.metrics.render_text()
+    assert 'grove_degradation_step_downs_total{subsystem="mesh"} 1' in text
+    assert "grove_bind_rollbacks_total 2" in text
+
+
+def test_manager_start_installs_and_stop_clears_injector(tmp_path):
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "solver": {"compilationCacheDir": "", "prewarmTopK": 0},
+            "faults": {
+                "enabled": True,
+                "sites": {"bind.commit": {"kind": "error", "rate": 0.0}},
+            },
+        }
+    )
+    assert not errors, errors
+    m = Manager(cfg)
+    assert faults_mod.active().enabled is False
+    m.start()
+    try:
+        assert faults_mod.active() is m.fault_injector
+    finally:
+        m.stop()
+    assert faults_mod.active().enabled is False
+
+
+def test_cli_get_resilience_renders():
+    from grove_tpu.cli.main import _get_table
+
+    class FakeClient:
+        def statusz(self):
+            return {
+                "resilience": {
+                    "enabled": True,
+                    "ladder": {
+                        "waveFailures": 3,
+                        "waveSuccesses": 40,
+                        "subsystems": {
+                            "pruning": {
+                                "state": "open",
+                                "stepDowns": 1,
+                                "stepUps": 0,
+                            }
+                        },
+                    },
+                    "binds": {"bind_rollbacks": 2, "stale_plan_requeues": 1},
+                    "watch": {"reconnects": 4, "resyncs": 1, "bindRetries": 3},
+                    "recorder": {"degraded": True, "writeErrors": 2},
+                    "faults": {
+                        "seed": 7,
+                        "sites": {
+                            "solver.dispatch": {
+                                "kind": "error",
+                                "fired": 3,
+                                "evaluated": 10,
+                            }
+                        },
+                    },
+                }
+            }
+
+    out = _get_table(FakeClient(), "resilience")
+    assert "ladder.pruning" in out and "open" in out
+    assert "binds.bind_rollbacks" in out
+    assert "watch.reconnects" in out
+    assert "recorder.degraded" in out and "yes" in out
+    assert "faults.solver.dispatch" in out and "fired 3/10" in out
+
+
+def test_kube_bind_retry_uses_backoff_and_counts():
+    """observe_binding retries the create+bind sequence in-call (injected
+    5xx on the wire), converging without double-binding; exhaustion
+    returns False for the cross-tick retry set."""
+    from fixture_apiserver import FixtureApiServer
+    from grove_tpu.cluster.kubernetes import KubeContext, KubernetesWatchSource
+
+    api = FixtureApiServer()
+    try:
+        src = KubernetesWatchSource(
+            KubeContext(server=api.url, namespace="default"),
+            watch_workloads=False,
+            qps=0.0,
+            bind_retry_attempts=3,
+            backoff_base_s=0.01,
+            backoff_cap_s=0.02,
+            pod_manifest_for=lambda name: {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": name},
+                "spec": {"containers": []},
+            },
+        )
+        faults_mod.install(
+            FaultInjector(
+                {"kube.request": SiteSpec(kind="http503", rate=1.0, count=1)},
+                seed=0,
+            )
+        )
+        assert src.observe_binding("pod-x", "node-y", 0.0) is True
+        assert src.bind_retries == 1
+        assert api.binding_log == [("pod-x", "node-y")]  # bound exactly once
+        # Persistent failure: exhausts in-call retries, returns False.
+        faults_mod.install(
+            FaultInjector(
+                {"kube.request": SiteSpec(kind="http503", rate=1.0, count=0)},
+                seed=0,
+            )
+        )
+        assert src.observe_binding("pod-z", "node-y", 0.0) is False
+        assert api.binding_log == [("pod-x", "node-y")]
+    finally:
+        api.close()
+        faults_mod.install(None)
+
+
+# ---- slow soak --------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_stream_chaos_soak_long_trace(tmp_path):
+    """Longer chaos soak (slow tier): a denser fault schedule over a longer
+    arrival trace, same gates — parity, full accounting, ladder recovery."""
+    from grove_tpu.solver.warm import WarmPath
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+
+    topo, snapshot = _fleet(racks=4, hosts=8)
+    arrivals, pods = _trace(duration_s=30.0, rate=8.0)
+    cfg = StreamConfig(depth=2, wave_size=32)
+    wp = WarmPath()
+    pruning = _pruning()
+    base, _ = drain_stream(
+        arrivals, pods, snapshot, config=cfg, warm_path=wp, pruning=pruning
+    )
+    injector = FaultInjector(
+        {
+            "solver.dispatch": SiteSpec(kind="error", rate=0.6, count=8, after=2),
+            "solver.harvest": SiteSpec(kind="timeout", rate=0.5, count=6, after=4),
+        },
+        seed=SEED,
+    )
+    ladder = DegradationLadder(
+        ResilienceConfig(
+            enabled=True, max_wave_retries=1, breaker_threshold=2,
+            breaker_window_seconds=300.0, probation_seconds=0.01,
+        )
+    )
+    rec = TraceRecorder(str(tmp_path / "journal"), max_files=64)
+    rec.start()
+    injector.recorder = rec
+    try:
+        chaos, stats = drain_stream(
+            arrivals, pods, snapshot, config=cfg, warm_path=wp,
+            pruning=pruning, faults=injector, resilience=ladder, recorder=rec,
+        )
+        rec.flush()
+    finally:
+        rec.stop()
+    assert chaos == base
+    records = read_journal(str(tmp_path / "journal"))
+    actions = sum(
+        1
+        for r in records
+        if r.get("kind") == "action" and r.get("action") == "fault.injected"
+    )
+    assert actions == injector.total_fired() > 0
+    assert ladder.fully_closed()
