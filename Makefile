@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak bench-chaos bench-chaos-soak profile-host dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak bench-chaos bench-chaos-soak bench-tenancy bench-tenancy-soak profile-host dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -117,6 +117,19 @@ bench-chaos:     ## chaos soak: streaming drain under injected faults + degradat
 bench-chaos-soak: ## chaos soak over a longer arrival trace (slow)
 	@mkdir -p evidence
 	GROVE_BENCH_SCENARIO=chaos GROVE_FORCE_CPU=1 GROVE_BENCH_CHAOS_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_chaos_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+# Tenancy scenario: hundreds of churning tenants with a mixed SLO-class
+# arrival trace through the manager's reconcile loop — fairness spread,
+# per-tier time-to-bind p50/p99, reclaim under the disruption budget, chaos
+# healing, and journal replay all gated in one run. Evidence JSON tee'd
+# under evidence/; the soak variant lengthens the trace (slow tier).
+bench-tenancy:   ## multi-tenant SLO tiers: fairness + tier ordering + reclaim budget + replay
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=tenancy GROVE_FORCE_CPU=1 $(PY) bench.py | tee evidence/bench_tenancy_cpu_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+bench-tenancy-soak: ## tenancy scenario over a longer trace with more tenants (slow)
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=tenancy GROVE_FORCE_CPU=1 GROVE_BENCH_TENANCY_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_tenancy_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
 
 # Host hot-path profile: cProfile a warm steady-state drain, top cumulative
 # frames + the host-stage ledger as JSON under evidence/.

@@ -51,6 +51,13 @@ serial-per-config baselines over one recorded stream trace, winner
 validation gates included. GROVE_BENCH_SWEEP_{DURATION_S,RATE,SEED,K,
 RUNGS,RACKS,HOSTS} shape it; GROVE_BENCH_SWEEP_SOAK=1 lengthens the trace
 (slow tier analog: tests/test_tuning.py soak).
+
+Tenancy scenario (GROVE_BENCH_SCENARIO=tenancy, `make bench-tenancy`):
+hundreds of churning tenants under SLO tiers — fairness spread, per-tier
+time-to-bind p50/p99, reclaim under the disruption budget, chaos healing,
+and journal replay. GROVE_BENCH_TENANCY_{DURATION_S,RATE,TENANTS,HOLD_S,
+TAIL_S,SEED,ORG_QUOTA_CPU,FAIR_SPREAD} shape it;
+GROVE_BENCH_TENANCY_SOAK=1 lengthens the trace (slow tier).
 """
 
 from __future__ import annotations
@@ -1782,6 +1789,335 @@ def run_shard_bench() -> dict:
     return out
 
 
+def run_tenancy_bench() -> dict:
+    """Tenancy scenario (`make bench-tenancy` / GROVE_BENCH_SCENARIO=tenancy):
+    hundreds of churning tenants with a mixed SLO-class arrival trace pushed
+    through the MANAGER's reconcile loop (the controller path tenancy lives
+    on, not the raw streaming drain), on the sim clock.
+
+    One run, all surfaces: tenant queues under one borrowing org quota sized
+    below peak demand (so tiers actually contend), workloads departing
+    `hold_s` after they bind (churn frees the capacity the backlog drains
+    into), deterministic mid-trace chaos (node kill + un-cordon + pod fail —
+    the PR 10 simulator fault actions, journaled), a flight recorder on the
+    controller, and the fairness ledger read back at the end.
+
+    Gates (vs_baseline is 1.0 only when ALL hold):
+      - fairness: admitted-ratio spread across tenants with >= 2 submissions
+        bounded (<= GROVE_BENCH_TENANCY_FAIR_SPREAD);
+      - tier ordering: pooled p99 time-to-bind strictly ordered
+        latency < standard < batch-preemptible;
+      - the disruption budget is NEVER exceeded (sampled every sim tick);
+      - reclaim actually exercised (>= 1 journaled quota reclaim);
+      - zero lost gangs: every offered workload binds and completes its
+        hold inside the drain tail, chaos included;
+      - zero oversubscribed ticks: no node ever holds more active bound
+        demand than capacity (the double-bind detector);
+      - replay: zero divergences re-solving the journal.
+
+    GROVE_BENCH_TENANCY_SOAK=1 lengthens the trace (slow tier)."""
+    import tempfile
+
+    from grove_tpu.api import constants
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+    from grove_tpu.sim.simulator import Simulator
+    from grove_tpu.sim.workloads import (
+        arrival_pcs,
+        arrival_process,
+        synthetic_cluster,
+    )
+    from grove_tpu.tenancy import quantile
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    soak = os.environ.get("GROVE_BENCH_TENANCY_SOAK", "0") == "1"
+    duration = float(
+        os.environ.get("GROVE_BENCH_TENANCY_DURATION_S", "150" if soak else "75")
+    )
+    rate = float(
+        os.environ.get("GROVE_BENCH_TENANCY_RATE", "2.4" if soak else "1.6")
+    )
+    n_tenants = int(
+        os.environ.get("GROVE_BENCH_TENANCY_TENANTS", "400" if soak else "200")
+    )
+    hold_s = float(os.environ.get("GROVE_BENCH_TENANCY_HOLD_S", "12"))
+    tail_cap_s = float(
+        os.environ.get("GROVE_BENCH_TENANCY_TAIL_S", "300" if soak else "240")
+    )
+    seed = int(os.environ.get("GROVE_BENCH_TENANCY_SEED", "20260804"))
+    # Sized below peak offered demand (rate * ~7 cpu * hold) so the tiers
+    # contend during the trace, but high enough that the backlog drains
+    # inside the tail.
+    org_quota = float(
+        os.environ.get(
+            "GROVE_BENCH_TENANCY_ORG_QUOTA_CPU", "96" if soak else "64"
+        )
+    )
+    spread_cap = float(os.environ.get("GROVE_BENCH_TENANCY_FAIR_SPREAD", "0.25"))
+
+    events = arrival_process(
+        seed,
+        duration_s=duration,
+        base_rate=rate,
+        tenants=n_tenants,
+        active_tenants=max(4, n_tenants // 16),
+        tenant_churn_s=max(0.25, duration / max(1, n_tenants)),
+        slo_mix=(
+            ("latency", 0.2),
+            ("standard", 0.5),
+            ("batch-preemptible", 0.3),
+        ),
+    )
+    tenant_names = sorted({ev.tenant for ev in events})
+    # Every tenant's quota covers the LARGEST single workload (disagg, 17
+    # cpu) so latency gangs — in-quota only — are always eventually
+    # admissible, while a tenant running more than one workload at once has
+    # to borrow; the org envelope below peak demand is what makes the tiers
+    # contend (borrowers queue and get reclaimed, in-quota latency cuts
+    # through).
+    queues: dict = {"org": {"resources": {"cpu": {"quota": str(org_quota)}}}}
+    for t in tenant_names:
+        queues[t] = {"parentQueue": "org", "resources": {"cpu": {"quota": "18"}}}
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "scheduling": {"queues": queues},
+            "tenancy": {
+                "enabled": True,
+                "agingHalfLifeSeconds": 5.0,
+                "agingMaxBoost": 4,
+            },
+            # Budget for a whole disagg family (base + 2 scaled gangs) with
+            # one slot spare — whole-set reclaims fit, partials never happen.
+            "defrag": {"maxConcurrentMigrations": 4},
+        }
+    )
+    if errors:
+        raise ValueError(f"operator config invalid: {errors}")
+    m = Manager(cfg)
+    for node in synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=8, hosts_per_rack=12
+    ):
+        m.cluster.nodes[node.name] = node
+    sim = Simulator(m.cluster, m.controller)
+    trace_dir = tempfile.mkdtemp(prefix="grove-tenancy-trace-")
+    recorder = TraceRecorder(trace_dir)
+    recorder.start()
+    m.controller.recorder = recorder
+
+    pending_events = list(events)
+    applied: dict[str, float] = {}  # workload -> sim time applied
+    bound_at: dict[str, float] = {}
+    delete_at: dict[str, float] = {}
+    budget_peak = 0
+    budget_samples = 0
+    oversubscribed_ticks = 0
+    fault_log: list[dict] = []
+    killed_node: str | None = None
+    uncordoned = False
+    failed_pod: str | None = None
+    kill_at = duration / 3.0
+    uncordon_at = duration / 2.0
+    fail_pod_at = 2.0 * duration / 3.0
+    dt = 1.0
+    wall0 = time.perf_counter()
+    try:
+        while True:
+            now_next = sim.now + dt
+            while pending_events and pending_events[0].t <= now_next:
+                ev = pending_events.pop(0)
+                pcs = arrival_pcs(ev)
+                pcs.metadata.annotations[constants.ANNOTATION_QUEUE] = ev.tenant
+                m.apply_podcliqueset(pcs)
+                applied[ev.name] = now_next
+            for name, at in list(delete_at.items()):
+                if at <= now_next:
+                    m.delete_podcliqueset(name)
+                    del delete_at[name]
+            # Deterministic chaos: targets are pure functions of sim state,
+            # which is itself deterministic in the seed.
+            if killed_node is None and now_next >= kill_at:
+                busy: dict[str, int] = {}
+                for p in m.cluster.pods.values():
+                    if p.is_active and p.is_scheduled:
+                        busy[p.node_name] = busy.get(p.node_name, 0) + 1
+                if busy:
+                    killed_node = min(
+                        busy, key=lambda n: (-busy[n], n)
+                    )  # busiest node, name-tiebroken
+                    sim.kill_node(killed_node)
+                    fault_log.append(
+                        {"t": now_next, "action": "kill_node", "target": killed_node}
+                    )
+            if (
+                killed_node is not None
+                and not uncordoned
+                and now_next >= uncordon_at
+            ):
+                sim.uncordon(killed_node)
+                uncordoned = True
+                fault_log.append(
+                    {"t": now_next, "action": "uncordon", "target": killed_node}
+                )
+            if failed_pod is None and now_next >= fail_pod_at:
+                victim = min(
+                    (
+                        p.name
+                        for p in m.cluster.pods.values()
+                        if p.is_active and p.is_scheduled
+                    ),
+                    default=None,
+                )
+                if victim is not None:
+                    failed_pod = victim
+                    sim.fail_pod(victim)
+                    fault_log.append(
+                        {"t": now_next, "action": "fail_pod", "target": victim}
+                    )
+            sim.step(dt)
+            # Fresh FLOOR binds start the hold clock (churn departures).
+            # Operational = every base gang scheduled; scaled gangs beyond
+            # minAvailable are elastic extras, and holding a workload open
+            # for them would deadlock the org quota on partial families.
+            bases_by_pcs: dict[str, list] = {}
+            for g in m.cluster.podgangs.values():
+                if not g.is_scaled:
+                    bases_by_pcs.setdefault(g.pcs_name, []).append(g)
+            for name in list(applied):
+                if name in bound_at or name not in m.cluster.podcliquesets:
+                    continue
+                bases = bases_by_pcs.get(name, [])
+                if bases and all(g.is_base_gang_scheduled() for g in bases):
+                    bound_at[name] = sim.now
+                    delete_at[name] = sim.now + hold_s
+            # Disruption budget + double-bind detectors, every tick.
+            in_flight = m.controller.disrupted_now()
+            budget_peak = max(budget_peak, in_flight)
+            budget_samples += 1
+            used: dict[str, dict[str, float]] = {}
+            for p in m.cluster.pods.values():
+                if p.is_active and p.is_scheduled:
+                    node_used = used.setdefault(p.node_name, {})
+                    for r, q in p.spec.total_requests().items():
+                        node_used[r] = node_used.get(r, 0.0) + q
+            for n, res in used.items():
+                cap = m.cluster.nodes[n].capacity
+                if any(q > cap.get(r, 0.0) + 1e-6 for r, q in res.items()):
+                    oversubscribed_ticks += 1
+                    break
+            if not pending_events and not m.cluster.podcliquesets:
+                break
+            if sim.now >= duration + tail_cap_s:
+                break
+        recorder.flush()
+    finally:
+        recorder.stop()
+    wall_s = time.perf_counter() - wall0
+
+    led = m.controller.tenancy_ledger
+    pooled = led.tier_latencies()
+    tiers = {
+        cls: {
+            "samples": len(samples),
+            "p50_bind_s": round(quantile(samples, 0.50), 3),
+            "p99_bind_s": round(quantile(samples, 0.99), 3),
+        }
+        for cls, samples in sorted(pooled.items())
+    }
+    p99 = {cls: d["p99_bind_s"] for cls, d in tiers.items()}
+    tier_ordered = (
+        all(cls in p99 for cls in ("latency", "standard", "batch-preemptible"))
+        and p99["latency"] < p99["standard"] < p99["batch-preemptible"]
+    )
+    # Fairness on the FLOOR contract: per-tenant fraction of offered
+    # workloads whose base gangs bound. Gang-level ledger ratios are
+    # reported too but not gated — elastic extras deleted with their family
+    # before binding depress them by design, not by unfairness.
+    tenant_of = {ev.name: ev.tenant for ev in events}
+    floor_offered: dict[str, int] = {}
+    floor_bound: dict[str, int] = {}
+    for name in applied:
+        t = tenant_of[name]
+        floor_offered[t] = floor_offered.get(t, 0) + 1
+        if name in bound_at:
+            floor_bound[t] = floor_bound.get(t, 0) + 1
+    ratios = {
+        t: floor_bound.get(t, 0) / n
+        for t, n in floor_offered.items()
+        if n >= 2
+    }
+    spread = (max(ratios.values()) - min(ratios.values())) if ratios else None
+    gang_ratios = [
+        st.admitted_ratio() for st in led.tenants.values() if st.submitted >= 2
+    ]
+    gang_spread = (max(gang_ratios) - min(gang_ratios)) if gang_ratios else None
+    lost = sorted(n for n in applied if n not in bound_at)
+    stranded = sorted(
+        p.name
+        for p in m.cluster.pods.values()
+        if p.is_active
+        and p.is_scheduled
+        and not m.cluster.nodes[p.node_name].schedulable
+    )
+
+    records = read_journal(trace_dir)
+    report = replay_journal(records)
+    reclaim_records = [
+        r
+        for r in records
+        if r.get("kind") == "action" and r.get("action") == "quota-reclaim"
+    ]
+
+    gates = {
+        "fairness_spread_bounded": (
+            len(ratios) >= 5 and spread is not None and spread <= spread_cap
+        ),
+        "tier_p99_ordered": tier_ordered,
+        "budget_never_exceeded": budget_peak <= m.controller.defrag_max_concurrent,
+        "reclaims_exercised": led.totals["reclaims"] >= 1,
+        "zero_lost_gangs": not lost and not m.cluster.podcliquesets,
+        "zero_oversubscribed_ticks": oversubscribed_ticks == 0,
+        "chaos_injected_and_healed": len(fault_log) >= 3 and not stranded,
+        "replay_bit_identical": report.divergence_count == 0,
+    }
+    return {
+        "scenario": "tenancy",
+        "metric": "tenancy_fair_spread",
+        "unit": "ratio",
+        "value": round(spread, 4) if spread is not None else None,
+        "vs_baseline": 1.0 if all(gates.values()) else 0.0,
+        "gates": gates,
+        "soak": soak,
+        "host_cpus": len(os.sched_getaffinity(0)),
+        "trace_seed": seed,
+        "trace_duration_s": duration,
+        "sim_seconds": round(sim.now, 1),
+        "wall_s": round(wall_s, 3),
+        "workloads_offered": len(events),
+        "workloads_bound": len(bound_at),
+        "tenant_count": len(led.tenants),
+        "tenants_rated": len(ratios),
+        "fair_spread_cap": spread_cap,
+        "gang_admitted_ratio_spread": (
+            round(gang_spread, 4) if gang_spread is not None else None
+        ),
+        "tiers": tiers,
+        "ledger_totals": dict(led.totals),
+        "budget_peak_in_flight": budget_peak,
+        "budget_cap": m.controller.defrag_max_concurrent,
+        "budget_samples": budget_samples,
+        "oversubscribed_ticks": oversubscribed_ticks,
+        "faults": fault_log,
+        "lost_gangs": lost[:8],
+        "stranded_pods": stranded[:8],
+        "reclaim_decisions_journaled": len(reclaim_records),
+        "replay_divergences": report.divergence_count,
+        "replay_waves": len(report.waves),
+    }
+
+
 # Scenario registry: GROVE_BENCH_SCENARIO -> (headline metric, unit, runner).
 # "" is the default north-star drain. New scenarios slot in as one entry —
 # main() owns no per-scenario branching.
@@ -1795,6 +2131,7 @@ SCENARIOS: dict[str, tuple[str, str, object]] = {
     "shard": ("shard_solve_speedup", "x", run_shard_bench),
     "sweep": ("sweep_vs_single_replay", "x", run_sweep_bench),
     "chaos": ("chaos_bind_p99_inflation", "x", run_chaos_bench),
+    "tenancy": ("tenancy_fair_spread", "ratio", run_tenancy_bench),
 }
 
 

@@ -89,6 +89,17 @@ ANNOTATION_QUEUE = "grove.io/queue"
 # protection for its children (constants.go:43-45).
 ANNOTATION_DISABLE_PROTECTION = "grove.io/disable-managed-resource-protection"
 
+# SLO classes (spec.template.sloClass; tenancy subsystem, docs/design.md
+# "Multi-tenant SLO tiers"). The class maps to admission order, borrowing
+# eligibility, and preemptibility: `latency` admits first and never borrows
+# (so reclaim cannot name it off borrowed share), `batch-preemptible` is
+# evicted first when an in-quota contender reclaims.
+SLO_CLASS_LATENCY = "latency"
+SLO_CLASS_STANDARD = "standard"
+SLO_CLASS_BATCH = "batch-preemptible"
+SLO_CLASSES = (SLO_CLASS_LATENCY, SLO_CLASS_STANDARD, SLO_CLASS_BATCH)
+DEFAULT_SLO_CLASS = SLO_CLASS_STANDARD
+
 # Default PodCliqueSet name budget: pod names must fit the 63-char DNS label after
 # the operator appends `-<i>-[<pcsg>-<j>-]<pclq>-<5char suffix>`
 # (webhook/admission/pcs/validation/podcliqueset.go:37-39,564).

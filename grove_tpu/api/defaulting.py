@@ -13,6 +13,7 @@ Applied in place on a freshly loaded PodCliqueSet before validation:
 
 from __future__ import annotations
 
+from grove_tpu.api.constants import DEFAULT_SLO_CLASS
 from grove_tpu.api.types import (
     AutoScalingConfig,
     HeadlessServiceConfig,
@@ -48,6 +49,8 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
 
     if tmpl.termination_delay_seconds is None:
         tmpl.termination_delay_seconds = 4 * 3600.0
+    if not tmpl.slo_class:
+        tmpl.slo_class = DEFAULT_SLO_CLASS
     if tmpl.headless_service_config is None:
         tmpl.headless_service_config = HeadlessServiceConfig(publish_not_ready_addresses=True)
     return pcs

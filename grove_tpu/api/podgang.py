@@ -127,6 +127,10 @@ class PodGang:
     # Queue analog — quota enforcement is the controller's pre-solve
     # admission filter (orchestrator/controller.py _solve_wave).
     queue: str = ""
+    # SLO tier (spec.template.sloClass, api/constants.py SLO_CLASSES):
+    # admission order, borrowing eligibility, preemptibility. "" ranks as
+    # "standard" for gangs admitted before the field existed.
+    slo_class: str = ""
     pcs_replica_index: int = 0
     # For scaled gangs: the base gang that must schedule first
     # (grove.io/base-podgang label; podclique/components/pod/syncflow.go:347-387).

@@ -533,6 +533,10 @@ class PodCliqueSetTemplateSpec:
     pod_clique_scaling_group_configs: list[PodCliqueScalingGroupConfig] = field(default_factory=list)
     termination_delay_seconds: float = 4 * 3600.0  # default 4h (podcliqueset.go:154)
     priority_class_name: str = ""
+    # SLO tier (constants.SLO_CLASSES): admission order, borrowing
+    # eligibility, preemptibility (docs/design.md "Multi-tenant SLO
+    # tiers"). "" on load; defaulting fills "standard".
+    slo_class: str = ""
     headless_service_config: Optional[HeadlessServiceConfig] = None
     topology_constraint: Optional[TopologyConstraint] = None
 
@@ -559,6 +563,7 @@ class PodCliqueSetTemplateSpec:
             ],
             termination_delay_seconds=term_s,
             priority_class_name=d.get("priorityClassName", ""),
+            slo_class=d.get("sloClass", ""),
             headless_service_config=(
                 HeadlessServiceConfig(bool(hs.get("publishNotReadyAddresses", True))) if hs else None
             ),

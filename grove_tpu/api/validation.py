@@ -26,7 +26,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from grove_tpu.api.constants import MAX_PCS_NAME_LENGTH
+from grove_tpu.api.constants import MAX_PCS_NAME_LENGTH, SLO_CLASSES
 from grove_tpu.api.types import (
     ClusterTopology,
     CliqueStartupType,
@@ -85,6 +85,16 @@ def validate_podcliqueset(
         errs.append(ValidationError("spec.template.cliques", "at least one PodClique must be defined"))
     if tmpl.termination_delay_seconds is not None and tmpl.termination_delay_seconds <= 0:
         errs.append(ValidationError("spec.template.terminationDelay", "must be greater than 0"))
+    # sloClass: one of the fixed tenancy tiers ("" = defaulting fills
+    # "standard"; an unknown tier would silently schedule as standard, so
+    # reject it at admission instead).
+    if tmpl.slo_class and tmpl.slo_class not in SLO_CLASSES:
+        errs.append(
+            ValidationError(
+                "spec.template.sloClass",
+                f"unknown SLO class {tmpl.slo_class!r}; must be one of {', '.join(SLO_CLASSES)}",
+            )
+        )
 
     clique_names = [c.name for c in tmpl.cliques]
     _require_unique(errs, clique_names, "spec.template.cliques.name", "clique names must be unique")

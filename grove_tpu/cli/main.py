@@ -3,7 +3,7 @@
 Talks to a running manager (`python -m grove_tpu.runtime`) over its object
 API via the typed client. Commands:
 
-  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag|quality|resilience   table listing
+  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag|quality|resilience|tenancy   table listing
   get <kind> <name>                             full object as JSON
   describe <kind> <name>                        human detail + object events
   apply -f <file.yaml>                          admit a PodCliqueSet
@@ -69,6 +69,7 @@ KIND_ALIASES = {
     "defrag": "defrag",
     "quality": "quality",
     "resilience": "resilience",
+    "tenancy": "tenancy",
 }
 
 
@@ -302,6 +303,50 @@ def _get_table(client: GroveClient, kind: str) -> str:
                         f"{s.get('evaluated', 0)} evals",
                     ]
                 )
+        return _table(rows, ["METRIC", "VALUE"])
+    if kind == "tenancy":
+        # Tenancy fairness at a glance: aging state, shared disruption
+        # budget, per-tier bind latencies, and the busiest tenants' ledger
+        # rows — from /statusz (the grove_tenancy_* metrics source doc).
+        doc = client.statusz().get("tenancy", {})
+        ledger = doc.get("ledger", {})
+        budget = doc.get("disruptionBudget", {})
+        rows = [
+            ["enabled", "yes" if doc.get("enabled") else "no"],
+            ["agingHalfLifeSeconds", doc.get("agingHalfLifeSeconds", "-")],
+            ["agingMaxBoost", doc.get("agingMaxBoost", "-")],
+            ["tenants", ledger.get("tenantCount", 0)],
+            [
+                "disruptionBudget",
+                f"{budget.get('inFlight', 0)}/{budget.get('max', 0)} in flight",
+            ],
+            ["reclaimEvicting", ",".join(doc.get("reclaimEvicting", [])) or "-"],
+            ["agedGangs", len(doc.get("aged", {}))],
+        ]
+        rows += [
+            [f"totals.{k}", v]
+            for k, v in sorted(ledger.get("totals", {}).items())
+        ]
+        for cls, tier in sorted(ledger.get("tiers", {}).items()):
+            rows.append(
+                [
+                    f"tier.{cls}",
+                    f"p50 {tier.get('p50BindSeconds', 0)}s "
+                    f"p99 {tier.get('p99BindSeconds', 0)}s "
+                    f"({tier.get('samples', 0)} binds)",
+                ]
+            )
+        for tname, t in sorted(ledger.get("tenants", {}).items()):
+            rows.append(
+                [
+                    f"tenant.{tname}",
+                    f"admitted {t.get('admitted', 0)}/{t.get('submitted', 0)} "
+                    f"(ratio {t.get('admittedRatio', 0)}, "
+                    f"borrowed {t.get('borrowedShare', 0)}) "
+                    f"preempted {t.get('preemptionsSuffered', 0)} "
+                    f"reclaimed {t.get('reclaimsSuffered', 0)}",
+                ]
+            )
         return _table(rows, ["METRIC", "VALUE"])
     if kind == "quality":
         # Placement quality at a glance: the last solve wave's aggregate +

@@ -62,6 +62,12 @@ from grove_tpu.solver.planner import (
 )
 from grove_tpu.solver.warm import WarmPath
 from grove_tpu.state.cluster import build_snapshot
+from grove_tpu.tenancy import (
+    TenantLedger,
+    aging_boost,
+    slo_borrow_eligible,
+    slo_rank,
+)
 
 
 @dataclass
@@ -217,6 +223,32 @@ class GroveController:
             "solve_degraded_retries": 0,
         }
     )
+    # Tenancy subsystem (config section `tenancy`; grove_tpu/tenancy,
+    # docs/design.md "Multi-tenant SLO tiers"): SLO tiers lead the
+    # admission order (latency < standard < batch-preemptible), `latency`
+    # gangs never ride borrowed capacity, starved contenders climb
+    # effective priority on a deterministic aging ladder, reclaim
+    # evictions share the defrag disruption budget, and a per-tenant
+    # fairness ledger feeds /statusz tenancy + grove_tenancy_* metrics +
+    # `grove-tpu get tenancy`. Disabled = the pre-tenancy behavior exactly.
+    tenancy_enabled: bool = False
+    tenancy_aging_half_life_seconds: float = 300.0
+    tenancy_aging_max_boost: int = 4
+    # Pending-since stamps (gang name -> first reconcile time seen pending)
+    # feeding the aging boost; entries leave with the gang (churn-pruned
+    # every pass alongside the flap guards) or when it stops pending.
+    _pending_since: dict = field(default_factory=dict)
+    # Current aging boost per pending gang — refreshed once per floors wave
+    # so every consumer of _priority_of inside one pass sees one value; a
+    # step up is journaled as a `tenancy.aging` action with its inputs.
+    _aging_boost: dict = field(default_factory=dict)
+    # Reclaim transactions in flight: victim gang -> (contender, start).
+    # Counted WITH _defrag_migrating against defrag_max_concurrent (the one
+    # disruption budget); an entry clears when the contender binds, the
+    # victim is whole again, or either departs.
+    _reclaim_evicting: dict = field(default_factory=dict)
+    # Per-tenant fairness accounting (tenant = capacity queue).
+    tenancy_ledger: TenantLedger = field(default_factory=TenantLedger)
     # Gangs mid-migration (name -> start time); a migration completes when
     # every pod of the gang is scheduled and Ready again. This set IS the
     # disruption budget's denominator.
@@ -491,6 +523,26 @@ class GroveController:
         # (rolling updates churn gang names; same discipline as
         # _preempted_for_at): a recreated namesake must event again.
         self._quota_blocked &= set(self.cluster.podgangs)
+        # Prune the flap-guard cooldown maps here, EVERY pass — not only
+        # inside the preempt/reclaim handlers, which a calm controller may
+        # never call again: under tenant churn the departed-gang entries
+        # otherwise accumulate without bound. Same for the tenancy
+        # pending/aging stamps and in-flight reclaim ledger.
+        live = self.cluster.podgangs
+        for m in (
+            self._preempted_for_at,
+            self._reclaimed_for_at,
+            self._pending_since,
+            self._aging_boost,
+            self._reclaim_evicting,
+        ):
+            for name in [n for n in m if n not in live]:
+                del m[name]
+        if self._reclaim_evicting:
+            # Completion sweep every pass, not only on the reclaim/defrag
+            # paths: a landed transaction must release its disruption slot
+            # even when the controller goes calm afterward.
+            self._sweep_reclaim_evictions()
         # One queue-usage scan per pass: the floors wave builds the
         # hierarchical usage map from live usage and leaves its post-grant
         # state here for the extras wave (a floor grant the SOLVER then
@@ -517,8 +569,17 @@ class GroveController:
         scheduled_names = {
             g.name for g in c.podgangs.values() if g.is_base_gang_scheduled() and g.spec.pod_groups
         }
+        if self.tenancy_enabled and floors_only:
+            # Refresh aging stamps once per pass (the floors wave): every
+            # consumer of effective priority below — batch order, preemption
+            # contender choice, reclaim ordering — sees one boost value.
+            self._refresh_aging(pending, now)
         pending = sort_pending(
-            pending, lambda g: self.priority_classes.get(g.spec.priority_class_name, 0)
+            pending,
+            self._priority_of,
+            # SLO tiers lead the batch order when tenancy is on: a latency
+            # gang admits ahead of standard/batch regardless of priority.
+            tier_of=self._slo_rank_of if self.tenancy_enabled else None,
         )
 
         # Capacity queues (the hierarchical KAI Queue analog,
@@ -549,6 +610,9 @@ class GroveController:
         # of this pass beats borrowed, and heavier borrowers beat lighter.
         granted: list[tuple[int, PodGang, PodGang, dict]] = []
         borrowers: list[tuple[int, PodGang, PodGang, dict, dict]] = []
+        # Gangs whose grant this wave rode borrowed capacity — the ledger's
+        # borrowed-share input at first admission (tenancy only).
+        borrow_granted: set[str] = set()
         order = 0
         for gang in pending:
             unbound_refs: dict[str, list[NamespacedName]] = {}
@@ -618,10 +682,37 @@ class GroveController:
                 key=lambda b: (-qtree.borrow_weight(b[1].queue, b[4]), b[0])
             )
             for order_i, gang, sub, pgn, demand in borrowers:
+                if self.tenancy_enabled and not slo_borrow_eligible(
+                    getattr(gang, "slo_class", "")
+                ):
+                    # `latency` gangs are in-quota only: no borrowing retry.
+                    # Re-derive the hard-quota verdict — blocked at an
+                    # ANCESTOR while in-quota at its own level means the
+                    # tenant's deserved share is squeezed by borrowers, and
+                    # that is exactly the reclaim case.
+                    verdict = qtree.try_charge(
+                        qusage, gang.queue, demand,
+                        commit=False, allow_borrow=False,
+                    )
+                    if gang.name not in self._quota_blocked:
+                        self._quota_blocked.add(gang.name)
+                        c.record_event(
+                            now,
+                            gang.name,
+                            f"gang waiting on queue {gang.queue!r} quota "
+                            f"({verdict.blocked_reason} at "
+                            f"{verdict.blocked_at!r}; sloClass latency "
+                            "does not borrow)",
+                        )
+                    if verdict.reclaim_eligible:
+                        reclaim_candidates.append((gang, demand, verdict))
+                    continue
                 verdict = qtree.try_charge(qusage, gang.queue, demand)
                 if verdict.admitted:
                     self._quota_blocked.discard(gang.name)
                     granted.append((order_i, gang, sub, pgn))
+                    if self.tenancy_enabled:
+                        borrow_granted.add(gang.name)
                     continue
                 if gang.name not in self._quota_blocked:
                     self._quota_blocked.add(gang.name)
@@ -1062,6 +1153,18 @@ class GroveController:
                     now, gang_name, f"gang admitted ({len(pod_bindings)} pods bound)"
                 )
                 admitted += 1
+                if self.tenancy_enabled:
+                    tenant = self._tenant_of(gang)
+                    self.tenancy_ledger.note_admitted(
+                        tenant, borrowed=gang_name in borrow_granted
+                    )
+                    # Time-to-bind in reconcile-clock seconds, from the
+                    # first pass that saw the gang pending to this bind.
+                    self.tenancy_ledger.note_bound(
+                        tenant,
+                        getattr(gang, "slo_class", ""),
+                        now - self._pending_since.get(gang_name, now),
+                    )
 
         # Priority preemption: a rejected gang that outranks placed gangs may
         # evict the lowest-priority ones (whole gangs — gang semantics) to
@@ -1194,6 +1297,12 @@ class GroveController:
         return (
             sub.name,
             getattr(sub, "queue", ""),
+            # Tenancy inputs: the SLO tier and the current aging boost both
+            # move the batch order / contender choice, so a boost step or a
+            # class change must break the solve-skip match (and the encode
+            # row key riding this digest).
+            getattr(sub, "slo_class", ""),
+            self._aging_boost.get(sub.name, 0) if self.tenancy_enabled else 0,
             sub.spec.priority_class_name,
             sub.base_podgang_name,
             getattr(sub.spec.reuse_reservation_ref, "name", None),
@@ -1257,7 +1366,58 @@ class GroveController:
         return usage
 
     def _priority_of(self, gang: PodGang) -> int:
-        return self.priority_classes.get(gang.spec.priority_class_name, 0)
+        """Effective priority: PriorityClass value plus the tenancy aging
+        boost (zero when tenancy is off or the gang is not aging)."""
+        base = self.priority_classes.get(gang.spec.priority_class_name, 0)
+        if not self.tenancy_enabled:
+            return base
+        return base + self._aging_boost.get(gang.name, 0)
+
+    def _slo_rank_of(self, gang: PodGang) -> int:
+        return slo_rank(getattr(gang, "slo_class", ""))
+
+    def _tenant_of(self, gang: PodGang) -> str:
+        return gang.queue or "(unqueued)"
+
+    def _refresh_aging(self, pending: list[PodGang], now: float) -> None:
+        """Advance the deterministic aging ladder (tenancy/aging.py) for
+        every pending gang. Each step up is journaled with its inputs
+        (waited, halfLife, boost, base priority) — the decision record the
+        replay gate checks; the boost itself re-derives from those inputs."""
+        pending_names = set()
+        for gang in pending:
+            pending_names.add(gang.name)
+            since = self._pending_since.get(gang.name)
+            if since is None:
+                self._pending_since[gang.name] = since = now
+                self.tenancy_ledger.note_submitted(self._tenant_of(gang))
+            boost = aging_boost(
+                now - since,
+                self.tenancy_aging_half_life_seconds,
+                self.tenancy_aging_max_boost,
+            )
+            prev = self._aging_boost.get(gang.name, 0)
+            if boost > prev:
+                self._aging_boost[gang.name] = boost
+                self.tenancy_ledger.note_aging(self._tenant_of(gang))
+                self._journal_action(
+                    now,
+                    "tenancy.aging",
+                    gang.name,
+                    waitedSeconds=round(now - since, 6),
+                    halfLifeSeconds=self.tenancy_aging_half_life_seconds,
+                    boost=boost,
+                    basePriority=self.priority_classes.get(
+                        gang.spec.priority_class_name, 0
+                    ),
+                    sloClass=getattr(gang, "slo_class", "") or "standard",
+                )
+        # A gang that stopped pending (bound, or departed — the departed
+        # case is also churn-pruned in solve_pending) ages from scratch if
+        # it ever re-enters: aging measures THIS episode of starvation.
+        for name in [n for n in self._pending_since if n not in pending_names]:
+            del self._pending_since[name]
+            self._aging_boost.pop(name, None)
 
     def _preempt_for_rejected(self, rejected: list[PodGang], now: float) -> bool:
         """Evict lower-priority placed gangs so the highest-priority rejected
@@ -1307,7 +1467,14 @@ class GroveController:
                 for gang, pods in placed_gangs()
                 if self._priority_of(gang) < prio
             ),
-            key=lambda gp: (self._priority_of(gp[0]), len(gp[1])),
+            # Tenancy leads with preemptibility: batch-preemptible gangs go
+            # first, latency last (rank descending), before the existing
+            # lowest-priority / smallest-blast-radius order.
+            key=lambda gp: (
+                -self._slo_rank_of(gp[0]) if self.tenancy_enabled else 0,
+                self._priority_of(gp[0]),
+                len(gp[1]),
+            ),
         )
         if not victims:
             return False
@@ -1344,12 +1511,18 @@ class GroveController:
             c.record_event(
                 now, gang.name, f"gang preempted by {contender.name} ({len(pods)} pods)"
             )
+            if self.tenancy_enabled:
+                self.tenancy_ledger.note_preemption(
+                    self._tenant_of(gang), self._tenant_of(contender)
+                )
         self._journal_action(
             now,
             "preemption",
             contender.name,
             victims=[g.name for g, _ in chosen],
             podsEvicted=sum(len(p) for _, p in chosen),
+            contenderPriority=prio,
+            sloClass=getattr(contender, "slo_class", "") or "standard",
         )
         return True
 
@@ -1366,9 +1539,17 @@ class GroveController:
         qtree = self.queue_tree
         for name in [n for n in self._reclaimed_for_at if n not in c.podgangs]:
             del self._reclaimed_for_at[name]
+        self._sweep_reclaim_evictions()
         chosen_cand = None
         for gang, demand, verdict in sorted(
-            candidates, key=lambda t: -self._priority_of(t[0])
+            candidates,
+            # Tenancy: the SLO tier outranks priority among in-quota
+            # contenders (a latency tenant's deserved share reclaims ahead
+            # of a standard one's), matching the admission order.
+            key=lambda t: (
+                self._slo_rank_of(t[0]) if self.tenancy_enabled else 0,
+                -self._priority_of(t[0]),
+            ),
         ):
             last = self._reclaimed_for_at.get(gang.name)
             if last is None or now - last >= self.preemption_cooldown_seconds:
@@ -1416,10 +1597,13 @@ class GroveController:
                 ]
                 if pods:
                     victims.append((other, pods))
-        # Lightest borrowers go first (overQuotaWeight ascending), then
-        # lowest priority, then smallest blast radius.
+        # Victim order: batch-preemptible first when tenancy is on (SLO rank
+        # descending — latency victims only as a last resort), then lightest
+        # borrowers (overQuotaWeight ascending), lowest priority, smallest
+        # blast radius.
         victims.sort(
             key=lambda gp: (
+                -self._slo_rank_of(gp[0]) if self.tenancy_enabled else 0,
                 qtree.borrow_weight(gp[0].queue, needed),
                 self._priority_of(gp[0]),
                 len(gp[1]),
@@ -1437,6 +1621,37 @@ class GroveController:
                 break
         else:
             return False  # even evicting every borrower cannot free enough
+        if self.tenancy_enabled:
+            # Make-first, break-bounded: the victim set is only evicted
+            # when (a) its released usage provably covers the contender's
+            # overage at the blocking level (the for-else above) AND (b) it
+            # fits the SAME disruption budget defrag migrations draw from —
+            # at most defrag_max_concurrent gangs disrupted at any instant,
+            # in-flight reclaims swept on completion like migrations. A set
+            # over budget defers whole (journaled, counted): no partial
+            # eviction that frees too little to admit anyone.
+            budget = self.defrag_max_concurrent - len(
+                self._defrag_migrating
+            ) - len(self._reclaim_evicting)
+            if len(chosen) > budget:
+                self.tenancy_ledger.note_reclaim_deferred()
+                self._journal_action(
+                    now,
+                    "tenancy.reclaim_deferred",
+                    gang.name,
+                    victims=[g.name for g, _ in chosen],
+                    blockedAt=blocked_at,
+                    budget=max(0, budget),
+                    inFlight=len(self._defrag_migrating)
+                    + len(self._reclaim_evicting),
+                )
+                c.record_event(
+                    now,
+                    gang.name,
+                    f"reclaim deferred: {len(chosen)} victim(s) exceed the "
+                    f"disruption budget ({max(0, budget)} slot(s) free)",
+                )
+                return False
         from grove_tpu.api.types import Condition, set_condition
 
         self._reclaimed_for_at[gang.name] = now
@@ -1460,14 +1675,45 @@ class GroveController:
                 other.name,
                 f"gang reclaimed by in-quota {gang.name} ({len(pods)} pods)",
             )
+            if self.tenancy_enabled:
+                self._reclaim_evicting[other.name] = (gang.name, now)
+                self.tenancy_ledger.note_reclaim(
+                    self._tenant_of(other), self._tenant_of(gang)
+                )
         self._journal_action(
             now,
             "quota-reclaim",
             gang.name,
             victims=[g.name for g, _ in chosen],
             blockedAt=blocked_at,
+            needed={r: round(v, 6) for r, v in needed.items()},
+            victimSloClasses=[
+                getattr(g, "slo_class", "") or "standard" for g, _ in chosen
+            ],
+            contenderSloClass=getattr(gang, "slo_class", "") or "standard",
         )
         return True
+
+    def _sweep_reclaim_evictions(self) -> None:
+        """Completion sweep for in-flight reclaim transactions (the defrag
+        _defrag_migrating discipline): an eviction stops counting against
+        the disruption budget when the contender that demanded the capacity
+        is scheduled (the transaction landed), the victim is whole again
+        (it re-placed elsewhere), or either side departed."""
+        c = self.cluster
+        for victim in list(self._reclaim_evicting):
+            contender_name, _ = self._reclaim_evicting[victim]
+            vg = c.podgangs.get(victim)
+            if vg is None:
+                del self._reclaim_evicting[victim]
+                continue
+            cg = c.podgangs.get(contender_name)
+            if cg is None or cg.is_base_gang_scheduled():
+                del self._reclaim_evicting[victim]
+                continue
+            pods = [p for p in c.pods_of_gang(victim) if p.is_active]
+            if pods and all(p.is_scheduled for p in pods):
+                del self._reclaim_evicting[victim]
 
     # --- statuses ----------------------------------------------------------------
 
@@ -1789,6 +2035,9 @@ class GroveController:
                 counts["migrations_completed"] += 1
         for name in [n for n in self._defrag_migrated_at if n not in c.podgangs]:
             del self._defrag_migrated_at[name]
+        # In-flight reclaim evictions share this budget (tenancy); sweep
+        # them on the same cadence so a landed reclaim frees its slot.
+        self._sweep_reclaim_evictions()
         if not c.nodes:
             return None
         nodes = list(c.nodes.values())
@@ -1811,7 +2060,11 @@ class GroveController:
         if report.score < self.defrag_threshold:
             counts["skipped_below_threshold"] += 1
             return summary
-        budget = self.defrag_max_concurrent - len(self._defrag_migrating)
+        budget = (
+            self.defrag_max_concurrent
+            - len(self._defrag_migrating)
+            - len(self._reclaim_evicting)
+        )
         if budget <= 0:
             counts["skipped_budget"] += 1
             summary["deferred"] = "disruption budget exhausted"
@@ -1941,6 +2194,32 @@ class GroveController:
                 if qc["admitted"]
                 else 0.0,
             },
+        }
+
+    def disrupted_now(self) -> int:
+        """Gangs currently counted against the disruption budget: defrag
+        migrations in flight plus reclaim evictions in flight. The tenancy
+        bench samples this every tick against defrag_max_concurrent."""
+        return len(self._defrag_migrating) + len(self._reclaim_evicting)
+
+    def tenancy_status(self, top: int = 50) -> dict:
+        """JSON-able tenancy state for /statusz "tenancy" and `grove-tpu
+        get tenancy`. `top` bounds the per-tenant table (busiest first)."""
+        return {
+            "enabled": self.tenancy_enabled,
+            "agingHalfLifeSeconds": self.tenancy_aging_half_life_seconds,
+            "agingMaxBoost": self.tenancy_aging_max_boost,
+            "aged": {
+                name: boost
+                for name, boost in sorted(self._aging_boost.items())
+                if boost > 0
+            },
+            "reclaimEvicting": sorted(self._reclaim_evicting),
+            "disruptionBudget": {
+                "max": self.defrag_max_concurrent,
+                "inFlight": self.disrupted_now(),
+            },
+            "ledger": self.tenancy_ledger.snapshot(top=top),
         }
 
     def defrag_status(self) -> dict:

@@ -200,6 +200,9 @@ def expand_podcliqueset(
             # Capacity queue rides the PCS annotation (KAI Queue analog);
             # every gang of the set draws from the same queue.
             queue=pcs.metadata.annotations.get(constants.ANNOTATION_QUEUE, ""),
+            # SLO tier rides the template; every gang of the set shares it
+            # (a scaled gang cannot out-tier its base).
+            slo_class=tmpl.slo_class,
             spec=PodGangSpec(
                 priority_class_name=tmpl.priority_class_name,
                 topology_constraint=translate_pack_constraint(

@@ -436,6 +436,26 @@ class ResilienceConfig:
 
 
 @dataclass
+class TenancyConfig:
+    """Multi-tenant SLO tiers (grove_tpu/tenancy; docs/design.md
+    "Multi-tenant SLO tiers"). When enabled: the workload sloClass
+    (latency | standard | batch-preemptible) leads the admission order,
+    `latency` gangs never ride borrowed queue capacity, starved pending
+    gangs climb effective priority on a deterministic half-life-doubling
+    aging ladder, quota-reclaim evictions draw from the defrag disruption
+    budget (deferred — never partially applied — when over it), and a
+    per-tenant fairness ledger feeds /statusz tenancy, the
+    grove_tenancy_* metrics, and `grove-tpu get tenancy`. Disabled = the
+    pre-tenancy scheduling behavior exactly."""
+
+    enabled: bool = False
+    # Aging ladder: boost step k unlocks after half_life*(2^k - 1) seconds
+    # pending (tenancy/aging.py), capped at aging_max_boost.
+    aging_half_life_seconds: float = 300.0
+    aging_max_boost: int = 4
+
+
+@dataclass
 class BackendConfig:
     """Scheduler-backend sidecar (GREP-375 boundary)."""
 
@@ -532,6 +552,7 @@ class OperatorConfiguration:
     tuning: TuningConfig = field(default_factory=TuningConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
@@ -571,6 +592,7 @@ _SECTION_TYPES = {
     "tuning": ("tuning", TuningConfig),
     "faults": ("faults", FaultsConfig),
     "resilience": ("resilience", ResilienceConfig),
+    "tenancy": ("tenancy", TenancyConfig),
     "backend": ("backend", BackendConfig),
     "persistence": ("persistence", PersistenceConfig),
     "cluster": ("cluster", ClusterConfig),
@@ -611,6 +633,8 @@ _CAMEL_FIELDS = {
     "backoffBaseSeconds": "backoff_base_seconds",
     "backoffCapSeconds": "backoff_cap_seconds",
     "stalePlanRevalidation": "stale_plan_revalidation",
+    "agingHalfLifeSeconds": "aging_half_life_seconds",
+    "agingMaxBoost": "aging_max_boost",
     "sites": "sites",
     "auditSeeds": "audit_seeds",
     "queueSize": "queue_size",
@@ -943,6 +967,15 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
         df.min_efficiency, bool
     ) or df.min_efficiency < 0:
         errors.append("defrag.minEfficiency: must be >= 0")
+    tn = cfg.tenancy
+    if not isinstance(tn.aging_half_life_seconds, (int, float)) or isinstance(
+        tn.aging_half_life_seconds, bool
+    ) or tn.aging_half_life_seconds <= 0:
+        errors.append("tenancy.agingHalfLifeSeconds: must be > 0")
+    if not isinstance(tn.aging_max_boost, int) or isinstance(
+        tn.aging_max_boost, bool
+    ) or tn.aging_max_boost < 0:
+        errors.append("tenancy.agingMaxBoost: must be an int >= 0")
     tr = cfg.trace
     if tr.enabled and not tr.path:
         errors.append("trace.path: required when trace is enabled")

@@ -523,6 +523,9 @@ class Manager:
             defrag_cooldown_seconds=config.defrag.gang_cooldown_seconds,
             defrag_max_moves=config.defrag.max_moves_per_plan,
             defrag_min_efficiency=config.defrag.min_efficiency,
+            tenancy_enabled=config.tenancy.enabled,
+            tenancy_aging_half_life_seconds=config.tenancy.aging_half_life_seconds,
+            tenancy_aging_max_boost=config.tenancy.aging_max_boost,
         )
         # Bounded event ring (controllers.eventsBuffer): long soaks must not
         # leak; overflow drops oldest + counts (grove_events_dropped_total).
@@ -697,6 +700,44 @@ class Manager:
             "grove_defrag_migrating", "Gangs currently mid-migration"
         )
         self._defrag_exported = {"plans": 0, "migrations": 0, "pods_migrated": 0}
+        # Tenancy fairness surfaces (grove_tpu/tenancy): counters are
+        # delta-exported from the ledger totals (same discipline as defrag),
+        # gauges sample the ledger/budget each reconcile.
+        self._m_tenancy_admitted = self.metrics.counter(
+            "grove_tenancy_admitted_total", "Gangs first-admitted (tenancy view)"
+        )
+        self._m_tenancy_borrowed = self.metrics.counter(
+            "grove_tenancy_admitted_borrowing_total",
+            "Admissions that rode borrowed queue capacity",
+        )
+        self._m_tenancy_preemptions = self.metrics.counter(
+            "grove_tenancy_preemptions_total", "Gangs preempted (tenancy view)"
+        )
+        self._m_tenancy_reclaims = self.metrics.counter(
+            "grove_tenancy_reclaims_total", "Gangs evicted by quota reclaim"
+        )
+        self._m_tenancy_reclaim_deferred = self.metrics.counter(
+            "grove_tenancy_reclaim_deferred_total",
+            "Reclaims deferred by the shared disruption budget",
+        )
+        self._m_tenancy_aging = self.metrics.counter(
+            "grove_tenancy_aging_boosts_total", "Aging-ladder steps granted"
+        )
+        self._m_tenancy_tenants = self.metrics.gauge(
+            "grove_tenancy_tenants", "Tenants (queues) seen by the ledger"
+        )
+        self._m_tenancy_disrupted = self.metrics.gauge(
+            "grove_tenancy_disrupted",
+            "Gangs counted against the shared disruption budget right now",
+        )
+        self._tenancy_exported = {
+            "admitted": 0,
+            "admitted_borrowing": 0,
+            "preemptions": 0,
+            "reclaims": 0,
+            "reclaim_deferred": 0,
+            "aging_boosts": 0,
+        }
         # Placement-quality gauges (quality/report.py consumers): the last
         # non-empty solve wave's aggregate view, refreshed each reconcile —
         # the live-serving counterpart of the bench's quality report, so a
@@ -1127,6 +1168,9 @@ class Manager:
             # in-flight migrations, monotonic counters (what `grove-tpu get
             # defrag` renders).
             "defrag": self.controller.defrag_status(),
+            # Tenancy: per-tenant fairness ledger, aging state, shared
+            # disruption-budget view (`grove-tpu get tenancy` renders this).
+            "tenancy": self.controller.tenancy_status(),
             # Placement quality of live serving solves (quality/report.py
             # discipline — what `grove-tpu get quality` renders).
             "quality": self.controller.quality_status(),
@@ -1841,6 +1885,24 @@ class Manager:
                 if delta > 0:
                     metric.inc(float(delta))
                     self._defrag_exported[key] = counts[key]
+        if self.controller.tenancy_enabled:
+            ledger = self.controller.tenancy_ledger
+            for key, metric in (
+                ("admitted", self._m_tenancy_admitted),
+                ("admitted_borrowing", self._m_tenancy_borrowed),
+                ("preemptions", self._m_tenancy_preemptions),
+                ("reclaims", self._m_tenancy_reclaims),
+                ("reclaim_deferred", self._m_tenancy_reclaim_deferred),
+                ("aging_boosts", self._m_tenancy_aging),
+            ):
+                delta = ledger.totals[key] - self._tenancy_exported[key]
+                if delta > 0:
+                    metric.inc(float(delta))
+                    self._tenancy_exported[key] = ledger.totals[key]
+            self._m_tenancy_tenants.set(float(len(ledger.tenants)))
+            self._m_tenancy_disrupted.set(
+                float(self.controller.disrupted_now())
+            )
         prune = self.controller.warm.prune
         self._m_candidate_nodes.set(float(prune.last_candidate_nodes))
         delta = prune.escalations - self._prune_escalations_exported

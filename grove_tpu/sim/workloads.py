@@ -454,6 +454,33 @@ class ArrivalEvent:
     tenant: str
     kind: str  # frontend | disagg | train
     size: int  # worker replicas (train; heavy-tailed), else the fixed shape
+    slo_class: str = "standard"  # api.constants.SLO_CLASSES member
+
+
+def _slo_pick(seed: int, tenant: str, seq: int, slo_mix: tuple) -> str:
+    """Stable per-(tenant, seq) SLO-class draw for arrival_process.
+
+    Keyed on a hash rather than the trace RNG on purpose: adding slo_mix to
+    an existing trace must not perturb the main generator's draw sequence,
+    so a (seed, slo_mix=None) trace is bitwise-identical to what the
+    generator produced before the field existed, and turning slo_mix on
+    changes ONLY the slo_class column. Each tenant sees its own
+    deterministic class sequence (seq counts that tenant's arrivals), so
+    the per-tenant mix converges to the requested weights independent of
+    how tenants interleave."""
+    import hashlib
+
+    digest = hashlib.blake2b(
+        f"{seed}:{tenant}:{seq}".encode(), digest_size=8
+    ).digest()
+    u = int.from_bytes(digest, "big") / 2.0**64
+    total = sum(w for _, w in slo_mix)
+    acc = 0.0
+    for cls, w in slo_mix:
+        acc += w / total
+        if u < acc:
+            return cls
+    return slo_mix[-1][0]
 
 
 def arrival_process(
@@ -471,6 +498,7 @@ def arrival_process(
     active_tenants: int = 3,  # concurrently-active tenant subset size
     tenant_churn_s: float = 10.0,  # active-set rotation period
     mix: tuple = (("frontend", 0.45), ("disagg", 0.35), ("train", 0.20)),
+    slo_mix: tuple | None = None,  # ((slo_class, weight), ...) per-tenant mix
 ) -> list[ArrivalEvent]:
     """Deterministic arrival trace: inhomogeneous Poisson (diurnal rate
     modulation via thinning) + compound burst episodes, heavy-tailed train
@@ -479,6 +507,13 @@ def arrival_process(
 
     Events are returned sorted by offset; names embed (kind, tenant, seq) so
     two traces are comparable field-by-field.
+
+    `slo_mix`: optional ((slo_class, weight), ...) tuple. When given, every
+    event's slo_class is drawn from the mix via a stable hash of
+    (seed, tenant, that tenant's arrival sequence number) — see _slo_pick —
+    so the draw is deterministic in the seed, per-tenant, and does NOT
+    consume main-RNG entropy: the rest of the trace (times, tenants, kinds,
+    sizes, names) is bitwise-identical with slo_mix on or off.
     """
     import numpy as np
 
@@ -524,6 +559,7 @@ def arrival_process(
     weights = weights / weights.sum()
 
     events: list[ArrivalEvent] = []
+    tenant_seq: dict[str, int] = {}
     for i, at in enumerate(times):
         # Tenant churn: the active window slides one tenant per churn period,
         # so over the trace every tenant enters and leaves the mix.
@@ -542,6 +578,13 @@ def arrival_process(
             size = 18  # disagg_pcs pod count (fixed shape)
         else:
             size = 4  # frontend_pcs pod count (fixed shape)
+        seq = tenant_seq.get(tenant, 0)
+        tenant_seq[tenant] = seq + 1
+        slo = (
+            _slo_pick(seed, tenant, seq, slo_mix)
+            if slo_mix
+            else "standard"
+        )
         events.append(
             ArrivalEvent(
                 t=round(float(at), 6),
@@ -549,6 +592,7 @@ def arrival_process(
                 tenant=tenant,
                 kind=kind,
                 size=size,
+                slo_class=slo,
             )
         )
     return events
@@ -557,15 +601,21 @@ def arrival_process(
 def arrival_pcs(ev: ArrivalEvent) -> PodCliqueSet:
     """Build the PodCliqueSet for one arrival event (pure in the event)."""
     if ev.kind == "frontend":
-        return frontend_pcs(ev.name)
-    if ev.kind == "disagg":
-        return disagg_pcs(ev.name)
-    # train: rack-packed all-or-nothing gang, heavy-tailed worker count.
-    return _pcs(
-        ev.name,
-        cliques=[_clique("w", ev.size, "1", tpu=1, min_available=ev.size)],
-        constraint_domain="rack",
-    )
+        pcs = frontend_pcs(ev.name)
+    elif ev.kind == "disagg":
+        pcs = disagg_pcs(ev.name)
+    else:
+        # train: rack-packed all-or-nothing gang, heavy-tailed worker count.
+        pcs = _pcs(
+            ev.name,
+            cliques=[_clique("w", ev.size, "1", tpu=1, min_available=ev.size)],
+            constraint_domain="rack",
+        )
+    if ev.slo_class:
+        # Stamp the event's SLO class onto the template so expansion carries
+        # it into every PodGang of the set (orchestrator/expansion.py).
+        pcs.spec.template.slo_class = ev.slo_class
+    return pcs
 
 
 def expand_arrivals(

@@ -34,6 +34,8 @@ def build_pending_subgang(
         pcs_replica_index=gang.pcs_replica_index,
         base_podgang_name=gang.base_podgang_name,
         scaled_index=gang.scaled_index,
+        queue=gang.queue,
+        slo_class=gang.slo_class,
     )
     sub.spec.topology_constraint = gang.spec.topology_constraint
     sub.spec.priority_class_name = gang.spec.priority_class_name
@@ -62,7 +64,9 @@ def build_pending_subgang(
 
 
 def sort_pending(
-    gangs: list[PodGang], priority_of: Callable[[PodGang], int]
+    gangs: list[PodGang],
+    priority_of: Callable[[PodGang], int],
+    tier_of: Optional[Callable[[PodGang], int]] = None,
 ) -> list[PodGang]:
     """Priority order = solver batch order: higher priority first, base gangs
     before their scaled gangs, then stable by scaled index and name.
@@ -80,7 +84,13 @@ def sort_pending(
     Only the BASE is lifted: a scaled sibling keeps its own priority (its
     base's lifted rank plus the is_scaled tiebreak already guarantee the
     base sorts earlier), so a low-priority scaled sibling cannot ride its
-    family's lift past higher-priority unrelated gangs."""
+    family's lift past higher-priority unrelated gangs.
+
+    `tier_of` (tenancy SLO rank, tenancy/slo.py) leads the key when given:
+    tiers dominate priority, so a latency gang admits ahead of any
+    standard/batch gang regardless of PriorityClass or aging boost. Every
+    gang of a family shares one template and hence one tier, so the
+    family-lift invariant is unaffected."""
     family_prio: dict[str, int] = {}
     for g in gangs:
         root = g.base_podgang_name or g.name
@@ -90,9 +100,10 @@ def sort_pending(
     def rank(g: PodGang) -> int:
         return priority_of(g) if g.is_scaled else family_prio[g.name]
 
+    tier = tier_of if tier_of is not None else (lambda g: 0)
     return sorted(
         gangs,
-        key=lambda g: (-rank(g), g.is_scaled, g.scaled_index, g.name),
+        key=lambda g: (tier(g), -rank(g), g.is_scaled, g.scaled_index, g.name),
     )
 
 

@@ -150,6 +150,7 @@ def drain_stream(
     mesh=None,  # None | parallel.mesh.SolveLayout | parallel.mesh.MeshConfig
     faults=None,  # faults.FaultInjector; None = the process-installed one
     resilience=None,  # None | ResilienceConfig | DegradationLadder (shared)
+    order_key=None,  # None | callable(PodGang) -> sort key; tenancy ordering
 ) -> tuple[dict[str, dict[str, str]], StreamStats]:
     """Admit a live arrival trace; returns ({gang: {pod: node}}, StreamStats).
 
@@ -185,6 +186,16 @@ def drain_stream(
 
     `faults`: deterministic fault injector threaded through the engine's
     named sites (grove_tpu/faults) — chaos runs replay bit-for-bit.
+
+    `order_key`: optional key callable; when given, the backlog of queued
+    arrivals is STABLE-sorted by it before each window is sliced, so e.g.
+    a tenancy tier key (slo_rank, -priority) lets latency-class gangs jump
+    ahead of batch work that arrived earlier. The key must be
+    family-uniform (identical for a base gang and its scaled siblings —
+    true for anything derived from the template, like sloClass), so the
+    stable sort preserves the base-before-scaled arrival invariant the
+    encoder depends on. Paced-mode batching waits still key off the
+    oldest ARRIVAL in the queue, not the sorted head.
     """
     from grove_tpu.solver import warm as warm_mod
     from grove_tpu.solver.resilience import ladder_for
@@ -379,8 +390,17 @@ def drain_stream(
         ready = len(queue) >= cfg.wave_size or (i >= n and bool(queue))
         if pace and queue and not ready:
             # Batching window: the oldest queued gang only waits so long.
-            ready = (now - avail[queue[0].name]) >= cfg.max_wait_s
+            # (Under order_key the sorted head need not be the oldest —
+            # always anchor the wait on the earliest arrival still queued.)
+            oldest = (
+                min(avail[g.name] for g in queue)
+                if order_key is not None
+                else avail[queue[0].name]
+            )
+            ready = (now - oldest) >= cfg.max_wait_s
         if ready:
+            if order_key is not None and len(queue) > 1:
+                queue.sort(key=order_key)  # stable: FIFO within equal keys
             window, queue = queue[: cfg.wave_size], queue[cfg.wave_size :]
             stats.windows += 1
             for ws in plan_waves(window, cfg.wave_size):

@@ -649,3 +649,165 @@ def test_cli_get_queues_table(simple1, capsys):
         assert "cpu=0.13" in out
     finally:
         m.stop()
+
+
+# --- deep-tree edge cases (tenancy PR hardening) ----------------------------------
+
+
+def test_queue_tree_four_levels_rollup_and_chain():
+    """A 4-level chain (root > org > team > sub): usage rolls up through
+    every level, ancestors() orders self->root, depth() counts edges."""
+    from grove_tpu.orchestrator.queues import parse_queue_config
+
+    tree = parse_queue_config(
+        {
+            "root": {"resources": {"cpu": {"quota": "16"}}},
+            "org": {"parentQueue": "root", "resources": {"cpu": {"quota": "8"}}},
+            "team": {"parentQueue": "org", "resources": {"cpu": {"quota": "4"}}},
+            "sub": {"parentQueue": "team", "resources": {}},
+        }
+    )
+    assert tree.ancestors("sub") == ["sub", "team", "org", "root"]
+    assert tree.depth("sub") == 3 and tree.depth("root") == 0
+    assert tree.subtree("root") == {"root", "org", "team", "sub"}
+    usage = tree.hierarchical_usage({"sub": {"cpu": 2.0}, "org": {"cpu": 1.0}})
+    assert usage["sub"]["cpu"] == 2.0
+    assert usage["team"]["cpu"] == 2.0
+    assert usage["org"]["cpu"] == 3.0, "sub's 2 + org's own 1"
+    assert usage["root"]["cpu"] == 3.0
+
+
+def test_queue_tree_four_levels_borrow_blocks_at_each_ancestor():
+    """Borrowing walks the WHOLE chain: the same demand is blocked at
+    whichever intermediate level's envelope binds first, and the block
+    names that level."""
+    from grove_tpu.orchestrator.queues import parse_queue_config
+
+    tree = parse_queue_config(
+        {
+            "root": {"resources": {"cpu": {"quota": "16"}}},
+            "org": {
+                "parentQueue": "root",
+                "resources": {"cpu": {"quota": "8", "limit": "10"}},
+            },
+            "team": {"parentQueue": "org", "resources": {"cpu": {"quota": "4"}}},
+            "sub": {"parentQueue": "team", "resources": {}},
+        }
+    )
+    usage = tree.hierarchical_usage({"sub": {"cpu": 4.0}})
+    # sub has no envelope; team 4->9 borrows past quota 4; org's limit 10
+    # binds before root's quota 16 is in sight.
+    v = tree.try_charge(usage, "sub", {"cpu": 7.0})
+    assert not v.admitted and v.blocked_at == "org" and v.blocked_reason == "limit"
+    # A smaller demand borrows through team AND org within every envelope.
+    v = tree.try_charge(usage, "sub", {"cpu": 5.0})
+    assert v.admitted and v.borrowed
+    assert usage["root"]["cpu"] == 9.0, "charge lands on all four levels"
+    # Root quota is ALWAYS hard, even for a deep descendant. Drop org's
+    # limit so it is root's envelope that binds: 9 + 8 > 16.
+    tree2 = parse_queue_config(
+        {
+            "root": {"resources": {"cpu": {"quota": "16"}}},
+            "org": {"parentQueue": "root", "resources": {"cpu": {"quota": "8"}}},
+            "team": {"parentQueue": "org", "resources": {"cpu": {"quota": "4"}}},
+            "sub": {"parentQueue": "team", "resources": {}},
+        }
+    )
+    usage2 = tree2.hierarchical_usage({"sub": {"cpu": 9.0}})
+    v = tree2.try_charge(usage2, "sub", {"cpu": 8.0})
+    assert not v.admitted and v.blocked_at == "root"
+    assert v.blocked_reason == "root-quota"
+
+
+def test_over_quota_queues_returns_unordered_tie_set():
+    """Two queues tied over quota: over_quota_queues is a SET (no ordering
+    contract) and must name exactly the borrowers, never in-quota siblings
+    or queues without a set quota."""
+    from grove_tpu.orchestrator.queues import parse_queue_config
+
+    tree = parse_queue_config(
+        {
+            "org": {"resources": {"cpu": {"quota": "12"}}},
+            "a": {"parentQueue": "org", "resources": {"cpu": {"quota": "1"}}},
+            "b": {"parentQueue": "org", "resources": {"cpu": {"quota": "1"}}},
+            "c": {"parentQueue": "org", "resources": {"cpu": {"quota": "5"}}},
+            "free": {"parentQueue": "org", "resources": {}},
+        }
+    )
+    usage = tree.hierarchical_usage(
+        {
+            "a": {"cpu": 2.0},  # over by 1
+            "b": {"cpu": 2.0},  # over by 1 (the tie)
+            "c": {"cpu": 4.0},  # in quota
+            "free": {"cpu": 3.0},  # no envelope -> can't be over
+        }
+    )
+    # org's rolled-up usage is 11 <= 12, so the subtree scan (which
+    # includes `under` itself) names only the tied leaf borrowers.
+    over = tree.over_quota_queues(usage, "org")
+    assert isinstance(over, set)
+    assert over == {"a", "b"}
+    # Scoped: asking under a leaf sees only that subtree.
+    assert tree.over_quota_queues(usage, "c") == set()
+
+
+def test_zero_weight_quota_is_hard_at_depth():
+    """overQuotaWeight 0 pins a MID-tree queue to its quota even though
+    both its parent and grandparent have headroom to lend."""
+    from grove_tpu.orchestrator.queues import parse_queue_config
+
+    tree = parse_queue_config(
+        {
+            "root": {"resources": {"cpu": {"quota": "100"}}},
+            "org": {"parentQueue": "root", "resources": {"cpu": {"quota": "50"}}},
+            "pinned": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "2", "overQuotaWeight": 0}},
+            },
+            "leaf": {"parentQueue": "pinned", "resources": {}},
+        }
+    )
+    usage = tree.hierarchical_usage({"leaf": {"cpu": 2.0}})
+    v = tree.try_charge(usage, "leaf", {"cpu": 1.0})
+    assert not v.admitted and v.blocked_at == "pinned"
+    assert v.blocked_reason == "quota"
+    # The same envelope with weight > 0 borrows fine.
+    tree2 = parse_queue_config(
+        {
+            "root": {"resources": {"cpu": {"quota": "100"}}},
+            "org": {"parentQueue": "root", "resources": {"cpu": {"quota": "50"}}},
+            "pinned": {
+                "parentQueue": "org",
+                "resources": {"cpu": {"quota": "2", "overQuotaWeight": 1}},
+            },
+            "leaf": {"parentQueue": "pinned", "resources": {}},
+        }
+    )
+    usage2 = tree2.hierarchical_usage({"leaf": {"cpu": 2.0}})
+    v = tree2.try_charge(usage2, "leaf", {"cpu": 1.0})
+    assert v.admitted and v.borrowed
+
+
+def test_root_quota_blocks_are_reclaim_eligible_only_for_in_quota_demand():
+    """The root-quota block distinguishes the two starvation cases: an
+    in-quota contender squeezed by borrowers may reclaim; a contender that
+    is ITSELF over its own quota may not."""
+    from grove_tpu.orchestrator.queues import parse_queue_config
+
+    tree = parse_queue_config(
+        {
+            "org": {"resources": {"cpu": {"quota": "4"}}},
+            "deserved": {"parentQueue": "org", "resources": {"cpu": {"quota": "3"}}},
+            "greedy": {"parentQueue": "org", "resources": {"cpu": {"quota": "1"}}},
+        }
+    )
+    usage = tree.hierarchical_usage({"greedy": {"cpu": 4.0}})  # borrowed to the hilt
+    v = tree.try_charge(usage, "deserved", {"cpu": 2.0})
+    assert not v.admitted and v.blocked_reason == "root-quota"
+    assert v.reclaim_eligible, "in-quota at its own level -> may reclaim"
+    v = tree.try_charge(usage, "greedy", {"cpu": 2.0})
+    assert not v.admitted
+    assert not v.reclaim_eligible, "an over-quota contender cannot reclaim"
+    # Borrow weight for ordering: min across demanded resources.
+    assert tree.borrow_weight("greedy", {"cpu": 1.0}) == 1.0
+    assert tree.borrow_weight("greedy", {}) == 0.0
