@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak bench-chaos bench-chaos-soak bench-tenancy bench-tenancy-soak profile-host dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak bench-chaos bench-chaos-soak bench-tenancy bench-tenancy-soak bench-rollout bench-rollout-soak profile-host dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -130,6 +130,20 @@ bench-tenancy:   ## multi-tenant SLO tiers: fairness + tier ordering + reclaim b
 bench-tenancy-soak: ## tenancy scenario over a longer trace with more tenants (slow)
 	@mkdir -p evidence
 	GROVE_BENCH_SCENARIO=tenancy GROVE_FORCE_CPU=1 GROVE_BENCH_TENANCY_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_tenancy_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+# Fleet-lifecycle scenario: a make-before-break rolling update of a resident
+# workload overlapping a revocation storm on the spot slice of the fleet —
+# gates zero lost/double-bound gangs, the shared disruption budget at every
+# tick, >=1 revocation absorbed by migration AND >=1 by slo-ordered eviction,
+# bounded latency-tier p99, and bitwise journal replay. Evidence JSON tee'd
+# under evidence/; the soak variant lengthens the trace and widens the storm.
+bench-rollout:   ## fleet lifecycle: MBB rolling update + revocation storm, all gates in one run
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=rollout GROVE_FORCE_CPU=1 $(PY) bench.py | tee evidence/bench_rollout_cpu_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+bench-rollout-soak: ## rollout scenario over a longer trace with a wider storm (slow)
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=rollout GROVE_FORCE_CPU=1 GROVE_BENCH_ROLLOUT_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_rollout_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
 
 # Host hot-path profile: cProfile a warm steady-state drain, top cumulative
 # frames + the host-stage ledger as JSON under evidence/.

@@ -2118,6 +2118,375 @@ def run_tenancy_bench() -> dict:
     }
 
 
+def run_rollout_bench() -> dict:
+    """Fleet-lifecycle chaos gate (`make bench-rollout` /
+    GROVE_BENCH_SCENARIO=rollout): a make-before-break rolling update of a
+    long-lived resident workload OVERLAPPING a revocation storm on the
+    revocable (spot) slice of the fleet, with a churning multi-tier arrival
+    trace underneath — all through the Manager's reconcile loop on the sim
+    clock, flight-recorded.
+
+    Storm shape: wave 1 serves standard-grace notices on busy revocable
+    nodes (the controller has room to rescue residents make-before-break);
+    wave 2 serves short-grace notices (deadline already inside the eviction
+    lead — the provider barely warned us), which MUST resolve by slo-ordered
+    eviction. Between the waves the resident's generation hash changes with
+    the make-before-break annotation set.
+
+    Gates (vs_baseline is 1.0 only when ALL hold):
+      - generation fully rolled: the rolling update ENDED, >= 1 MBB cutover
+        committed, and no resident pod still carries the old template hash;
+      - zero lost gangs: every offered arrival workload reached its floor
+        binds and completed its hold; the resident is whole and ready;
+      - zero oversubscribed ticks (double-bind detector, sampled per tick);
+      - the shared disruption budget is NEVER exceeded at any sampled tick;
+      - >= 1 revocation absorbed by make-before-break migration AND >= 1 by
+        slo-ordered eviction;
+      - latency-tier p99 time-to-bind bounded (the storm + rollout must not
+        starve the latency tier);
+      - replay: zero divergences re-solving the journal.
+
+    GROVE_BENCH_ROLLOUT_SOAK=1 lengthens the trace (slow tier)."""
+    import tempfile
+
+    from grove_tpu.api import constants
+    from grove_tpu.orchestrator import expansion as _exp
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+    from grove_tpu.sim.simulator import Simulator
+    from grove_tpu.sim.workloads import (
+        _clique,
+        _pcs,
+        arrival_pcs,
+        arrival_process,
+        synthetic_cluster,
+    )
+    from grove_tpu.tenancy import quantile
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    soak = os.environ.get("GROVE_BENCH_ROLLOUT_SOAK", "0") == "1"
+    duration = float(
+        os.environ.get("GROVE_BENCH_ROLLOUT_DURATION_S", "180" if soak else "90")
+    )
+    rate = float(
+        os.environ.get("GROVE_BENCH_ROLLOUT_RATE", "1.6" if soak else "1.2")
+    )
+    n_tenants = int(os.environ.get("GROVE_BENCH_ROLLOUT_TENANTS", "60"))
+    hold_s = float(os.environ.get("GROVE_BENCH_ROLLOUT_HOLD_S", "10"))
+    tail_cap_s = float(
+        os.environ.get("GROVE_BENCH_ROLLOUT_TAIL_S", "360" if soak else "300")
+    )
+    seed = int(os.environ.get("GROVE_BENCH_ROLLOUT_SEED", "20260805"))
+    lat_p99_cap_s = float(os.environ.get("GROVE_BENCH_ROLLOUT_LAT_P99_S", "30"))
+    storm1_n = int(os.environ.get("GROVE_BENCH_ROLLOUT_STORM1_NODES", "3"))
+    storm2_n = int(
+        os.environ.get("GROVE_BENCH_ROLLOUT_STORM2_NODES", "12" if soak else "8")
+    )
+
+    events = arrival_process(
+        seed,
+        duration_s=duration,
+        base_rate=rate,
+        tenants=n_tenants,
+        active_tenants=max(4, n_tenants // 8),
+        tenant_churn_s=max(0.5, duration / max(1, n_tenants)),
+        slo_mix=(
+            ("latency", 0.25),
+            ("standard", 0.5),
+            ("batch-preemptible", 0.25),
+        ),
+    )
+    tenant_names = sorted({ev.tenant for ev in events})
+    # Generous quotas: tenancy is on for the tier ledger (latency p99 gate),
+    # not for contention — the storm and the rollout are the stressors here.
+    queues: dict = {"org": {"resources": {"cpu": {"quota": "4096"}}}}
+    for t in tenant_names:
+        queues[t] = {"parentQueue": "org", "resources": {"cpu": {"quota": "128"}}}
+    queues["resident-q"] = {
+        "parentQueue": "org", "resources": {"cpu": {"quota": "512"}}
+    }
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1},
+            "backend": {"enabled": False},
+            "scheduling": {"queues": queues},
+            "tenancy": {"enabled": True},
+            "defrag": {"maxConcurrentMigrations": 4},
+            # The MBB machinery itself is opted into per-workload via the
+            # grove.io/rollout-strategy annotation; the section here wires
+            # the surge what-if + backoff knobs through the config path.
+            "rollout": {"surgeRacks": 1, "deadlineSeconds": 120.0},
+        }
+    )
+    if errors:
+        raise ValueError(f"operator config invalid: {errors}")
+    m = Manager(cfg)
+    for node in synthetic_cluster(
+        zones=1, blocks_per_zone=2, racks_per_block=4, hosts_per_rack=8
+    ):
+        m.cluster.nodes[node.name] = node
+    # The revocable (spot) slice: every other node, interleaved across the
+    # fleet — spot capacity mixed into the same racks as on-demand, so the
+    # packing solver lands real work on it (a name-ordered tail slice would
+    # sit idle and the storm would have nothing to hit).
+    names_sorted = sorted(m.cluster.nodes)
+    revocable_slice = set(names_sorted[1::2])
+    for n in revocable_slice:
+        m.cluster.nodes[n].revocable = True
+    sim = Simulator(m.cluster, m.controller)
+    trace_dir = tempfile.mkdtemp(prefix="grove-rollout-trace-")
+    recorder = TraceRecorder(trace_dir)
+    recorder.start()
+    m.controller.recorder = recorder
+
+    # The long-lived resident the rolling update targets: 6 replicas of
+    # {srv x4 @8cpu, aux x2 @4cpu} — 36 pods, opted into make-before-break.
+    resident = _pcs(
+        "resident",
+        cliques=[_clique("srv", 4, "8"), _clique("aux", 2, "4")],
+        replicas=6,
+    )
+    resident.metadata.annotations[constants.ANNOTATION_QUEUE] = "resident-q"
+    resident.metadata.annotations[constants.ANNOTATION_ROLLOUT_STRATEGY] = (
+        constants.ROLLOUT_STRATEGY_MAKE_BEFORE_BREAK
+    )
+    resident = m.apply_podcliqueset(resident)
+
+    pending_events = list(events)
+    applied: dict[str, float] = {}
+    bound_at: dict[str, float] = {}
+    delete_at: dict[str, float] = {}
+    budget_peak = 0
+    budget_samples = 0
+    oversubscribed_ticks = 0
+    fault_log: list[dict] = []
+    update_pushed = False
+    storm_done = [False, False]
+    update_at = duration * 0.3
+    storm1_at = duration * 0.4
+    storm2_at = duration * 0.6
+    dt = 1.0
+    wall0 = time.perf_counter()
+
+    def _busy_revocable(k: int) -> list[str]:
+        """The k busiest revocable nodes not yet under a notice — a pure
+        function of (deterministic) sim state."""
+        busy: dict[str, int] = {}
+        for p in m.cluster.pods.values():
+            if p.is_active and p.is_scheduled and p.node_name in revocable_slice:
+                busy[p.node_name] = busy.get(p.node_name, 0) + 1
+        alive = [
+            n
+            for n in busy
+            if m.cluster.nodes[n].schedulable
+            and m.cluster.nodes[n].revocation_deadline is None
+        ]
+        return sorted(alive, key=lambda n: (-busy[n], n))[:k]
+
+    try:
+        while True:
+            now_next = sim.now + dt
+            while pending_events and pending_events[0].t <= now_next:
+                ev = pending_events.pop(0)
+                pcs = arrival_pcs(ev)
+                pcs.metadata.annotations[constants.ANNOTATION_QUEUE] = ev.tenant
+                m.apply_podcliqueset(pcs)
+                applied[ev.name] = now_next
+            for name, at in list(delete_at.items()):
+                if at <= now_next:
+                    m.delete_podcliqueset(name)
+                    del delete_at[name]
+            if not update_pushed and now_next >= update_at:
+                # Generation change: new image on the srv clique of the
+                # STORED object — the controller's reconcile picks up the
+                # hash change, and the MBB annotation routes it through
+                # orchestrator/rollout.py.
+                live = m.cluster.podcliquesets["resident"]
+                for tmpl in live.spec.template.cliques:
+                    if tmpl.name == "srv":
+                        for c in tmpl.spec.pod_spec.containers:
+                            c.image = c.image.rsplit(":", 1)[0] + ":v2"
+                update_pushed = True
+                fault_log.append(
+                    {"t": now_next, "action": "rolling_update", "target": "resident"}
+                )
+            if not storm_done[0] and now_next >= storm1_at:
+                for n in _busy_revocable(storm1_n):
+                    sim.revoke_node(n)
+                    fault_log.append(
+                        {"t": now_next, "action": "revoke_node", "target": n,
+                         "grace_s": sim.revocation_grace_s}
+                    )
+                storm_done[0] = True
+            if not storm_done[1] and now_next >= storm2_at:
+                # Short-grace wave: the deadline lands inside the eviction
+                # lead, so migration never gets a turn — the slo-ordered
+                # eviction ladder MUST absorb these.
+                sim.revocation_grace_s = (
+                    m.controller.revocation_eviction_lead_seconds
+                )
+                for n in _busy_revocable(storm2_n):
+                    sim.revoke_node(n)
+                    fault_log.append(
+                        {"t": now_next, "action": "revoke_node", "target": n,
+                         "grace_s": sim.revocation_grace_s}
+                    )
+                storm_done[1] = True
+            sim.step(dt)
+            bases_by_pcs: dict[str, list] = {}
+            for g in m.cluster.podgangs.values():
+                if not g.is_scaled:
+                    bases_by_pcs.setdefault(g.pcs_name, []).append(g)
+            for name in list(applied):
+                if name in bound_at or name not in m.cluster.podcliquesets:
+                    continue
+                bases = bases_by_pcs.get(name, [])
+                if bases and all(g.is_base_gang_scheduled() for g in bases):
+                    bound_at[name] = sim.now
+                    delete_at[name] = sim.now + hold_s
+            in_flight = m.controller.disrupted_now()
+            budget_peak = max(budget_peak, in_flight)
+            budget_samples += 1
+            used: dict[str, dict[str, float]] = {}
+            for p in m.cluster.pods.values():
+                if p.is_active and p.is_scheduled:
+                    node_used = used.setdefault(p.node_name, {})
+                    for r, q in p.spec.total_requests().items():
+                        node_used[r] = node_used.get(r, 0.0) + q
+            for n, res in used.items():
+                cap = m.cluster.nodes[n].capacity
+                if any(q > cap.get(r, 0.0) + 1e-6 for r, q in res.items()):
+                    oversubscribed_ticks += 1
+                    break
+            prog = m.cluster.podcliquesets["resident"].status.rolling_update_progress
+            rc = m.controller.revocation_counts
+            drained = not pending_events and len(m.cluster.podcliquesets) == 1
+            rolled = (
+                update_pushed
+                and prog is not None
+                and prog.update_ended_at is not None
+            )
+            if (
+                drained
+                and rolled
+                and rc["migrated"] >= 1
+                and rc["evicted"] >= 1
+                and sum(
+                    1
+                    for p in m.cluster.pods.values()
+                    if p.pclq_fqn.startswith("resident-")
+                    and p.is_active
+                    and p.ready
+                ) == 36
+            ):
+                break
+            if sim.now >= duration + tail_cap_s:
+                break
+        recorder.flush()
+    finally:
+        recorder.stop()
+    wall_s = time.perf_counter() - wall0
+
+    live_resident = m.cluster.podcliquesets["resident"]
+    prog = live_resident.status.rolling_update_progress
+    want_hash = _exp.compute_pod_template_hash(
+        live_resident.clique_template("srv"),
+        live_resident.spec.template.priority_class_name,
+    )
+    resident_pods = [
+        p
+        for p in m.cluster.pods.values()
+        if p.pclq_fqn.startswith("resident-") and p.is_active
+    ]
+    stale_resident = [
+        p.name
+        for p in resident_pods
+        if p.pclq_fqn.endswith("-srv") and p.pod_template_hash != want_hash
+    ]
+    resident_ready = sum(1 for p in resident_pods if p.ready)
+    rollout_counts = dict(m.controller.rollout_counts)
+    rc = dict(m.controller.revocation_counts)
+
+    led = m.controller.tenancy_ledger
+    pooled = led.tier_latencies()
+    tiers = {
+        cls: {
+            "samples": len(samples),
+            "p50_bind_s": round(quantile(samples, 0.50), 3),
+            "p99_bind_s": round(quantile(samples, 0.99), 3),
+        }
+        for cls, samples in sorted(pooled.items())
+    }
+    lat_p99 = tiers.get("latency", {}).get("p99_bind_s")
+    lost = sorted(n for n in applied if n not in bound_at)
+
+    records = read_journal(trace_dir)
+    report = replay_journal(records)
+    action_counts: dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "action":
+            a = r.get("action", "")
+            if a.startswith(("rollout.", "revocation.", "chaos.")):
+                action_counts[a] = action_counts.get(a, 0) + 1
+
+    gates = {
+        "generation_rolled": (
+            update_pushed
+            and prog is not None
+            and prog.update_ended_at is not None
+            and rollout_counts["cutovers"] >= 1
+            and not stale_resident
+        ),
+        "zero_lost_gangs": (
+            not lost
+            and len(m.cluster.podcliquesets) == 1
+            and resident_ready == 36
+        ),
+        "zero_oversubscribed_ticks": oversubscribed_ticks == 0,
+        "budget_never_exceeded": budget_peak <= m.controller.defrag_max_concurrent,
+        "revocations_migrated_and_evicted": (
+            rc["migrated"] >= 1 and rc["evicted"] >= 1
+        ),
+        "latency_p99_bounded": (
+            lat_p99 is not None and lat_p99 <= lat_p99_cap_s
+        ),
+        "replay_bit_identical": report.divergence_count == 0,
+    }
+    return {
+        "scenario": "rollout",
+        "metric": "rollout_chaos_gates_green",
+        "unit": "bool",
+        "value": 1.0 if all(gates.values()) else 0.0,
+        "vs_baseline": 1.0 if all(gates.values()) else 0.0,
+        "gates": gates,
+        "soak": soak,
+        "host_cpus": len(os.sched_getaffinity(0)),
+        "trace_seed": seed,
+        "trace_duration_s": duration,
+        "sim_seconds": round(sim.now, 1),
+        "wall_s": round(wall_s, 3),
+        "workloads_offered": len(events),
+        "workloads_bound": len(bound_at),
+        "lost_gangs": lost[:8],
+        "resident_pods_ready": resident_ready,
+        "resident_stale_pods": stale_resident[:8],
+        "rollout_counts": rollout_counts,
+        "revocation_counts": rc,
+        "revocable_nodes": len(revocable_slice),
+        "budget_peak_in_flight": budget_peak,
+        "budget_cap": m.controller.defrag_max_concurrent,
+        "budget_samples": budget_samples,
+        "oversubscribed_ticks": oversubscribed_ticks,
+        "latency_p99_cap_s": lat_p99_cap_s,
+        "tiers": tiers,
+        "faults": fault_log,
+        "lifecycle_actions_journaled": action_counts,
+        "replay_divergences": report.divergence_count,
+        "replay_waves": len(report.waves),
+    }
+
+
 # Scenario registry: GROVE_BENCH_SCENARIO -> (headline metric, unit, runner).
 # "" is the default north-star drain. New scenarios slot in as one entry —
 # main() owns no per-scenario branching.
@@ -2132,6 +2501,7 @@ SCENARIOS: dict[str, tuple[str, str, object]] = {
     "sweep": ("sweep_vs_single_replay", "x", run_sweep_bench),
     "chaos": ("chaos_bind_p99_inflation", "x", run_chaos_bench),
     "tenancy": ("tenancy_fair_spread", "ratio", run_tenancy_bench),
+    "rollout": ("rollout_chaos_gates_green", "bool", run_rollout_bench),
 }
 
 

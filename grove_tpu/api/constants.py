@@ -88,6 +88,14 @@ ANNOTATION_QUEUE = "grove.io/queue"
 # Set "true" on a PodCliqueSet to bypass the authorizer's managed-resource
 # protection for its children (constants.go:43-45).
 ANNOTATION_DISABLE_PROTECTION = "grove.io/disable-managed-resource-protection"
+# Per-PCS rolling-update strategy (docs/design.md "Fleet lifecycle"):
+# "make-before-break" plans the replacement generation onto free capacity
+# and cuts over atomically; "recreate" pins the delete-then-recreate seed
+# behavior. Unset defers to the operator config's `rollout.enabled`.
+ANNOTATION_ROLLOUT_STRATEGY = "grove.io/rollout-strategy"
+ROLLOUT_STRATEGY_MAKE_BEFORE_BREAK = "make-before-break"
+ROLLOUT_STRATEGY_RECREATE = "recreate"
+ROLLOUT_STRATEGIES = (ROLLOUT_STRATEGY_MAKE_BEFORE_BREAK, ROLLOUT_STRATEGY_RECREATE)
 
 # SLO classes (spec.template.sloClass; tenancy subsystem, docs/design.md
 # "Multi-tenant SLO tiers"). The class maps to admission order, borrowing

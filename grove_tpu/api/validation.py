@@ -26,7 +26,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from grove_tpu.api.constants import MAX_PCS_NAME_LENGTH, SLO_CLASSES
+from grove_tpu.api.constants import (
+    ANNOTATION_ROLLOUT_STRATEGY,
+    MAX_PCS_NAME_LENGTH,
+    ROLLOUT_STRATEGIES,
+    SLO_CLASSES,
+)
 from grove_tpu.api.types import (
     ClusterTopology,
     CliqueStartupType,
@@ -93,6 +98,18 @@ def validate_podcliqueset(
             ValidationError(
                 "spec.template.sloClass",
                 f"unknown SLO class {tmpl.slo_class!r}; must be one of {', '.join(SLO_CLASSES)}",
+            )
+        )
+    # grove.io/rollout-strategy: the per-PCS update-strategy override must
+    # name a known strategy — a typo'd value would silently fall back to the
+    # global rollout.enabled default, the opposite of what was asked for.
+    strategy = (pcs.metadata.annotations or {}).get(ANNOTATION_ROLLOUT_STRATEGY)
+    if strategy is not None and strategy not in ROLLOUT_STRATEGIES:
+        errs.append(
+            ValidationError(
+                f"metadata.annotations[{ANNOTATION_ROLLOUT_STRATEGY}]",
+                f"unknown rollout strategy {strategy!r}; must be one of "
+                + ", ".join(ROLLOUT_STRATEGIES),
             )
         )
 

@@ -3,7 +3,7 @@
 Talks to a running manager (`python -m grove_tpu.runtime`) over its object
 API via the typed client. Commands:
 
-  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag|quality|resilience|tenancy   table listing
+  get pcs|pclq|pcsg|podgangs|pods|nodes|services|hpas|queues|topology|solver|defrag|quality|resilience|tenancy|rollout   table listing
   get <kind> <name>                             full object as JSON
   describe <kind> <name>                        human detail + object events
   apply -f <file.yaml>                          admit a PodCliqueSet
@@ -70,6 +70,8 @@ KIND_ALIASES = {
     "quality": "quality",
     "resilience": "resilience",
     "tenancy": "tenancy",
+    "rollout": "rollout",
+    "rollouts": "rollout",
 }
 
 
@@ -257,6 +259,42 @@ def _get_table(client: GroveClient, kind: str) -> str:
                 ["lastPlan.solveSeconds", plan.get("planSolveSeconds", 0)],
             ]
         rows += [[f"counts.{k}", v] for k, v in sorted(counts.items())]
+        return _table(rows, ["METRIC", "VALUE"])
+    if kind == "rollout":
+        # Fleet lifecycle at a glance: make-before-break rollout state
+        # (replicas mid-replacement, last decision, monotonic counters) and
+        # the revocable-capacity picture (pending notices with deadlines,
+        # migrate/evict counters) — from /statusz (the grove_rollout_* and
+        # grove_revocation_* metrics source doc).
+        doc = client.statusz().get("rollout", {})
+        last = doc.get("last", {})
+        rows = [
+            ["enabled", "yes" if doc.get("enabled") else "no"],
+            ["surgeRacks", doc.get("surgeRacks", "-")],
+            ["deadlineSeconds", doc.get("deadlineSeconds", "-")],
+            ["replacing", ",".join(doc.get("replacing", [])) or "-"],
+        ]
+        for pcs_name, dec in sorted(last.items()):
+            rows.append(
+                [
+                    f"last.{pcs_name}",
+                    f"{dec.get('decision', '?')} replica {dec.get('replica', '?')} "
+                    f"at t={dec.get('at', 0)}",
+                ]
+            )
+        rows += [
+            [f"counts.{k}", v] for k, v in sorted(doc.get("counts", {}).items())
+        ]
+        rev = doc.get("revocation", {})
+        rows.append(
+            ["revocation.evictionLeadSeconds", rev.get("evictionLeadSeconds", "-")]
+        )
+        for node, deadline in sorted(rev.get("pendingNodes", {}).items()):
+            rows.append([f"revocation.pending.{node}", f"deadline t={deadline}"])
+        rows += [
+            [f"revocation.counts.{k}", v]
+            for k, v in sorted(rev.get("counts", {}).items())
+        ]
         return _table(rows, ["METRIC", "VALUE"])
     if kind == "resilience":
         # Failure-domain state at a glance: ladder breaker states + step

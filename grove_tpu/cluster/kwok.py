@@ -188,6 +188,12 @@ def kwok_fleet_from_config(cluster_cfg, topology, now: float = 0.0) -> KwokClust
             # factors for them).
             sizes.append(sizes[-1] * 4)
     nodes = []
+    # Revocable (spot) slice: the LAST `cluster.revocableNodes` nodes carry
+    # the revocable attribute — the fleet segment a revocation notice
+    # (sim.node_revocation site / Simulator.revoke_node) may take back.
+    revocable_from = cluster_cfg.kwok_nodes - max(
+        0, int(getattr(cluster_cfg, "revocable_nodes", 0) or 0)
+    )
     for n in range(cluster_cfg.kwok_nodes):
         labels: dict[str, str] = {}
         for lvl, size in zip(reversed(levels), sizes):
@@ -201,6 +207,7 @@ def kwok_fleet_from_config(cluster_cfg, topology, now: float = 0.0) -> KwokClust
                     "google.com/tpu": cluster_cfg.kwok_tpu_per_node,
                 },
                 labels=labels,
+                revocable=n >= revocable_from,
             )
         )
     return kwok_fleet(

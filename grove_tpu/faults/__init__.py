@@ -11,6 +11,8 @@ replayable input: code threads named *sites* through the stack —
   watch.disconnect   the watch stream drops (kubernetes.py reader loop)
   recorder.write     the journal segment write hits ENOSPC (trace/recorder)
   sim.node_death     schedulable chaos-script node kill (sim/simulator)
+  sim.node_revocation  a revocable node gets a revocation notice with a
+                     grace window (sim/simulator; spot capacity reclaim)
 
 — and an injector decides, per evaluation, whether the fault fires. The
 decision is a pure function of (site seed, evaluation index): two runs with
@@ -55,6 +57,7 @@ SITES = (
     "watch.disconnect",
     "recorder.write",
     "sim.node_death",
+    "sim.node_revocation",
 )
 
 

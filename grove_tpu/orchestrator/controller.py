@@ -273,6 +273,60 @@ class GroveController:
             "moves_deferred": 0,
         }
     )
+    # Make-before-break rolling updates (orchestrator/rollout.py; config
+    # section `rollout`): when enabled (globally or per-PCS via the
+    # grove.io/rollout-strategy annotation), the current replica's stale
+    # pods are replaced by planning the NEW generation onto capacity that
+    # is free while the old placement still holds (plan_rescue with usage
+    # held), then cutting over atomically through _bind_gang. Infeasible
+    # replicas price "+surge racks" and "next candidate replica" what-ifs
+    # through the trace engine's clone_racks, then defer whole on a
+    # decorrelated-jitter backoff (utils/backoff.py); a spent deadline
+    # falls back to the seed delete-then-recreate path. Off = the seed
+    # behavior exactly (RU7-RU21 pin it).
+    rollout_enabled: bool = False
+    rollout_surge_racks: int = 1
+    rollout_backoff_base_seconds: float = 0.5
+    rollout_backoff_cap_seconds: float = 30.0
+    rollout_deadline_seconds: float = 600.0
+    # Retry/decision ledger the manager exports (grove_rollout_* metrics).
+    rollout_counts: dict = field(
+        default_factory=lambda: {
+            "planned": 0,
+            "cutovers": 0,
+            "deferred_budget": 0,
+            "deferred_capacity": 0,
+            "replans": 0,
+            "retries": 0,
+            "whatifs": 0,
+            "fallbacks": 0,
+        }
+    )
+    # Per-(pcs, replica) backoff episodes: (Backoff, clock cell, retry_at).
+    _rollout_backoff: dict = field(default_factory=dict)
+    # Replicas mid-replacement ((pcs, replica) -> start); counted against
+    # the shared disruption budget until the replica is whole again.
+    _rollout_replacing: dict = field(default_factory=dict)
+    # Last MBB decision per PCS — /statusz "rollout" + `get rollout`.
+    rollout_last: dict = field(default_factory=dict)
+    # Revocable capacity (docs/design.md "Fleet lifecycle"): nodes carrying
+    # a revocation notice (Node.revocation_deadline) are handled within
+    # grace — resident gangs migrate make-before-break through plan_rescue
+    # under the shared disruption budget while time allows; inside the
+    # eviction lead (or when no plan fits) residents are evicted in SLO
+    # rank order, batch-preemptible first. Expired notices become node
+    # deaths (the simulator enforces that).
+    revocation_eviction_lead_seconds: float = 10.0
+    revocation_counts: dict = field(
+        default_factory=lambda: {
+            "notices": 0,
+            "migrated": 0,
+            "evicted": 0,
+            "migration_deferred": 0,
+        }
+    )
+    # Nodes whose pending notice was already counted/journaled.
+    _revocation_seen: set = field(default_factory=set)
 
     # --- top-level pass ----------------------------------------------------------
 
@@ -280,6 +334,7 @@ class GroveController:
         for pcs in list(self.cluster.podcliquesets.values()):
             self.sync_workload(pcs, now)
         self.rolling_updates(now)
+        self.revocation_tick(now)
         self.solve_pending(now)
         self.update_statuses(now)
         self.gang_termination(now)
@@ -1230,10 +1285,15 @@ class GroveController:
             or self.resilience.config.stale_plan_revalidation
         )
         if revalidate:
+            # A revocation-pending node is as dead as a cordoned one for NEW
+            # bindings: a notice landing between solve and bind must never
+            # produce a bind into doomed capacity.
             dead = sorted(
                 node
                 for node in set(pod_bindings.values())
-                if (n := c.nodes.get(node)) is None or not n.schedulable
+                if (n := c.nodes.get(node)) is None
+                or not n.schedulable
+                or n.revocation_deadline is not None
             )
             if dead:
                 self.resilience_counts["stale_plan_requeues"] += 1
@@ -1243,8 +1303,8 @@ class GroveController:
                 c.record_event(
                     now,
                     gang_name,
-                    f"bind requeued: target node(s) {', '.join(dead)} died "
-                    "or were cordoned after the solve",
+                    f"bind requeued: target node(s) {', '.join(dead)} died, "
+                    "were cordoned, or got a revocation notice after the solve",
                 )
                 return False
         injector = faults_mod.active()
@@ -1800,8 +1860,29 @@ class GroveController:
 
     # --- rolling updates (rollingupdate.go) --------------------------------------
 
+    def _sweep_rollout_replacements(self) -> None:
+        """Free (pcs, replica) disruption-budget slots whose make-before-break
+        replacement completed — the replica shows up in
+        updated_replica_indices (or the update / the PCS itself is gone).
+        Runs at the top of rolling_updates so a slot frees on the pass after
+        the replica comes whole."""
+        c = self.cluster
+        for key in list(self._rollout_replacing):
+            pcs_name, replica = key
+            pcs = c.podcliquesets.get(pcs_name)
+            prog = pcs.status.rolling_update_progress if pcs is not None else None
+            if (
+                pcs is None
+                or prog is None
+                or prog.update_ended_at is not None
+                or replica in prog.updated_replica_indices
+            ):
+                del self._rollout_replacing[key]
+                self._rollout_backoff.pop(key, None)
+
     def rolling_updates(self, now: float) -> None:
         c = self.cluster
+        self._sweep_rollout_replacements()
         for pcs in c.podcliquesets.values():
             new_hash = exp.compute_generation_hash(pcs)
             st = pcs.status
@@ -1912,6 +1993,17 @@ class GroveController:
         # (RU-10 delete-first: exactly ONE pod down at a time under no
         # capacity, rolling_updates_test.go:210-258).
         stale = stale_pods(current)
+        # Make-before-break (opt-in via config `rollout.enabled` or the
+        # grove.io/rollout-strategy annotation): plan the replacement
+        # generation onto capacity that is free while the old pods still
+        # run, then cut over atomically — or defer the replica whole.
+        # True = handled this pass; False = the backoff deadline is spent,
+        # fall through to the seed delete-then-recreate path below.
+        if stale and self._rollout_mbb_enabled(pcs):
+            from grove_tpu.orchestrator.rollout import advance_make_before_break
+
+            if advance_make_before_break(self, pcs, current, stale, desired_hash, now):
+                return
 
         def _replacement_in_flight() -> bool:
             """A replacement pod (new hash, in a clique the update touches)
@@ -2023,18 +2115,7 @@ class GroveController:
         c = self.cluster
         counts = self.defrag_counts
         counts["ticks"] += 1
-        # Completion sweep: a migration is done when the gang is whole again.
-        for name in list(self._defrag_migrating):
-            gang = c.podgangs.get(name)
-            if gang is None:
-                del self._defrag_migrating[name]
-                continue
-            pods = [p for p in c.pods_of_gang(name) if p.is_active]
-            if pods and all(p.is_scheduled and p.ready for p in pods):
-                del self._defrag_migrating[name]
-                counts["migrations_completed"] += 1
-        for name in [n for n in self._defrag_migrated_at if n not in c.podgangs]:
-            del self._defrag_migrated_at[name]
+        self._sweep_migrations()
         # In-flight reclaim evictions share this budget (tenancy); sweep
         # them on the same cadence so a landed reclaim frees its slot.
         self._sweep_reclaim_evictions()
@@ -2113,6 +2194,26 @@ class GroveController:
         summary["migrating"] = len(self._defrag_migrating)
         return summary
 
+    def _sweep_migrations(self) -> None:
+        """Completion sweep shared by defrag and revocation rescue: a
+        migration is done when the gang is whole again (every active pod
+        scheduled and Ready). Revocation rescues ride _defrag_migrating, so
+        this must run even when defrag itself is disabled — otherwise a
+        rescue would hold its disruption-budget slot forever."""
+        c = self.cluster
+        counts = self.defrag_counts
+        for name in list(self._defrag_migrating):
+            gang = c.podgangs.get(name)
+            if gang is None:
+                del self._defrag_migrating[name]
+                continue
+            pods = [p for p in c.pods_of_gang(name) if p.is_active]
+            if pods and all(p.is_scheduled and p.ready for p in pods):
+                del self._defrag_migrating[name]
+                counts["migrations_completed"] += 1
+        for name in [n for n in self._defrag_migrated_at if n not in c.podgangs]:
+            del self._defrag_migrated_at[name]
+
     def _execute_move(self, mv, snapshot, now: float) -> bool:
         """Atomically rebind one gang to its planned nodes; False when the
         move cannot run yet (capacity not free, gang changed under the plan).
@@ -2177,6 +2278,227 @@ class GroveController:
         )
         return True
 
+    # --- fleet lifecycle: rollout strategy + revocable capacity -------------------
+
+    def _rollout_mbb_enabled(self, pcs: PodCliqueSet) -> bool:
+        """Per-PCS make-before-break opt-in: the grove.io/rollout-strategy
+        annotation wins ("make-before-break" / "recreate"), else the global
+        `rollout.enabled` config. Default off — the seed delete-then-recreate
+        behavior is pinned by the RU scenario suite."""
+        strategy = (pcs.metadata.annotations or {}).get(
+            constants.ANNOTATION_ROLLOUT_STRATEGY, ""
+        )
+        if strategy == constants.ROLLOUT_STRATEGY_MAKE_BEFORE_BREAK:
+            return True
+        if strategy == constants.ROLLOUT_STRATEGY_RECREATE:
+            return False
+        return self.rollout_enabled
+
+    def revocation_tick(self, now: float) -> None:
+        """React to pending revocation notices within their grace window.
+
+        For every schedulable node carrying a revocation_deadline: while
+        time allows (outside revocation_eviction_lead_seconds), resident
+        gangs migrate make-before-break through plan_rescue under the
+        shared disruption budget, highest SLO tier planned first so latency
+        work gets the scarce free capacity. Inside the lead — or for
+        whatever migration could not place in time — residents are evicted
+        in DESCENDING SLO rank (batch-preemptible first) and reschedule
+        from the queue; the node must be empty before the deadline turns it
+        into a dead node. Evictions are forced by the provider, not chosen
+        by us, so they do not consume disruption-budget slots."""
+        c = self.cluster
+        pending = [
+            n
+            for n in c.nodes.values()
+            if n.revocation_deadline is not None and n.schedulable
+        ]
+        if self._revocation_seen:
+            # Bookkeeping for resolved notices (expired → killed → cordoned).
+            self._revocation_seen &= {n.name for n in pending}
+        if not pending:
+            return
+        c_counts = self.revocation_counts
+        # Rescues ride the defrag-migration machinery; sweep completions even
+        # when defrag itself is disabled so budget slots free up.
+        self._sweep_migrations()
+        self._sweep_reclaim_evictions()
+        for node in sorted(pending, key=lambda n: (n.revocation_deadline, n.name)):
+            if node.name not in self._revocation_seen:
+                self._revocation_seen.add(node.name)
+                c_counts["notices"] += 1
+                self._journal_action(
+                    now,
+                    "revocation.notice",
+                    node.name,
+                    deadline=node.revocation_deadline,
+                )
+                c.record_event(
+                    now,
+                    node.name,
+                    f"revocation notice: capacity gone at t={node.revocation_deadline:g}",
+                )
+            residents = self._gangs_on_node(node.name)
+            if not residents:
+                continue
+            if now >= node.revocation_deadline - self.revocation_eviction_lead_seconds:
+                self._revocation_evict(node, residents, now)
+            else:
+                self._revocation_migrate(node, residents, now)
+
+    def _gangs_on_node(self, node_name: str) -> list[PodGang]:
+        """Gangs with at least one active scheduled pod on the node, in
+        deterministic name order."""
+        c = self.cluster
+        return [
+            gang
+            for name, gang in sorted(c.podgangs.items())
+            if any(
+                p.node_name == node_name and p.is_active and p.is_scheduled
+                for p in c.pods_of_gang(name)
+            )
+        ]
+
+    def _revocation_migrate(self, node, residents: list[PodGang], now: float) -> None:
+        """Rescue residents off a revocation-pending node make-before-break:
+        plan_rescue re-places each whole gang onto capacity that is free
+        while the old placement still holds (hold_usage=True — the same
+        discipline _execute_move enforces at commit time), with every
+        revocation-pending node masked. Deferred or unplaceable gangs retry
+        next tick and age into eviction."""
+        from grove_tpu.solver.defrag import plan_rescue
+
+        c = self.cluster
+        candidates = [g for g in residents if g.name not in self._defrag_migrating]
+        if not candidates:
+            return
+        budget = (
+            self.defrag_max_concurrent
+            - len(self._defrag_migrating)
+            - len(self._reclaim_evicting)
+            - len(self._rollout_replacing)
+        )
+        if budget <= 0:
+            self.revocation_counts["migration_deferred"] += len(candidates)
+            return
+        # Highest-SLO work first: free capacity is scarce during a storm and
+        # latency gangs must not lose their escape slot to batch work that
+        # the eviction ladder handles acceptably.
+        candidates.sort(
+            key=lambda g: (self._slo_rank_of(g), -self._priority_of(g), g.name)
+        )
+        candidates = candidates[:budget]
+        plan = plan_rescue(
+            list(c.nodes.values()),
+            self.topology,
+            candidates,
+            dict(c.pods),
+            params=self.solver_params,
+            warm=self.warm,
+            pruning=self.pruning,
+            hold_usage=True,
+        )
+        planned = {mv.gang for mv in plan}
+        self.revocation_counts["migration_deferred"] += sum(
+            1 for g in candidates if g.name not in planned
+        )
+        if not plan:
+            return
+        nodes = list(c.nodes.values())
+        bound = [p for p in c.pods.values() if p.is_scheduled and p.is_active]
+        snapshot = build_snapshot(
+            nodes,
+            self.topology,
+            bound_pods=bound,
+            pad_nodes_to=next_pow2(len(c.nodes)),
+        )
+        for mv in plan:
+            if self._execute_move(mv, snapshot, now):
+                self.revocation_counts["migrated"] += 1
+                self._journal_action(
+                    now,
+                    "revocation.migrated",
+                    mv.gang,
+                    node=node.name,
+                    podsRebound=len(mv.bindings),
+                )
+            else:
+                self.revocation_counts["migration_deferred"] += 1
+
+    def _revocation_evict(self, node, residents: list[PodGang], now: float) -> None:
+        """Inside the eviction lead the node WILL die: clear every resident,
+        batch-preemptible tiers first (tenancy/slo.revocation_victim_key),
+        so the journal shows low-SLO work absorbing the reclaim ahead of
+        latency work. Released pods recreate and reschedule off-node."""
+        from grove_tpu.api.types import Condition, set_condition
+        from grove_tpu.tenancy.slo import revocation_victim_key
+
+        c = self.cluster
+        victims = sorted(
+            residents,
+            key=lambda g: revocation_victim_key(
+                getattr(g, "slo_class", ""), self._priority_of(g), g.name
+            ),
+        )
+        for gang in victims:
+            gang.status.conditions = set_condition(
+                gang.status.conditions,
+                Condition(
+                    type=constants.PODGANG_CONDITION_DISRUPTION_TARGET,
+                    status="True",
+                    reason="Revoked",
+                    message=f"evicted ahead of revocation deadline on {node.name}",
+                ),
+                now,
+            )
+            # Only the doomed node's residents: gang-mates elsewhere keep
+            # their slots and the gang heals pod-by-pod, exactly like the
+            # node-death recovery path.
+            pods = [
+                p
+                for p in c.pods_of_gang(gang.name)
+                if p.is_active and p.node_name == node.name
+            ]
+            for pod in pods:
+                self._release_pod(pod, now, reason="revocation")
+            self.revocation_counts["evicted"] += 1
+            self._journal_action(
+                now,
+                "revocation.evicted",
+                gang.name,
+                node=node.name,
+                podsEvicted=len(pods),
+                sloClass=getattr(gang, "slo_class", "") or "standard",
+            )
+            c.record_event(
+                now,
+                gang.name,
+                f"gang evicted ahead of revocation deadline on {node.name}",
+            )
+
+    def rollout_status(self) -> dict:
+        """JSON-able fleet-lifecycle state for /statusz "rollout" and
+        `grove-tpu get rollout`."""
+        c = self.cluster
+        pending = {
+            n.name: n.revocation_deadline
+            for n in c.nodes.values()
+            if n.revocation_deadline is not None and n.schedulable
+        }
+        return {
+            "enabled": self.rollout_enabled,
+            "surgeRacks": self.rollout_surge_racks,
+            "deadlineSeconds": self.rollout_deadline_seconds,
+            "replacing": sorted(f"{p}/{i}" for (p, i) in self._rollout_replacing),
+            "counts": dict(self.rollout_counts),
+            "last": dict(self.rollout_last),
+            "revocation": {
+                "evictionLeadSeconds": self.revocation_eviction_lead_seconds,
+                "pendingNodes": dict(sorted(pending.items())),
+                "counts": dict(self.revocation_counts),
+            },
+        }
+
     def quality_status(self) -> dict:
         """JSON-able placement-quality state for /statusz "quality" and
         `grove-tpu get quality`."""
@@ -2198,9 +2520,15 @@ class GroveController:
 
     def disrupted_now(self) -> int:
         """Gangs currently counted against the disruption budget: defrag
-        migrations in flight plus reclaim evictions in flight. The tenancy
-        bench samples this every tick against defrag_max_concurrent."""
-        return len(self._defrag_migrating) + len(self._reclaim_evicting)
+        migrations (including revocation rescues) in flight, reclaim
+        evictions in flight, and rolling-update replicas mid-replacement.
+        The tenancy/rollout benches sample this every tick against
+        defrag_max_concurrent."""
+        return (
+            len(self._defrag_migrating)
+            + len(self._reclaim_evicting)
+            + len(self._rollout_replacing)
+        )
 
     def tenancy_status(self, top: int = 50) -> dict:
         """JSON-able tenancy state for /statusz "tenancy" and `grove-tpu

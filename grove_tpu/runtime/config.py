@@ -312,6 +312,30 @@ class DefragConfig:
 
 
 @dataclass
+class RolloutConfig:
+    """Make-before-break rolling updates (orchestrator/rollout.py;
+    docs/design.md "Fleet lifecycle"): when enabled — globally here or
+    per-PCS via the grove.io/rollout-strategy annotation — the current
+    replica's new generation is planned onto capacity that is free WHILE the
+    old placement still holds (plan_rescue with usage held), cut over
+    atomically under the shared disruption budget, and deferred whole (with
+    surge/next-replica what-if pricing journaled) when it does not fit.
+    Off = the seed delete-then-recreate behavior exactly."""
+
+    enabled: bool = False
+    # "+surge racks" what-if size priced for parked replicas (0 disables
+    # the surge scenario; the next-replica what-if always runs).
+    surge_racks: int = 1
+    # Decorrelated-jitter retry pacing for deferred replicas
+    # (utils/backoff.py): first retry after base, capped growth after.
+    backoff_base_seconds: float = 0.5
+    backoff_cap_seconds: float = 30.0
+    # Per-replica make-before-break deadline: once spent, the replica falls
+    # back to the seed delete-then-recreate path (always makes progress).
+    deadline_seconds: float = 600.0
+
+
+@dataclass
 class TraceConfig:
     """Decision flight recorder (grove_tpu/trace): journals every solve wave
     (snapshot digest, compact node/gang encodings, solver config fingerprint,
@@ -530,6 +554,17 @@ class ClusterConfig:
     ready_delay_seconds: float = 0.2
     # Informer-latency model: events become pollable only this much later.
     event_lag_seconds: float = 0.0
+    # Revocable (spot) capacity: mark the LAST N kwok nodes revocable — the
+    # fleet slice the provider may take back on a revocation notice
+    # (Node.revocable; sim.node_revocation fault site). 0 = all on-demand.
+    revocable_nodes: int = 0
+    # Grace window granted with a notice: seconds between the notice and the
+    # capacity disappearing (Simulator.revocation_grace_s analog).
+    revocable_grace_seconds: float = 30.0
+    # Controller reaction ladder: with at least this much grace left it
+    # migrates residents make-before-break; inside the lead it evicts in
+    # SLO-rank order (batch-preemptible first) so the node drains in time.
+    revocable_eviction_lead_seconds: float = 10.0
 
 
 @dataclass
@@ -548,6 +583,7 @@ class OperatorConfiguration:
     scheduling: SchedulingConfig = field(default_factory=SchedulingConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
     defrag: DefragConfig = field(default_factory=DefragConfig)
+    rollout: RolloutConfig = field(default_factory=RolloutConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     tuning: TuningConfig = field(default_factory=TuningConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
@@ -588,6 +624,7 @@ _SECTION_TYPES = {
     "scheduling": ("scheduling", SchedulingConfig),
     "solver": ("solver", SolverConfig),
     "defrag": ("defrag", DefragConfig),
+    "rollout": ("rollout", RolloutConfig),
     "trace": ("trace", TraceConfig),
     "tuning": ("tuning", TuningConfig),
     "faults": ("faults", FaultsConfig),
@@ -682,6 +719,11 @@ _CAMEL_FIELDS = {
     "runningDelaySeconds": "running_delay_seconds",
     "readyDelaySeconds": "ready_delay_seconds",
     "eventLagSeconds": "event_lag_seconds",
+    "surgeRacks": "surge_racks",
+    "deadlineSeconds": "deadline_seconds",
+    "revocableNodes": "revocable_nodes",
+    "revocableGraceSeconds": "revocable_grace_seconds",
+    "revocableEvictionLeadSeconds": "revocable_eviction_lead_seconds",
 }
 
 
@@ -967,6 +1009,30 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
         df.min_efficiency, bool
     ) or df.min_efficiency < 0:
         errors.append("defrag.minEfficiency: must be >= 0")
+    ro = cfg.rollout
+    if not isinstance(ro.surge_racks, int) or isinstance(
+        ro.surge_racks, bool
+    ) or ro.surge_racks < 0:
+        errors.append("rollout.surgeRacks: must be an int >= 0")
+    for ro_name, ro_val in (
+        ("rollout.backoffBaseSeconds", ro.backoff_base_seconds),
+        ("rollout.backoffCapSeconds", ro.backoff_cap_seconds),
+        ("rollout.deadlineSeconds", ro.deadline_seconds),
+    ):
+        if not isinstance(ro_val, (int, float)) or isinstance(
+            ro_val, bool
+        ) or ro_val <= 0:
+            errors.append(f"{ro_name}: must be a number > 0")
+    if (
+        isinstance(ro.backoff_base_seconds, (int, float))
+        and isinstance(ro.backoff_cap_seconds, (int, float))
+        and not isinstance(ro.backoff_base_seconds, bool)
+        and not isinstance(ro.backoff_cap_seconds, bool)
+        and ro.backoff_cap_seconds < ro.backoff_base_seconds
+    ):
+        errors.append(
+            "rollout.backoffCapSeconds: must be >= rollout.backoffBaseSeconds"
+        )
     tn = cfg.tenancy
     if not isinstance(tn.aging_half_life_seconds, (int, float)) or isinstance(
         tn.aging_half_life_seconds, bool
@@ -1115,6 +1181,24 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             )
         if cl.event_lag_seconds < 0:
             errors.append("cluster.eventLagSeconds: must be >= 0")
+        if not isinstance(cl.revocable_nodes, int) or isinstance(
+            cl.revocable_nodes, bool
+        ) or cl.revocable_nodes < 0 or (
+            isinstance(cl.kwok_nodes, int) and cl.revocable_nodes > cl.kwok_nodes
+        ):
+            errors.append(
+                "cluster.revocableNodes: must be an int in [0, kwokNodes]"
+            )
+        if not isinstance(cl.revocable_grace_seconds, (int, float)) or isinstance(
+            cl.revocable_grace_seconds, bool
+        ) or cl.revocable_grace_seconds <= 0:
+            errors.append("cluster.revocableGraceSeconds: must be > 0")
+        if not isinstance(
+            cl.revocable_eviction_lead_seconds, (int, float)
+        ) or isinstance(
+            cl.revocable_eviction_lead_seconds, bool
+        ) or cl.revocable_eviction_lead_seconds < 0:
+            errors.append("cluster.revocableEvictionLeadSeconds: must be >= 0")
         if (
             cl.kwok_cpu_per_node < 0
             or cl.kwok_memory_per_node < 0

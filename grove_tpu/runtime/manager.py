@@ -523,6 +523,14 @@ class Manager:
             defrag_cooldown_seconds=config.defrag.gang_cooldown_seconds,
             defrag_max_moves=config.defrag.max_moves_per_plan,
             defrag_min_efficiency=config.defrag.min_efficiency,
+            rollout_enabled=config.rollout.enabled,
+            rollout_surge_racks=config.rollout.surge_racks,
+            rollout_backoff_base_seconds=config.rollout.backoff_base_seconds,
+            rollout_backoff_cap_seconds=config.rollout.backoff_cap_seconds,
+            rollout_deadline_seconds=config.rollout.deadline_seconds,
+            revocation_eviction_lead_seconds=(
+                config.cluster.revocable_eviction_lead_seconds
+            ),
             tenancy_enabled=config.tenancy.enabled,
             tenancy_aging_half_life_seconds=config.tenancy.aging_half_life_seconds,
             tenancy_aging_max_boost=config.tenancy.aging_max_boost,
@@ -700,6 +708,38 @@ class Manager:
             "grove_defrag_migrating", "Gangs currently mid-migration"
         )
         self._defrag_exported = {"plans": 0, "migrations": 0, "pods_migrated": 0}
+        # Fleet lifecycle (rollout + revocable capacity) counters, exported
+        # as deltas from controller.rollout_counts / revocation_counts; the
+        # gauge samples replicas currently mid-replacement.
+        self._m_rollout_cutovers = self.metrics.counter(
+            "grove_rollout_cutovers_total",
+            "Make-before-break replica cutovers committed",
+        )
+        self._m_rollout_retries = self.metrics.counter(
+            "grove_rollout_retries_total",
+            "Deferred-replica retries scheduled by the rollout backoff",
+        )
+        self._m_rollout_fallbacks = self.metrics.counter(
+            "grove_rollout_fallbacks_total",
+            "Replicas that fell back to delete-then-recreate (deadline spent)",
+        )
+        self._m_rollout_replacing = self.metrics.gauge(
+            "grove_rollout_replacing",
+            "Rolling-update replicas currently mid-replacement",
+        )
+        self._m_revocation_notices = self.metrics.counter(
+            "grove_revocation_notices_total", "Revocation notices observed"
+        )
+        self._m_revocation_migrated = self.metrics.counter(
+            "grove_revocation_migrations_total",
+            "Gangs rescued off revocation-pending nodes by migration",
+        )
+        self._m_revocation_evicted = self.metrics.counter(
+            "grove_revocation_evictions_total",
+            "Gangs evicted ahead of a revocation deadline (SLO-rank order)",
+        )
+        self._rollout_exported = {"cutovers": 0, "retries": 0, "fallbacks": 0}
+        self._revocation_exported = {"notices": 0, "migrated": 0, "evicted": 0}
         # Tenancy fairness surfaces (grove_tpu/tenancy): counters are
         # delta-exported from the ledger totals (same discipline as defrag),
         # gauges sample the ledger/budget each reconcile.
@@ -1168,6 +1208,7 @@ class Manager:
             # in-flight migrations, monotonic counters (what `grove-tpu get
             # defrag` renders).
             "defrag": self.controller.defrag_status(),
+            "rollout": self.controller.rollout_status(),
             # Tenancy: per-tenant fairness ledger, aging state, shared
             # disruption-budget view (`grove-tpu get tenancy` renders this).
             "tenancy": self.controller.tenancy_status(),
@@ -1885,6 +1926,30 @@ class Manager:
                 if delta > 0:
                     metric.inc(float(delta))
                     self._defrag_exported[key] = counts[key]
+        for key, metric in (
+            ("cutovers", self._m_rollout_cutovers),
+            ("retries", self._m_rollout_retries),
+            ("fallbacks", self._m_rollout_fallbacks),
+        ):
+            delta = self.controller.rollout_counts[key] - self._rollout_exported[key]
+            if delta > 0:
+                metric.inc(float(delta))
+                self._rollout_exported[key] = self.controller.rollout_counts[key]
+        self._m_rollout_replacing.set(
+            float(len(self.controller._rollout_replacing))
+        )
+        for key, metric in (
+            ("notices", self._m_revocation_notices),
+            ("migrated", self._m_revocation_migrated),
+            ("evicted", self._m_revocation_evicted),
+        ):
+            delta = (
+                self.controller.revocation_counts[key]
+                - self._revocation_exported[key]
+            )
+            if delta > 0:
+                metric.inc(float(delta))
+                self._revocation_exported[key] = self.controller.revocation_counts[key]
         if self.controller.tenancy_enabled:
             ledger = self.controller.tenancy_ledger
             for key, metric in (

@@ -33,7 +33,7 @@ class SimConfig:
 class ScriptedFault:
     """One schedulable chaos action: at sim-time `at`, apply `action` to
     `target`. Actions are the simulator's own fault methods (kill_node,
-    cordon, uncordon, fail_pod, crash_pod), so a script entry journals and
+    cordon, uncordon, fail_pod, crash_pod, revoke_node), so a script entry journals and
     behaves exactly like a hand-driven fault — but the schedule is DATA,
     shippable with a chaos scenario and replayable run after run."""
 
@@ -55,10 +55,20 @@ class Simulator:
     # stale-plan revalidation exists for). Order within one step follows
     # the schedule order.
     fault_script: list = field(default_factory=list)
+    # Grace window granted with a revocation notice (revoke_node and the
+    # sim.node_revocation injector site): revocation_deadline = now + grace.
+    revocation_grace_s: float = 30.0
     _bound_at: dict[str, float] = field(default_factory=dict)
     _running_at: dict[str, float] = field(default_factory=dict)
 
-    _SCRIPT_ACTIONS = ("kill_node", "cordon", "uncordon", "fail_pod", "crash_pod")
+    _SCRIPT_ACTIONS = (
+        "kill_node",
+        "cordon",
+        "uncordon",
+        "fail_pod",
+        "crash_pod",
+        "revoke_node",
+    )
 
     def schedule_fault(self, at: float, action: str, target: str) -> None:
         """Append one scripted fault (validated; keeps the script sorted)."""
@@ -98,6 +108,34 @@ class Simulator:
             )
             if victim is not None:
                 self.kill_node(victim)
+        # Injector-driven revocation notice (site sim.node_revocation): the
+        # first revocable, schedulable node without a pending notice, in name
+        # order. Candidates are checked BEFORE the dice roll so a fleet with
+        # nothing left to revoke doesn't consume (and journal) no-op fires.
+        if inj.enabled and "sim.node_revocation" in inj.specs:
+            victim = next(
+                (
+                    name
+                    for name in sorted(self.cluster.nodes)
+                    if (n := self.cluster.nodes[name]).revocable
+                    and n.schedulable
+                    and n.revocation_deadline is None
+                ),
+                None,
+            )
+            if victim is not None and inj.should_fire("sim.node_revocation") is not None:
+                self.revoke_node(victim)
+        # Expired notices: the capacity actually disappears — node-death
+        # semantics for whatever the controller did not rescue in time.
+        for name in sorted(self.cluster.nodes):
+            node = self.cluster.nodes[name]
+            if (
+                node.revocation_deadline is not None
+                and node.revocation_deadline <= self.now
+                and node.schedulable
+            ):
+                self._journal_chaos("chaos.revocation_expired", name)
+                self.kill_node(name)
 
     def step(self, dt: float = 1.0) -> None:
         """Advance time, run scripted chaos, pod lifecycle, then one
@@ -226,3 +264,22 @@ class Simulator:
         for pod in self.cluster.pods.values():
             if pod.node_name == node_name and pod.is_active:
                 self.fail_pod(pod.name)
+
+    def revoke_node(self, node_name: str) -> None:
+        """Revocation notice: the node's capacity disappears at
+        now + revocation_grace_s. The node is marked revocable (a scripted
+        notice on a permanent node models a spot conversion), the deadline is
+        stamped, and the controller gets the grace window to migrate or evict
+        residents; whatever remains dies with the node when the deadline
+        expires (see _run_script)."""
+        node = self.cluster.nodes.get(node_name)
+        if node is None or node.revocation_deadline is not None:
+            return
+        node.revocable = True
+        node.revocation_deadline = self.now + self.revocation_grace_s
+        self.cluster.record_event(
+            self.now, node_name, f"node {node_name} revocation notice"
+        )
+        self._journal_chaos(
+            "chaos.revoke_node", node_name, deadline=node.revocation_deadline
+        )

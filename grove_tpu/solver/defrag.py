@@ -469,3 +469,98 @@ def plan_migrations(
             warm.executables.lowerings - lowerings0 if warm is not None else 0
         )
     return best_plan
+
+
+def plan_rescue(
+    nodes: list,
+    topology,
+    gangs: list,
+    pods_by_name: dict,
+    *,
+    params: SolverParams = SolverParams(),
+    warm=None,
+    resource_names: tuple[str, ...] | None = None,
+    pruning=None,
+    hold_usage: bool = False,
+) -> list[GangMove]:
+    """Whole-gang re-placement WITHOUT the fragmentation/efficiency gating of
+    plan_migrations — the lifeboat planner for gangs that must move because
+    their capacity is going away (revocation rescue) or that must land on
+    genuinely free capacity while the incumbent generation still holds its
+    slots (make-before-break rollout feasibility).
+
+    `hold_usage=True` keeps EVERY bound pod accounted, so the plan only
+    lands on capacity that is free while the old placement still holds —
+    required whenever _execute_move commits the result, since its
+    reservation check measures free capacity with the old placement intact.
+    `hold_usage=False` releases the rescue gangs' own usage before solving
+    (a displaced gang may reuse its surviving slots) — only safe when the
+    old slots are already gone. Nodes masked by build_snapshot (cordoned or
+    revocation-pending) are never targets.
+
+    Returns one GangMove per gang the solver admitted; a gang absent from
+    the result did not fit (the caller escalates — what-if, defer, evict)."""
+    if not gangs or not nodes:
+        return []
+    kwargs = {} if resource_names is None else {"resource_names": resource_names}
+    pad = next_pow2(len(nodes))
+    all_bound = [p for p in pods_by_name.values() if p.is_scheduled and p.is_active]
+    if hold_usage:
+        bound = all_bound
+    else:
+        own = {
+            r.name
+            for g in gangs
+            for grp in g.spec.pod_groups
+            for r in grp.pod_references
+        }
+        bound = [p for p in all_bound if p.name not in own]
+    snap = build_snapshot(
+        nodes, topology, bound_pods=bound, pad_nodes_to=pad, **kwargs
+    )
+    subs = [s for g in gangs if (s := _whole_subgang(g, pods_by_name))]
+    if not subs:
+        return []
+    epoch = snap.encode_epoch()
+    row_keys = None
+    row_cache = None
+    if warm is not None:
+        from grove_tpu.solver.warm import gang_row_digest
+
+        row_cache = warm.encode_rows
+        row_keys = [(gang_row_digest(s, pods_by_name), epoch) for s in subs]
+    batch, decode = encode_gangs(
+        subs,
+        pods_by_name,
+        snap,
+        pad_gangs_to=next_pow2(len(subs)),
+        row_cache=row_cache,
+        row_keys=row_keys,
+        # A rescue candidate is a RUNNING gang: its base-gang dependency was
+        # satisfied at admission. Without this, a PCSG child gang whose base
+        # is absent from the batch gets gang_valid=False and can never be
+        # rescued.
+        scheduled_gangs={
+            g.base_podgang_name for g in gangs if g.base_podgang_name is not None
+        },
+    )
+    result = solve(snap, batch, params, warm=warm, pruning=pruning)
+    new_bindings = decode_assignments(result, decode, snap)
+
+    moves: list[GangMove] = []
+    for g in gangs:
+        plan_b = new_bindings.get(g.name)
+        if not plan_b:
+            continue
+        changed: dict[str, str] = {}
+        total = 0
+        for pod_name, node_name in plan_b.items():
+            pod = pods_by_name.get(pod_name)
+            if pod is None:
+                continue
+            total += 1
+            if pod.node_name != node_name:
+                changed[pod_name] = node_name
+        if changed:
+            moves.append(GangMove(gang=g.name, bindings=changed, pods_total=total))
+    return moves

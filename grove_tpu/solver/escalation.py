@@ -31,6 +31,11 @@ def node_state_digest(nodes: Iterable) -> frozenset:
             tuple(sorted(n.capacity.items())),
             tuple(sorted(n.labels.items())),
             tuple(sorted(repr(sorted(t.items())) for t in n.taints)),
+            # Revocation state flips snapshot schedulability without touching
+            # the fields above — a notice must re-arm escalation (and break
+            # the solve-skip wave fingerprint) exactly like a cordon.
+            bool(getattr(n, "revocable", False)),
+            getattr(n, "revocation_deadline", None),
         )
         for n in nodes
     )

@@ -86,6 +86,14 @@ class CircuitBreaker:
     state: str = CLOSED
     failures: list = field(default_factory=list)  # stamps inside the window
     opened_at: float = 0.0
+    # True while a half-open probe has been dispensed and its verdict is
+    # outstanding. Exactly ONE probe runs per half-open episode: further
+    # allow() calls stay degraded until record_success/record_failure lands
+    # the verdict, and record_success only closes the breaker when a probe
+    # was actually dispensed — a success from a wave that never ran the
+    # subsystem at full config must not re-close it (that eager close is
+    # what makes sustained faults oscillate closed<->open).
+    trial_pending: bool = False
     # Monotonic transition counters (the grove_degradation_* metrics and
     # /statusz rows are cut from these).
     step_downs: int = 0
@@ -93,18 +101,27 @@ class CircuitBreaker:
 
     def allow(self, now: float) -> bool:
         """May the subsystem run at full config right now? OPEN past its
-        probation window flips to HALF-OPEN and allows ONE trial."""
+        probation window flips to HALF-OPEN and allows ONE trial; while that
+        trial's verdict is outstanding every other caller stays degraded."""
         if self.state == OPEN and now - self.opened_at >= self.probation_s:
             self.state = HALF_OPEN
+            self.trial_pending = False
+        if self.state == HALF_OPEN:
+            if self.trial_pending:
+                return False  # one probe per episode; verdict outstanding
+            self.trial_pending = True
+            return True
         return self.state != OPEN
 
     def record_failure(self, now: float) -> bool:
         """True when this failure OPENED the breaker (a step-down)."""
         if self.state == HALF_OPEN:
-            # Failed trial: straight back to open, probation restarts.
+            # Failed trial: straight back to open, probation restarts with
+            # its FULL window from the failure stamp.
             self.state = OPEN
             self.opened_at = now
             self.failures = []
+            self.trial_pending = False
             return False  # the step-down was already counted when it opened
         self.failures = [t for t in self.failures if now - t < self.window_s]
         self.failures.append(now)
@@ -117,10 +134,14 @@ class CircuitBreaker:
         return False
 
     def record_success(self, now: float) -> bool:
-        """True when a half-open trial CLOSED the breaker (a step-up)."""
-        if self.state == HALF_OPEN:
+        """True when a half-open trial CLOSED the breaker (a step-up). A
+        success with no dispensed probe leaves the breaker half-open: the
+        wave that succeeded ran at the degraded config and proves nothing
+        about this subsystem."""
+        if self.state == HALF_OPEN and self.trial_pending:
             self.state = CLOSED
             self.failures = []
+            self.trial_pending = False
             self.step_ups += 1
             return True
         return False

@@ -50,6 +50,15 @@ def slo_borrow_eligible(cls: str | None) -> bool:
     return normalized_slo_class(cls) != SLO_CLASS_LATENCY
 
 
+def revocation_victim_key(cls: str | None, priority: int, name: str) -> tuple:
+    """Eviction order when a revocation deadline forces a node clear
+    (controller._revocation_evict): batch-preemptible tiers go first
+    (descending rank), then lowest effective priority, then name — the
+    deterministic mirror of the admission order, so the journal shows
+    low-SLO work absorbing the reclaim ahead of latency work."""
+    return (-slo_rank(cls), priority, name)
+
+
 def stream_order_key(priority_of=None):
     """Window-ordering key for solver.stream.drain_stream(order_key=...):
     tier first, then priority descending. The key depends only on

@@ -45,18 +45,20 @@ from grove_tpu.trace.replay import (
 from grove_tpu.utils import serde
 
 
-def add_racks(fleet: dict, count: int = 1) -> list[Node]:
-    """Recorded fleet + `count` cloned racks. The template is the rack of
-    the LAST recorded node (narrowest non-host level of the recorded
-    topology); clones keep its capacity/labels/taints shape with a fresh
-    rack label value and fresh hostnames, so the counterfactual asks "one
-    more rack of the same SKU", not an arbitrary fleet."""
-    nodes = nodes_from_fleet(fleet)
+def clone_racks(
+    nodes: list[Node], topology, count: int = 1, *, tag: str = "whatif"
+) -> list[Node]:
+    """`nodes` + `count` cloned racks. The template is the rack of the LAST
+    node (narrowest non-host level of `topology`); clones keep its
+    capacity/labels/taints shape with a fresh rack label value and fresh
+    hostnames, so the counterfactual asks "one more rack of the same SKU",
+    not an arbitrary fleet. Works on any live Node list — the journal
+    what-if path and the rollout surge pricer share this one definition of
+    "+N racks"."""
     if count <= 0:
-        return nodes
-    topo = topology_from_fleet(fleet)
+        return list(nodes)
     non_host = [
-        lvl for lvl in topo.sorted_levels() if lvl.domain.value != "host"
+        lvl for lvl in topology.sorted_levels() if lvl.domain.value != "host"
     ]
     if not non_host or not nodes:
         raise ValueError("fleet has no non-host topology level to clone a rack in")
@@ -69,10 +71,10 @@ def add_racks(fleet: dict, count: int = 1) -> list[Node]:
     for i in range(count):
         for j, src in enumerate(template):
             labels = dict(src.labels)
-            labels[rack_key] = f"whatif-r{i}"
+            labels[rack_key] = f"{tag}-r{i}"
             out.append(
                 Node(
-                    name=f"whatif{i}h{j}",
+                    name=f"{tag}{i}h{j}",
                     capacity=dict(src.capacity),
                     labels=labels,
                     schedulable=True,
@@ -80,6 +82,11 @@ def add_racks(fleet: dict, count: int = 1) -> list[Node]:
                 )
             )
     return out
+
+
+def add_racks(fleet: dict, count: int = 1) -> list[Node]:
+    """Recorded fleet + `count` cloned racks (see clone_racks)."""
+    return clone_racks(nodes_from_fleet(fleet), topology_from_fleet(fleet), count)
 
 
 @dataclass

@@ -85,6 +85,70 @@ def test_breaker_success_in_closed_is_noop():
     assert br.state == "closed" and br.step_ups == 0
 
 
+def test_breaker_sustained_faults_do_not_flap():
+    """Sustained faults across many probation cycles: every cycle dispenses
+    exactly ONE full-config probe, every failed probe re-opens with the FULL
+    probation window, and the transition counters stay at the original
+    step-down — no closed<->open oscillation, no step churn."""
+    br = CircuitBreaker(threshold=1, probation_s=5.0)
+    br.record_failure(0.0)
+    assert br.state == "open" and br.step_downs == 1
+    t = 0.0
+    for _cycle in range(10):
+        # Full window must elapse before the next probe.
+        assert br.allow(t + 4.9) is False
+        t += 5.0
+        assert br.allow(t) is True  # the one probe of this episode
+        # While the probe's verdict is outstanding nobody else runs full
+        # config — a second caller in the same episode stays degraded.
+        assert br.allow(t) is False
+        assert br.allow(t + 1.0) is False
+        t += 2.0
+        assert br.record_failure(t) is False  # probe failed: re-open, full window
+        assert br.state == "open" and br.opened_at == t
+    assert br.step_downs == 1  # the original open, never re-counted
+    assert br.step_ups == 0  # no eager close ever happened
+
+
+def test_breaker_success_without_dispensed_probe_does_not_close():
+    """A wave that succeeded WITHOUT running the subsystem at full config
+    proves nothing: a half-open breaker whose probe was never dispensed must
+    stay half-open (the eager re-close is what made sustained faults
+    oscillate), then close normally once a real probe succeeds."""
+    br = CircuitBreaker(threshold=1, probation_s=5.0)
+    br.record_failure(0.0)
+    # Probation elapsed but allow() was never called: state transitions on
+    # the next allow, so a success landing first must not close anything.
+    assert br.record_success(6.0) is False
+    assert br.state == "open"
+    assert br.allow(6.0) is True  # probe dispensed
+    assert br.record_success(6.5) is True  # probe verdict: close
+    assert br.state == "closed" and br.step_ups == 1
+
+
+def test_ladder_success_does_not_close_untried_half_open_breaker():
+    """Ladder-level flap guard: record_success closes only breakers whose
+    half-open probe was actually dispensed via allows()."""
+    now = [0.0]
+    cfg = ResilienceConfig(
+        enabled=True,
+        breaker_threshold=1,
+        probation_seconds=5.0,
+        breaker_window_seconds=60.0,
+    )
+    lad = DegradationLadder(cfg, clock=lambda: now[0])
+    lad.record_failure("mesh")
+    lad.record_failure("pruning")
+    now[0] = 6.0
+    assert lad.allows("pruning")  # pruning probe dispensed; mesh untouched
+    assert lad.record_success() == ["pruning"]  # mesh must NOT ride along
+    assert lad.breakers["mesh"].state == "open"
+    now[0] = 7.0
+    assert lad.allows("mesh")  # mesh runs its own probe
+    assert lad.record_success() == ["mesh"]
+    assert lad.fully_closed()
+
+
 # ---- degradation ladder -----------------------------------------------------------
 
 

@@ -1,0 +1,237 @@
+"""Revocable (spot) capacity: the notice -> grace -> reclaim lifecycle.
+
+A node with `revocable: true` can receive a revocation notice (scripted
+`revoke_node`, or the seed-deterministic `sim.node_revocation` fault site,
+covered in test_faults.py). Within the grace window the controller must
+get resident work off the node: make-before-break migration when the
+shared disruption budget and free capacity allow, otherwise slo-ordered
+eviction (batch-preemptible first) inside the eviction lead — and a
+revocation-pending node is as dead as a cordoned one for NEW bindings
+(stale-plan revalidation at bind time).
+"""
+
+from __future__ import annotations
+
+from scenario_harness import Scenario, wl1
+
+from grove_tpu.api import constants
+from grove_tpu.orchestrator.controller import GroveController
+from grove_tpu.orchestrator.store import Cluster
+from grove_tpu.sim.simulator import Simulator
+from grove_tpu.sim.workloads import _clique, _pcs, bench_topology, synthetic_cluster
+
+
+class _CaptureRecorder:
+    def __init__(self):
+        self.records = []
+
+    def capture_action(self, now, action, obj, **fields):
+        self.records.append((now, action, obj, fields))
+
+    def actions(self, name):
+        return [r for r in self.records if r[1] == name]
+
+
+# ---- migration rescue -------------------------------------------------------------
+
+
+def test_notice_with_free_capacity_migrates_make_before_break():
+    """Free capacity exists: the resident gang is rescued whole onto nodes
+    that are free while its old placement still holds, well before the
+    deadline — zero evictions, and the gang comes back fully ready."""
+    s = Scenario(20)
+    rec = _CaptureRecorder()
+    s.controller.recorder = rec
+    s.deploy(wl1())
+    assert s.until_ready(10)
+    victim = sorted({p.node_name for p in s.scheduled()})[0]
+    s.sim.revoke_node(victim)
+    deadline = s.cluster.nodes[victim].revocation_deadline
+    assert deadline == s.sim.now + s.sim.revocation_grace_s
+    assert s.until(
+        lambda: not any(p.node_name == victim for p in s.scheduled()),
+        timeout=25,
+    )
+    assert s.sim.now < deadline, "rescue must land inside the grace window"
+    rc = s.controller.revocation_counts
+    assert rc["notices"] == 1 and rc["migrated"] >= 1 and rc["evicted"] == 0
+    assert s.until_ready(10, timeout=60)
+    assert rec.actions("revocation.notice") and rec.actions("revocation.migrated")
+    # The in-flight migration draws from the shared disruption budget.
+    assert s.controller.defrag_counts["migrations"] >= 1
+
+
+def test_migration_defers_when_budget_consumed_then_evicts_in_lead():
+    """Budget fully consumed: migration defers (counted) every tick; once
+    inside the eviction lead the node is cleared by eviction instead —
+    revocation NEVER waits past its deadline on a budget token."""
+    s = Scenario(20)
+    s.deploy(wl1())
+    assert s.until_ready(10)
+    s.controller.defrag_max_concurrent = 0  # zero budget: migration can't run
+    victim = sorted({p.node_name for p in s.scheduled()})[0]
+    s.sim.revoke_node(victim)
+    deadline = s.cluster.nodes[victim].revocation_deadline
+    assert s.until(
+        lambda: s.controller.revocation_counts["evicted"] >= 1, timeout=35
+    )
+    assert s.sim.now <= deadline
+    rc = s.controller.revocation_counts
+    assert rc["migrated"] == 0 and rc["migration_deferred"] >= 1
+    assert s.until_ready(10, timeout=120), "evicted pod must reschedule off-node"
+    assert not any(p.node_name == victim for p in s.scheduled())
+
+
+# ---- slo-ordered eviction ---------------------------------------------------------
+
+
+def test_full_fleet_falls_back_to_eviction():
+    """Nowhere to migrate (fleet exactly full): the node is cleared by
+    eviction inside the lead window and the pods reschedule after the dead
+    node's capacity returns elsewhere (here: post-expiry re-solve)."""
+    s = Scenario(10)
+    s.deploy(wl1())
+    assert s.until_ready(10)
+    victim = sorted({p.node_name for p in s.scheduled()})[0]
+    s.sim.revoke_node(victim)
+    assert s.until(
+        lambda: s.controller.revocation_counts["evicted"] >= 1, timeout=35
+    )
+    rc = s.controller.revocation_counts
+    assert rc["migrated"] == 0 and rc["migration_deferred"] >= 1
+
+
+def test_eviction_order_is_batch_preemptible_first():
+    """Two gangs share the doomed node: the batch-preemptible gang absorbs
+    the reclaim FIRST, the latency gang last — the journal records the
+    order (tenancy/slo.revocation_victim_key)."""
+    cluster = Cluster()
+    for n in synthetic_cluster(
+        zones=1, blocks_per_zone=1, racks_per_block=1, hosts_per_rack=1,
+        cpu=4.0, tpu=0.0,
+    ):
+        cluster.nodes[n.name] = n
+    ctrl = GroveController(cluster=cluster, topology=bench_topology())
+    rec = _CaptureRecorder()
+    ctrl.recorder = rec
+    sim = Simulator(cluster=cluster, controller=ctrl)
+
+    lat = _pcs("lat", cliques=[_clique("w", 1, "2")])
+    lat.spec.template.slo_class = constants.SLO_CLASS_LATENCY
+    batch = _pcs("bat", cliques=[_clique("w", 1, "2")])
+    batch.spec.template.slo_class = constants.SLO_CLASS_BATCH
+    ctrl.sync_workload(lat, sim.now)
+    ctrl.sync_workload(batch, sim.now)
+    node = next(iter(cluster.nodes))
+    assert sim.run_until(
+        lambda: sum(
+            1 for p in cluster.pods.values() if p.is_scheduled and p.is_active
+        ) == 2,
+        timeout=60,
+    )
+    sim.revoke_node(node)
+    assert sim.run_until(
+        lambda: ctrl.revocation_counts["evicted"] >= 2, timeout=35
+    )
+    evictions = rec.actions("revocation.evicted")
+    assert [f["sloClass"] for _, _, _, f in evictions[:2]] == [
+        constants.SLO_CLASS_BATCH,
+        constants.SLO_CLASS_LATENCY,
+    ]
+    assert all(f["node"] == node for _, _, _, f in evictions)
+    # Both gangs carry the DisruptionTarget condition with the Revoked reason.
+    from grove_tpu.api.types import get_condition
+
+    for gname in list(cluster.podgangs):
+        cond = get_condition(
+            cluster.podgangs[gname].status.conditions,
+            constants.PODGANG_CONDITION_DISRUPTION_TARGET,
+        )
+        assert cond is not None and cond.reason == "Revoked"
+
+
+# ---- bind-time revalidation -------------------------------------------------------
+
+
+def test_bind_revalidation_rejects_revocation_pending_target():
+    """A notice landing between solve and bind: _bind_gang requeues the gang
+    untouched instead of binding into the doomed node."""
+    s = Scenario(12)
+    s.deploy(wl1())
+    assert s.until_ready(10)
+    victim = sorted({p.node_name for p in s.scheduled()})[0]
+    gang_name = next(iter(s.cluster.podgangs))
+    pod = next(p for p in s.scheduled() if p.node_name != victim)
+    before = (pod.node_name, list(pod.scheduling_gates), pod.phase)
+    s.sim.revoke_node(victim)
+    requeues0 = s.controller.resilience_counts["stale_plan_requeues"]
+    assert (
+        s.controller._bind_gang(gang_name, {pod.name: victim}, s.sim.now) is False
+    )
+    assert s.controller.resilience_counts["stale_plan_requeues"] == requeues0 + 1
+    assert (pod.node_name, list(pod.scheduling_gates), pod.phase) == before
+
+
+# ---- config + fleet plumbing ------------------------------------------------------
+
+
+def test_kwok_fleet_marks_revocable_slice():
+    from grove_tpu.cluster.kwok import kwok_fleet_from_config
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {
+            "cluster": {
+                "source": "kwok",
+                "kwokNodes": 8,
+                "kwokHostsPerRack": 4,
+                "revocableNodes": 3,
+                "revocableGraceSeconds": 12.0,
+                "revocableEvictionLeadSeconds": 4.0,
+            }
+        }
+    )
+    assert errors == []
+    from grove_tpu.sim.workloads import bench_topology
+
+    fleet = kwok_fleet_from_config(cfg.cluster, bench_topology())
+    revocable = sorted(n.name for n in fleet.nodes.values() if n.revocable)
+    assert revocable == ["kwok-5", "kwok-6", "kwok-7"]  # the LAST 3
+    assert all(
+        n.revocation_deadline is None for n in fleet.nodes.values()
+    )  # a notice is an event, never a birth attribute
+
+
+def test_revocable_config_validation_rejects_bad_values():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    for bad in (
+        {"revocableNodes": -1},
+        {"revocableNodes": 9},  # more than kwokNodes
+        {"revocableGraceSeconds": 0},
+        {"revocableEvictionLeadSeconds": -2.0},
+    ):
+        _, errors = parse_operator_config(
+            {"cluster": {"source": "kwok", "kwokNodes": 8, **bad}}
+        )
+        assert any("revocable" in e for e in errors), (bad, errors)
+
+
+def test_rollout_status_surfaces_pending_revocations():
+    s = Scenario(12)
+    s.deploy(wl1())
+    assert s.until_ready(10)
+    victim = sorted({p.node_name for p in s.scheduled()})[0]
+    s.sim.revoke_node(victim)
+    s.settle(2)
+    status = s.controller.rollout_status()
+    rev = status["revocation"]
+    assert victim in rev["pendingNodes"]
+    assert rev["counts"]["notices"] == 1
+    assert rev["evictionLeadSeconds"] == s.controller.revocation_eviction_lead_seconds
+    # Once resolved the node leaves the pending set.
+    assert s.until(lambda: not any(
+        p.node_name == victim for p in s.scheduled()
+    ), timeout=40)
+    s.settle(35)
+    assert victim not in s.controller.rollout_status()["revocation"]["pendingNodes"]

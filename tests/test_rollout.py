@@ -1,0 +1,276 @@
+"""Make-before-break rolling updates (orchestrator/rollout.py).
+
+The seed behavior — delete-then-recreate, one ready pod at a time — is
+pinned by RU7-RU21 (test_scenarios_ru.py) and stays the default. These
+tests pin the OPT-IN make-before-break path: the shadow generation is
+planned through plan_rescue onto capacity that is free while the incumbent
+generation still holds its slots, the cutover rebinds whole gangs through
+the _bind_gang rollback discipline, and a replica that does not fit defers
+WHOLE (backoff-paced, deadline-bounded, what-if priced) — never
+partial-generation limbo — falling back to the seed recreate path when the
+deadline expires.
+
+The chaos test at the bottom is the ISSUE's scripted race: a node the
+rollout targeted receives a revocation notice mid-update; the planner
+re-plans, no node is ever oversubscribed, no gang is lost, and the journal
+replays bitwise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from scenario_harness import Scenario, wl1
+
+from grove_tpu.api import constants
+from grove_tpu.state.cluster import pod_request_vector
+
+
+def _mbb(pcs):
+    pcs.metadata.annotations[constants.ANNOTATION_ROLLOUT_STRATEGY] = (
+        constants.ROLLOUT_STRATEGY_MAKE_BEFORE_BREAK
+    )
+    return pcs
+
+
+def _update_ended(pcs) -> bool:
+    prog = pcs.status.rolling_update_progress
+    return prog is not None and prog.update_ended_at is not None
+
+
+def _assert_never_oversubscribed(s: Scenario) -> None:
+    """No node's active scheduled pods may exceed its capacity — the
+    double-bind detector. Checked against raw requests, not the solver
+    snapshot, so a bookkeeping bug cannot hide it."""
+    names = ("cpu", "memory", "google.com/tpu")
+    for node in s.cluster.nodes.values():
+        used = np.zeros(len(names))
+        for p in s.scheduled():
+            if p.node_name == node.name:
+                used += pod_request_vector(p, names)
+        cap = np.array([float(node.capacity.get(r, 0.0)) for r in names])
+        assert (used <= cap + 1e-6).all(), (
+            f"node {node.name} oversubscribed: used={used} cap={cap}"
+        )
+
+
+# ---- validation + enablement ------------------------------------------------------
+
+
+def test_rollout_strategy_annotation_validated():
+    from grove_tpu.api.validation import validate_podcliqueset
+
+    good = _mbb(wl1())
+    assert validate_podcliqueset(good) == []
+    bad = wl1()
+    bad.metadata.annotations[constants.ANNOTATION_ROLLOUT_STRATEGY] = "blue-green"
+    errs = validate_podcliqueset(bad)
+    assert any(
+        "rollout-strategy" in e.field and "blue-green" in e.message for e in errs
+    )
+
+
+def test_annotation_wins_over_controller_flag():
+    s = Scenario(4)
+    ctl = s.controller
+    pcs = wl1()
+    assert not ctl._rollout_mbb_enabled(pcs)  # default: seed recreate path
+    _mbb(pcs)
+    assert ctl._rollout_mbb_enabled(pcs)
+    # An explicit recreate annotation opts OUT even when the fleet-wide
+    # rollout.enabled flag is on.
+    ctl.rollout_enabled = True
+    pcs.metadata.annotations[constants.ANNOTATION_ROLLOUT_STRATEGY] = (
+        constants.ROLLOUT_STRATEGY_RECREATE
+    )
+    assert not ctl._rollout_mbb_enabled(pcs)
+    del pcs.metadata.annotations[constants.ANNOTATION_ROLLOUT_STRATEGY]
+    assert ctl._rollout_mbb_enabled(pcs)
+
+
+def test_recreate_updates_leave_rollout_counters_untouched():
+    """Without the opt-in, an update must never enter the MBB machinery."""
+    s = Scenario(10)
+    pcs = s.deploy(wl1())
+    assert s.until_ready(10)
+    s.change_clique_spec(pcs, "pc-a")
+    assert s.until(lambda: _update_ended(pcs), timeout=240)
+    assert all(v == 0 for v in s.controller.rollout_counts.values())
+
+
+# ---- the make-before-break cutover ------------------------------------------------
+
+
+def test_mbb_cutover_with_free_capacity(tmp_path):
+    """With spare capacity the whole stale set is replaced in ONE atomic
+    cutover: shadow pods planned onto genuinely-free nodes, old pods
+    drained, replacements bound through _bind_gang — and at no sampled tick
+    is any node oversubscribed or the disruption budget exceeded."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    s = Scenario(20)
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    s.controller.recorder = rec
+    try:
+        pcs = s.deploy(_mbb(wl1()))
+        assert s.until_ready(10)
+        old_names = {p.name for p in s.scheduled()}
+        s.change_clique_spec(pcs, "pc-a")
+        for _ in range(120):
+            s.sim.step(1.0)
+            _assert_never_oversubscribed(s)
+            assert s.controller.disrupted_now() <= s.controller.defrag_max_concurrent
+            if _update_ended(pcs):
+                break
+        assert _update_ended(pcs)
+        assert s.until_ready(10, timeout=60)
+    finally:
+        rec.stop()
+    counts = s.controller.rollout_counts
+    assert counts["cutovers"] >= 1 and counts["fallbacks"] == 0
+    # pc-a pods were replaced (new names), the rest survived untouched.
+    new_names = {p.name for p in s.scheduled()}
+    assert {n for n in old_names - new_names} == {
+        n for n in old_names if "-pc-a-" in n
+    }
+    records = read_journal(rec.path)
+    actions = [r.get("action") for r in records if r.get("kind") == "action"]
+    assert "rollout.cutover" in actions
+    assert replay_journal(records).divergence_count == 0
+    # The decision surface for `grove-tpu get rollout` / statusz.
+    status = s.controller.rollout_status()
+    assert status["counts"]["cutovers"] >= 1
+    assert pcs.metadata.name in status["last"]
+
+
+def test_mbb_defers_whole_and_falls_back_at_deadline(tmp_path):
+    """No free capacity: the replica defers WHOLE — no stale pod is deleted
+    while deferred (no partial-generation limbo), each defer is what-if
+    priced (+surge racks / next replica) and backoff-paced — and once the
+    rollout deadline expires the replica falls back to the seed recreate
+    path, which still completes the update."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+
+    s = Scenario(10)  # wl1 fills the fleet exactly: zero free capacity
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    s.controller.recorder = rec
+    s.controller.rollout_deadline_seconds = 12.0
+    try:
+        pcs = s.deploy(_mbb(wl1()))
+        assert s.until_ready(10)
+        n_pods = len(s.pods())
+        s.change_clique_spec(pcs, "pc-a")
+        s.settle(6)
+        counts = s.controller.rollout_counts
+        assert counts["deferred_capacity"] >= 1 and counts["cutovers"] == 0
+        assert counts["retries"] >= 1 and counts["whatifs"] >= 1
+        # Deferred WHOLE: every pod still exists and holds its node.
+        assert len(s.pods()) == n_pods
+        assert len(s.scheduled()) == n_pods
+        assert s.until(lambda: _update_ended(pcs), timeout=300)
+    finally:
+        rec.stop()
+    assert s.controller.rollout_counts["fallbacks"] >= 1
+    records = read_journal(rec.path)
+    by_action: dict[str, list] = {}
+    for r in records:
+        if r.get("kind") == "action":
+            by_action.setdefault(r.get("action"), []).append(r)
+    assert "rollout.deferred" in by_action and "rollout.fallback" in by_action
+    whatifs = {r.get("scenario") for r in by_action.get("rollout.whatif", [])}
+    assert "surge-racks" in whatifs
+    # +1 surge rack (7 hosts) is enough for the 2-pod shadow: the what-if
+    # answers the operator's "would more capacity unblock this?" question.
+    assert any(
+        r.get("fits") for r in by_action["rollout.whatif"]
+        if r.get("scenario") == "surge-racks"
+    )
+
+
+def test_mbb_budget_gate_defers_without_touching_pods():
+    """A rollout step never overdraws the shared disruption budget: with the
+    budget fully consumed by (synthetic) in-flight migrations, the replica
+    defers on 'budget' and no pod is touched."""
+    s = Scenario(20)
+    pcs = s.deploy(_mbb(wl1()))
+    assert s.until_ready(10)
+    s.controller._defrag_migrating["synthetic-hold"] = s.sim.now
+    before = {p.name: p.node_name for p in s.scheduled()}
+    s.change_clique_spec(pcs, "pc-a")
+    s.settle(4)
+    assert s.controller.rollout_counts["deferred_budget"] >= 1
+    assert s.controller.rollout_counts["cutovers"] == 0
+    assert {p.name: p.node_name for p in s.scheduled()} == before
+    # Budget released -> the deferred replica cuts over after its backoff.
+    del s.controller._defrag_migrating["synthetic-hold"]
+    assert s.until(lambda: _update_ended(pcs), timeout=240)
+    assert s.controller.rollout_counts["cutovers"] >= 1
+
+
+# ---- the ISSUE's scripted chaos race ----------------------------------------------
+
+
+def test_mbb_replans_when_rollout_target_gets_revocation_notice(tmp_path):
+    """Mid-update revocation storm hitting the rollout's own target nodes:
+    the freshly-cut-over generation's node gets a revocation notice while
+    the next replica is still rolling. The controller must re-plan around
+    the doomed node (bind revalidation treats it as dead), migrate or evict
+    its residents inside the grace window, never double-bind a pod or
+    oversubscribe a node, finish the update — and the journal must replay
+    bitwise."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    s = Scenario(22)
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    s.controller.recorder = rec
+    try:
+        pcs = s.deploy(_mbb(wl1(replicas=2)))  # 20 pods; 2 nodes spare
+        assert s.until_ready(20, timeout=300)
+        free = sorted(
+            set(s.cluster.nodes) - {p.node_name for p in s.scheduled()}
+        )
+        assert len(free) == 2
+        # The spare nodes are exactly where replica 0's shadow must land;
+        # revoke one of them just after the first cutover commits.
+        s.change_clique_spec(pcs, "pc-a")
+        s.sim.schedule_fault(s.sim.now + 2.0, "revoke_node", free[0])
+        notice_at = None
+        residents_at_notice: set[str] = set()
+        for _ in range(300):
+            s.sim.step(1.0)
+            _assert_never_oversubscribed(s)
+            assert s.controller.disrupted_now() <= s.controller.defrag_max_concurrent
+            node = s.cluster.nodes[free[0]]
+            on_node = {p.name for p in s.scheduled() if p.node_name == free[0]}
+            if node.revocation_deadline is not None and notice_at is None:
+                notice_at = s.sim.now
+                residents_at_notice = on_node
+            if notice_at is not None:
+                # Never a NEW binding into the doomed node after the notice.
+                assert on_node <= residents_at_notice, (
+                    f"pod bound onto revoked node {free[0]}: "
+                    f"{on_node - residents_at_notice}"
+                )
+            rc = s.controller.revocation_counts
+            if (
+                _update_ended(pcs)
+                and len(s.ready()) == 20
+                and (rc["migrated"] + rc["evicted"]) >= 1
+            ):
+                break
+        assert notice_at is not None, "scripted revocation never fired"
+        assert _update_ended(pcs)
+        # Zero lost gangs: the full generation is back and ready.
+        assert len(s.ready()) == 20
+        # The revocation was absorbed (migrated or evicted), not ignored.
+        rc = s.controller.revocation_counts
+        assert rc["notices"] >= 1 and (rc["migrated"] + rc["evicted"]) >= 1
+    finally:
+        rec.stop()
+    records = read_journal(rec.path)
+    assert replay_journal(records).divergence_count == 0
