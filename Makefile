@@ -64,11 +64,17 @@ bench-replay:    ## flight recorder: record a sim drain -> bitwise replay -> +1-
 bench-replay-cpu: ## replay scenario with the TPU-relay probe skipped
 	GROVE_BENCH_SCENARIO=replay GROVE_FORCE_CPU=1 $(PY) bench.py
 
+# The scale sweep now carries the scan-vs-pipelined dispatch A/B at the top
+# scale (device_roundtrips_{scan,pipelined}, host_per_wave_ms, parity-gated),
+# so its JSON line is tee'd under evidence/ like the other acceptance
+# artifacts.
 bench-scale:     ## fleet-scale sweep: dense vs candidate-pruned solve at GROVE_BENCH_SCALES (1,2,4)
-	GROVE_BENCH_SCENARIO=scale $(PY) bench.py
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=scale $(PY) bench.py | tee evidence/bench_scale_$$(date -u +%Y%m%dT%H%M%SZ).json
 
 bench-scale-cpu: ## scale sweep with the TPU-relay probe skipped
-	GROVE_BENCH_SCENARIO=scale GROVE_FORCE_CPU=1 $(PY) bench.py
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=scale GROVE_FORCE_CPU=1 $(PY) bench.py | tee evidence/bench_scale_cpu_$$(date -u +%Y%m%dT%H%M%SZ).json
 
 # Streaming-drain scenario writes its evidence JSON under evidence/ (the
 # one stdout line is tee'd, so the acceptance artifact survives the run).

@@ -209,6 +209,10 @@ def _get_table(client: GroveClient, kind: str) -> str:
             ["mesh." + k, v]
             for k, v in sorted(solver_doc.get("mesh", {}).items())
         ]
+        rows += [
+            ["scan." + k, v]
+            for k, v in sorted(solver_doc.get("scan", {}).items())
+        ]
         # Host-stage timing: the serving path's per-pass encode/solve/decode
         # split, then the drain/stream ledgers (host* rows inside lastDrain/
         # lastStream carry the per-stage host seconds).

@@ -209,6 +209,23 @@ class SolverConfig:
     #   pollS     number > 0, paced mode: idle poll granularity (default
     #             0.005)
     streaming: dict = field(default_factory=dict)
+    # On-device fused drain (solver/drain.py harvest="scan"): an entire
+    # shape-class of planned waves dispatches as ONE lax.scan program — the
+    # free/ok_global carry threads between waves on device, verdict planes
+    # accumulate as scan outputs, and the host pays O(shape classes +
+    # escalations) round-trips instead of O(waves). Bitwise-equal admitted
+    # sets vs the per-wave disciplines (test-pinned), so enabling it is a
+    # pure host-overhead choice; the resilience ladder's first rung steps
+    # scan -> pipelined on failure. Keys:
+    #   enabled           bool, default true (the block gates callers that
+    #                     request the scan discipline; serving paths still
+    #                     choose harvest explicitly)
+    #   maxScanLen        int >= 1, max waves fused into one scan chunk
+    #                     (default 32; chunk lengths bucket to pow2)
+    #   minWavesPerClass  int >= 1, runs shorter than this dispatch
+    #                     per-wave — fusion overhead isn't worth one wave
+    #                     (default 2)
+    scan: dict = field(default_factory=dict)
     # Mesh-sharded solve (parallel/mesh.py): distribute the single-variant
     # batched solve across the TPU mesh — node-axis tensors split over the
     # devices (GSPMD inserts the segment-reduction collectives), the free
@@ -282,6 +299,21 @@ class SolverConfig:
         if "pollS" in s:
             kwargs["poll_s"] = float(s["pollS"])
         return StreamConfig(**kwargs)
+
+    def scan_config(self):
+        """SolverConfig.scan -> solver.drain.ScanConfig (validated at config
+        load; always returns a config — the enabled bit rides it, default
+        ON: a disabled block makes harvest="scan" requests fall back to
+        pipelined)."""
+        s = self.scan or {}
+        from grove_tpu.solver.drain import ScanConfig
+
+        kwargs = {}
+        if "maxScanLen" in s:
+            kwargs["max_scan_len"] = int(s["maxScanLen"])
+        if "minWavesPerClass" in s:
+            kwargs["min_waves_per_class"] = int(s["minWavesPerClass"])
+        return ScanConfig(enabled=bool(s.get("enabled", True)), **kwargs)
 
 
 @dataclass
@@ -969,6 +1001,23 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             or sm["pollS"] <= 0
         ):
             errors.append("solver.streaming.pollS: must be > 0")
+    sc = cfg.solver.scan
+    if not isinstance(sc, dict):
+        errors.append("solver.scan: must be a mapping")
+    elif sc:
+        _SCAN_KEYS = {"enabled", "maxScanLen", "minWavesPerClass"}
+        for ck in sc:
+            if ck not in _SCAN_KEYS:
+                errors.append(f"solver.scan.{ck}: unknown field")
+        if "enabled" in sc and not isinstance(sc["enabled"], bool):
+            errors.append("solver.scan.enabled: must be a boolean")
+        for ck in ("maxScanLen", "minWavesPerClass"):
+            if ck in sc and (
+                not isinstance(sc[ck], int)
+                or isinstance(sc[ck], bool)
+                or sc[ck] < 1
+            ):
+                errors.append(f"solver.scan.{ck}: must be an int >= 1")
     mh = cfg.solver.mesh
     if not isinstance(mh, dict):
         errors.append("solver.mesh: must be a mapping")

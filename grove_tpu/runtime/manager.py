@@ -844,6 +844,23 @@ class Manager:
             "Pruned-solve rejections re-verified by a dense re-solve",
         )
         self._prune_escalations_exported = 0
+        # Host<->device round-trip ledger (solver/drain.DrainStats): every
+        # drain/stream feeds the warm path's cumulative dispatch/harvest
+        # counters through record_drain regardless of harvest discipline,
+        # so the deltas here never miss a drain between scrapes. The scan
+        # discipline's whole point is this counter: O(shape classes +
+        # escalations) instead of O(waves).
+        self._m_drain_roundtrips = self.metrics.counter(
+            "grove_drain_device_roundtrips_total",
+            "Host-blocking device harvest syncs across all drains/streams",
+        )
+        self._m_drain_dispatches = self.metrics.counter(
+            "grove_drain_dispatches_total",
+            "Solve programs dispatched across all drains/streams "
+            "(a scanned chunk counts once)",
+        )
+        self._roundtrips_exported = 0
+        self._dispatches_exported = 0
         # Streaming-drain observability (solver/stream.py): pipeline depth
         # and steady-state throughput of the last streaming run (gauges cut
         # from warm.last_stream), and the measured per-gang enqueue->bound
@@ -1288,6 +1305,22 @@ class Manager:
             "waveSize": int(scfg.wave_size),
             "maxWaitS": float(scfg.max_wait_s),
             "pollS": float(scfg.poll_s),
+        }
+        # On-device fused drain view (solver/drain.py harvest="scan"): the
+        # effective solver.scan block plus the cumulative round-trip ledger
+        # (source of the grove_drain_device_roundtrips_total counter — the
+        # number the scan discipline exists to shrink).
+        kcfg = self.config.solver.scan_config()
+        doc["scan"] = {
+            "enabled": bool(kcfg.enabled),
+            "maxScanLen": int(kcfg.max_scan_len),
+            "minWavesPerClass": int(kcfg.min_waves_per_class),
+            "dispatchesTotal": int(
+                self.controller.warm.drain_dispatches_total
+            ),
+            "deviceRoundtripsTotal": int(
+                self.controller.warm.drain_device_roundtrips_total
+            ),
         }
         if self.controller.warm.last_stream:
             doc["lastStream"] = dict(self.controller.warm.last_stream)
@@ -1986,6 +2019,14 @@ class Manager:
         except Exception:  # noqa: BLE001 — metrics must never break reconcile
             pass
         warm = self.controller.warm
+        delta = warm.drain_device_roundtrips_total - self._roundtrips_exported
+        if delta > 0:
+            self._m_drain_roundtrips.inc(float(delta))
+            self._roundtrips_exported = warm.drain_device_roundtrips_total
+        delta = warm.drain_dispatches_total - self._dispatches_exported
+        if delta > 0:
+            self._m_drain_dispatches.inc(float(delta))
+            self._dispatches_exported = warm.drain_dispatches_total
         if warm.last_stream:
             self._m_stream_depth.set(float(warm.last_stream.get("depth", 0)))
             self._m_stream_gps.set(

@@ -761,6 +761,205 @@ def sharded_solve_fn(layout, donate: bool = False):
         return _SHARDED_JIT.setdefault(key, jitted)
 
 
+class ScanSolveResult(NamedTuple):
+    """One scanned shape-class: per-wave verdict planes stacked on a leading
+    [W] wave axis, plus the final carry. `free_in`/`okg_in` are the ENTERING
+    carry per step (present iff retain=True) — byte-identical to what the
+    per-wave drain retains in rec["free_in"]/rec["okg_in"], so journaling and
+    retire-time dense escalation read the same values the serial path would."""
+
+    assigned: jax.Array  # i32 [W, G, MP]
+    ok: jax.Array  # bool [W, G]
+    placement_score: jax.Array  # f32 [W, G]
+    free_in: jax.Array | None  # f32 [W, N, R] entering free per step
+    okg_in: jax.Array | None  # bool [W, T] entering verdict bitmap per step
+    free_after: jax.Array  # f32 [N, R] final carry
+    ok_global: jax.Array  # bool [T] final verdict bitmap
+
+
+# One jitted scan wrapper per (pruned, retain, donate, layout): the wave loop
+# as a device program. The carry (free, ok_global) threads step-to-step with
+# pinned shardings (the SNIPPETS pjit-chaining idiom: constrain the carry so
+# the chain never reshards), the stacked GangBatch rides the scanned xs axis,
+# and the verdict planes come back as stacked ys — ONE dispatch and ONE
+# harvest round-trip for the whole shape class.
+_SCAN_JIT: dict[tuple, object] = {}
+_SCAN_JIT_LOCK = threading.Lock()
+
+
+def scan_solve_fn(layout=None, retain: bool = False, donate: bool = False):
+    """jitted `lax.scan` of solve_batch_impl over a stacked wave axis.
+
+    Signature of the returned callable:
+      (free0 [N,R], capacity [N,R], schedulable [N], node_domain_id [L,N],
+       stacked_batch (GangBatch, each leaf [W,...]), params,
+       ok_global [T], *, coarse_dmax) -> ScanSolveResult
+
+    Step w runs solve_batch_impl on wave w's batch with the carry exactly as
+    the serial drain would thread it — bitwise-identical verdicts by
+    construction (same traced step function, same op order). `retain=True`
+    additionally emits the entering (free, ok_global) per step so lossy-pruned
+    waves can escalate dense at retire time and the journal stays per-wave.
+    Process-wide memo like `sharded_solve_fn`; the AOT executable cache
+    lowers through this function."""
+    key = ("dense", bool(retain), bool(donate), None if layout is None else layout.key())
+    with _SCAN_JIT_LOCK:
+        cached = _SCAN_JIT.get(key)
+        if cached is not None:
+            return cached
+
+    rep = None if layout is None else layout.replicated()
+    free_sh = None if layout is None else layout.free_sharding()
+
+    def impl(
+        free0,
+        capacity,
+        schedulable,
+        node_domain_id,
+        stacked_batch,
+        params=SolverParams(),
+        ok_global=None,
+        coarse_dmax=None,
+    ):
+        c = jax.lax.with_sharding_constraint
+
+        def step(carry, wave_batch):
+            free, okg = carry
+            res = solve_batch_impl(
+                free,
+                capacity,
+                schedulable,
+                node_domain_id,
+                wave_batch,
+                params,
+                okg,
+                coarse_dmax=coarse_dmax,
+            )
+            free_out, okg_out = res.free_after, res.ok_global
+            if layout is not None:
+                # Pin the carry every step: node axis stays sharded, the
+                # small planes replicated — zero resharding across the chain.
+                free_out = c(free_out, free_sh)
+                okg_out = c(okg_out, rep)
+            ys = (res.assigned, res.ok, res.placement_score)
+            if retain:
+                ys = ys + (free, okg)
+            return (free_out, okg_out), ys
+
+        (free_final, okg_final), ys = jax.lax.scan(step, (free0, ok_global), stacked_batch)
+        if layout is not None:
+            ys = tuple(c(y, rep) for y in ys[:3]) + ys[3:]
+        return ScanSolveResult(
+            assigned=ys[0],
+            ok=ys[1],
+            placement_score=ys[2],
+            free_in=ys[3] if retain else None,
+            okg_in=ys[4] if retain else None,
+            free_after=free_final,
+            ok_global=okg_final,
+        )
+
+    jitted = jax.jit(
+        impl,
+        static_argnames=("coarse_dmax",),
+        # Same wave-carry donation contract as the per-wave variants:
+        # free0 (arg 0) + ok_global (arg 6) feed the next class's carry.
+        donate_argnums=(0, 6) if donate else (),
+    )
+    with _SCAN_JIT_LOCK:
+        return _SCAN_JIT.setdefault(key, jitted)
+
+
+def scan_pruned_solve_fn(layout=None, retain: bool = False, donate: bool = False):
+    """Candidate-pruned scan: per step, gather the FLEET free carry onto that
+    wave's candidate axis, solve there, scatter free_after back — the fleet
+    carry is what threads step-to-step, so the chain composes with dense
+    waves and the retained `free_in` is fleet-shaped (what escalation and the
+    journal need).
+
+    Signature of the returned callable:
+      (free0 [N,R], cand_idx i32 [W,CP], capacity_p [W,CP,R],
+       schedulable_p [W,CP], node_domain_id_p [W,L,CP],
+       stacked_batch (candidate-axis GangBatch, each leaf [W,...]), params,
+       ok_global [T], *, coarse_dmax) -> ScanSolveResult
+
+    `cand_idx` rows use the CandidatePlan._padded_idx convention: pad slots
+    point past the fleet axis, so gathers fill 0.0 and scatters drop."""
+    key = ("pruned", bool(retain), bool(donate), None if layout is None else layout.key())
+    with _SCAN_JIT_LOCK:
+        cached = _SCAN_JIT.get(key)
+        if cached is not None:
+            return cached
+
+    rep = None if layout is None else layout.replicated()
+    free_sh = None if layout is None else layout.free_sharding()
+
+    def impl(
+        free0,
+        cand_idx,
+        capacity_p,
+        schedulable_p,
+        node_domain_id_p,
+        stacked_batch,
+        params=SolverParams(),
+        ok_global=None,
+        coarse_dmax=None,
+    ):
+        c = jax.lax.with_sharding_constraint
+
+        def step(carry, xs):
+            free, okg = carry
+            idx, cap_w, sched_w, ndid_w, wave_batch = xs
+            free_p = free.at[idx].get(mode="fill", fill_value=0.0)
+            res = solve_batch_impl(
+                free_p,
+                cap_w,
+                sched_w,
+                ndid_w,
+                wave_batch,
+                params,
+                okg,
+                coarse_dmax=coarse_dmax,
+            )
+            free_out = free.at[idx].set(
+                res.free_after, mode="drop", unique_indices=True
+            )
+            okg_out = res.ok_global
+            if layout is not None:
+                free_out = c(free_out, free_sh)
+                okg_out = c(okg_out, rep)
+            ys = (res.assigned, res.ok, res.placement_score)
+            if retain:
+                ys = ys + (free, okg)
+            return (free_out, okg_out), ys
+
+        (free_final, okg_final), ys = jax.lax.scan(
+            step,
+            (free0, ok_global),
+            (cand_idx, capacity_p, schedulable_p, node_domain_id_p, stacked_batch),
+        )
+        if layout is not None:
+            ys = tuple(c(y, rep) for y in ys[:3]) + ys[3:]
+        return ScanSolveResult(
+            assigned=ys[0],
+            ok=ys[1],
+            placement_score=ys[2],
+            free_in=ys[3] if retain else None,
+            okg_in=ys[4] if retain else None,
+            free_after=free_final,
+            ok_global=okg_final,
+        )
+
+    jitted = jax.jit(
+        impl,
+        static_argnames=("coarse_dmax",),
+        # free0 (arg 0) + ok_global (arg 7) under the pruned signature.
+        donate_argnums=(0, 7) if donate else (),
+    )
+    with _SCAN_JIT_LOCK:
+        return _SCAN_JIT.setdefault(key, jitted)
+
+
 def coarse_dmax_of(snapshot) -> int | None:
     """Static bound on domains per non-host level, selecting the aggregation
     strategy for the backend the solve will run on:

@@ -60,7 +60,25 @@ from grove_tpu.solver.core import (
 )
 from grove_tpu.solver.encode import encode_gangs, gang_shape, next_pow2
 
-HARVEST_MODES = ("chained", "wave", "pipeline")
+HARVEST_MODES = ("chained", "wave", "pipeline", "scan")
+
+
+@dataclass(frozen=True)
+class ScanConfig:
+    """`solver.scan` config block (runtime/config.py validates the YAML
+    shape): the device-side wave scan that fuses a whole shape-class of
+    waves into ONE `lax.scan` dispatch — host participation per backlog
+    drops to O(shape classes + escalations) instead of O(waves)."""
+
+    enabled: bool = True
+    # Longest wave run fused into one scan executable. Runs longer than
+    # this split into chunks; each chunk's wave axis pads to its next power
+    # of two with NULL waves (gang_valid all-False — carry-neutral by
+    # construction), so backlogs of varying length share executables.
+    max_scan_len: int = 32
+    # Runs shorter than this dispatch per-wave instead — a 1-wave scan
+    # executable amortizes nothing and would only fragment the AOT cache.
+    min_waves_per_class: int = 2
 
 
 class WaveFault(RuntimeError):
@@ -77,6 +95,11 @@ class WaveFault(RuntimeError):
         # escalation re-chain died past its retry budget mid-adoption); the
         # driver must surface the error, not degrade around it.
         self.fatal = fatal
+        # A fused submit (submit_scan) that failed mid-run sets this to the
+        # planned waves NOT yet enqueued (the failed one onward, in order) —
+        # the driver resubmits exactly these, per-wave, under the
+        # stepped-down config, so a chunk failure never drops arrivals.
+        self.pending: list | None = None
 
 
 @dataclass
@@ -145,6 +168,22 @@ class DrainStats:
     watchdog_timeouts: int = 0
     waves_cancelled: int = 0
     wave_redispatches: int = 0
+    # Device round-trip ledger (the scan's O(shape classes) claim as a
+    # MEASURED number — wall-clock is unobservable on a 1-core CPU host):
+    # `dispatches` counts solve programs enqueued (per wave when stepping,
+    # per chunk when scanning, plus escalation re-solves); the roundtrip
+    # counter counts host-blocking device->host harvest syncs (one per
+    # wave fetch / per scan-chunk fetch / per chained flush / per
+    # escalation verdict check). Surfaced via host_stages(), /statusz
+    # warmPath, `get solver`, bench JSON, and the
+    # grove_drain_device_roundtrips_total counter.
+    dispatches: int = 0
+    device_roundtrips: int = 0
+    # Scan discipline ledger: chunks dispatched as device-side scans and
+    # the logical waves they covered (scanned_waves <= waves; the rest ran
+    # per-wave — short runs, escalation re-chains).
+    scan_chunks: int = 0
+    scanned_waves: int = 0
 
     def resilience_doc(self) -> dict:
         """The fault-recovery counters of this run (surfaced on lastDrain/
@@ -191,7 +230,14 @@ class DrainStats:
             "hostJournalS": round(self.journal_s, 6),
             "hostTotalS": round(host_total, 6),
             "hostHotPathS": round(hot, 6),
+            # Round-trip ledger: the structural host tax the scan harvest
+            # removes (see the field comments above).
+            "dispatches": self.dispatches,
+            "deviceRoundtrips": self.device_roundtrips,
         }
+        if self.scan_chunks or self.scanned_waves:
+            doc["scanChunks"] = self.scan_chunks
+            doc["scannedWaves"] = self.scanned_waves
         if self.waves:
             doc["hostPerWaveMs"] = round(1000.0 * host_total / self.waves, 4)
         return doc
@@ -226,15 +272,16 @@ def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int
     test_plan_waves_class_order_follows_input_order pins this.
 
     Gang-axis pad policy: full waves pad to max(32, next_pow2(wave_size)) —
-    the >=32 floor keeps recurring mid-size waves on one executable. A wave
-    that covers the REST of its class (the single-wave class, or a trailing
-    remainder) clamps to next_pow2(len) UNLESS the floored pad would equal
-    the class's full-wave pad (then keeping the floor reuses the already-
-    compiled executable instead of manufacturing a new smaller shape). A
-    3-gang class therefore pads to 4, not 32 — the 32-slot executable it
-    would otherwise compile is a shape the class never shares with anything
-    (executables are keyed per (mg, ms, mp) class, so cross-class pad
-    sharing does not exist)."""
+    the >=32 floor keeps recurring mid-size waves on one executable. A
+    class that fits in a SINGLE wave clamps to next_pow2(len) — the
+    full-size executable it would otherwise compile is a shape the class
+    never shares with anything (executables are keyed per (mg, ms, mp)
+    class, so cross-class pad sharing does not exist); a 3-gang class
+    therefore pads to 4, not 32. A class with at least one full wave
+    CANONICALIZES its trailing remainder up to the class pad: the
+    remainder then rides the full waves' executable (dense solve) and
+    their scan group (device-side drain) instead of splintering the class
+    across two pads, each compiling its own program."""
 
     def _padded_shape(g):
         mg_g, ms_g, mp_g = gang_shape(g)
@@ -251,11 +298,10 @@ def plan_waves(gangs: list, wave_size: int = 256) -> list[tuple[list, tuple, int
             n_full = len(members) // wave_size
             for i in range(0, len(members), wave_size):
                 wave = members[i : i + wave_size]
-                pad = max(32, next_pow2(len(wave)))
-                if len(wave) < wave_size and (n_full == 0 or pad != full_pad):
-                    # Remainder wave whose floored pad is a new executable
-                    # shape anyway (no full wave of this class to share
-                    # with) — clamp to the remainder's own pow2.
+                pad = full_pad
+                if len(wave) < wave_size and n_full == 0:
+                    # Single-wave class: no full wave to share a pad with —
+                    # clamp to the wave's own pow2.
                     pad = next_pow2(len(wave))
                 waves.append((wave, shape, pad))
     return waves
@@ -311,6 +357,7 @@ class _WavePipeline:
         max_wave_retries: int = 0,  # re-dispatches per wave before WaveFault
         clock=None,  # injectable for watchdog tests (default perf_counter)
         watchdog_poll_s: float = 0.001,
+        scan=None,  # ScanConfig: device-side wave scan (harvest="scan")
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -339,6 +386,10 @@ class _WavePipeline:
         self.max_wave_retries = int(max_wave_retries)
         self.clock = clock if clock is not None else time.perf_counter
         self.watchdog_poll_s = watchdog_poll_s
+        # Device-side wave scan (harvest="scan"): only meaningful on the
+        # exec-cache path — the portfolio closure owns its own dispatch.
+        self.scan = scan if solver is None else None
+        self._scan_warmed: set[tuple] = set()
         # Mesh-sharded solve: every wave's executable is the layout-keyed
         # sharded variant; the free carry chains node-sharded between waves
         # (out-sharding pinned), so the pipeline never reshards.
@@ -524,10 +575,20 @@ class _WavePipeline:
         outputs hold bitwise the same values."""
         if self.faults is not None:
             self.faults.maybe_raise("solver.dispatch", wave=rec.get("seq", -1))
+        # A per-wave (re-)dispatch supersedes any scan-chunk result this
+        # record was part of: escalation re-chains and watchdog re-dispatch
+        # must read THIS solve's planes, not the stale group fetch.
+        rec.pop("scan_group", None)
+        rec.pop("scan_pos", None)
         if free_in is None:
             free_in, okg_in = self.free, self.ok_g
+        self.stats.dispatches += 1
         if rec["plan"] is not None:
             plan = rec["plan"]
+            if "pruned_inputs" not in rec:
+                # Scan-encoded records skip the per-wave upload; materialize
+                # it on the first per-wave dispatch (escalation re-chain).
+                rec["pruned_inputs"] = self.pruned_inputs(rec["plan"], rec["batch"])
             wb, cap_p, sched_p, ndid_p = rec["pruned_inputs"]
             result = self.wp.executables.solve(
                 plan.gather_free(free_in, layout=self.layout),
@@ -650,15 +711,11 @@ class _WavePipeline:
         this instead of letting submit retire)."""
         return self.retire_lag is not None and len(self.inflight) > self.retire_lag
 
-    def submit(self, ws, retire: bool = True) -> None:
-        """Encode + dispatch one planned wave, then (by default) retire down
-        to the pipeline depth. Keeps only what decode needs per wave —
-        retaining full SolveResults would pin every wave's chaining buffers
-        in device memory. (Carry-retaining drains additionally keep each
-        wave's ENTERING free/ok_global for escalation and journaling.)
-        `retire=False` skips the retirement loop: a dispatch failure then
-        unambiguously means the wave was NOT enqueued, which is what the
-        resilient driver's resubmit logic needs."""
+    def _encode_rec(self, ws, for_scan: bool = False) -> dict:
+        """Encode one planned wave into an in-flight record (not yet
+        dispatched). `for_scan` defers the per-wave pruned-input upload —
+        the scan chunk stacks its own batched inputs, and a per-wave copy
+        would only be re-materialized on an escalation re-chain."""
         stats = self.stats
         te = time.perf_counter()
         batch, decode = self.encode_wave(ws)
@@ -672,21 +729,329 @@ class _WavePipeline:
             "decode": decode,
             "plan": plan,
             "escalated": False,
-            "seq": stats.waves,
+            "seq": stats.waves,  # restamped at dispatch (resubmit-safe)
         }
         if plan is not None:
-            rec["pruned_inputs"] = self.pruned_inputs(plan, batch)
+            if not for_scan:
+                rec["pruned_inputs"] = self.pruned_inputs(plan, batch)
             stats.pruned_waves += 1
             stats.candidate_nodes = max(stats.candidate_nodes, plan.count)
             stats.candidate_pad = max(stats.candidate_pad, plan.pad)
+        return rec
+
+    def _dispatch_one(self, rec: dict) -> None:
+        """Dispatch one encoded record and enqueue it for retirement.
+        `stats.waves` advances only on a successful dispatch, so a driver
+        resubmitting after WaveFault(in_flight=False) never double-counts."""
+        rec["seq"] = self.stats.waves
         ts = time.perf_counter()
         self._dispatch_with_retry(rec, in_flight=False)
-        stats.dispatch_s += time.perf_counter() - ts
-        stats.waves += 1
+        self.stats.dispatch_s += time.perf_counter() - ts
+        self.stats.waves += 1
         self.inflight.append(rec)
+
+    def submit(self, ws, retire: bool = True) -> None:
+        """Encode + dispatch one planned wave, then (by default) retire down
+        to the pipeline depth. Keeps only what decode needs per wave —
+        retaining full SolveResults would pin every wave's chaining buffers
+        in device memory. (Carry-retaining drains additionally keep each
+        wave's ENTERING free/ok_global for escalation and journaling.)
+        `retire=False` skips the retirement loop: a dispatch failure then
+        unambiguously means the wave was NOT enqueued, which is what the
+        resilient driver's resubmit logic needs."""
+        self._dispatch_one(self._encode_rec(ws))
         if retire and self.retire_lag is not None:
             while len(self.inflight) > self.retire_lag:
                 self._retire_next()
+
+    # ---- device-side wave scan (harvest="scan") ----------------------------------
+
+    def _scan_subkey(self, rec: dict) -> tuple:
+        """Records that can share one scan executable: same optional-feature
+        presence (the stacked GangBatch pytree structure) and, for pruned
+        waves, the same candidate pad (the scanned gather maps must stack)."""
+        b = rec["batch"]
+        presence = (
+            b.reuse_nodes is None,
+            b.group_node_ok is None,
+            b.spread_level is None,
+        )
+        plan = rec["plan"]
+        if plan is None:
+            return ("dense", presence)
+        return ("pruned", presence, plan.pad, plan.fleet_pad)
+
+    def submit_scan(self, class_waves: list, retire: bool = True) -> None:
+        """Encode a run of same-(shape, pad) planned waves and dispatch it
+        as device-side scan chunks: ONE solve program per chunk threads the
+        free/ok_global carry across the waves on device, so the host pays
+        O(chunks) dispatches and O(chunks) harvest syncs instead of
+        O(waves). Runs shorter than `min_waves_per_class` (and sub-chunks a
+        presence/pad split leaves too short) dispatch per-wave — identical
+        semantics, just not fused. Retirement (incl. escalation-at-retire)
+        is unchanged: scanned records retire in dispatch order through the
+        same `_retire_next`, reading numpy views of the chunk's one fetch."""
+        scan = self.scan
+        if scan is None or not scan.enabled or not self.use_exec_cache:
+            for ws in class_waves:
+                self.submit(ws, retire=retire)
+            return
+        recs = [self._encode_rec(ws, for_scan=True) for ws in class_waves]
+        min_run = max(1, int(scan.min_waves_per_class))
+        max_len = max(1, int(scan.max_scan_len))
+        i = 0
+        while i < len(recs):
+            j = i
+            key = self._scan_subkey(recs[i])
+            while j < len(recs) and self._scan_subkey(recs[j]) == key:
+                j += 1
+            run = recs[i:j]
+            for k in range(0, len(run), max_len):
+                chunk = run[k : k + max_len]
+                try:
+                    if len(chunk) < min_run:
+                        for off, rec in enumerate(chunk):
+                            try:
+                                self._dispatch_one(rec)
+                            except WaveFault as e:
+                                if not e.in_flight and e.pending is None:
+                                    e.pending = class_waves[i + k + off :]
+                                raise
+                    else:
+                        self._dispatch_scan_chunk(chunk)
+                except WaveFault as e:
+                    # Nothing of the failed chunk (or wave) was enqueued;
+                    # hand the un-enqueued tail back so the driver can
+                    # resubmit it per-wave after stepping the ladder.
+                    if not e.in_flight and e.pending is None:
+                        e.pending = class_waves[i + k :]
+                    raise
+                if retire and self.retire_lag is not None:
+                    while len(self.inflight) > self.retire_lag:
+                        self._retire_next()
+            i = j
+
+    def _dispatch_scan_chunk(self, run: list[dict]) -> None:
+        """Stack one chunk's encoded batches on a leading wave axis and
+        dispatch the whole chunk as ONE scan executable. The wave axis pads
+        to its next power of two with NULL waves (all-invalid gang_valid —
+        carry-neutral by construction: no gang admits, the free carry passes
+        through, and the null global_index scatters nothing), so chunk
+        lengths bucket like gang pads do."""
+        import jax
+        import numpy as np
+
+        ts = time.perf_counter()
+        w_real = len(run)
+        w_pad = next_pow2(w_real)
+        free_in, okg_in = self.free, self.ok_g
+        for i, rec in enumerate(run):
+            rec["seq"] = self.stats.waves + i
+        pruned = run[0]["plan"] is not None
+
+        def stack_tree(trees):
+            return jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees
+            )
+
+        attempts = 0
+        while True:
+            try:
+                if self.faults is not None:
+                    self.faults.maybe_raise(
+                        "solver.dispatch", wave=run[0]["seq"]
+                    )
+                if pruned:
+                    plans = [r["plan"] for r in run]
+                    idx_rows = [np.asarray(p._padded_idx()) for p in plans]
+                    cap_rows = [
+                        np.asarray(p.capacity, np.float32) for p in plans
+                    ]
+                    sched_rows = [
+                        np.asarray(p.schedulable, bool) for p in plans
+                    ]
+                    ndid_rows = [
+                        np.asarray(p.node_domain_id, np.int32) for p in plans
+                    ]
+                    pbatches = [
+                        p.gather_batch(r["batch"])
+                        for p, r in zip(plans, run)
+                    ]
+                    if w_pad > w_real:
+                        # Null pruned wave: every gather-map slot points past
+                        # the fleet axis (gathers fill 0, scatters drop).
+                        null_idx = np.full_like(
+                            idx_rows[0], plans[0].fleet_pad
+                        )
+                        null_b = jax.tree_util.tree_map(
+                            np.zeros_like, pbatches[0]
+                        )
+                        for _ in range(w_pad - w_real):
+                            idx_rows.append(null_idx)
+                            cap_rows.append(np.zeros_like(cap_rows[0]))
+                            sched_rows.append(np.zeros_like(sched_rows[0]))
+                            ndid_rows.append(np.zeros_like(ndid_rows[0]))
+                            pbatches.append(null_b)
+                    cds = [p.coarse_dmax() for p in plans]
+                    res = self.wp.executables.solve_scan_pruned(
+                        free_in,
+                        np.stack(idx_rows),
+                        np.stack(cap_rows),
+                        np.stack(sched_rows),
+                        np.stack(ndid_rows),
+                        stack_tree(pbatches),
+                        self.params,
+                        okg_in,
+                        coarse_dmax=None if cds[0] is None else max(cds),
+                        retain=self.retain_carries,
+                        donate=self.donate,
+                        layout=self.layout,
+                    )
+                else:
+                    batches = [r["batch"] for r in run]
+                    if w_pad > w_real:
+                        null_b = jax.tree_util.tree_map(
+                            np.zeros_like, batches[0]
+                        )
+                        batches = batches + [null_b] * (w_pad - w_real)
+                    res = self.wp.executables.solve_scan(
+                        free_in,
+                        self.capacity,
+                        self.schedulable,
+                        self.node_domain_id,
+                        stack_tree(batches),
+                        self.params,
+                        okg_in,
+                        coarse_dmax=self.dmax,
+                        retain=self.retain_carries,
+                        donate=self.donate,
+                        layout=self.layout,
+                    )
+                break
+            except Exception as e:  # noqa: BLE001 — retry budget, then surface
+                if attempts >= self.max_wave_retries:
+                    if self.max_wave_retries == 0 and self.faults is None:
+                        raise
+                    raise WaveFault(
+                        f"scan chunk dispatch failed after {attempts} "
+                        f"retries: {e}",
+                        in_flight=False,
+                    ) from e
+                attempts += 1
+                self.stats.wave_retries += 1
+
+        # One fetch per chunk at retirement; every member reads views of it.
+        group = {
+            "ok": res.ok,
+            "score": res.placement_score,
+            "assigned": res.assigned,
+            "free_in": res.free_in,
+            "okg_in": res.okg_in,
+        }
+        now = self.clock()
+        for i, rec in enumerate(run):
+            rec.update(
+                # The whole-chunk planes: readiness (watchdog) is chunk
+                # completion — a scan step cannot finish before its program.
+                ok=res.ok,
+                score=res.placement_score,
+                assigned=res.assigned,
+                ok_np=None,
+                # Device slices of the retained entering carries — the
+                # escalation re-chain and watchdog re-dispatch inputs;
+                # replaced by numpy views at the group fetch.
+                free_in=res.free_in[i] if self.retain_carries else None,
+                okg_in=res.okg_in[i] if self.retain_carries else None,
+                dispatched_at=now,
+                cancelled=False,
+                scan_group=group,
+                scan_pos=i,
+            )
+            self.inflight.append(rec)
+        self.free, self.ok_g = res.free_after, res.ok_global
+        self.stats.waves += w_real
+        self.stats.dispatches += 1
+        self.stats.scan_chunks += 1
+        self.stats.scanned_waves += w_real
+        self.stats.dispatch_s += time.perf_counter() - ts
+
+    def warm_scan(self, class_waves: list) -> bool:
+        """AOT-compile (never execute) the scan executables a run of
+        same-shape waves will need — one per chunk-length bucket — from
+        abstract avals, so the timed drain section pays zero lowerings.
+        Presence/pad splits inside the run can still cold-compile at
+        dispatch (the warm pass assumes the common uniform-run case).
+        Returns True when anything was actually compiled (drivers use it to
+        attribute the wall time to compile_s, like warm_shape)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from grove_tpu.solver import warm as warm_mod
+
+        scan = self.scan
+        if scan is None or not scan.enabled or not self.use_exec_cache:
+            return False
+        n = len(class_waves)
+        min_run = max(1, int(scan.min_waves_per_class))
+        max_len = max(1, int(scan.max_scan_len))
+        lens = set()
+        for k in range(0, n, max_len):
+            chunk_len = min(max_len, n - k)
+            if chunk_len >= min_run:
+                lens.add(next_pow2(chunk_len))
+        lens = {
+            length
+            for length in lens
+            if (class_waves[0][1:], length) not in self._scan_warmed
+        }
+        if not lens:
+            return False
+        for length in lens:
+            self._scan_warmed.add((class_waves[0][1:], length))
+        warm_batch, _ = self.encode_wave(class_waves[0], reuse_rows=False)
+        zeros_okg = jnp.zeros_like(self.ok_g)
+        plan = self.cut_plan(warm_batch, count=False)
+        if plan is not None:
+            args = warm_mod._canon(
+                plan.gather_free(np.asarray(self.snapshot.free, np.float32)),
+                plan.capacity,
+                plan.schedulable,
+                plan.node_domain_id,
+                plan.gather_batch(warm_batch),
+                self.params,
+                zeros_okg,
+            )
+            for length in lens:
+                self.wp.executables.ensure_compiled_scan(
+                    warm_mod._scan_pruned_avals(
+                        args, tuple(self.free.shape), length, self.layout
+                    ),
+                    coarse_dmax=plan.coarse_dmax(),
+                    retain=self.retain_carries,
+                    donate=self.donate,
+                    layout=self.layout,
+                    pruned=True,
+                )
+        else:
+            args = warm_mod._canon(
+                self.free,
+                self.capacity,
+                self.schedulable,
+                self.node_domain_id,
+                warm_batch,
+                self.params,
+                zeros_okg,
+                layout=self.layout,
+            )
+            for length in lens:
+                self.wp.executables.ensure_compiled_scan(
+                    warm_mod._scan_avals(args, length, self.layout),
+                    coarse_dmax=self.dmax,
+                    retain=self.retain_carries,
+                    donate=self.donate,
+                    layout=self.layout,
+                )
+        return True
 
     # ---- retirement --------------------------------------------------------------
 
@@ -716,11 +1081,49 @@ class _WavePipeline:
                     )
                 attempts += 1
                 self._redispatch(rec)
-            rec["ok_np"] = np.asarray(rec["ok"])
-            rec["score_np"] = np.asarray(rec["score"])
-            rec["assigned_np"] = np.asarray(rec["assigned"])
+            group = rec.get("scan_group")
+            if group is not None:
+                # One host-blocking fetch covers the whole scan chunk; this
+                # wave (and every sibling) reads numpy views of its step.
+                self._fetch_scan_group(group)
+                i = rec["scan_pos"]
+                rec["ok_np"] = group["ok_np"][i]
+                rec["score_np"] = group["score_np"][i]
+                rec["assigned_np"] = group["assigned_np"][i]
+                if group.get("free_in_np") is not None:
+                    # Retained entering carries ride the same fetch —
+                    # journaling/escalation must not pay a second sync.
+                    rec["free_in"] = group["free_in_np"][i]
+                    rec["okg_in"] = group["okg_in_np"][i]
+            else:
+                rec["ok_np"] = np.asarray(rec["ok"])
+                rec["score_np"] = np.asarray(rec["score"])
+                rec["assigned_np"] = np.asarray(rec["assigned"])
+                self.stats.device_roundtrips += 1
         finally:
             self.stats.harvest_s += time.perf_counter() - th
+
+    def _fetch_scan_group(self, group: dict) -> None:
+        """Harvest a scan chunk's accumulated planes with ONE device_get
+        (idempotent — the first retiring wave of the chunk pays it)."""
+        import numpy as np
+
+        if group.get("ok_np") is not None:
+            return
+        import jax
+
+        planes = [group["ok"], group["score"], group["assigned"]]
+        retained = group.get("free_in") is not None
+        if retained:
+            planes += [group["free_in"], group["okg_in"]]
+        fetched = jax.device_get(planes)
+        self.stats.device_roundtrips += 1
+        group["ok_np"] = np.asarray(fetched[0])
+        group["score_np"] = np.asarray(fetched[1])
+        group["assigned_np"] = np.asarray(fetched[2])
+        if retained:
+            group["free_in_np"] = np.asarray(fetched[3])
+            group["okg_in_np"] = np.asarray(fetched[4])
 
     def _retire_next(self) -> None:
         # Peek-fetch-pop: a WaveFault out of _fetch (watchdog exhaustion)
@@ -754,6 +1157,7 @@ class _WavePipeline:
             if bool(lossy.any()):
                 rec["escalated"] = True
                 stats.escalations += 1
+                stats.dispatches += 1
                 dense = self.wp.executables.solve(
                     rec["free_in"], self.capacity, self.schedulable,
                     self.node_domain_id, rec["batch"], self.params,
@@ -761,6 +1165,7 @@ class _WavePipeline:
                     layout=self.layout,
                 )
                 dense_ok = np.asarray(dense.ok)
+                stats.device_roundtrips += 1
                 if not bool(np.all(dense_ok == rec["ok_np"])):
                     stats.escalations_adopted += 1
                     rec.update(
@@ -841,15 +1246,21 @@ class _WavePipeline:
         in order; the other modes have at most `retire_lag` waves left."""
         import numpy as np
 
-        if self.retire_lag is None and self.inflight:
+        plain = [
+            r
+            for r in self.inflight
+            if r.get("scan_group") is None and r.get("ok_np") is None
+        ]
+        if self.retire_lag is None and plain:
             import jax
 
             th = time.perf_counter()
             fetched = jax.device_get(
-                [(r["ok"], r["score"], r["assigned"]) for r in self.inflight]
+                [(r["ok"], r["score"], r["assigned"]) for r in plain]
             )
             self.stats.harvest_s += time.perf_counter() - th
-            for rec, (ok, score, assigned) in zip(self.inflight, fetched):
+            self.stats.device_roundtrips += 1
+            for rec, (ok, score, assigned) in zip(plain, fetched):
                 rec["ok_np"] = np.asarray(ok)
                 rec["score_np"] = np.asarray(score)
                 rec["assigned_np"] = np.asarray(assigned)
@@ -859,10 +1270,19 @@ class _WavePipeline:
     # ---- degradation-ladder hooks (solver/resilience.py) -------------------------
     #
     # Each rung of the ladder maps to one engine mutation, applied BETWEEN
-    # waves by the driver. All three are admitted-set-preserving by the
-    # pinned equivalences: sharded == unsharded bitwise (tests/test_mesh),
-    # pruned == dense admitted-equal via escalation (solver/pruning), and
-    # retire_lag is a pure harvest-discipline choice (tests/test_drain).
+    # waves by the driver. All are admitted-set-preserving by the pinned
+    # equivalences: scanned == per-wave bitwise (tests/test_scan), sharded
+    # == unsharded bitwise (tests/test_mesh), pruned == dense
+    # admitted-equal via escalation (solver/pruning), and retire_lag is a
+    # pure harvest-discipline choice (tests/test_drain).
+
+    def set_scan(self, scan) -> None:
+        """scan <-> pipelined for runs submitted from now on (the first
+        rung). Purely a dispatch-fusion choice: a scanned chunk threads the
+        exact per-wave carry chain on device, so stepping down (or back up)
+        mid-drain never changes an admitted set — only how many host
+        round-trips pay for it."""
+        self.scan = scan if self.use_exec_cache else None
 
     def set_retire_lag(self, lag: int | None) -> None:
         """pipeline <-> serial: where the host blocks, never what it binds."""
@@ -1015,6 +1435,7 @@ def drain_backlog(
     mesh=None,  # None | parallel.mesh.SolveLayout | parallel.mesh.MeshConfig
     faults=None,  # faults.FaultInjector; None = the process-installed one
     resilience=None,  # None | ResilienceConfig | DegradationLadder
+    scan=None,  # harvest="scan": ScanConfig (None = defaults)
 ) -> tuple[dict[str, dict[str, str]], DrainStats]:
     """Admit a whole backlog; returns ({gang: {pod: node}}, DrainStats).
 
@@ -1040,7 +1461,11 @@ def drain_backlog(
     "chained" batches every wave's fetch into one device_get; "wave" blocks
     per wave (serial; measured completion stamps); "pipeline" retires wave
     N-`depth` while wave N is in flight — measured stamps at near-chained
-    throughput. See the module docstring.
+    throughput; "scan" fuses each run of same-shape waves into ONE
+    device-side `lax.scan` (the `scan` ScanConfig governs chunking) — host
+    dispatches and harvest syncs drop to O(shape classes + escalations),
+    counted on DrainStats.dispatches/device_roundtrips. See the module
+    docstring.
 
     Candidate pruning (`pruning`, solver/pruning.py): each wave's solve runs
     on the gathered candidate sub-fleet; the fleet free carry chains on
@@ -1105,10 +1530,20 @@ def drain_backlog(
             mesh = None
         if not ladder.allows("pruning"):
             pruning = None
+        if harvest == "scan" and not ladder.allows("scan"):
+            harvest = "pipeline"  # scan -> pipelined: the first ladder rung
         if harvest == "pipeline" and not ladder.allows("pipeline"):
             harvest = "wave"
         if portfolio > 1 and not ladder.allows("portfolio"):
             portfolio = 1
+    scan_cfg = None
+    if harvest == "scan":
+        scan_cfg = scan if scan is not None else ScanConfig()
+        if not scan_cfg.enabled or portfolio > 1:
+            # Disabled config / portfolio closure (owns its own dispatch):
+            # same pipelined semantics, no device-side fusion.
+            harvest = "pipeline"
+            scan_cfg = None
     if pruning is not None and portfolio > 1:
         pruning = None  # portfolio solves own the node-axis layout
     if donate is None:
@@ -1147,7 +1582,7 @@ def drain_backlog(
     stats = DrainStats(
         gangs=len(gangs),
         harvest=harvest,
-        depth=depth if harvest == "pipeline" else 0,
+        depth=depth if harvest in ("pipeline", "scan") else 0,
         shard_fallbacks=shard_fallback,
     )
     if not gangs:
@@ -1158,7 +1593,9 @@ def drain_backlog(
 
     waves = plan_waves(gangs, wave_size)
 
-    retire_lag = {"chained": None, "wave": 0, "pipeline": depth}[harvest]
+    retire_lag = {"chained": None, "wave": 0, "pipeline": depth, "scan": depth}[
+        harvest
+    ]
     engine = _WavePipeline(
         gangs=gangs,
         pods_by_name=pods_by_name,
@@ -1172,12 +1609,24 @@ def drain_backlog(
         retire_lag=retire_lag,
         recorder=recorder,
         wave_prefix="drain",
-        record_stamps=harvest in ("wave", "pipeline"),
+        record_stamps=harvest in ("wave", "pipeline", "scan"),
         layout=layout,
         faults=faults,
         watchdog_s=watchdog_s,
         max_wave_retries=max_wave_retries,
+        scan=scan_cfg,
     )
+
+    # Consecutive same-(shape, pad) runs — plan_waves emits each class's
+    # waves contiguously within a rank, so this is the scan grouping.
+    def _class_runs(planned):
+        i = 0
+        while i < len(planned):
+            j = i
+            while j < len(planned) and planned[j][1:] == planned[i][1:]:
+                j += 1
+            yield planned[i:j]
+            i = j
 
     if warm:
         t0 = time.perf_counter()
@@ -1200,6 +1649,9 @@ def drain_backlog(
                     coarse_dmax=engine.dmax,
                 )
                 jax.block_until_ready(last.ok)
+        if harvest == "scan":
+            for run in _class_runs(waves):
+                engine.warm_scan(run)
         stats.compile_s = time.perf_counter() - t0
         # Prime the device->host path OUTSIDE both the compile and the timed
         # drain regions (first d2h in a process pays a ~0.5s relay setup that
@@ -1208,8 +1660,12 @@ def drain_backlog(
 
     t0 = time.perf_counter()
     engine.t0 = t0
-    for ws in waves:
-        engine.submit(ws)
+    if harvest == "scan":
+        for run in _class_runs(waves):
+            engine.submit_scan(run)
+    else:
+        for ws in waves:
+            engine.submit(ws)
     engine.flush()
     stats.total_s = time.perf_counter() - t0
     stats.exec_cache_hits = wp.executables.hits - exec0[0]

@@ -9,9 +9,14 @@ double-buffered pipeline engine as the drain (solver/drain._WavePipeline):
 while wave N solves on device, the host encodes wave N+1 from fresh arrivals
 and decodes/binds wave N-depth — the drain never syncs except at retirement.
 
-Two disciplines, one dispatch chain (identical admissions by construction —
+Three disciplines, one dispatch chain (identical admissions by construction —
 the chain is the same; test-pinned):
 
+  scan       pipeline + device-side fusion: consecutive same-shape-class
+             waves (across windows, saturated mode) dispatch as ONE
+             lax.scan chunk — O(shape classes) host round-trips instead of
+             O(waves). Window composition is untouched, so admitted sets
+             stay bitwise-equal to both baselines.
   pipeline   retire wave N-depth while wave N is in flight (the steady-state
              serving shape; ~chained-drain throughput, measured latencies)
   serial     retire every wave before forming the next (the wave-at-a-time
@@ -48,7 +53,13 @@ import time
 from dataclasses import dataclass, field
 
 from grove_tpu.solver.core import SolverParams
-from grove_tpu.solver.drain import DrainStats, WaveFault, _WavePipeline, plan_waves
+from grove_tpu.solver.drain import (
+    DrainStats,
+    ScanConfig,
+    WaveFault,
+    _WavePipeline,
+    plan_waves,
+)
 
 
 @dataclass(frozen=True)
@@ -145,6 +156,7 @@ def drain_stream(
     pruning=None,  # solver.pruning.PruningConfig; None/disabled = dense
     recorder=None,  # trace.recorder.TraceRecorder; journals committed waves
     pipeline: bool = True,  # False = wave-serial baseline
+    scan=None,  # None | True | ScanConfig: fuse same-class wave runs on device
     pace: bool = False,  # True = honor arrival offsets in wall time
     donate: bool | None = None,
     mesh=None,  # None | parallel.mesh.SolveLayout | parallel.mesh.MeshConfig
@@ -187,6 +199,19 @@ def drain_stream(
     `faults`: deterministic fault injector threaded through the engine's
     named sites (grove_tpu/faults) — chaos runs replay bit-for-bit.
 
+    `scan`: the on-device fused-drain discipline (requires `pipeline`).
+    True uses ScanConfig defaults; a ScanConfig tunes maxScanLen /
+    minWavesPerClass. In saturated mode the driver buffers CONSECUTIVE
+    same-shape-class planned waves across windows and dispatches each run
+    as lax.scan chunks through the engine (`submit_scan`) — window/wave
+    composition is untouched, only dispatch fuses, so admitted sets stay
+    bitwise-equal to the pipelined and serial baselines while host
+    round-trips drop to O(shape classes). Paced runs never hold an arrival
+    back for fusion (each window flushes), so pacing degenerates to the
+    pipelined discipline unless a single window plans a fusable run. Under
+    a ladder, "scan" is the FIRST rung: a failure steps the loop down to
+    per-wave pipelined dispatch (bitwise-equal), probation steps it back.
+
     `order_key`: optional key callable; when given, the backlog of queued
     arrivals is STABLE-sorted by it before each window is sliced, so e.g.
     a tenancy tier key (slo_rank, -priority) lets latency-class gangs jump
@@ -222,16 +247,26 @@ def drain_stream(
         if layout is None and requested:
             shard_fallback = 1
 
+    base_scan = None
+    if scan is not None and pipeline:
+        base_scan = ScanConfig() if scan is True else scan
+        if not base_scan.enabled:
+            base_scan = None
+
     gangs_all = [g for _, g in arrivals]
     stats = StreamStats(
         offered=len(gangs_all),
         depth=cfg.depth if pipeline else 0,
-        mode="pipeline" if pipeline else "serial",
+        mode=(
+            "scan"
+            if base_scan is not None
+            else ("pipeline" if pipeline else "serial")
+        ),
         paced=bool(pace),
     )
     dstats = stats.drain
     dstats.gangs = len(gangs_all)
-    dstats.harvest = "pipeline" if pipeline else "wave"
+    dstats.harvest = stats.mode if pipeline else "wave"
     dstats.depth = stats.depth
     dstats.shard_fallbacks = shard_fallback
     if not gangs_all:
@@ -250,11 +285,14 @@ def drain_stream(
     # Ladder-effective starting configuration + engine watchdog/retry arms.
     base_lag = cfg.depth if pipeline else 0
     base_layout, base_pruning = layout, pruning
+    scan_cfg = base_scan
     watchdog_s = None
     max_wave_retries = 0
     if ladder is not None:
         watchdog_s = ladder.config.watchdog_seconds
         max_wave_retries = ladder.config.max_wave_retries
+        if scan_cfg is not None and not ladder.allows("scan"):
+            scan_cfg = None
         if not ladder.allows("mesh"):
             layout = None
         if not ladder.allows("pruning"):
@@ -284,6 +322,7 @@ def drain_stream(
         faults=faults,
         watchdog_s=watchdog_s,
         max_wave_retries=max_wave_retries,
+        scan=scan_cfg,
     )
     engine_box.append(engine)
 
@@ -291,6 +330,8 @@ def drain_stream(
         """The rungs currently at full config — the ones a new failure can
         step down (ladder attribution order is resilience.SUBSYSTEMS)."""
         active = []
+        if engine.scan is not None:
+            active.append("scan")
         if engine.layout is not None:
             active.append("mesh")
         if engine.pruning is not None:
@@ -319,6 +360,7 @@ def drain_stream(
         except WaveFault as e:
             if e.fatal:
                 raise
+        engine.set_scan(base_scan if ladder.allows("scan") else None)
         engine.set_pruning(
             base_pruning if ladder.allows("pruning") else None
         )
@@ -369,6 +411,49 @@ def drain_stream(
                 _charge(e)
         _retire_down(to_lag=True)
 
+    # Fusion buffer: consecutive same-shape-class planned waves awaiting a
+    # scanned dispatch. Only ever non-empty while engine.scan is armed and
+    # the loop is saturated; buffered waves are NOT in flight yet, so the
+    # final flush below owns draining it before retirement.
+    run_buf: list = []
+
+    def _submit_run(run: list) -> None:
+        """Dispatch a same-class run fused (`submit_scan`); a failure past
+        the engine's retry budget charges the ladder (the "scan" rung goes
+        first) and resubmits exactly the not-yet-enqueued tail — per-wave
+        once the rung is open — so arrivals are never dropped and the
+        dispatch order matches the per-wave disciplines bitwise."""
+        pending = run
+        while pending:
+            if ladder is not None:
+                _reconcile_ladder()
+            if engine.scan is None or len(pending) < max(
+                1, int(engine.scan.min_waves_per_class)
+            ):
+                for ws in pending:
+                    _submit(ws)
+                return
+            try:
+                tc = time.perf_counter()
+                warmed = engine.warm_shape(pending[0])
+                warmed = engine.warm_scan(pending) or warmed
+                if warmed:
+                    dstats.compile_s += time.perf_counter() - tc
+                engine.submit_scan(pending, retire=False)
+                if ladder is not None:
+                    ladder.record_success()
+                pending = []
+            except WaveFault as e:
+                rest = e.pending if e.pending is not None else pending
+                _charge(e)  # raises when no ladder / bottom of the ladder
+                pending = rest
+            _retire_down(to_lag=True)
+
+    def _flush_run() -> None:
+        if run_buf:
+            run, run_buf[:] = list(run_buf), []
+            _submit_run(run)
+
     t0 = time.perf_counter()
     engine.t0 = t0
     queue: list = []
@@ -403,8 +488,24 @@ def drain_stream(
                 queue.sort(key=order_key)  # stable: FIFO within equal keys
             window, queue = queue[: cfg.wave_size], queue[cfg.wave_size :]
             stats.windows += 1
-            for ws in plan_waves(window, cfg.wave_size):
-                _submit(ws)
+            planned = plan_waves(window, cfg.wave_size)
+            if engine.scan is not None and not pace:
+                # Saturated scan: buffer consecutive same-class waves across
+                # windows; a class change (or a full chunk) flushes the run
+                # as one scanned dispatch. Composition untouched — only WHEN
+                # the host dispatches changes, never what a wave contains.
+                for ws in planned:
+                    if run_buf and (
+                        run_buf[0][1:] != ws[1:]
+                        or len(run_buf)
+                        >= max(1, int(engine.scan.max_scan_len))
+                    ):
+                        _flush_run()
+                    run_buf.append(ws)
+            else:
+                _flush_run()  # scan stepped down (or paced): drain the buffer
+                for ws in planned:
+                    _submit(ws)
         elif pace:
             if engine.inflight:
                 # Host idle until the next arrival: retire the oldest
@@ -416,6 +517,7 @@ def drain_stream(
             else:
                 next_due = (t0 + arrivals[i][0]) if i < n else now
                 time.sleep(min(cfg.poll_s, max(0.0, next_due - now)))
+    _flush_run()  # trace exhausted: dispatch any run still buffering
     _retire_down(to_lag=False)
     stats.wall_s = time.perf_counter() - t0
     dstats.total_s = stats.wall_s

@@ -107,6 +107,17 @@ def _jitted_solve(donate: bool, layout=None):
         return _JITTED[key]
 
 
+def _jitted_scan(pruned: bool, retain: bool, donate: bool, layout=None):
+    """jitted device-side wave scan (core.scan_solve_fn /
+    scan_pruned_solve_fn) — already memoized process-wide per (pruned,
+    retain, donate, layout key) in core._SCAN_JIT, so every ExecutableCache
+    lowers through the one traced function, like _jitted_solve."""
+    from grove_tpu.solver.core import scan_pruned_solve_fn, scan_solve_fn
+
+    fn = scan_pruned_solve_fn if pruned else scan_solve_fn
+    return fn(layout, retain=retain, donate=donate)
+
+
 def donation_default() -> bool:
     """Donate the wave carry by default on accelerators only: CPU PJRT
     ignores donation (harmless but pointless), and keeping the CPU default
@@ -152,7 +163,7 @@ def _canon(
 
 def _exec_key(
     args: tuple, coarse_dmax: Optional[int], donate: bool, layout=None,
-    stacked: bool = False,
+    stacked: bool = False, scan: Optional[tuple] = None,
 ) -> tuple:
     """Full executable identity: pytree structure (covers optional-feature
     presence) + every leaf's (shape, dtype) (covers node pad, gang pad,
@@ -160,13 +171,18 @@ def _exec_key(
     stacked variant, K via the params leaf shapes) + the statics + the mesh
     layout (a sharded executable demands its input layout — an unsharded
     solve of the same shapes must never alias to it) + the stacked flag (a
-    K-stacked solve and a portfolio-shaped single solve must never alias)."""
+    K-stacked solve and a portfolio-shaped single solve must never alias) +
+    the scan tag (("dense"|"pruned", retain) for the device-side wave scan —
+    the scan LENGTH bucket rides in on the stacked batch leaf shapes, but
+    retain changes the output arity without changing any input aval, so it
+    must be in the key explicitly)."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(args)
     return (
         bool(donate),
         bool(stacked),
+        scan,
         coarse_dmax,
         None if layout is None else layout.key(),
         str(treedef),
@@ -286,6 +302,188 @@ def _args_from_desc(desc: dict, layout=None) -> tuple:
     )
 
 
+def _canon_scan(
+    free0, capacity, schedulable, node_domain_id, stacked_batch, params,
+    ok_global, layout=None,
+):
+    """_canon for the device-side wave scan: node tensors + ok_global are
+    per-class (unstacked), the GangBatch leaves carry the leading [W] wave
+    axis and stay replicated under a mesh layout (the node-sharded thing is
+    the CARRY; the per-wave gang tensors are small)."""
+    import jax
+    import jax.numpy as jnp
+
+    free0 = jnp.asarray(free0, jnp.float32)
+    capacity = jnp.asarray(capacity, jnp.float32)
+    schedulable = jnp.asarray(schedulable, bool)
+    node_domain_id = jnp.asarray(node_domain_id, jnp.int32)
+    batch = GangBatch(
+        *(None if x is None else jnp.asarray(x) for x in stacked_batch)
+    )
+    params = SolverParams(*(jnp.asarray(w, jnp.float32) for w in params))
+    ok_global = jnp.asarray(ok_global, bool)
+    if layout is not None:
+        nsh, rep = layout.node_sharding, layout.replicated()
+        free0 = jax.device_put(free0, nsh(0, 2))
+        capacity = jax.device_put(capacity, nsh(0, 2))
+        schedulable = jax.device_put(schedulable, nsh(0, 1))
+        node_domain_id = jax.device_put(node_domain_id, nsh(1, 2))
+        batch = GangBatch(
+            *(None if x is None else jax.device_put(x, rep) for x in batch)
+        )
+        params = SolverParams(*(jax.device_put(w, rep) for w in params))
+        ok_global = jax.device_put(ok_global, rep)
+    return free0, capacity, schedulable, node_domain_id, batch, params, ok_global
+
+
+def _canon_scan_pruned(
+    free0, cand_idx, capacity_p, schedulable_p, node_domain_id_p,
+    stacked_batch, params, ok_global, layout=None,
+):
+    """_canon for the pruned wave scan: the fleet free carry is dense (and
+    node-sharded under a layout); the per-wave gather maps, pruned node
+    tensors, and batch leaves all carry the leading [W] axis."""
+    import jax
+    import jax.numpy as jnp
+
+    free0 = jnp.asarray(free0, jnp.float32)
+    cand_idx = jnp.asarray(cand_idx, jnp.int32)
+    capacity_p = jnp.asarray(capacity_p, jnp.float32)
+    schedulable_p = jnp.asarray(schedulable_p, bool)
+    node_domain_id_p = jnp.asarray(node_domain_id_p, jnp.int32)
+    batch = GangBatch(
+        *(None if x is None else jnp.asarray(x) for x in stacked_batch)
+    )
+    params = SolverParams(*(jnp.asarray(w, jnp.float32) for w in params))
+    ok_global = jnp.asarray(ok_global, bool)
+    if layout is not None:
+        rep = layout.replicated()
+        free0 = jax.device_put(free0, layout.node_sharding(0, 2))
+        cand_idx = jax.device_put(cand_idx, rep)
+        capacity_p = jax.device_put(capacity_p, rep)
+        schedulable_p = jax.device_put(schedulable_p, rep)
+        node_domain_id_p = jax.device_put(node_domain_id_p, rep)
+        batch = GangBatch(
+            *(None if x is None else jax.device_put(x, rep) for x in batch)
+        )
+        params = SolverParams(*(jax.device_put(w, rep) for w in params))
+        ok_global = jax.device_put(ok_global, rep)
+    return (
+        free0, cand_idx, capacity_p, schedulable_p, node_domain_id_p, batch,
+        params, ok_global,
+    )
+
+
+def _scan_avals(args, scan_len: int, layout=None) -> tuple:
+    """Single-wave canonical solver args -> abstract scan arguments: the
+    GangBatch leaves gain a leading [scan_len] axis, node tensors and
+    ok_global pass through shape-identical. Good for `jit.lower` (the warm
+    pre-pass compiles the scan executable without stacking any real data)."""
+    import jax
+
+    free0, capacity, schedulable, node_domain_id, batch, params, ok_global = args
+    rep = None if layout is None else layout.replicated()
+
+    def nsh(axis, ndim):
+        return None if layout is None else layout.node_sharding(axis, ndim)
+
+    def plain(x, sh=None):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype, sharding=sh)
+
+    def stack(x):
+        if x is None:
+            return None
+        return jax.ShapeDtypeStruct(
+            (int(scan_len),) + tuple(x.shape), x.dtype, sharding=rep
+        )
+
+    return (
+        plain(free0, nsh(0, 2)),
+        plain(capacity, nsh(0, 2)),
+        plain(schedulable, nsh(0, 1)),
+        plain(node_domain_id, nsh(1, 2)),
+        GangBatch(*(stack(x) for x in batch)),
+        SolverParams(*(plain(w, rep) for w in params)),
+        plain(ok_global, rep),
+    )
+
+
+def _scan_pruned_avals(args, fleet_shape: tuple, scan_len: int, layout=None) -> tuple:
+    """Single-wave canonical PRUNED solver args (candidate axis) + the dense
+    fleet-carry shape -> abstract scan-pruned arguments for `jit.lower`."""
+    import jax
+    import jax.numpy as jnp
+
+    _free_p, capacity_p, schedulable_p, node_domain_id_p, batch, params, ok_global = args
+    w = int(scan_len)
+    rep = None if layout is None else layout.replicated()
+
+    def plain(x, sh=None):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype, sharding=sh)
+
+    def stack(x):
+        if x is None:
+            return None
+        return jax.ShapeDtypeStruct((w,) + tuple(x.shape), x.dtype, sharding=rep)
+
+    cand_pad = int(capacity_p.shape[0])
+    free_sh = None if layout is None else layout.node_sharding(0, 2)
+    return (
+        jax.ShapeDtypeStruct(tuple(fleet_shape), jnp.float32, sharding=free_sh),
+        jax.ShapeDtypeStruct((w, cand_pad), jnp.int32, sharding=rep),
+        stack(capacity_p),
+        stack(schedulable_p),
+        stack(node_domain_id_p),
+        GangBatch(*(stack(x) for x in batch)),
+        SolverParams(*(plain(p, rep) for p in params)),
+        plain(ok_global, rep),
+    )
+
+
+def _scan_desc(
+    args: tuple, coarse_dmax: Optional[int], donate: bool, layout, scan: tuple
+) -> Optional[dict]:
+    """Prewarm history descriptor for a DENSE scan signature: the per-wave
+    shape-bucket fields (leading wave axis stripped) + the scan length and
+    retain flag. Pruned scans are not recorded — their per-wave candidate
+    gather maps are backlog-specific, so a historical descriptor could not
+    reconstruct them."""
+    if scan[0] != "dense":
+        return None
+    free0, _, _, node_domain_id, batch, params, ok_global = args
+    if params[0].ndim != 0:
+        return None
+    n, r = free0.shape
+    return {
+        "mesh": None
+        if layout is None
+        else [layout.portfolio_devices, layout.node_devices],
+        "n": int(n),
+        "r": int(r),
+        "levels": int(node_domain_id.shape[0]),
+        "g": int(batch.gang_valid.shape[1]),
+        "mg": int(batch.group_req.shape[2]),
+        "ms": int(batch.set_member.shape[2]),
+        "mp": int(batch.pod_group.shape[2]),
+        "t": int(ok_global.shape[0]),
+        "reuse": batch.reuse_nodes is not None,
+        "node_ok": batch.group_node_ok is not None,
+        "spread": batch.spread_level is not None,
+        "coarse_dmax": coarse_dmax,
+        "donate": bool(donate),
+        "portfolio": 1,
+        "scan": int(batch.gang_valid.shape[0]),
+        "retain": bool(scan[1]),
+    }
+
+
+def _scan_args_from_desc(desc: dict, layout=None) -> tuple:
+    """Scan descriptor -> abstract scan arguments (the single-wave avals
+    from _args_from_desc with the batch leaves stacked to [scan])."""
+    args = _args_from_desc(desc, layout)
+    return _scan_avals(args, int(desc["scan"]), layout)
+
+
 class ExecutableCache:
     """In-process AOT executable cache for the batched solver.
 
@@ -372,6 +570,83 @@ class ExecutableCache:
         )
         return compiled(*args)
 
+    def solve_scan(
+        self,
+        free0,
+        capacity,
+        schedulable,
+        node_domain_id,
+        stacked_batch: GangBatch,  # each leaf [W, ...]
+        params: SolverParams = SolverParams(),
+        ok_global=None,
+        *,
+        coarse_dmax: Optional[int] = None,
+        retain: bool = False,
+        donate: bool = False,
+        layout=None,
+    ):
+        """core.scan_solve_fn through the AOT cache: a whole shape-class of
+        waves dispatched as ONE executable, the (free, ok_global) carry
+        threaded on-device. Returns a ScanSolveResult (verdict planes stacked
+        on the leading [W] wave axis). The cache keys on the scan length via
+        the stacked leaf shapes plus the ("dense", retain) scan tag."""
+        args = _canon_scan(
+            free0, capacity, schedulable, node_domain_id, stacked_batch,
+            params, ok_global, layout=layout,
+        )
+        compiled = self._get_or_compile(
+            args, coarse_dmax, donate, layout, scan=("dense", bool(retain))
+        )
+        return compiled(*args)
+
+    def solve_scan_pruned(
+        self,
+        free0,  # DENSE fleet carry [N, R]
+        cand_idx,  # i32 [W, CP] per-wave padded gather maps
+        capacity_p,  # f32 [W, CP, R]
+        schedulable_p,  # bool [W, CP]
+        node_domain_id_p,  # i32 [W, L, CP]
+        stacked_batch: GangBatch,  # candidate-axis leaves, each [W, ...]
+        params: SolverParams = SolverParams(),
+        ok_global=None,
+        *,
+        coarse_dmax: Optional[int] = None,
+        retain: bool = False,
+        donate: bool = False,
+        layout=None,
+    ):
+        """core.scan_pruned_solve_fn through the AOT cache: per scan step the
+        fleet carry is gathered onto that wave's candidate axis, solved, and
+        scattered back — the dense fleet free is what threads on-device."""
+        args = _canon_scan_pruned(
+            free0, cand_idx, capacity_p, schedulable_p, node_domain_id_p,
+            stacked_batch, params, ok_global, layout=layout,
+        )
+        compiled = self._get_or_compile(
+            args, coarse_dmax, donate, layout, scan=("pruned", bool(retain))
+        )
+        return compiled(*args)
+
+    def ensure_compiled_scan(
+        self,
+        avals: tuple,  # from _scan_avals / _scan_pruned_avals
+        *,
+        coarse_dmax: Optional[int] = None,
+        retain: bool = False,
+        donate: bool = False,
+        layout=None,
+        pruned: bool = False,
+    ) -> bool:
+        """Compile-only warm-up of a scan executable from abstract arguments
+        (the drain's warm pre-pass knows the per-wave shapes and scan length
+        before any data is stacked). Returns True when this paid a lowering."""
+        before = self.lowerings
+        self._get_or_compile(
+            avals, coarse_dmax, donate, layout,
+            scan=("pruned" if pruned else "dense", bool(retain)),
+        )
+        return self.lowerings != before
+
     def ensure_compiled(
         self,
         free0,
@@ -399,9 +674,9 @@ class ExecutableCache:
 
     def _get_or_compile(
         self, args: tuple, coarse_dmax, donate: bool, layout=None,
-        stacked: bool = False,
+        stacked: bool = False, scan: Optional[tuple] = None,
     ):
-        key = _exec_key(args, coarse_dmax, donate, layout, stacked)
+        key = _exec_key(args, coarse_dmax, donate, layout, stacked, scan)
         while True:
             with self._lock:
                 compiled = self._entries.get(key)
@@ -413,7 +688,7 @@ class ExecutableCache:
             if compiled is not None:
                 self.hits += 1
                 if not stacked:
-                    self._record(args, coarse_dmax, donate, layout, new=False)
+                    self._record(args, coarse_dmax, donate, layout, new=False, scan=scan)
                 return compiled
             if pending is None:
                 break
@@ -424,7 +699,12 @@ class ExecutableCache:
             pending.wait()
         try:
             self.lowerings += 1
-            jitted = _jitted_stacked() if stacked else _jitted_solve(donate, layout)
+            if stacked:
+                jitted = _jitted_stacked()
+            elif scan is not None:
+                jitted = _jitted_scan(scan[0] == "pruned", scan[1], donate, layout)
+            else:
+                jitted = _jitted_solve(donate, layout)
             compiled = (
                 jitted.lower(*args, coarse_dmax=coarse_dmax).compile()
             )
@@ -437,17 +717,21 @@ class ExecutableCache:
             if ev is not None:
                 ev.set()
         if not stacked:
-            self._record(args, coarse_dmax, donate, layout, new=True)
+            self._record(args, coarse_dmax, donate, layout, new=True, scan=scan)
         return compiled
 
     # ---- shape history + prewarm -------------------------------------------
 
     def _record(
-        self, args: tuple, coarse_dmax, donate: bool, layout=None, *, new: bool
+        self, args: tuple, coarse_dmax, donate: bool, layout=None, *,
+        new: bool, scan: Optional[tuple] = None,
     ) -> None:
         if not self.history_path:
             return
-        desc = _exec_desc(args, coarse_dmax, donate, layout)
+        if scan is not None:
+            desc = _scan_desc(args, coarse_dmax, donate, layout, scan)
+        else:
+            desc = _exec_desc(args, coarse_dmax, donate, layout)
         if desc is None:
             return
         hkey = json.dumps(desc, sort_keys=True)
@@ -506,10 +790,15 @@ class ExecutableCache:
                 continue
             try:
                 layout = _layout_from_desc(desc)
-                args = _args_from_desc(desc, layout)
+                scan = None
+                if desc.get("scan"):
+                    scan = ("dense", bool(desc.get("retain", False)))
+                    args = _scan_args_from_desc(desc, layout)
+                else:
+                    args = _args_from_desc(desc, layout)
                 key = _exec_key(
                     args, desc.get("coarse_dmax"), desc.get("donate", False),
-                    layout,
+                    layout, scan=scan,
                 )
                 with self._lock:
                     if key in self._entries:
@@ -527,8 +816,14 @@ class ExecutableCache:
                     continue
                 try:
                     self.lowerings += 1
+                    if scan is not None:
+                        jitted = _jitted_scan(
+                            False, scan[1], bool(desc.get("donate", False)), layout
+                        )
+                    else:
+                        jitted = _jitted_solve(bool(desc.get("donate", False)), layout)
                     exe = (
-                        _jitted_solve(bool(desc.get("donate", False)), layout)
+                        jitted
                         .lower(*args, coarse_dmax=desc.get("coarse_dmax"))
                         .compile()
                     )
@@ -890,9 +1185,19 @@ class WarmPath:
     # histogram. Bounded: a stream outrunning the scrape loses oldest
     # samples, never memory.
     stream_bind_samples: object = None  # collections.deque, lazy
+    # Cumulative round-trip ledger across EVERY drain/stream through this
+    # warm path — all harvest disciplines (chained/wave/pipeline/scan) and
+    # both drivers feed it through record_drain uniformly, so the
+    # grove_drain_device_roundtrips_total counter (manager delta export)
+    # never under-counts when several drains land between scrapes or the
+    # resilience ladder changes the discipline mid-run.
+    drain_dispatches_total: int = 0
+    drain_device_roundtrips_total: int = 0
 
     def record_drain(self, stats) -> None:
         """Fold one DrainStats into the observable surface."""
+        self.drain_dispatches_total += stats.dispatches
+        self.drain_device_roundtrips_total += stats.device_roundtrips
         doc = {
             "drainWaves": stats.waves,
             "drainGangs": stats.gangs,
@@ -941,6 +1246,10 @@ class WarmPath:
         except Exception:  # noqa: BLE001 — stats must never fail a scrape
             pass
         out.update(self.last_drain)
+        # Cumulative (NOT last-drain) round-trip totals — the counter
+        # sources; the last_drain doc above carries the per-drain numbers.
+        out["dispatchesTotal"] = self.drain_dispatches_total
+        out["deviceRoundtripsTotal"] = self.drain_device_roundtrips_total
         return out
 
 

@@ -13,8 +13,15 @@ Knobs (flags, env-free so the harness composes with the bench env):
   --racks N        racks per block for the synthetic fleet (default 16)
   --backlog-frac F scales the gang backlog (default 0.5)
   --wave-size N    drain wave size (default 256)
+  --harvest MODE   drain discipline to profile (pipeline|scan|wave|chained)
   --top N          frames to keep (default 40)
   --out PATH       output JSON (default evidence/profile_host_<utc>.json)
+
+The document also reports the round-trip ledger (`dispatches`,
+`device_roundtrips`, `waves`) so the host-participation claim of the
+scanned drain — O(shape classes + escalations) host syncs instead of
+O(waves) — is part of the same diffable artifact (profile the two
+disciplines back to back with --harvest).
 """
 
 from __future__ import annotations
@@ -84,6 +91,11 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--backlog-frac", type=float, default=0.5)
     ap.add_argument("--wave-size", type=int, default=256)
     ap.add_argument("--prune-min-fleet", type=int, default=256)
+    ap.add_argument(
+        "--harvest",
+        choices=("pipeline", "scan", "wave", "chained"),
+        default="pipeline",
+    )
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--out", default="")
     args = ap.parse_args(argv)
@@ -101,14 +113,14 @@ def main(argv: list[str] | None = None) -> dict:
     drain_backlog(
         gangs, pods, snapshot, wave_size=args.wave_size,
         params=SolverParams(), warm_path=wp, pruning=pruning,
-        harvest="pipeline",
+        harvest=args.harvest,
     )
     pr = cProfile.Profile()
     pr.enable()
     _, stats = drain_backlog(
         gangs, pods, snapshot, wave_size=args.wave_size,
         params=SolverParams(), warm_path=wp, pruning=pruning,
-        harvest="pipeline",
+        harvest=args.harvest,
     )
     pr.disable()
 
@@ -121,7 +133,16 @@ def main(argv: list[str] | None = None) -> dict:
         "wave_size": args.wave_size,
         "gangs": len(gangs),
         "nodes": int(snapshot.capacity.shape[0]),
+        "harvest": args.harvest,
         "admitted": stats.admitted,
+        # Round-trip ledger: the scanned drain's host participation is
+        # O(shape classes + escalations) syncs; the per-wave disciplines
+        # pay one per wave.
+        "waves": stats.waves,
+        "dispatches": stats.dispatches,
+        "device_roundtrips": stats.device_roundtrips,
+        "scan_chunks": stats.scan_chunks,
+        "scanned_waves": stats.scanned_waves,
         "host_stages": stats.host_stages(),
         "top_frames": _top_frames(pr, args.top),
     }

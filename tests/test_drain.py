@@ -131,22 +131,25 @@ def test_plan_waves_pad_clamps_small_classes():
 
 def test_plan_waves_non_pow2_wave_size():
     """wave_size=48 (non-pow2): full waves pad to max(32, next_pow2(48))=64;
-    a trailing remainder either clamps to its own pow2 (when that is a new
-    executable shape anyway) or keeps the class's full-wave pad (when the
-    floored pad would equal it and can share the compiled executable). The
-    remainder-pad clamp vs full-wave floor interaction was previously only
-    pinned for pow2 sizes."""
+    a trailing remainder of a class that HAS full waves canonicalizes up to
+    the class pad — one executable (and one scan group) for the whole class
+    instead of splintering the remainder onto its own smaller pad. Only a
+    single-wave class clamps to its own pow2."""
     from grove_tpu.solver.encode import next_pow2
 
     full_pad = max(32, next_pow2(48))
     assert full_pad == 64
 
-    # 100 frontend gangs of one shape class: 48 + 48 + remainder 4.
+    # 100 frontend gangs of one shape class: 48 + 48 + remainder 4. The
+    # remainder rides the 64-slot class executable (previously it compiled
+    # its own 4-slot program — shape-class fragmentation).
     gangs, _, _ = _setup(n_disagg=0, n_agg=0, n_frontend=100)
     frontend = [g for g in gangs if g.base_podgang_name is None]
     waves = plan_waves(frontend, wave_size=48)
     sizes_pads = [(len(w), pad) for w, _, pad in waves]
-    assert sizes_pads == [(48, 64), (48, 64), (4, 4)], sizes_pads
+    assert sizes_pads == [(48, 64), (48, 64), (4, 64)], sizes_pads
+    # ONE executable shape for the whole class.
+    assert len({(ws[1], ws[2]) for ws in waves}) == 1
 
     # Remainder of 33..48 floors to 64 == the class full-wave pad: it must
     # KEEP the floor and share the already-compiled 64-slot executable.

@@ -1,0 +1,316 @@
+"""On-device fused drain (solver/drain.py harvest="scan" + stream scan).
+
+The contract under test, strongest first:
+
+1. BITWISE PARITY — the scanned drain admits the IDENTICAL bindings as the
+   per-wave serial baseline on the tier-1 scenarios (uncontended,
+   capacity-shortfall, contended trap-blocks incl. pruned + mesh-sharded):
+   a scan chunk threads the exact per-wave carry chain on device, so fusion
+   is a pure dispatch choice.
+2. ROUND-TRIP LEDGER — dispatches and host-blocking harvest syncs are
+   COUNTED and drop to O(shape-class chunks + escalations) under scan,
+   versus O(waves) per-wave; the warm path accumulates both cumulatively
+   for the grove_drain_device_roundtrips_total counter.
+3. ESCALATION — retire-time exactness escalation (CONFIRM and ADOPT) is
+   unchanged mid-scan: lossy-pruned scanned waves re-solve dense from the
+   journaled per-step carry and re-chain.
+4. REPLAY — scanned drains journal PER LOGICAL WAVE; the journal replays
+   bitwise standalone (the replayer never needs the scan executable).
+5. CACHE — a second same-shape scanned drain pays ZERO new XLA lowerings.
+6. LADDER — "scan" is the first resilience rung: an open breaker steps the
+   drain down to pipelined dispatch, bindings unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from grove_tpu.orchestrator import expand_podcliqueset
+from grove_tpu.sim.workloads import (
+    bench_topology,
+    contended_backlog,
+    contended_cluster,
+    synthetic_backlog,
+    synthetic_cluster,
+)
+from grove_tpu.solver.drain import ScanConfig, drain_backlog
+from grove_tpu.solver.pruning import PruningConfig
+from grove_tpu.solver.warm import WarmPath
+from grove_tpu.state import build_snapshot
+
+TOPO = bench_topology()
+
+
+def _expand(backlog):
+    gangs, pods = [], {}
+    for pcs in backlog:
+        ds = expand_podcliqueset(pcs, TOPO)
+        gangs.extend(ds.podgangs)
+        pods.update({p.name: p for p in ds.pods})
+    return gangs, pods
+
+
+def _setup(racks=6, nd=10, na=14, nf=12):
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=racks)
+    gangs, pods = _expand(
+        synthetic_backlog(n_disagg=nd, n_agg=na, n_frontend=nf)
+    )
+    return gangs, pods, build_snapshot(nodes, TOPO)
+
+
+# --- bitwise parity + the round-trip ledger -----------------------------------
+
+
+def test_scan_drain_bitwise_parity_and_roundtrip_ledger():
+    """Scanned bindings == serial bindings EXACTLY (same dict, not just the
+    admitted set), and the ledger arithmetic is pinned: one dispatch and one
+    harvest sync per scan chunk, one of each per unfused wave."""
+    gangs, pods, snap = _setup()
+    bs, ss = drain_backlog(gangs, pods, snap, wave_size=4, harvest="wave")
+    bk, sk = drain_backlog(gangs, pods, snap, wave_size=4, harvest="scan")
+    assert bk == bs
+    assert sk.admitted == ss.admitted
+    assert sk.scanned_waves > 0 and sk.scan_chunks > 0
+    # Serial pays one dispatch + one sync per wave.
+    assert ss.dispatches == ss.waves
+    assert ss.device_roundtrips == ss.waves
+    # Scan pays per chunk; unfused (short-run) waves stay per-wave.
+    unfused = sk.waves - sk.scanned_waves
+    assert sk.dispatches == sk.scan_chunks + unfused + sk.escalations
+    assert sk.device_roundtrips == sk.scan_chunks + unfused + sk.escalations
+    assert sk.device_roundtrips < ss.device_roundtrips
+    # The ledger is part of the host-stage doc (statusz/bench surface).
+    doc = sk.host_stages()
+    assert doc["dispatches"] == sk.dispatches
+    assert doc["deviceRoundtrips"] == sk.device_roundtrips
+    assert doc["scanChunks"] == sk.scan_chunks
+    assert doc["scannedWaves"] == sk.scanned_waves
+
+
+def test_scan_drain_parity_under_capacity_shortfall():
+    """A fleet too small for the backlog: real rejections flow through the
+    scanned ok_global chain exactly as through the per-wave chain."""
+    gangs, pods, snap = _setup(racks=1, nd=10, na=10, nf=10)
+    bs, ss = drain_backlog(gangs, pods, snap, wave_size=4, harvest="wave")
+    bk, sk = drain_backlog(gangs, pods, snap, wave_size=4, harvest="scan")
+    assert len(bs) < len(gangs), "scenario must carry real rejections"
+    assert bk == bs
+    assert sk.scanned_waves > 0
+
+
+def test_scan_drain_parity_contended_trap_blocks_pruned_and_meshed():
+    """Tier-1 contended scenario under the full fast path — candidate
+    pruning AND the 8-virtual-device mesh — scanned vs per-wave."""
+    from grove_tpu.parallel.mesh import MeshConfig
+
+    cn, csq = contended_cluster()
+    gangs, pods = _expand(contended_backlog(n_gangs=48))
+    snap = build_snapshot(cn, TOPO, bound_pods=csq)
+    cfg = PruningConfig(enabled=True, max_candidates=48, min_fleet=16, min_pad=8)
+    mesh = MeshConfig(enabled=True, min_nodes=16)
+    kw = dict(wave_size=8, pruning=cfg, mesh=mesh, warm_path=WarmPath())
+    bs, ss = drain_backlog(gangs, pods, snap, harvest="wave", **kw)
+    bk, sk = drain_backlog(gangs, pods, snap, harvest="scan", **kw)
+    assert set(bk) == set(bs)
+    assert sk.admitted == ss.admitted
+    assert len(bs) < len(gangs), "scenario must carry real rejections"
+    assert sk.scanned_waves > 0
+
+
+# --- retire-time escalation through scanned chunks ----------------------------
+
+
+def test_scan_escalation_confirms_dense_rejections():
+    """Lossy-pruned scanned waves escalate at retirement; on the contended
+    scenario the dense re-solve CONFIRMS the genuine rejections — the
+    admitted set equals the dense drain's, nothing flips."""
+    cn, csq = contended_cluster()
+    gangs, pods = _expand(contended_backlog(n_gangs=48))
+    snap = build_snapshot(cn, TOPO, bound_pods=csq)
+    bd, _ = drain_backlog(gangs, pods, snap, wave_size=8, warm_path=WarmPath())
+    cfg = PruningConfig(enabled=True, max_candidates=32, min_fleet=16, min_pad=8)
+    bk, sk = drain_backlog(
+        gangs, pods, snap, wave_size=8, harvest="scan", pruning=cfg,
+        warm_path=WarmPath(),
+    )
+    assert set(bk) == set(bd)
+    assert sk.scanned_waves > 0
+    assert sk.escalations >= 1
+    assert len(bk) < len(gangs)
+
+
+def test_scan_escalation_adopts_dense_verdicts_mid_scan():
+    """A clipped budget strands gangs the dense fleet would admit: the
+    mid-scan escalation ADOPTS the dense verdicts from the journaled
+    per-step carry and re-chains the rest — final set equals dense, and
+    each escalation is a counted extra dispatch + sync."""
+    nodes = synthetic_cluster(zones=1, blocks_per_zone=1, racks_per_block=2)
+    gangs, pods = _expand(
+        synthetic_backlog(n_disagg=10, n_agg=10, n_frontend=10)
+    )
+    snap = build_snapshot(nodes, TOPO)
+    bd, _ = drain_backlog(gangs, pods, snap, wave_size=8, warm_path=WarmPath())
+    cfg = PruningConfig(enabled=True, max_candidates=24, min_fleet=16, min_pad=8)
+    bk, sk = drain_backlog(
+        gangs, pods, snap, wave_size=8, harvest="scan", pruning=cfg,
+        warm_path=WarmPath(),
+    )
+    assert set(bk) == set(bd)
+    assert sk.scanned_waves > 0
+    assert sk.escalations >= 1
+    assert sk.escalations_adopted >= 1
+    # Adoption re-chains the waves still in flight per-wave — each a
+    # counted dispatch on top of the chunk + escalation baseline.
+    unfused = sk.waves - sk.scanned_waves
+    assert sk.dispatches >= sk.scan_chunks + unfused + sk.escalations
+
+
+# --- flight-recorder replay ---------------------------------------------------
+
+
+def test_scanned_journal_replays_bitwise_per_logical_wave(tmp_path):
+    """The scanned drain journals one record per LOGICAL wave (never per
+    chunk) carrying the exact entering carry; the journal replays standalone
+    with zero divergences — the replayer re-solves per wave and never needs
+    the scan executable."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_journal
+    from grove_tpu.trace.replay import replay_journal
+
+    gangs, pods, snap = _setup()
+    rec = TraceRecorder(str(tmp_path / "journal"))
+    rec.start()
+    try:
+        _, sk = drain_backlog(
+            gangs, pods, snap, wave_size=4, harvest="scan", recorder=rec,
+        )
+    finally:
+        rec.stop()
+    assert sk.scanned_waves > 0
+    assert sk.journaled_waves == sk.waves
+    records = read_journal(str(tmp_path / "journal"))
+    assert sum(1 for r in records if r.get("kind") == "wave") == sk.waves
+    assert replay_journal(records).divergence_count == 0
+
+
+# --- executable-cache keying --------------------------------------------------
+
+
+def test_second_scanned_drain_pays_zero_lowerings():
+    gangs, pods, snap = _setup()
+    wp = WarmPath()
+    b1, s1 = drain_backlog(
+        gangs, pods, snap, wave_size=4, harvest="scan", warm_path=wp
+    )
+    assert s1.scanned_waves > 0 and s1.lowerings > 0
+    b2, s2 = drain_backlog(
+        gangs, pods, snap, wave_size=4, harvest="scan", warm_path=wp
+    )
+    assert b2 == b1
+    assert s2.scanned_waves == s1.scanned_waves
+    assert s2.lowerings == 0, "same-shape scanned drain re-lowered"
+
+
+# --- streaming driver ---------------------------------------------------------
+
+
+def test_stream_scan_fuses_across_windows_with_identical_bindings():
+    """Saturated streaming under scan: window/wave composition is untouched
+    (same plan_waves per window), consecutive same-class waves fuse ACROSS
+    windows, and bindings match both per-wave disciplines exactly."""
+    from grove_tpu.solver.stream import StreamConfig, drain_stream
+
+    gangs, pods, snap = _setup()
+    arrivals = [(0.0, g) for g in gangs]
+    cfg = StreamConfig(wave_size=4)
+    bp, sp = drain_stream(arrivals, pods, snap, config=cfg, pipeline=True)
+    bw, _ = drain_stream(arrivals, pods, snap, config=cfg, pipeline=False)
+    bk, sk = drain_stream(
+        arrivals, pods, snap, config=cfg, pipeline=True, scan=True
+    )
+    assert bk == bp == bw
+    assert sk.mode == "scan" and sk.drain.harvest == "scan"
+    assert sk.drain.scanned_waves > 0
+    assert sk.drain.device_roundtrips < sp.drain.device_roundtrips
+    assert sk.to_doc()["deviceRoundtrips"] == sk.drain.device_roundtrips
+
+
+# --- resilience: the "scan" rung ----------------------------------------------
+
+
+def test_open_scan_rung_steps_drain_down_to_pipelined():
+    from grove_tpu.solver.resilience import (
+        DegradationLadder,
+        ResilienceConfig,
+    )
+
+    gangs, pods, snap = _setup(racks=2, nd=4, na=4, nf=4)
+    lad = DegradationLadder(
+        ResilienceConfig(enabled=True, breaker_threshold=1)
+    )
+    lad.record_failure("scan")
+    assert not lad.allows("scan")
+    bk, sk = drain_backlog(
+        gangs, pods, snap, wave_size=4, harvest="scan", resilience=lad
+    )
+    assert sk.harvest == "pipeline"
+    assert sk.scan_chunks == 0 and sk.scanned_waves == 0
+    bs, _ = drain_backlog(gangs, pods, snap, wave_size=4, harvest="wave")
+    assert bk == bs
+
+
+# --- warm-path cumulative ledger + config block -------------------------------
+
+
+def test_warm_path_accumulates_roundtrips_across_drains():
+    """record_drain feeds the cumulative dispatch/sync totals regardless of
+    harvest discipline — the delta-exported Prometheus counter never misses
+    a drain landing between scrapes."""
+    gangs, pods, snap = _setup(racks=2, nd=4, na=4, nf=4)
+    wp = WarmPath()
+    _, s1 = drain_backlog(
+        gangs, pods, snap, wave_size=4, harvest="scan", warm_path=wp
+    )
+    _, s2 = drain_backlog(
+        gangs, pods, snap, wave_size=4, harvest="wave", warm_path=wp
+    )
+    assert wp.drain_dispatches_total == s1.dispatches + s2.dispatches
+    assert (
+        wp.drain_device_roundtrips_total
+        == s1.device_roundtrips + s2.device_roundtrips
+    )
+    doc = wp.stats()
+    assert doc["dispatchesTotal"] == wp.drain_dispatches_total
+    assert doc["deviceRoundtripsTotal"] == wp.drain_device_roundtrips_total
+
+
+def test_scan_config_block_parses_and_validates():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {"solver": {"scan": {"enabled": True, "maxScanLen": 16,
+                             "minWavesPerClass": 3}}}
+    )
+    assert errors == []
+    sc = cfg.solver.scan_config()
+    assert isinstance(sc, ScanConfig)
+    assert sc.enabled and sc.max_scan_len == 16 and sc.min_waves_per_class == 3
+    # Defaults: enabled rides the block, ON when absent.
+    assert parse_operator_config({})[0].solver.scan_config() == ScanConfig()
+    _, errors = parse_operator_config(
+        {"solver": {"scan": {"enabled": "yes", "maxScanLen": 0, "bogus": 1}}}
+    )
+    assert any("solver.scan.enabled" in e for e in errors)
+    assert any("solver.scan.maxScanLen" in e for e in errors)
+    assert any("solver.scan.bogus" in e for e in errors)
+
+
+def test_disabled_scan_config_falls_back_to_pipelined():
+    gangs, pods, snap = _setup(racks=2, nd=4, na=4, nf=4)
+    bk, sk = drain_backlog(
+        gangs, pods, snap, wave_size=4, harvest="scan",
+        scan=ScanConfig(enabled=False),
+    )
+    assert sk.harvest == "pipeline" and sk.scan_chunks == 0
+    bs, _ = drain_backlog(gangs, pods, snap, wave_size=4, harvest="wave")
+    assert bk == bs
