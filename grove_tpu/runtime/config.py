@@ -225,6 +225,17 @@ class SolverConfig:
     #   minWavesPerClass  int >= 1, runs shorter than this dispatch
     #                     per-wave — fusion overhead isn't worth one wave
     #                     (default 2)
+    #   affinityLookahead int >= 0, stream saturated mode: planned waves
+    #                     from up to this many windows ahead reorder by
+    #                     (rank, shape class) before dispatch so same-
+    #                     class runs form and fuse; 0 = strict window-at-
+    #                     a-time dispatch order (default 4). Window
+    #                     composition and admitted sets are unchanged.
+    #   deviceResident    bool, default false: saturated stream drains
+    #                     retire nothing until the trace is exhausted —
+    #                     ONE batched harvest at the end, device round-
+    #                     trips O(1 + escalations). First ladder rung
+    #                     ("resident"), stepping down to scanned.
     scan: dict = field(default_factory=dict)
     # Mesh-sharded solve (parallel/mesh.py): distribute the single-variant
     # batched solve across the TPU mesh — node-axis tensors split over the
@@ -313,6 +324,10 @@ class SolverConfig:
             kwargs["max_scan_len"] = int(s["maxScanLen"])
         if "minWavesPerClass" in s:
             kwargs["min_waves_per_class"] = int(s["minWavesPerClass"])
+        if "affinityLookahead" in s:
+            kwargs["affinity_lookahead"] = int(s["affinityLookahead"])
+        if "deviceResident" in s:
+            kwargs["device_resident"] = bool(s["deviceResident"])
         return ScanConfig(enabled=bool(s.get("enabled", True)), **kwargs)
 
 
@@ -1005,12 +1020,19 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
     if not isinstance(sc, dict):
         errors.append("solver.scan: must be a mapping")
     elif sc:
-        _SCAN_KEYS = {"enabled", "maxScanLen", "minWavesPerClass"}
+        _SCAN_KEYS = {
+            "enabled",
+            "maxScanLen",
+            "minWavesPerClass",
+            "affinityLookahead",
+            "deviceResident",
+        }
         for ck in sc:
             if ck not in _SCAN_KEYS:
                 errors.append(f"solver.scan.{ck}: unknown field")
-        if "enabled" in sc and not isinstance(sc["enabled"], bool):
-            errors.append("solver.scan.enabled: must be a boolean")
+        for ck in ("enabled", "deviceResident"):
+            if ck in sc and not isinstance(sc[ck], bool):
+                errors.append(f"solver.scan.{ck}: must be a boolean")
         for ck in ("maxScanLen", "minWavesPerClass"):
             if ck in sc and (
                 not isinstance(sc[ck], int)
@@ -1018,6 +1040,12 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
                 or sc[ck] < 1
             ):
                 errors.append(f"solver.scan.{ck}: must be an int >= 1")
+        if "affinityLookahead" in sc and (
+            not isinstance(sc["affinityLookahead"], int)
+            or isinstance(sc["affinityLookahead"], bool)
+            or sc["affinityLookahead"] < 0
+        ):
+            errors.append("solver.scan.affinityLookahead: must be an int >= 0")
     mh = cfg.solver.mesh
     if not isinstance(mh, dict):
         errors.append("solver.mesh: must be a mapping")

@@ -1315,6 +1315,8 @@ class Manager:
             "enabled": bool(kcfg.enabled),
             "maxScanLen": int(kcfg.max_scan_len),
             "minWavesPerClass": int(kcfg.min_waves_per_class),
+            "affinityLookahead": int(kcfg.affinity_lookahead),
+            "deviceResident": bool(kcfg.device_resident),
             "dispatchesTotal": int(
                 self.controller.warm.drain_dispatches_total
             ),

@@ -960,6 +960,75 @@ def scan_pruned_solve_fn(layout=None, retain: bool = False, donate: bool = False
         return _SCAN_JIT.setdefault(key, jitted)
 
 
+class StackedScanResult(NamedTuple):
+    """A run of journaled waves solved under K configs each: every verdict
+    plane gains leading [W, K] axes. No carry threads between steps — the
+    sweep replays RECORDED waves, each from its journaled entering free
+    (cross-wave dependencies were resolved on the host at record time), so
+    the scan is pure batching: step w row k is bitwise-identical to a
+    single stacked_solve_batch call on wave w (the sweep's replay-agreement
+    contract, pinned in tests/test_tuning.py)."""
+
+    assigned: jax.Array  # i32 [W, K, G, MP]
+    ok: jax.Array  # bool [W, K, G]
+    placement_score: jax.Array  # f32 [W, K, G]
+
+
+def stacked_scan_solve_fn():
+    """jitted `lax.scan` of stacked_solve_batch_impl over a journaled wave
+    axis — the tuning sweep's run batcher.
+
+    Signature of the returned callable:
+      (free_stack [W,N,R], capacity [N,R], schedulable [N],
+       node_domain_id [L,N], stacked_batch (GangBatch, each leaf [W,...]),
+       params_stack (SolverParams, each leaf [K]), *, coarse_dmax)
+      -> StackedScanResult
+
+    Each step solves wave w from its RECORDED entering free under all K
+    sweep configs; a run of W same-shape journaled waves costs ONE dispatch
+    instead of W per-wave stacked solves, which is what keeps a sweep over
+    a scanned journal at ~stacked-replay cost. Pad the wave axis with NULL
+    waves (zero free, all-invalid batch) to bucket run lengths — null steps
+    admit nothing and there is no carry to disturb. Process-wide memo like
+    scan_solve_fn; the AOT executable cache lowers through this function."""
+    key = ("stacked",)
+    with _SCAN_JIT_LOCK:
+        cached = _SCAN_JIT.get(key)
+        if cached is not None:
+            return cached
+
+    def impl(
+        free_stack,
+        capacity,
+        schedulable,
+        node_domain_id,
+        stacked_batch,
+        params_stack,
+        coarse_dmax=None,
+    ):
+        def step(_, xs):
+            free_w, wave_batch = xs
+            res = stacked_solve_batch_impl(
+                free_w,
+                capacity,
+                schedulable,
+                node_domain_id,
+                wave_batch,
+                params_stack,
+                coarse_dmax=coarse_dmax,
+            )
+            return 0, (res.assigned, res.ok, res.placement_score)
+
+        _, ys = jax.lax.scan(step, 0, (free_stack, stacked_batch))
+        return StackedScanResult(
+            assigned=ys[0], ok=ys[1], placement_score=ys[2]
+        )
+
+    jitted = jax.jit(impl, static_argnames=("coarse_dmax",))
+    with _SCAN_JIT_LOCK:
+        return _SCAN_JIT.setdefault(key, jitted)
+
+
 def coarse_dmax_of(snapshot) -> int | None:
     """Static bound on domains per non-host level, selecting the aggregation
     strategy for the backend the solve will run on:

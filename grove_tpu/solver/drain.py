@@ -60,7 +60,7 @@ from grove_tpu.solver.core import (
 )
 from grove_tpu.solver.encode import encode_gangs, gang_shape, next_pow2
 
-HARVEST_MODES = ("chained", "wave", "pipeline", "scan")
+HARVEST_MODES = ("chained", "wave", "pipeline", "scan", "resident")
 
 
 @dataclass(frozen=True)
@@ -79,6 +79,25 @@ class ScanConfig:
     # Runs shorter than this dispatch per-wave instead — a 1-wave scan
     # executable amortizes nothing and would only fragment the AOT cache.
     min_waves_per_class: int = 2
+    # Class-affine window forming (stream saturated mode only): planned
+    # waves from up to this many windows AHEAD of the current one buffer
+    # and reorder by (rank, shape class) before dispatch, so same-class
+    # runs actually form under mixed arrival traffic. 0 disables forming
+    # (bitwise today's window-at-a-time order). Window COMPOSITION is
+    # untouched — forming only reorders dispatch of already-planned waves
+    # within the look-ahead group, and the reorder is discipline-
+    # independent (serial/pipelined/scanned runs at the same look-ahead
+    # see the identical wave sequence), so admitted sets stay bitwise-
+    # equal to serial.
+    affinity_lookahead: int = 4
+    # Device-resident saturated drain (stream): retire NOTHING until the
+    # trace is exhausted — scan chunks chain device-side and the host
+    # harvests every verdict in ONE batched device_get at the end, so
+    # device_roundtrips collapses to O(1 + escalations). First ladder
+    # rung ("resident"), stepping down to the scanned-but-pipelined
+    # discipline. drain_backlog exposes the same thing as
+    # harvest="resident".
+    device_resident: bool = False
 
 
 class WaveFault(RuntimeError):
@@ -181,9 +200,13 @@ class DrainStats:
     device_roundtrips: int = 0
     # Scan discipline ledger: chunks dispatched as device-side scans and
     # the logical waves they covered (scanned_waves <= waves; the rest ran
-    # per-wave — short runs, escalation re-chains).
+    # per-wave — short runs). `scan_rechains` counts fused chunks re-
+    # dispatched from an ADOPTED carry (escalation re-chain riding the
+    # scan instead of falling back per-wave) — kept out of scan_chunks so
+    # the no-adoption roundtrip arithmetic stays exact.
     scan_chunks: int = 0
     scanned_waves: int = 0
+    scan_rechains: int = 0
 
     def resilience_doc(self) -> dict:
         """The fault-recovery counters of this run (surfaced on lastDrain/
@@ -238,6 +261,8 @@ class DrainStats:
         if self.scan_chunks or self.scanned_waves:
             doc["scanChunks"] = self.scan_chunks
             doc["scannedWaves"] = self.scanned_waves
+        if self.scan_rechains:
+            doc["scanRechains"] = self.scan_rechains
         if self.waves:
             doc["hostPerWaveMs"] = round(1000.0 * host_total / self.waves, 4)
         return doc
@@ -831,22 +856,19 @@ class _WavePipeline:
                         self._retire_next()
             i = j
 
-    def _dispatch_scan_chunk(self, run: list[dict]) -> None:
-        """Stack one chunk's encoded batches on a leading wave axis and
-        dispatch the whole chunk as ONE scan executable. The wave axis pads
-        to its next power of two with NULL waves (all-invalid gang_valid —
-        carry-neutral by construction: no gang admits, the free carry passes
-        through, and the null global_index scatters nothing), so chunk
-        lengths bucket like gang pads do."""
+    def _solve_scan_chunk(self, run: list[dict], free_in, okg_in):
+        """Stack one run's encoded batches on a leading wave axis and solve
+        the whole run as ONE scan executable from the given carry (no
+        retries, no ledger, no enqueue — callers own all three). The wave
+        axis pads to its next power of two with NULL waves (all-invalid
+        gang_valid — carry-neutral by construction: no gang admits, the
+        free carry passes through, and the null global_index scatters
+        nothing), so chunk lengths bucket like gang pads do."""
         import jax
         import numpy as np
 
-        ts = time.perf_counter()
         w_real = len(run)
         w_pad = next_pow2(w_real)
-        free_in, okg_in = self.free, self.ok_g
-        for i, rec in enumerate(run):
-            rec["seq"] = self.stats.waves + i
         pruned = run[0]["plan"] is not None
 
         def stack_tree(trees):
@@ -854,92 +876,63 @@ class _WavePipeline:
                 lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees
             )
 
-        attempts = 0
-        while True:
-            try:
-                if self.faults is not None:
-                    self.faults.maybe_raise(
-                        "solver.dispatch", wave=run[0]["seq"]
-                    )
-                if pruned:
-                    plans = [r["plan"] for r in run]
-                    idx_rows = [np.asarray(p._padded_idx()) for p in plans]
-                    cap_rows = [
-                        np.asarray(p.capacity, np.float32) for p in plans
-                    ]
-                    sched_rows = [
-                        np.asarray(p.schedulable, bool) for p in plans
-                    ]
-                    ndid_rows = [
-                        np.asarray(p.node_domain_id, np.int32) for p in plans
-                    ]
-                    pbatches = [
-                        p.gather_batch(r["batch"])
-                        for p, r in zip(plans, run)
-                    ]
-                    if w_pad > w_real:
-                        # Null pruned wave: every gather-map slot points past
-                        # the fleet axis (gathers fill 0, scatters drop).
-                        null_idx = np.full_like(
-                            idx_rows[0], plans[0].fleet_pad
-                        )
-                        null_b = jax.tree_util.tree_map(
-                            np.zeros_like, pbatches[0]
-                        )
-                        for _ in range(w_pad - w_real):
-                            idx_rows.append(null_idx)
-                            cap_rows.append(np.zeros_like(cap_rows[0]))
-                            sched_rows.append(np.zeros_like(sched_rows[0]))
-                            ndid_rows.append(np.zeros_like(ndid_rows[0]))
-                            pbatches.append(null_b)
-                    cds = [p.coarse_dmax() for p in plans]
-                    res = self.wp.executables.solve_scan_pruned(
-                        free_in,
-                        np.stack(idx_rows),
-                        np.stack(cap_rows),
-                        np.stack(sched_rows),
-                        np.stack(ndid_rows),
-                        stack_tree(pbatches),
-                        self.params,
-                        okg_in,
-                        coarse_dmax=None if cds[0] is None else max(cds),
-                        retain=self.retain_carries,
-                        donate=self.donate,
-                        layout=self.layout,
-                    )
-                else:
-                    batches = [r["batch"] for r in run]
-                    if w_pad > w_real:
-                        null_b = jax.tree_util.tree_map(
-                            np.zeros_like, batches[0]
-                        )
-                        batches = batches + [null_b] * (w_pad - w_real)
-                    res = self.wp.executables.solve_scan(
-                        free_in,
-                        self.capacity,
-                        self.schedulable,
-                        self.node_domain_id,
-                        stack_tree(batches),
-                        self.params,
-                        okg_in,
-                        coarse_dmax=self.dmax,
-                        retain=self.retain_carries,
-                        donate=self.donate,
-                        layout=self.layout,
-                    )
-                break
-            except Exception as e:  # noqa: BLE001 — retry budget, then surface
-                if attempts >= self.max_wave_retries:
-                    if self.max_wave_retries == 0 and self.faults is None:
-                        raise
-                    raise WaveFault(
-                        f"scan chunk dispatch failed after {attempts} "
-                        f"retries: {e}",
-                        in_flight=False,
-                    ) from e
-                attempts += 1
-                self.stats.wave_retries += 1
+        if pruned:
+            plans = [r["plan"] for r in run]
+            idx_rows = [np.asarray(p._padded_idx()) for p in plans]
+            cap_rows = [np.asarray(p.capacity, np.float32) for p in plans]
+            sched_rows = [np.asarray(p.schedulable, bool) for p in plans]
+            ndid_rows = [np.asarray(p.node_domain_id, np.int32) for p in plans]
+            pbatches = [
+                p.gather_batch(r["batch"]) for p, r in zip(plans, run)
+            ]
+            if w_pad > w_real:
+                # Null pruned wave: every gather-map slot points past
+                # the fleet axis (gathers fill 0, scatters drop).
+                null_idx = np.full_like(idx_rows[0], plans[0].fleet_pad)
+                null_b = jax.tree_util.tree_map(np.zeros_like, pbatches[0])
+                for _ in range(w_pad - w_real):
+                    idx_rows.append(null_idx)
+                    cap_rows.append(np.zeros_like(cap_rows[0]))
+                    sched_rows.append(np.zeros_like(sched_rows[0]))
+                    ndid_rows.append(np.zeros_like(ndid_rows[0]))
+                    pbatches.append(null_b)
+            cds = [p.coarse_dmax() for p in plans]
+            return self.wp.executables.solve_scan_pruned(
+                free_in,
+                np.stack(idx_rows),
+                np.stack(cap_rows),
+                np.stack(sched_rows),
+                np.stack(ndid_rows),
+                stack_tree(pbatches),
+                self.params,
+                okg_in,
+                coarse_dmax=None if cds[0] is None else max(cds),
+                retain=self.retain_carries,
+                donate=self.donate,
+                layout=self.layout,
+            )
+        batches = [r["batch"] for r in run]
+        if w_pad > w_real:
+            null_b = jax.tree_util.tree_map(np.zeros_like, batches[0])
+            batches = batches + [null_b] * (w_pad - w_real)
+        return self.wp.executables.solve_scan(
+            free_in,
+            self.capacity,
+            self.schedulable,
+            self.node_domain_id,
+            stack_tree(batches),
+            self.params,
+            okg_in,
+            coarse_dmax=self.dmax,
+            retain=self.retain_carries,
+            donate=self.donate,
+            layout=self.layout,
+        )
 
+    def _commit_scan_chunk(self, run: list[dict], res) -> None:
+        """Bind one solved chunk's shared result planes onto its records
+        and advance the engine carry. Dispatch and the ADOPT re-chain share
+        this; only dispatch also enqueues the records."""
         # One fetch per chunk at retirement; every member reads views of it.
         group = {
             "ok": res.ok,
@@ -967,8 +960,40 @@ class _WavePipeline:
                 scan_group=group,
                 scan_pos=i,
             )
-            self.inflight.append(rec)
         self.free, self.ok_g = res.free_after, res.ok_global
+
+    def _dispatch_scan_chunk(self, run: list[dict]) -> None:
+        """Dispatch one chunk as a device-side scan (see _solve_scan_chunk)
+        with the per-wave retry budget, then enqueue its records."""
+        ts = time.perf_counter()
+        w_real = len(run)
+        free_in, okg_in = self.free, self.ok_g
+        for i, rec in enumerate(run):
+            rec["seq"] = self.stats.waves + i
+
+        attempts = 0
+        while True:
+            try:
+                if self.faults is not None:
+                    self.faults.maybe_raise(
+                        "solver.dispatch", wave=run[0]["seq"]
+                    )
+                res = self._solve_scan_chunk(run, free_in, okg_in)
+                break
+            except Exception as e:  # noqa: BLE001 — retry budget, then surface
+                if attempts >= self.max_wave_retries:
+                    if self.max_wave_retries == 0 and self.faults is None:
+                        raise
+                    raise WaveFault(
+                        f"scan chunk dispatch failed after {attempts} "
+                        f"retries: {e}",
+                        in_flight=False,
+                    ) from e
+                attempts += 1
+                self.stats.wave_retries += 1
+
+        self._commit_scan_chunk(run, res)
+        self.inflight.extend(run)
         self.stats.waves += w_real
         self.stats.dispatches += 1
         self.stats.scan_chunks += 1
@@ -1125,6 +1150,64 @@ class _WavePipeline:
             group["free_in_np"] = np.asarray(fetched[3])
             group["okg_in_np"] = np.asarray(fetched[4])
 
+    def _rechain_inflight(self) -> None:
+        """Re-dispatch every wave still in flight from the CURRENT carry
+        (the adoption point). Consecutive scan-compatible records re-chain
+        as fused chunks — the corrected carry threads back into the
+        remaining scan steps on device instead of the whole tail falling
+        back to per-wave re-dispatch; runs too short to fuse (or scan off)
+        dispatch per-wave exactly as before. Re-chained chunks count on
+        `scan_rechains`, NOT scan_chunks, so the no-adoption roundtrip
+        arithmetic (roundtrips == chunks + unfused + escalations) stays
+        exact."""
+        scan = self.scan
+        fuse = (
+            scan is not None
+            and scan.enabled
+            and self.use_exec_cache
+            and len(self.inflight) >= 2
+        )
+        if not fuse:
+            for rec2 in self.inflight:
+                rec2["escalated"] = False
+                self._dispatch(rec2)
+            return
+        min_run = max(1, int(scan.min_waves_per_class))
+        max_len = max(1, int(scan.max_scan_len))
+        n = len(self.inflight)
+        i = 0
+        while i < n:
+            key = (
+                self.inflight[i]["shape"],
+                self.inflight[i]["pad"],
+                self._scan_subkey(self.inflight[i]),
+            )
+            j = i
+            while j < n and (
+                self.inflight[j]["shape"],
+                self.inflight[j]["pad"],
+                self._scan_subkey(self.inflight[j]),
+            ) == key:
+                j += 1
+            run = self.inflight[i:j]
+            for k in range(0, len(run), max_len):
+                chunk = run[k : k + max_len]
+                for rec2 in chunk:
+                    rec2["escalated"] = False
+                if len(chunk) < min_run:
+                    for rec2 in chunk:
+                        self._dispatch(rec2)
+                    continue
+                if self.faults is not None:
+                    self.faults.maybe_raise(
+                        "solver.dispatch", wave=chunk[0]["seq"]
+                    )
+                res = self._solve_scan_chunk(chunk, self.free, self.ok_g)
+                self._commit_scan_chunk(chunk, res)
+                self.stats.dispatches += 1
+                self.stats.scan_rechains += 1
+            i = j
+
     def _retire_next(self) -> None:
         # Peek-fetch-pop: a WaveFault out of _fetch (watchdog exhaustion)
         # leaves the wave at the queue head, so the driver can step the
@@ -1190,9 +1273,7 @@ class _WavePipeline:
                     while True:
                         self.free, self.ok_g = adopt_carry
                         try:
-                            for rec2 in self.inflight:
-                                rec2["escalated"] = False
-                                self._dispatch(rec2)
+                            self._rechain_inflight()
                             break
                         except Exception as e:  # noqa: BLE001
                             if attempt >= self.max_wave_retries and not (
@@ -1240,10 +1321,15 @@ class _WavePipeline:
             self.on_commit(rec["members"], wave_bindings, stamp)
         stats.bind_s += time.perf_counter() - tb
 
-    def flush(self) -> None:
-        """Retire everything still in flight. Chained mode harvests with ONE
-        batched device_get (a single d2h relay round trip) before retiring
-        in order; the other modes have at most `retire_lag` waves left."""
+    def harvest_inflight(self) -> None:
+        """Make every in-flight wave's verdicts host-visible with ONE
+        batched device_get — the single harvest sync of the chained and
+        device-resident disciplines. Plain records contribute their verdict
+        planes plus any retained entering carries (escalation and
+        journaling at retirement must not pay a second sync); scan chunks
+        contribute their shared group planes, deduplicated. A no-op when
+        nothing is unfetched, so the ledger charges exactly one roundtrip
+        per harvest that moved data."""
         import numpy as np
 
         plain = [
@@ -1251,19 +1337,56 @@ class _WavePipeline:
             for r in self.inflight
             if r.get("scan_group") is None and r.get("ok_np") is None
         ]
-        if self.retire_lag is None and plain:
-            import jax
+        groups: list[dict] = []
+        seen: set[int] = set()
+        for r in self.inflight:
+            g = r.get("scan_group")
+            if g is not None and g.get("ok_np") is None and id(g) not in seen:
+                seen.add(id(g))
+                groups.append(g)
+        if not plain and not groups:
+            return
+        import jax
 
-            th = time.perf_counter()
-            fetched = jax.device_get(
-                [(r["ok"], r["score"], r["assigned"]) for r in plain]
-            )
-            self.stats.harvest_s += time.perf_counter() - th
-            self.stats.device_roundtrips += 1
-            for rec, (ok, score, assigned) in zip(plain, fetched):
-                rec["ok_np"] = np.asarray(ok)
-                rec["score_np"] = np.asarray(score)
-                rec["assigned_np"] = np.asarray(assigned)
+        th = time.perf_counter()
+        payload = []
+        for r in plain:
+            planes = [r["ok"], r["score"], r["assigned"]]
+            if r.get("free_in") is not None and not isinstance(
+                r["free_in"], np.ndarray
+            ):
+                planes += [r["free_in"], r["okg_in"]]
+            payload.append(planes)
+        for g in groups:
+            planes = [g["ok"], g["score"], g["assigned"]]
+            if g.get("free_in") is not None:
+                planes += [g["free_in"], g["okg_in"]]
+            payload.append(planes)
+        fetched = jax.device_get(payload)
+        self.stats.harvest_s += time.perf_counter() - th
+        self.stats.device_roundtrips += 1
+        for r, planes in zip(plain, fetched[: len(plain)]):
+            r["ok_np"] = np.asarray(planes[0])
+            r["score_np"] = np.asarray(planes[1])
+            r["assigned_np"] = np.asarray(planes[2])
+            if len(planes) > 3:
+                r["free_in"] = np.asarray(planes[3])
+                r["okg_in"] = np.asarray(planes[4])
+        for g, planes in zip(groups, fetched[len(plain) :]):
+            g["ok_np"] = np.asarray(planes[0])
+            g["score_np"] = np.asarray(planes[1])
+            g["assigned_np"] = np.asarray(planes[2])
+            if len(planes) > 3:
+                g["free_in_np"] = np.asarray(planes[3])
+                g["okg_in_np"] = np.asarray(planes[4])
+
+    def flush(self) -> None:
+        """Retire everything still in flight. Chained and device-resident
+        modes harvest with ONE batched device_get (a single d2h relay round
+        trip) before retiring in order; the other modes have at most
+        `retire_lag` waves left."""
+        if self.retire_lag is None:
+            self.harvest_inflight()
         while self.inflight:
             self._retire_next()
 
@@ -1464,8 +1587,11 @@ def drain_backlog(
     throughput; "scan" fuses each run of same-shape waves into ONE
     device-side `lax.scan` (the `scan` ScanConfig governs chunking) — host
     dispatches and harvest syncs drop to O(shape classes + escalations),
-    counted on DrainStats.dispatches/device_roundtrips. See the module
-    docstring.
+    counted on DrainStats.dispatches/device_roundtrips; "resident" is the
+    scan dispatch with the chained retirement point — the device runs the
+    whole backlog, then ONE batched device_get harvests every chunk and
+    unfused wave, so device_roundtrips == 1 + escalations (the fully
+    device-resident drain). See the module docstring.
 
     Candidate pruning (`pruning`, solver/pruning.py): each wave's solve runs
     on the gathered candidate sub-fleet; the fleet free carry chains on
@@ -1530,14 +1656,16 @@ def drain_backlog(
             mesh = None
         if not ladder.allows("pruning"):
             pruning = None
+        if harvest == "resident" and not ladder.allows("resident"):
+            harvest = "scan"  # resident -> scanned: the first ladder rung
         if harvest == "scan" and not ladder.allows("scan"):
-            harvest = "pipeline"  # scan -> pipelined: the first ladder rung
+            harvest = "pipeline"  # scan -> pipelined: the second rung
         if harvest == "pipeline" and not ladder.allows("pipeline"):
             harvest = "wave"
         if portfolio > 1 and not ladder.allows("portfolio"):
             portfolio = 1
     scan_cfg = None
-    if harvest == "scan":
+    if harvest in ("scan", "resident"):
         scan_cfg = scan if scan is not None else ScanConfig()
         if not scan_cfg.enabled or portfolio > 1:
             # Disabled config / portfolio closure (owns its own dispatch):
@@ -1593,9 +1721,17 @@ def drain_backlog(
 
     waves = plan_waves(gangs, wave_size)
 
-    retire_lag = {"chained": None, "wave": 0, "pipeline": depth, "scan": depth}[
-        harvest
-    ]
+    # "resident" is the scan dispatch with the chained retirement point:
+    # nothing retires until the backlog is fully dispatched, then ONE
+    # batched device_get (harvest_inflight) covers every chunk and wave —
+    # device_roundtrips collapses to 1 + escalations.
+    retire_lag = {
+        "chained": None,
+        "wave": 0,
+        "pipeline": depth,
+        "scan": depth,
+        "resident": None,
+    }[harvest]
     engine = _WavePipeline(
         gangs=gangs,
         pods_by_name=pods_by_name,
@@ -1649,7 +1785,7 @@ def drain_backlog(
                     coarse_dmax=engine.dmax,
                 )
                 jax.block_until_ready(last.ok)
-        if harvest == "scan":
+        if harvest in ("scan", "resident"):
             for run in _class_runs(waves):
                 engine.warm_scan(run)
         stats.compile_s = time.perf_counter() - t0
@@ -1660,7 +1796,7 @@ def drain_backlog(
 
     t0 = time.perf_counter()
     engine.t0 = t0
-    if harvest == "scan":
+    if harvest in ("scan", "resident"):
         for run in _class_runs(waves):
             engine.submit_scan(run)
     else:

@@ -12,6 +12,7 @@ back up on probation without ever risking a placement regression.
 
 The ladder orders the optional subsystems fastest-first:
 
+  resident   device-resident drain   -> scanned        (bitwise-equal)
   scan       device-side scanned drain -> pipelined    (bitwise-equal)
   mesh       mesh-sharded solve      -> unsharded      (bitwise-equal)
   pruning    candidate-pruned solve  -> dense          (admitted-equal)
@@ -41,7 +42,7 @@ from dataclasses import dataclass, field
 
 # Step-down order: fastest/most-optional first. An unattributed failure
 # charges the first rung still at full config.
-SUBSYSTEMS = ("scan", "mesh", "pruning", "pipeline", "portfolio")
+SUBSYSTEMS = ("resident", "scan", "mesh", "pruning", "pipeline", "portfolio")
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 

@@ -9,9 +9,15 @@ double-buffered pipeline engine as the drain (solver/drain._WavePipeline):
 while wave N solves on device, the host encodes wave N+1 from fresh arrivals
 and decodes/binds wave N-depth — the drain never syncs except at retirement.
 
-Three disciplines, one dispatch chain (identical admissions by construction —
+Four disciplines, one dispatch chain (identical admissions by construction —
 the chain is the same; test-pinned):
 
+  resident   scan + chained retirement: NOTHING retires until the trace is
+             exhausted — scan chunks chain device-side over the whole run
+             and the host harvests every verdict in ONE batched device_get
+             at the end, so device round-trips collapse to O(1 +
+             escalations). Saturated mode only (`solver.scan.deviceResident`);
+             first ladder rung, stepping down to scan.
   scan       pipeline + device-side fusion: consecutive same-shape-class
              waves (across windows, saturated mode) dispatch as ONE
              lax.scan chunk — O(shape classes) host round-trips instead of
@@ -21,6 +27,20 @@ the chain is the same; test-pinned):
              serving shape; ~chained-drain throughput, measured latencies)
   serial     retire every wave before forming the next (the wave-at-a-time
              baseline the pipelined mode is benchmarked against)
+
+Class-affine window forming (`solver.scan.affinityLookahead`, saturated
+mode only): planned waves from up to (1 + L) consecutive windows buffer and
+reorder by (rank, shape class) before dispatch — rank 0 before rank 1,
+classes in first-appearance order, each class's gang-axis pad canonicalized
+up to the class max within the group — so same-class RUNS form under mixed
+arrival traffic and the scan actually fuses. Window composition is
+untouched (forming only reorders dispatch of already-planned waves), the
+reorder is a pure function of the requested scan config (ladder state and
+harvest discipline never affect it), and rank order still guarantees every
+base dispatches before any scaled gang — so all four disciplines at the
+same look-ahead see the identical wave sequence and admitted sets stay
+bitwise-equal to serial. L=0 (or paced mode) is bitwise the unformed
+window-at-a-time order.
 
 Two clocks:
 
@@ -199,18 +219,29 @@ def drain_stream(
     `faults`: deterministic fault injector threaded through the engine's
     named sites (grove_tpu/faults) — chaos runs replay bit-for-bit.
 
-    `scan`: the on-device fused-drain discipline (requires `pipeline`).
-    True uses ScanConfig defaults; a ScanConfig tunes maxScanLen /
-    minWavesPerClass. In saturated mode the driver buffers CONSECUTIVE
-    same-shape-class planned waves across windows and dispatches each run
-    as lax.scan chunks through the engine (`submit_scan`) — window/wave
-    composition is untouched, only dispatch fuses, so admitted sets stay
-    bitwise-equal to the pipelined and serial baselines while host
-    round-trips drop to O(shape classes). Paced runs never hold an arrival
-    back for fusion (each window flushes), so pacing degenerates to the
-    pipelined discipline unless a single window plans a fusable run. Under
-    a ladder, "scan" is the FIRST rung: a failure steps the loop down to
-    per-wave pipelined dispatch (bitwise-equal), probation steps it back.
+    `scan`: the on-device fused-drain discipline (fusion requires
+    `pipeline`). True uses ScanConfig defaults; a ScanConfig tunes
+    maxScanLen / minWavesPerClass / affinityLookahead / deviceResident. In
+    saturated mode the driver buffers CONSECUTIVE same-shape-class planned
+    waves across windows and dispatches each run as lax.scan chunks
+    through the engine (`submit_scan`) — window/wave composition is
+    untouched, only dispatch fuses, so admitted sets stay bitwise-equal to
+    the pipelined and serial baselines while host round-trips drop to
+    O(shape classes). Class-affine forming (`affinityLookahead` > 0,
+    saturated only) reorders planned waves across a bounded window
+    look-ahead so same-class runs actually form under mixed traffic; the
+    reorder is a pure function of the requested config — a serial run
+    (pipeline=False) given the same `scan` applies the identical forming,
+    which is what keeps admitted sets bitwise-comparable. With
+    `deviceResident` the saturated loop retires nothing until the trace is
+    exhausted and harvests everything in ONE batched device_get —
+    device_roundtrips == 1 + escalations. Paced runs never hold an arrival
+    back for fusion or forming (each window flushes), so pacing
+    degenerates to the pipelined discipline unless a single window plans a
+    fusable run. Under a ladder, "resident" is the FIRST rung (falls back
+    to scanned-but-pipelined retirement), "scan" the second: a failure
+    steps the loop down to per-wave pipelined dispatch (bitwise-equal),
+    probation steps it back.
 
     `order_key`: optional key callable; when given, the backlog of queued
     arrivals is STABLE-sorted by it before each window is sliced, so e.g.
@@ -247,18 +278,37 @@ def drain_stream(
         if layout is None and requested:
             shard_fallback = 1
 
-    base_scan = None
-    if scan is not None and pipeline:
-        base_scan = ScanConfig() if scan is True else scan
-        if not base_scan.enabled:
-            base_scan = None
+    # Fusion (base_scan) needs the pipelined engine; class-affine FORMING
+    # (affine) deliberately does not — it is a pure function of the
+    # requested scan config, so a serial baseline handed the same config
+    # sees the identical wave sequence (the bitwise parity contract).
+    requested_scan = None
+    if scan is not None:
+        requested_scan = ScanConfig() if scan is True else scan
+        if not requested_scan.enabled:
+            requested_scan = None
+    base_scan = requested_scan if pipeline else None
+    affine = None
+    if (
+        requested_scan is not None
+        and not pace
+        and int(requested_scan.affinity_lookahead) > 0
+    ):
+        affine = requested_scan
+    # Device-resident saturated drain: retire nothing until the trace is
+    # exhausted, then ONE batched harvest. First ladder rung.
+    resident_req = (
+        base_scan is not None and base_scan.device_resident and not pace
+    )
 
     gangs_all = [g for _, g in arrivals]
     stats = StreamStats(
         offered=len(gangs_all),
         depth=cfg.depth if pipeline else 0,
         mode=(
-            "scan"
+            "resident"
+            if resident_req
+            else "scan"
             if base_scan is not None
             else ("pipeline" if pipeline else "serial")
         ),
@@ -300,6 +350,21 @@ def drain_stream(
         if not ladder.allows("pipeline"):
             pass  # applied via retire_lag below
 
+    def _effective_lag(scan_armed: bool) -> int | None:
+        """Where the host blocks, by ladder state: serial (0) when the
+        pipeline rung is open, fully resident (None — retire only at the
+        final flush) when requested and both the resident rung and the
+        scan dispatch are up, else the pipelined depth."""
+        if ladder is not None and not ladder.allows("pipeline"):
+            return 0
+        if (
+            resident_req
+            and scan_armed
+            and (ladder is None or ladder.allows("resident"))
+        ):
+            return None
+        return base_lag
+
     engine = _WavePipeline(
         gangs=gangs_all,
         pods_by_name=pods_by_name,
@@ -309,11 +374,7 @@ def drain_stream(
         stats=dstats,
         pruning=pruning,
         donate=bool(donate),
-        retire_lag=(
-            base_lag
-            if ladder is None or ladder.allows("pipeline")
-            else 0
-        ),
+        retire_lag=_effective_lag(scan_cfg is not None),
         recorder=recorder,
         wave_prefix="stream",
         record_stamps=True,
@@ -330,6 +391,8 @@ def drain_stream(
         """The rungs currently at full config — the ones a new failure can
         step down (ladder attribution order is resilience.SUBSYSTEMS)."""
         active = []
+        if resident_req and engine.retire_lag is None and engine.scan is not None:
+            active.append("resident")
         if engine.scan is not None:
             active.append("scan")
         if engine.layout is not None:
@@ -364,7 +427,7 @@ def drain_stream(
         engine.set_pruning(
             base_pruning if ladder.allows("pruning") else None
         )
-        engine.set_retire_lag(base_lag if ladder.allows("pipeline") else 0)
+        engine.set_retire_lag(_effective_lag(engine.scan is not None))
 
     def _charge(e: WaveFault) -> None:
         """A wave failed past the engine's own retry budget: charge the
@@ -381,7 +444,11 @@ def drain_stream(
         final flush) under the ladder: a retirement failure leaves the wave
         at the queue head, steps the ladder down, and retries with fresh
         watchdog budget — a hung wave degrades the loop, it never loses a
-        gang."""
+        gang. Under the resident discipline retire_due() is never true (the
+        lag is None), so the trace drains with zero mid-run retirement and
+        the final flush pays ONE batched harvest for the whole run."""
+        if not to_lag and engine.retire_lag is None:
+            engine.harvest_inflight()
         while engine.retire_due() if to_lag else engine.inflight:
             try:
                 engine._retire_next()
@@ -454,6 +521,70 @@ def drain_stream(
             run, run_buf[:] = list(run_buf), []
             _submit_run(run)
 
+    def _dispatch_planned(planned: list) -> None:
+        """Feed planned waves to the engine in the order given: buffered
+        into cross-window fused runs while the scan dispatch is armed
+        (saturated), per-wave otherwise."""
+        if engine.scan is not None and not pace:
+            # Saturated scan: buffer consecutive same-class waves across
+            # windows; a class change (or a full chunk) flushes the run
+            # as one scanned dispatch. Composition untouched — only WHEN
+            # the host dispatches changes, never what a wave contains.
+            for ws in planned:
+                if run_buf and (
+                    run_buf[0][1:] != ws[1:]
+                    or len(run_buf)
+                    >= max(1, int(engine.scan.max_scan_len))
+                ):
+                    _flush_run()
+                run_buf.append(ws)
+        else:
+            _flush_run()  # scan stepped down (or paced): drain the buffer
+            for ws in planned:
+                _submit(ws)
+
+    def _affine_order(group: list) -> list:
+        """Class-affine reorder of one look-ahead group of planned waves:
+        rank 0 before rank 1 (every base still dispatches before any
+        scaled gang — the only cross-wave dependency), shape classes in
+        first-appearance order within each rank, each class's waves
+        contiguous in window order, and the gang-axis pad canonicalized UP
+        to the class max across the group (pad-up is binding-neutral —
+        padded slots are invalid gangs that never touch the carry — and it
+        lets one class formed from different windows share one executable
+        and one scan run). A single-window group reproduces plan_waves'
+        own emission order bitwise, so look-ahead 0 is the unformed
+        baseline."""
+        buckets: dict = {}
+        for ws in group:
+            rank = 0 if ws[0][0].base_podgang_name is None else 1
+            buckets.setdefault((rank, ws[1]), []).append(ws)
+        out: list = []
+        for rank in (0, 1):
+            for (r, _shape), members in buckets.items():
+                if r != rank:
+                    continue
+                pad = max(ws[2] for ws in members)
+                out.extend((ws[0], ws[1], pad) for ws in members)
+        return out
+
+    # Class-affine look-ahead group: planned waves from up to
+    # (1 + affinityLookahead) consecutive windows awaiting reorder. A pure
+    # function of the REQUESTED scan config — never of ladder state or
+    # harvest discipline — so every discipline at the same look-ahead sees
+    # the identical dispatch sequence (the parity contract).
+    wave_buf: list = []
+    buf_windows = 0
+    lookahead = int(affine.affinity_lookahead) if affine is not None else 0
+
+    def _flush_group() -> None:
+        nonlocal buf_windows
+        if not wave_buf:
+            return
+        group, wave_buf[:] = list(wave_buf), []
+        buf_windows = 0
+        _dispatch_planned(_affine_order(group))
+
     t0 = time.perf_counter()
     engine.t0 = t0
     queue: list = []
@@ -489,23 +620,16 @@ def drain_stream(
             window, queue = queue[: cfg.wave_size], queue[cfg.wave_size :]
             stats.windows += 1
             planned = plan_waves(window, cfg.wave_size)
-            if engine.scan is not None and not pace:
-                # Saturated scan: buffer consecutive same-class waves across
-                # windows; a class change (or a full chunk) flushes the run
-                # as one scanned dispatch. Composition untouched — only WHEN
-                # the host dispatches changes, never what a wave contains.
-                for ws in planned:
-                    if run_buf and (
-                        run_buf[0][1:] != ws[1:]
-                        or len(run_buf)
-                        >= max(1, int(engine.scan.max_scan_len))
-                    ):
-                        _flush_run()
-                    run_buf.append(ws)
+            if affine is not None:
+                # Class-affine forming: buffer this window's planned waves
+                # and dispatch the whole look-ahead group reordered once
+                # (1 + lookahead) windows are in hand.
+                wave_buf.extend(planned)
+                buf_windows += 1
+                if buf_windows >= 1 + lookahead:
+                    _flush_group()
             else:
-                _flush_run()  # scan stepped down (or paced): drain the buffer
-                for ws in planned:
-                    _submit(ws)
+                _dispatch_planned(planned)
         elif pace:
             if engine.inflight:
                 # Host idle until the next arrival: retire the oldest
@@ -517,7 +641,8 @@ def drain_stream(
             else:
                 next_due = (t0 + arrivals[i][0]) if i < n else now
                 time.sleep(min(cfg.poll_s, max(0.0, next_due - now)))
-    _flush_run()  # trace exhausted: dispatch any run still buffering
+    _flush_group()  # trace exhausted: dispatch any partial look-ahead group
+    _flush_run()  # ... and any fused run still buffering
     _retire_down(to_lag=False)
     stats.wall_s = time.perf_counter() - t0
     dstats.total_s = stats.wall_s

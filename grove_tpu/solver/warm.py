@@ -84,6 +84,18 @@ def _jitted_stacked():
         return _JITTED[key]
 
 
+def _jitted_stacked_scan():
+    """jitted device-side scan of the K-variant stacked solve over a
+    journaled wave axis (core.stacked_scan_solve_fn) — memoized process-wide
+    in core._SCAN_JIT like the carry-threading scan variants. Never donated
+    (each wave replays from its recorded entering free; there is no carry)
+    and never mesh-sharded (the offline sweep runs wherever the journal is
+    replayed)."""
+    from grove_tpu.solver.core import stacked_scan_solve_fn
+
+    return stacked_scan_solve_fn()
+
+
 def _jitted_solve(donate: bool, layout=None):
     import jax
 
@@ -570,6 +582,41 @@ class ExecutableCache:
         )
         return compiled(*args)
 
+    def solve_scan_stacked(
+        self,
+        free_stack,  # f32 [W, N, R] — each wave's RECORDED entering free
+        capacity,
+        schedulable,
+        node_domain_id,
+        stacked_batch: GangBatch,  # each leaf [W, ...]
+        params_stack: SolverParams,  # each leaf [K]
+        *,
+        coarse_dmax: Optional[int] = None,
+    ):
+        """core.stacked_scan_solve_fn through the AOT cache: a run of W
+        same-shape journaled waves solved under K sweep configs as ONE
+        executable (verdict planes gain leading [W, K] axes). No carry
+        threads between steps — every wave replays from its recorded
+        entering free, so the run's cost stays ~one stacked replay while
+        paying one dispatch instead of W. The executable keys on (W, wave
+        shape bucket, K) via the leaf shapes plus the stacked+scan flags."""
+        import jax.numpy as jnp
+
+        args = (
+            jnp.asarray(free_stack, jnp.float32),
+            jnp.asarray(capacity, jnp.float32),
+            jnp.asarray(schedulable, bool),
+            jnp.asarray(node_domain_id, jnp.int32),
+            GangBatch(
+                *(None if x is None else jnp.asarray(x) for x in stacked_batch)
+            ),
+            SolverParams(*(jnp.asarray(w, jnp.float32) for w in params_stack)),
+        )
+        compiled = self._get_or_compile(
+            args, coarse_dmax, False, None, stacked=True, scan=("stacked",)
+        )
+        return compiled(*args)
+
     def solve_scan(
         self,
         free0,
@@ -699,7 +746,9 @@ class ExecutableCache:
             pending.wait()
         try:
             self.lowerings += 1
-            if stacked:
+            if stacked and scan is not None:
+                jitted = _jitted_stacked_scan()
+            elif stacked:
                 jitted = _jitted_stacked()
             elif scan is not None:
                 jitted = _jitted_scan(scan[0] == "pruned", scan[1], donate, layout)

@@ -34,6 +34,20 @@ Pruned waves journaled from the pipelined drain carry their candidate list;
 the sweep rebuilds the exact gather (`pruning.plan_from_indices`) once and
 shares it across all K rows — candidate selection is config-independent, so
 the gather cost does not scale with K either.
+
+Scanned-journal run batching: journals written by the scan/resident drain
+disciplines are long runs of same-shape waves, each record carrying its
+entering free (`freeRows`). Consecutive waves whose stacked-solve signature
+matches (same fleet digest, resources, node pad, batch leaf shapes) are
+dispatched as ONE `core.stacked_scan_solve_fn` executable — a device-side
+scan over the wave axis of the K-stacked solve, each step replaying its
+wave from the RECORDED entering free (no carry threads between steps, so
+per-wave bitwise equality to the single-wave stacked solve is structural:
+lax.scan runs the identical step computation on the identical inputs).
+Run lengths pad to power-of-two with null waves (zero free, all-invalid
+batch — admit nothing, score nothing), so a sweep over a scanned journal
+pays O(log max_run) lowerings per shape class instead of one dispatch per
+wave, keeping the whole sweep at ~one-replay cost.
 """
 
 from __future__ import annotations
@@ -49,6 +63,11 @@ from grove_tpu.trace.replay import diff_wave, nodes_from_fleet, snapshot_from_wa
 from grove_tpu.utils import serde
 
 _N_WEIGHTS = len(SolverParams._fields)
+
+# Longest same-signature wave run dispatched as one stacked-scan executable.
+# Runs pad to power-of-two lengths, so lowerings per shape class are bounded
+# by log2 of this (the drain's warm_scan uses the same bucketing trick).
+_MAX_RUN = 64
 
 
 @dataclass(frozen=True)
@@ -188,6 +207,25 @@ class ConfigTally:
         }
 
 
+@dataclass
+class _WavePrep:
+    """One wave record's host-side preparation (encode + snapshot rebuild),
+    done exactly once whether the wave solves alone or inside a stacked-scan
+    run."""
+
+    rec: dict
+    snapshot: object
+    cfg: dict
+    batch: object  # GangBatch (numpy leaves)
+    decode: object
+    valid_np: np.ndarray
+    free_override: object  # np [N, R] | None
+    free_np: np.ndarray  # entering free actually solved from
+    pruning: object  # PruningConfig | None
+    mesh_fp: object
+    prep_s: float  # host seconds spent building this prep
+
+
 class SweepEngine:
     """Replays journal records once, scoring every active config per wave.
 
@@ -211,6 +249,7 @@ class SweepEngine:
         }
         self.waves_seen = 0
         self.stacked_solves = 0
+        self.scan_stacked_solves = 0  # same-shape wave runs scanned as one
         self.fallback_solves = 0  # production-semantics per-row re-solves
         self._fleets: dict[str, dict] = {}
         self._fleet_nodes: dict[str, list] = {}
@@ -235,7 +274,27 @@ class SweepEngine:
     # ---- consumption -------------------------------------------------------
 
     def consume(self, records: list) -> None:
-        """Process one batch of journal records (fleets + waves)."""
+        """Process one batch of journal records (fleets + waves).
+
+        Consecutive wave records with matching stacked-solve signatures
+        (scanned-journal runs) buffer and dispatch as one device-side
+        stacked-scan executable; a signature break, a run reaching _MAX_RUN,
+        or the end of the batch flushes. Fleet records never split a run —
+        the signature carries the fleet digest, so a digest change breaks it
+        anyway. Runs never span consume() calls: the halving driver may
+        keep() between batches, which changes the param stack."""
+        run: list[_WavePrep] = []
+        run_sig = None
+
+        def flush() -> None:
+            nonlocal run, run_sig
+            if len(run) >= 2:
+                self._wave_run(run)
+            elif run:
+                self._wave_single(run[0])
+            run = []
+            run_sig = None
+
         for rec in records:
             kind = rec.get("kind")
             if kind == "fleet":
@@ -250,10 +309,48 @@ class SweepEngine:
                     "missing from this journal — cannot sweep (recorder drops? "
                     "check `grove-tpu trace info` recorderDropped)"
                 )
-            self._wave(rec, fleet)
+            prep = self._prep_wave(rec, fleet)
             self.waves_seen += 1
+            sig = self._run_sig(prep)
+            if sig is None:
+                flush()
+                self._wave_single(prep)
+                continue
+            if run and sig != run_sig:
+                flush()
+            run.append(prep)
+            run_sig = sig
+            if len(run) >= _MAX_RUN:
+                flush()
+        flush()
 
-    def _wave(self, rec: dict, fleet: dict) -> None:
+    def _run_sig(self, prep: _WavePrep):
+        """Stacked-scan run signature, or None when the wave cannot join a
+        run. Eligible waves are exactly the dense stacked-solve path:
+        recorded-candidate waves need their per-wave gather, and
+        snapshot-state pruned waves re-cut a candidate plan from the
+        entering free (per-wave by construction). Two waves with equal
+        signatures rebuild identical capacity/schedulable/node_domain_id
+        (same fleet digest + resources + node pad -> same build_snapshot
+        inputs) and stack on the wave axis leaf-for-leaf."""
+        if prep.rec.get("candidates") is not None:
+            return None
+        if prep.pruning is not None and prep.free_override is None:
+            return None
+        leaves = tuple(
+            None
+            if x is None
+            else (tuple(np.shape(x)), str(np.asarray(x).dtype))
+            for x in prep.batch
+        )
+        return (
+            prep.rec["fleet"],
+            tuple(prep.rec["resources"]),
+            prep.rec["padNodesTo"],
+            leaves,
+        )
+
+    def _prep_wave(self, rec: dict, fleet: dict) -> _WavePrep:
         t0 = time.perf_counter()
         gangs = [serde.decode(d) for d in rec["gangs"]]
         pods = {n: serde.decode(d) for n, d in rec["pods"].items()}
@@ -303,12 +400,36 @@ class SweepEngine:
                 min_fleet=int(pr.get("minFleet", 256)),
             )
         mesh_fp = cfg.get("mesh")
-
-        rows = self._solve_rows(
-            rec, snapshot, batch, valid_np, free_override, pruning, mesh_fp
+        free_np = (
+            free_override
+            if free_override is not None
+            else np.asarray(snapshot.free, np.float32)
         )
-        elapsed = time.perf_counter() - t0
+        return _WavePrep(
+            rec=rec,
+            snapshot=snapshot,
+            cfg=cfg,
+            batch=batch,
+            decode=decode,
+            valid_np=valid_np,
+            free_override=free_override,
+            free_np=free_np,
+            pruning=pruning,
+            mesh_fp=mesh_fp,
+            prep_s=time.perf_counter() - t0,
+        )
 
+    def _wave_single(self, prep: _WavePrep) -> None:
+        t0 = time.perf_counter()
+        rows = self._solve_rows(
+            prep.rec, prep.snapshot, prep.batch, prep.valid_np,
+            prep.free_override, prep.pruning, prep.mesh_fp,
+        )
+        self._tally(prep, rows, prep.prep_s + time.perf_counter() - t0)
+
+    def _tally(self, prep: _WavePrep, rows: list, elapsed: float) -> None:
+        rec, snapshot, decode = prep.rec, prep.snapshot, prep.decode
+        valid_np, cfg = prep.valid_np, prep.cfg
         per_cfg = elapsed / max(len(self.configs), 1)
         for config, (ok_row, assigned_row, score_row) in zip(self.configs, rows):
             plan = decode_bindings(ok_row, assigned_row, decode, snapshot)
@@ -333,6 +454,99 @@ class SweepEngine:
             tally.plans.append((plan, ok, scores))
             if config.matches_fingerprint(cfg):
                 tally.divergences += len(diff_wave(rec, plan, ok, scores))
+
+    # ---- the stacked-scan run solve ----------------------------------------
+
+    def _wave_run(self, run: list) -> None:
+        """A same-signature run of journaled waves under every active config,
+        solved as ONE device-side scan over the wave axis
+        (warm.solve_scan_stacked). Each scan step replays its wave from the
+        RECORDED entering free with no carry between steps, so row (w, k) is
+        bitwise what _wave_single's stacked solve produces for wave w —
+        the per-wave escalation fallbacks apply unchanged afterwards."""
+        import jax.numpy as jnp
+
+        from grove_tpu.solver.core import coarse_dmax_of
+        from grove_tpu.solver.encode import GangBatch
+
+        t0 = time.perf_counter()
+        w_real = len(run)
+        rows_by_wave: list = [[None] * len(self.configs) for _ in run]
+        stackable = [i for i, c in enumerate(self.configs) if c.portfolio == 1]
+        if stackable:
+            # Power-of-two run-length bucket, padded with null waves (zero
+            # free, all-invalid batch): a null step admits nothing and there
+            # is no carry to disturb, so padded rows are simply discarded.
+            w_pad = 1 << (w_real - 1).bit_length()
+
+            def stack(arrs, dtype=None):
+                out = np.stack([np.asarray(a) for a in arrs])
+                if dtype is not None:
+                    out = out.astype(dtype, copy=False)
+                if w_pad > w_real:
+                    out = np.concatenate(
+                        [
+                            out,
+                            np.zeros(
+                                (w_pad - w_real,) + out.shape[1:], out.dtype
+                            ),
+                        ]
+                    )
+                return out
+
+            pstack_full = self._param_stack()
+            sel = np.asarray(stackable, dtype=np.int64)
+            pstack = SolverParams(*(np.asarray(w)[sel] for w in pstack_full))
+            free_stack = stack([p.free_np for p in run], np.float32)
+            sbatch = GangBatch(
+                *(
+                    None
+                    if leaf0 is None
+                    else jnp.asarray(stack([p.batch[i] for p in run]))
+                    for i, leaf0 in enumerate(run[0].batch)
+                )
+            )
+            snapshot = run[0].snapshot
+            result = self.warm.executables.solve_scan_stacked(
+                jnp.asarray(free_stack),
+                jnp.asarray(snapshot.capacity),
+                jnp.asarray(snapshot.schedulable),
+                jnp.asarray(snapshot.node_domain_id),
+                sbatch,
+                pstack,
+                coarse_dmax=coarse_dmax_of(snapshot),
+            )
+            self.scan_stacked_solves += 1
+            ok_wk = np.asarray(result.ok, dtype=bool)
+            assigned_wk = np.asarray(result.assigned)
+            score_wk = np.asarray(result.placement_score)
+            for w, prep in enumerate(run):
+                for j, i in enumerate(stackable):
+                    config = self.configs[i]
+                    if config.escalate_portfolio > config.portfolio and bool(
+                        np.any(prep.valid_np & ~ok_wk[w, j])
+                    ):
+                        # Portfolio escalation would fire in production —
+                        # same per-row fallback the single-wave path takes.
+                        rows_by_wave[w][i] = self._solve_row_production(
+                            prep.rec, prep.snapshot, prep.batch,
+                            prep.free_override, prep.pruning, config,
+                        )
+                        self.tallies[config.name].escalations += 1
+                    else:
+                        rows_by_wave[w][i] = (
+                            ok_wk[w, j], assigned_wk[w, j], score_wk[w, j]
+                        )
+        for w, prep in enumerate(run):
+            for i, config in enumerate(self.configs):
+                if rows_by_wave[w][i] is None:
+                    rows_by_wave[w][i] = self._solve_row_production(
+                        prep.rec, prep.snapshot, prep.batch,
+                        prep.free_override, prep.pruning, config,
+                    )
+        solve_s = (time.perf_counter() - t0) / w_real
+        for w, prep in enumerate(run):
+            self._tally(prep, rows_by_wave[w], prep.prep_s + solve_s)
 
     # ---- the per-wave K-row solve ------------------------------------------
 
@@ -510,6 +724,7 @@ class SweepEngine:
         return {
             "waves": self.waves_seen,
             "stackedSolves": self.stacked_solves,
+            "scanStackedSolves": self.scan_stacked_solves,
             "fallbackSolves": self.fallback_solves,
             "configs": [t.to_doc() for t in ranked],
         }

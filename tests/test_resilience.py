@@ -170,6 +170,7 @@ def _ladder(clock, **kw):
 def test_unattributed_failures_walk_down_the_ladder_in_order():
     now = [0.0]
     lad, events = _ladder(lambda: now[0])
+    assert lad.record_failure() == "resident"
     assert lad.record_failure() == "scan"
     assert lad.record_failure() == "mesh"
     assert lad.record_failure() == "pruning"

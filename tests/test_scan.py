@@ -217,16 +217,27 @@ def test_second_scanned_drain_pays_zero_lowerings():
 def test_stream_scan_fuses_across_windows_with_identical_bindings():
     """Saturated streaming under scan: window/wave composition is untouched
     (same plan_waves per window), consecutive same-class waves fuse ACROSS
-    windows, and bindings match both per-wave disciplines exactly."""
+    windows, and bindings match both per-wave disciplines exactly. All
+    three runs share the same class-affine look-ahead (forming is a pure
+    function of the requested scan config, discipline-independent), so the
+    comparison is the bitwise parity contract: a pipelined baseline with
+    fusion disabled (min_waves_per_class too large to ever fuse) and a
+    serial baseline handed the identical config."""
     from grove_tpu.solver.stream import StreamConfig, drain_stream
 
     gangs, pods, snap = _setup()
     arrivals = [(0.0, g) for g in gangs]
     cfg = StreamConfig(wave_size=4)
-    bp, sp = drain_stream(arrivals, pods, snap, config=cfg, pipeline=True)
-    bw, _ = drain_stream(arrivals, pods, snap, config=cfg, pipeline=False)
+    scan_cfg = ScanConfig()
+    no_fuse = ScanConfig(min_waves_per_class=1 << 20)
+    bp, sp = drain_stream(
+        arrivals, pods, snap, config=cfg, pipeline=True, scan=no_fuse
+    )
+    bw, _ = drain_stream(
+        arrivals, pods, snap, config=cfg, pipeline=False, scan=scan_cfg
+    )
     bk, sk = drain_stream(
-        arrivals, pods, snap, config=cfg, pipeline=True, scan=True
+        arrivals, pods, snap, config=cfg, pipeline=True, scan=scan_cfg
     )
     assert bk == bp == bw
     assert sk.mode == "scan" and sk.drain.harvest == "scan"
