@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak bench-chaos bench-chaos-soak bench-tenancy bench-tenancy-soak bench-rollout bench-rollout-soak profile-host dryrun api-docs check clean ci
+.PHONY: test test-all test-e2e test-conformance test-cpp-shim test-go-shim test-kind bench bench-cpu bench-defrag bench-defrag-cpu bench-quality bench-quality-cpu bench-replay bench-replay-cpu bench-scale bench-scale-cpu bench-stream bench-stream-cpu bench-shard bench-shard-soak bench-sweep bench-sweep-soak bench-chaos bench-chaos-soak bench-cells bench-cells-soak bench-tenancy bench-tenancy-soak bench-rollout bench-rollout-soak profile-host dryrun api-docs check clean ci
 
 # The green-bar contract for a cold checkout: check + default suite +
 # process e2e + wire conformance + the Go shim when a toolchain exists.
@@ -136,6 +136,20 @@ bench-tenancy:   ## multi-tenant SLO tiers: fairness + tier ordering + reclaim b
 bench-tenancy-soak: ## tenancy scenario over a longer trace with more tenants (slow)
 	@mkdir -p evidence
 	GROVE_BENCH_SCENARIO=tenancy GROVE_FORCE_CPU=1 GROVE_BENCH_TENANCY_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_tenancy_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+# Cellular-control-plane scenario: a 2-cell partition killed mid-stream via
+# the cell.crash fault site — the replacement cell replays its journal tail
+# bitwise and resumes with zero lost / zero double-bound gangs and zero
+# oversubscribed node-ticks — plus a {1,2,4}-cell scaling sweep showing
+# per-cell host participation shrinking to O(own slice). Evidence JSON tee'd
+# under evidence/; the soak variant lengthens the trace (slow tier).
+bench-cells:     ## cellular control plane: kill/resume via journal replay + {1,2,4}-cell scaling
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=cells GROVE_FORCE_CPU=1 $(PY) bench.py | tee evidence/bench_cells_cpu_$$(date -u +%Y%m%dT%H%M%SZ).json
+
+bench-cells-soak: ## cells scenario over a longer arrival trace (slow)
+	@mkdir -p evidence
+	GROVE_BENCH_SCENARIO=cells GROVE_FORCE_CPU=1 GROVE_BENCH_CELLS_SOAK=1 GROVE_BENCH_BUDGET_S=3000 $(PY) bench.py | tee evidence/bench_cells_cpu_soak_$$(date -u +%Y%m%dT%H%M%SZ).json
 
 # Fleet-lifecycle scenario: a make-before-break rolling update of a resident
 # workload overlapping a revocation storm on the spot slice of the fleet —

@@ -1351,6 +1351,262 @@ def run_stream_bench() -> dict:
     return out
 
 
+def run_cells_bench() -> dict:
+    """Cellular-control-plane scenario (`make bench-cells` /
+    GROVE_BENCH_SCENARIO=cells): sharded reconcile cells with
+    journal-replay crash recovery (grove_tpu/cells; docs/design.md
+    "Cellular control plane").
+
+    Phase 1 — kill/resume gate: a 2-cell partition streams a deterministic
+    arrival trace; an injected `cell.crash` fault kills cell-0 mid-stream
+    (between family chunks — engines are reused unchanged, so the fault
+    site sits at the cell's chunk boundary). A replacement cell recovers by
+    replaying its journal tail BITWISE (trace/replay; divergences must be
+    0), rebuilds decided/bindings/allocated from the recorded verdicts, and
+    resumes the trace. Gates:
+      - zero lost gangs: every offered gang carries a journaled verdict
+        across the two lives;
+      - zero double-bound gangs: the resumed run re-admits nothing the
+        first life decided (the journal IS the dedup source);
+      - zero oversubscribed node-ticks across the whole journal
+        (cells.audit_journal checks every (wave, node) tick against the
+        recorded fleet capacity);
+      - replay-verified handoff (divergence_count == 0).
+
+    Phase 2 — multi-cell scaling {1, 2, 4} over the SAME trace and fleet:
+    each cell owns a topology slice (whole zones) and serves only its
+    routed share. On this host (host_cpus below) the cells timeshare the
+    same core, so wall-clock aggregate gangs/sec is NOT the signal —
+    the MECHANISM is: per-cell host participation (engine host seconds,
+    gangs served) must shrink to O(own slice) as cell count grows, while
+    aggregate dispatches stay O(trace). A `cell.partition` probe against
+    the coordinator shows cross-cell routing deferring (counted), never
+    half-applying.
+
+    GROVE_BENCH_CELLS_SOAK=1 lengthens the trace (slow tier, excluded from
+    tier-1)."""
+    import tempfile
+
+    from grove_tpu.cells import (
+        Cell,
+        CellCoordinator,
+        CellCrash,
+        audit_journal,
+        fleet_slices,
+        partition_tree,
+        recover,
+        with_fleet,
+    )
+    from grove_tpu.faults import FaultInjector, SiteSpec
+    from grove_tpu.sim.workloads import (
+        ZONE_KEY,
+        arrival_process,
+        bench_topology,
+        expand_arrivals,
+        synthetic_cluster,
+    )
+    from grove_tpu.trace.recorder import read_journal, read_manifest
+
+    soak = os.environ.get("GROVE_BENCH_CELLS_SOAK", "0") == "1"
+    duration = float(
+        os.environ.get("GROVE_BENCH_CELLS_DURATION_S", "60" if soak else "25")
+    )
+    rate = float(os.environ.get("GROVE_BENCH_CELLS_RATE", "4"))
+    seed = int(os.environ.get("GROVE_BENCH_CELLS_SEED", "20260807"))
+    chunk = int(os.environ.get("GROVE_BENCH_CELLS_CHUNK", "12"))
+
+    topo = bench_topology()
+    # 4 zones so the fleet shards cleanly into {1, 2, 4} cells along whole
+    # zones; modest rack/host counts keep the per-cell engines inside the
+    # 1-core budget (the scaling signal is counts + host seconds, not wall).
+    nodes = synthetic_cluster(
+        zones=4, blocks_per_zone=1, racks_per_block=2, hosts_per_rack=4
+    )
+    events = arrival_process(seed, duration_s=duration, base_rate=rate)
+    arrivals, pods = expand_arrivals(events, topo)
+    root = tempfile.mkdtemp(prefix="grove-bench-cells-")
+
+    def _build(count: int, tag: str, faults_by_cell: dict | None = None):
+        """A count-cell deployment: plan, fleet slices, live cells, and a
+        coordinator routing the shared trace."""
+        plan = with_fleet(partition_tree(None, count), nodes, ZONE_KEY)
+        slices = fleet_slices(plan, nodes, ZONE_KEY)
+        cells = {}
+        for cname in plan.cells:
+            cells[cname] = Cell(
+                cname,
+                slices[cname],
+                topo,
+                journal_path=os.path.join(root, tag, cname),
+                faults=(faults_by_cell or {}).get(cname),
+                crash_check_every=chunk,
+            )
+            cells[cname].start()
+        return plan, slices, cells, CellCoordinator(plan, cells)
+
+    # ---- phase 1: kill-and-resume a cell mid-stream ---------------------
+    crash_inj = FaultInjector(
+        {"cell.crash": SiteSpec(kind="error", rate=1.0, count=1)}, seed=seed
+    )
+    plan, slices, cells, coord = _build(
+        2, "killresume", faults_by_cell={"cell-0": crash_inj}
+    )
+    assigned = coord.assign(arrivals)
+    survivor = cells["cell-1"].serve(assigned["cell-1"], pods)
+    crashed = False
+    try:
+        cells["cell-0"].serve(assigned["cell-0"], pods)
+    except CellCrash:
+        crashed = True
+    pre_decided = set(cells["cell-0"].decided)
+    pre_bound = dict(cells["cell-0"].bindings)
+    jp0 = os.path.join(root, "killresume", "cell-0")
+    replacement, report = recover(
+        "cell-0", slices["cell-0"], topo, journal_path=jp0,
+        crash_check_every=chunk,
+    )
+    recovery_state_ok = (
+        replacement.decided == pre_decided
+        and set(replacement.bindings) == set(pre_bound)
+    )
+    replacement.start()
+    resumed = replacement.serve(assigned["cell-0"], pods)
+    replacement.close()
+    cells["cell-1"].close()
+    double_bound = sorted(set(resumed) & set(pre_bound))
+    offered_names = {g.name for _, g in assigned["cell-0"]}
+    lost = sorted(offered_names - replacement.decided)
+    audit0 = audit_journal(read_journal(jp0))
+    audit1 = audit_journal(
+        read_journal(os.path.join(root, "killresume", "cell-1"))
+    )
+    manifest0 = read_manifest(jp0) or {}
+    kill_gates = {
+        "crash_injected": crashed,
+        "replay_verified": bool(report.verified),
+        "recovery_state_matches_precrash": recovery_state_ok,
+        "zero_lost_gangs": not lost,
+        "zero_double_bound_gangs": not double_bound,
+        "zero_oversubscribed_node_ticks": (
+            audit0["oversubscribed"] == 0 and audit1["oversubscribed"] == 0
+        ),
+    }
+
+    # ---- phase 2: multi-cell scaling {1, 2, 4} --------------------------
+    scaling = []
+    for count in (1, 2, 4):
+        _, _, sc_cells, sc_coord = _build(count, f"scale{count}")
+        sc_assigned = sc_coord.assign(arrivals)
+        bound_by_cell = {}
+        for cname, arr in sc_assigned.items():
+            bound_by_cell[cname] = sc_cells[cname].serve(arr, pods)
+        per_cell = {
+            cname: {
+                "gangs_offered": c.stats.offered,
+                "gangs_admitted": c.stats.admitted,
+                "dispatches": c.stats.dispatches,
+                "host_total_s": round(c.stats.host_total_s, 4),
+                "host_blocked_s": round(c.stats.host_blocked_s, 4),
+                "nodes": len(c.nodes),
+            }
+            for cname, c in sc_cells.items()
+        }
+        # Cross-cell disjointness: a gang bound in exactly one cell.
+        all_bound = [g for b in bound_by_cell.values() for g in b]
+        scaling.append(
+            {
+                "cells": count,
+                "per_cell": per_cell,
+                "aggregate_dispatches": sum(
+                    c.stats.dispatches for c in sc_cells.values()
+                ),
+                "aggregate_admitted": sum(
+                    c.stats.admitted for c in sc_cells.values()
+                ),
+                "max_cell_host_total_s": round(
+                    max(c.stats.host_total_s for c in sc_cells.values()), 4
+                ),
+                "max_cell_gangs_offered": max(
+                    c.stats.offered for c in sc_cells.values()
+                ),
+                "bound_disjoint": len(all_bound) == len(set(all_bound)),
+            }
+        )
+        for c in sc_cells.values():
+            c.close()
+    # O(own slice): the busiest cell's share of the trace must shrink as
+    # the plan fans out (gangs are the host-participation driver; host
+    # seconds on a timeshared core carry too much compile/GC noise to gate
+    # on, so they are recorded as evidence, not gated).
+    share_shrinks = (
+        scaling[2]["max_cell_gangs_offered"]
+        < scaling[0]["max_cell_gangs_offered"]
+    )
+    scaling_gates = {
+        "bound_disjoint_all_counts": all(s["bound_disjoint"] for s in scaling),
+        "per_cell_share_shrinks": share_shrinks,
+        "aggregate_admitted_stable": len(
+            {s["aggregate_admitted"] for s in scaling}
+        )
+        <= 3,  # recorded; placement differs across slicings by design
+    }
+
+    # ---- cell.partition probe: cross-cell routing defers, never splits --
+    part_inj = FaultInjector(
+        {"cell.partition": SiteSpec(kind="error", rate=1.0, count=1)},
+        seed=seed,
+    )
+    pplan, _, pcells, pcoord = _build(2, "partition")
+    pcoord.faults = part_inj
+    partition_deferred_then_ok = (
+        not pcoord.reachable("cell-1") and pcoord.reachable("cell-1")
+    )
+    for c in pcells.values():
+        c.close()
+
+    gates = {
+        **kill_gates,
+        **scaling_gates,
+        "partition_defers_then_recovers": partition_deferred_then_ok,
+    }
+    green = all(gates.values())
+    return {
+        "scenario": "cells",
+        "metric": "cells_gates_green",
+        "unit": "bool",
+        "value": 1.0 if green else 0.0,
+        "vs_baseline": 1.0 if green else 0.0,
+        "soak": soak,
+        # 1-core caveat: cells timeshare this host's core(s), so aggregate
+        # wall-clock gangs/sec does NOT scale here; the recorded mechanism
+        # signals are per-cell share + host seconds and aggregate
+        # dispatches (see the docstring).
+        "host_cpus": len(os.sched_getaffinity(0)),
+        "nodes": len(nodes),
+        "trace_seed": seed,
+        "trace_duration_s": duration,
+        "trace_base_rate": rate,
+        "gangs_offered": len(arrivals),
+        "crash_check_every": chunk,
+        "gates": gates,
+        "kill_resume": {
+            "precrash_decided": len(pre_decided),
+            "precrash_bound": len(pre_bound),
+            "resumed_bound": len(resumed),
+            "survivor_bound": len(survivor),
+            "lost_gangs": lost[:8],
+            "double_bound_gangs": double_bound[:8],
+            "replay": report.to_doc(),
+            "audit_cell0": audit0,
+            "audit_cell1": audit1,
+            "manifest_segments": len(manifest0.get("segments", [])),
+            "manifest_last_wave": manifest0.get("lastWave"),
+        },
+        "scaling": scaling,
+        "partition_deferred_count": part_inj.fired.get("cell.partition", 0),
+    }
+
+
 def run_chaos_bench() -> dict:
     """Chaos-soak scenario (`make bench-chaos` / GROVE_BENCH_SCENARIO=chaos):
     the streaming drain under a STANDARD deterministic fault schedule, with
@@ -2736,6 +2992,7 @@ SCENARIOS: dict[str, tuple[str, str, object]] = {
     "stream": ("stream_pipeline_speedup", "x", run_stream_bench),
     "shard": ("shard_solve_speedup", "x", run_shard_bench),
     "sweep": ("sweep_vs_single_replay", "x", run_sweep_bench),
+    "cells": ("cells_gates_green", "bool", run_cells_bench),
     "chaos": ("chaos_bind_p99_inflation", "x", run_chaos_bench),
     "tenancy": ("tenancy_fair_spread", "ratio", run_tenancy_bench),
     "rollout": ("rollout_chaos_gates_green", "bool", run_rollout_bench),

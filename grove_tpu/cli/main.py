@@ -70,6 +70,8 @@ KIND_ALIASES = {
     "quality": "quality",
     "resilience": "resilience",
     "tenancy": "tenancy",
+    "cell": "cells",
+    "cells": "cells",
     "rollout": "rollout",
     "rollouts": "rollout",
 }
@@ -390,6 +392,26 @@ def _get_table(client: GroveClient, kind: str) -> str:
                 ]
             )
         return _table(rows, ["METRIC", "VALUE"])
+    if kind == "cells":
+        # Cellular control plane at a glance: the partition plan (which
+        # cell owns which root subtrees), per-cell lease holdership, and
+        # each cell's journal path — from /statusz "cells" (the
+        # grove_cell_* metrics source doc).
+        doc = client.statusz().get("cells", {})
+        if not doc.get("enabled"):
+            return _table([["enabled", "no"]], ["METRIC", "VALUE"])
+        rows = []
+        for cname, c in sorted(doc.get("cells", {}).items()):
+            rows.append(
+                [
+                    cname,
+                    "held" if c.get("leaseHeld") else "lost",
+                    ",".join(c.get("queues", [])) or "-",
+                    ",".join(c.get("domains", [])) or "-",
+                    c.get("journal", "-"),
+                ]
+            )
+        return _table(rows, ["CELL", "LEASE", "QUEUES", "DOMAINS", "JOURNAL"])
     if kind == "quality":
         # Placement quality at a glance: the last solve wave's aggregate +
         # cumulative counters from /statusz (quality/report.py units; the
@@ -607,6 +629,28 @@ def _trace_cmd(args) -> int:
                 ["timeRange", f"{min(times):.1f} - {max(times):.1f}"],
             ]
         rows += [[f"actions.{k}", v] for k, v in sorted(actions.items())]
+        # Segment manifest (manifest.json, written atomically beside the
+        # segments): tail replay finds its resume point here without
+        # scanning every segment file.
+        from grove_tpu.trace.recorder import read_manifest
+
+        manifest = read_manifest(args.path)
+        if manifest is not None:
+            rows += [
+                ["manifest.segments", len(manifest.get("segments", []))],
+                ["manifest.waves", manifest.get("waves", 0)],
+                ["manifest.lastWave", manifest.get("lastWave") or "-"],
+            ]
+            for seg in manifest.get("segments", []):
+                wr = seg.get("waveRange")
+                rows.append(
+                    [
+                        f"manifest.{seg.get('file', '?')}",
+                        f"{seg.get('records', 0)} records, "
+                        f"{seg.get('waves', 0)} waves"
+                        + (f" ({wr[0]} .. {wr[1]})" if wr else ""),
+                    ]
+                )
         print(_table(rows, ["FIELD", "VALUE"]))
         if jstats["dropped"]:
             print(

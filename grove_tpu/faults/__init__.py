@@ -13,6 +13,10 @@ replayable input: code threads named *sites* through the stack —
   sim.node_death     schedulable chaos-script node kill (sim/simulator)
   sim.node_revocation  a revocable node gets a revocation notice with a
                      grace window (sim/simulator; spot capacity reclaim)
+  cell.crash         a reconcile cell dies mid-stream; the replacement must
+                     recover from its journal tail (cells/cell.py)
+  cell.partition     the coordinator cannot reach a cell — cross-cell
+                     borrow/reclaim routing defers (cells/coordinator.py)
 
 — and an injector decides, per evaluation, whether the fault fires. The
 decision is a pure function of (site seed, evaluation index): two runs with
@@ -58,6 +62,8 @@ SITES = (
     "recorder.write",
     "sim.node_death",
     "sim.node_revocation",
+    "cell.crash",
+    "cell.partition",
 )
 
 

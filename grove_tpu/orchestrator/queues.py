@@ -116,6 +116,22 @@ class QueueTree:
         """name, parent, ..., root."""
         return self._chain[name]
 
+    def roots(self) -> list[str]:
+        """Parentless queues, sorted — the subtree seams. Each root's
+        subtree is a self-contained borrow domain (roots cannot borrow), so
+        roots are exactly the boundaries the cellular control plane shards
+        on (grove_tpu/cells/partition.py)."""
+        return sorted(n for n, s in self.specs.items() if s.parent is None)
+
+    def leaves(self) -> list[str]:
+        """Childless queues, sorted — the queues gangs are actually
+        submitted to (hierarchical usage charges ancestors automatically)."""
+        return sorted(n for n, kids in self._children.items() if not kids)
+
+    def root_of(self, name: str) -> str:
+        """The root of `name`'s subtree (name itself when parentless)."""
+        return self._chain[name][-1]
+
     def subtree(self, name: str) -> set[str]:
         out, stack = set(), [name]
         while stack:

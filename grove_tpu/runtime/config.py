@@ -454,6 +454,40 @@ class FaultsConfig:
 
 
 @dataclass
+class CellsConfig:
+    """Cellular control plane (grove_tpu/cells; docs/design.md "Cellular
+    control plane"). When enabled the manager partitions the control plane
+    into `count` reconcile cells along QueueTree root-subtree seams (each
+    root subtree is a self-contained borrow domain) and shards the fleet
+    along `topologyLevel`; each cell runs its own drain/stream engine with
+    its own journal (under journalRoot/<cell>) and its own named lease
+    (runtime/lease.LeaseSet — losing one cell's lease never touches
+    another's). A restarting cell recovers by replaying its journal tail
+    bitwise (trace/replay) before admitting new work. Cross-cell traffic
+    (spanning gangs, borrowed capacity, reclaim) routes through the
+    coordinator only."""
+
+    enabled: bool = False
+    # How many cells to shard into (cell-0 .. cell-(count-1)).
+    count: int = 2
+    # Partition axis: "queue" pins gangs by QueueTree root subtree;
+    # "topology" leaves queues unpinned (pure fleet sharding).
+    shard_by: str = "queue"
+    # TAS domain the fleet shards along (a domain's nodes land wholly in
+    # one cell, so each engine sees a topologically coherent sub-snapshot).
+    topology_level: str = "zone"
+    # Per-cell journal directories: journalRoot/<cell-name>/.
+    journal_root: str = RUNTIME_STATE_DIR + "/cells"
+    # Per-cell named lease files: leaseDir/<cell-name>.lease.
+    lease_dir: str = RUNTIME_STATE_DIR + "/cell-leases"
+    lease_duration_seconds: float = 15.0
+    renew_deadline_seconds: float = 10.0
+    # Gangs per engine run between crash-fault checkpoints (cell.crash
+    # fires only at chunk boundaries; families never split across chunks).
+    crash_check_every: int = 128
+
+
+@dataclass
 class ResilienceConfig:
     """Graceful-degradation ladder + failure-domain hardening
     (solver/resilience.py). When enabled: a watchdog cancels and
@@ -635,6 +669,7 @@ class OperatorConfiguration:
     tuning: TuningConfig = field(default_factory=TuningConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    cells: CellsConfig = field(default_factory=CellsConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     backend: BackendConfig = field(default_factory=BackendConfig)
     persistence: PersistenceConfig = field(default_factory=PersistenceConfig)
@@ -676,6 +711,7 @@ _SECTION_TYPES = {
     "tuning": ("tuning", TuningConfig),
     "faults": ("faults", FaultsConfig),
     "resilience": ("resilience", ResilienceConfig),
+    "cells": ("cells", CellsConfig),
     "tenancy": ("tenancy", TenancyConfig),
     "backend": ("backend", BackendConfig),
     "persistence": ("persistence", PersistenceConfig),
@@ -768,6 +804,11 @@ _CAMEL_FIELDS = {
     "eventLagSeconds": "event_lag_seconds",
     "surgeRacks": "surge_racks",
     "deadlineSeconds": "deadline_seconds",
+    "shardBy": "shard_by",
+    "topologyLevel": "topology_level",
+    "journalRoot": "journal_root",
+    "leaseDir": "lease_dir",
+    "crashCheckEvery": "crash_check_every",
     "revocableNodes": "revocable_nodes",
     "revocableGraceSeconds": "revocable_grace_seconds",
     "revocableEvictionLeadSeconds": "revocable_eviction_lead_seconds",
@@ -901,6 +942,26 @@ def validate_operator_config(cfg: OperatorConfiguration) -> list[str]:
             errors.append(f"topologyAwareScheduling.levels: {e}")
     if cfg.persistence.enabled and not cfg.persistence.path:
         errors.append("persistence.path: required when persistence is enabled")
+    ce = cfg.cells
+    if not isinstance(ce.count, int) or isinstance(ce.count, bool) or ce.count < 1:
+        errors.append("cells.count: must be an int >= 1")
+    if ce.shard_by not in ("queue", "topology"):
+        errors.append(f"cells.shardBy: {ce.shard_by!r} not in queue|topology")
+    if (
+        not isinstance(ce.crash_check_every, int)
+        or isinstance(ce.crash_check_every, bool)
+        or ce.crash_check_every < 1
+    ):
+        errors.append("cells.crashCheckEvery: must be an int >= 1")
+    if ce.enabled:
+        if not ce.journal_root:
+            errors.append("cells.journalRoot: required when cells are enabled")
+        if not ce.lease_dir:
+            errors.append("cells.leaseDir: required when cells are enabled")
+        if ce.renew_deadline_seconds >= ce.lease_duration_seconds:
+            errors.append(
+                "cells.renewDeadlineSeconds: must be < leaseDurationSeconds"
+            )
     import re as _re
 
     pcs_map = cfg.scheduling.priority_classes

@@ -83,3 +83,79 @@ class FileLease:
                 os.unlink(self.path)
             except OSError:
                 pass
+
+
+_LEASE_NAME_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-"
+)
+
+
+class LeaseSet:
+    """Multiple named leases held by ONE process — one per reconcile cell.
+
+    Each name maps to its own `FileLease` at `<directory>/<name>.lease` with
+    its OWN renewal clock (`_last_renew` is per-FileLease state), so a cell
+    whose reconcile stalls past its renew deadline stands down for THAT
+    lease only: losing one cell's lease never releases another's
+    (tests/test_cells.py pins this with a fake clock). All leases share one
+    process identity so a restarted process steals its own stale leases
+    uniformly.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        lease_duration_seconds: float = 15.0,
+        renew_deadline_seconds: float | None = None,
+        identity: str | None = None,
+    ) -> None:
+        self.directory = directory
+        self.lease_duration_seconds = float(lease_duration_seconds)
+        self.renew_deadline_seconds = renew_deadline_seconds
+        self.identity = (
+            identity
+            if identity is not None
+            else f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        )
+        self._leases: dict[str, FileLease] = {}
+
+    def lease(self, name: str) -> FileLease:
+        """The named lease (created lazily). Names are path components, so
+        only [A-Za-z0-9._-] is accepted — a separator in a cell name must
+        not escape the lease directory."""
+        got = self._leases.get(name)
+        if got is not None:
+            return got
+        if not name or not set(name) <= _LEASE_NAME_OK or name.startswith("."):
+            raise ValueError(f"lease name {name!r}: use [A-Za-z0-9_-][A-Za-z0-9._-]*")
+        got = FileLease(
+            path=os.path.join(self.directory, f"{name}.lease"),
+            lease_duration_seconds=self.lease_duration_seconds,
+            renew_deadline_seconds=self.renew_deadline_seconds,
+            identity=self.identity,
+        )
+        self._leases[name] = got
+        return got
+
+    def try_acquire(self, name: str, now: float | None = None) -> bool:
+        """Acquire/renew one named lease; the other names' clocks are
+        untouched (independent renewal — the whole point of the set)."""
+        return self.lease(name).try_acquire(now)
+
+    def held(self) -> dict[str, bool]:
+        """Last-known holdership per name (True = the most recent
+        try_acquire succeeded and no stand-down happened since)."""
+        return {
+            name: lease._last_renew is not None
+            for name, lease in sorted(self._leases.items())
+        }
+
+    def release(self, name: str) -> None:
+        got = self._leases.get(name)
+        if got is not None:
+            got.release()
+
+    def release_all(self) -> None:
+        for lease in self._leases.values():
+            lease.release()

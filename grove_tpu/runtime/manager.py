@@ -598,6 +598,12 @@ class Manager:
         # (same try_acquire/release surface).
         self._lease: Optional[FileLease] = None
         self._is_leader = not config.leader_election.enabled
+        # Cellular control plane (config cells section): the partition plan
+        # (grove_tpu/cells/partition.py) and the per-cell named leases
+        # (runtime/lease.LeaseSet — independent renewal clocks). Built by
+        # start() when cells.enabled; renewed each run-loop tick.
+        self.cell_plan = None
+        self.cell_leases = None
         self._backend_server = None
         self.backend_port: Optional[int] = None
         self.health_port: Optional[int] = None
@@ -656,6 +662,18 @@ class Manager:
         )
         self._m_leader = self.metrics.gauge(
             "grove_leader", "1 when this process holds the leader lease"
+        )
+        # Cellular control plane (grove_tpu/cells): plan size plus per-cell
+        # lease holdership and queue-pin counts, labeled by cell name.
+        self._m_cell_count = self.metrics.gauge(
+            "grove_cell_count", "Reconcile cells in the partition plan"
+        )
+        self._m_cell_lease_held = self.metrics.gauge(
+            "grove_cell_lease_held",
+            "1 when this process holds the named cell lease",
+        )
+        self._m_cell_queues = self.metrics.gauge(
+            "grove_cell_queues", "Queues pinned to the cell by the plan"
         )
         self._m_gangs_admitted = self.metrics.counter(
             "grove_gangs_admitted_total", "Gangs admitted by the solver"
@@ -1229,6 +1247,10 @@ class Manager:
             # Tenancy: per-tenant fairness ledger, aging state, shared
             # disruption-budget view (`grove-tpu get tenancy` renders this).
             "tenancy": self.controller.tenancy_status(),
+            # Cellular control plane: partition plan + per-cell lease
+            # holdership and journal paths (`grove-tpu get cells` renders
+            # this; grove_cell_* metrics are cut from the same state).
+            "cells": self.cells_status(),
             # Placement quality of live serving solves (quality/report.py
             # discipline — what `grove-tpu get quality` renders).
             "quality": self.controller.quality_status(),
@@ -1257,6 +1279,35 @@ class Manager:
                 "nodes": len(self.cluster.nodes),
             },
         }
+
+    def cells_status(self) -> dict:
+        """JSON-able cellular-control-plane view for /statusz "cells" and
+        `grove-tpu get cells`: the partition plan (which cell owns which
+        root subtrees/queues), per-cell lease holdership, and where each
+        cell's journal lives (the tail a replacement cell replays)."""
+        import os as _os
+
+        cfg = self.config.cells
+        doc: dict = {"enabled": bool(cfg.enabled)}
+        if not cfg.enabled or self.cell_plan is None:
+            return doc
+        held = self.cell_leases.held() if self.cell_leases is not None else {}
+        doc.update(
+            count=len(self.cell_plan.cells),
+            shardBy=cfg.shard_by,
+            journalRoot=cfg.journal_root,
+            plan=self.cell_plan.to_doc(),
+            cells={
+                name: {
+                    "queues": self.cell_plan.queues_of(name),
+                    "domains": self.cell_plan.domains_of(name),
+                    "leaseHeld": bool(held.get(name, False)),
+                    "journal": _os.path.join(cfg.journal_root, name),
+                }
+                for name in self.cell_plan.cells
+            },
+        )
+        return doc
 
     def solver_status(self) -> dict:
         """JSON-able solver view for /statusz "solver" and `grove-tpu get
@@ -1481,6 +1532,38 @@ class Manager:
                 )
             self._is_leader = self._lease.try_acquire()
         self._m_leader.set(1.0 if self._is_leader else 0.0)
+        if cfg.cells.enabled:
+            # Cellular control plane: partition along QueueTree root-subtree
+            # seams (shard_by queue; "topology" leaves queues unpinned) and
+            # acquire one named lease per cell — independent renewal clocks,
+            # so one stalled cell stands down alone (runtime/lease.LeaseSet).
+            from grove_tpu.cells import partition_tree
+            from grove_tpu.runtime.lease import LeaseSet
+
+            tree = (
+                self.controller.queue_tree
+                if cfg.cells.shard_by == "queue"
+                else None
+            )
+            self.cell_plan = partition_tree(tree, cfg.cells.count)
+            self.cell_leases = LeaseSet(
+                cfg.cells.lease_dir,
+                lease_duration_seconds=cfg.cells.lease_duration_seconds,
+                renew_deadline_seconds=cfg.cells.renew_deadline_seconds,
+            )
+            self._m_cell_count.set(float(len(self.cell_plan.cells)))
+            for cell_name in self.cell_plan.cells:
+                held = self.cell_leases.try_acquire(cell_name)
+                self._m_cell_lease_held.set(1.0 if held else 0.0, cell=cell_name)
+                self._m_cell_queues.set(
+                    float(len(self.cell_plan.queues_of(cell_name))), cell=cell_name
+                )
+            self.log.info(
+                "cellular control plane enabled",
+                cells=len(self.cell_plan.cells),
+                shardBy=cfg.cells.shard_by,
+                journalRoot=cfg.cells.journal_root,
+            )
 
         if cfg.servers.health_port >= 0:
             self.health_port = self._serve_http(cfg.servers.health_port)
@@ -2188,6 +2271,14 @@ class Manager:
             if self._lease is not None:
                 self._is_leader = self._lease.try_acquire(now)
                 self._m_leader.set(1.0 if self._is_leader else 0.0)
+            if self.cell_leases is not None:
+                # Per-cell renewal, one clock each: a cell that oversleeps
+                # its renew deadline stands down alone, the others renew on.
+                for cell_name in self.cell_plan.cells:
+                    held = self.cell_leases.try_acquire(cell_name, now)
+                    self._m_cell_lease_held.set(
+                        1.0 if held else 0.0, cell=cell_name
+                    )
             if self._is_leader:
                 self.reconcile_once(now)
                 interval = cfg.controllers.reconcile_interval_seconds
@@ -2226,6 +2317,8 @@ class Manager:
             server.shutdown()
         if self._lease is not None:
             self._lease.release()
+        if self.cell_leases is not None:
+            self.cell_leases.release_all()
         if self.persistence is not None:
             self.persistence.snapshot(self.cluster)
         self.log.info("manager stopped")

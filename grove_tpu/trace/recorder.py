@@ -51,6 +51,7 @@ from grove_tpu.utils.fsio import atomic_write_json
 SCHEMA_VERSION = 1
 
 _SEGMENT_GLOB = "segment-*.json"
+_MANIFEST = "manifest.json"
 
 for _m in (types_mod, pod_mod, podgang_mod, state_mod):
     serde.register_module(_m)
@@ -147,6 +148,13 @@ class TraceRecorder:
         self.write_errors = 0
         self.degraded = False
         self._last_write_error: Optional[str] = None
+        # Segment manifest bookkeeping: the writer maintains manifest.json
+        # beside the segments (atomic like them) so tail replay can find its
+        # resume point — last journaled wave id, per-segment wave ranges,
+        # fleet digests — without opening every segment. Derived data: a
+        # failed manifest write is counted but never degrades the journal.
+        self.manifest_writes = 0
+        self.manifest_write_errors = 0
         # fleet digests already enqueued this process (the writer re-emits
         # per segment from its own payload cache).
         self._announced: set[str] = set()
@@ -378,6 +386,12 @@ class TraceRecorder:
         import time as _time
 
         last_flush = _time.monotonic()
+        # seq -> manifest entry for every segment currently on disk, seeded
+        # from the prior process's manifest (entries for pruned files drop;
+        # unmanifested segments — written before the manifest existed — are
+        # summarized once from disk here, never again per write).
+        manifest = self._seed_manifest()
+        self._write_manifest(manifest)
 
         def write_segment() -> None:
             nonlocal dirty, last_flush, segment, seg_digests
@@ -410,6 +424,8 @@ class TraceRecorder:
                     )
                     self.segments_written += 1
                     self.degraded = False
+                    manifest[seq] = _manifest_entry(seq, segment)
+                    self._write_manifest(manifest)
                 except OSError as e:
                     # Counting-drops mode: the journal is observability, the
                     # solve loop is the product — a full disk must cost a
@@ -432,7 +448,8 @@ class TraceRecorder:
             seq += 1
             segment = []
             seg_digests = set()
-            self._prune()
+            if self._prune(manifest):
+                self._write_manifest(manifest)
 
         while True:
             try:
@@ -466,7 +483,8 @@ class TraceRecorder:
             if self._stop.is_set() and self._queue.empty():
                 break
         write_segment()
-        self._prune()
+        if self._prune(manifest):
+            self._write_manifest(manifest)
 
     def _segments(self) -> list[str]:
         return sorted(glob.glob(os.path.join(self.path, _SEGMENT_GLOB)))
@@ -481,13 +499,78 @@ class TraceRecorder:
                 continue
         return max(seqs) + 1 if seqs else 0
 
-    def _prune(self) -> None:
+    def _prune(self, manifest: dict | None = None) -> bool:
         files = self._segments()
+        removed = False
         for p in files[: max(0, len(files) - self.max_files)]:
             try:
                 os.unlink(p)
             except OSError:
-                pass  # pruning is best-effort; the journal stays readable
+                continue  # pruning is best-effort; the journal stays readable
+            removed = True
+            if manifest is not None:
+                stem = os.path.basename(p)[len("segment-"):-len(".json")]
+                try:
+                    manifest.pop(int(stem), None)
+                except ValueError:
+                    pass
+        return removed
+
+    # ---- segment manifest (writer thread) ------------------------------------------
+
+    def _seed_manifest(self) -> dict[int, dict]:
+        """Entries for every segment already on disk: reuse the previous
+        process's manifest where its entries still match a file, summarize
+        the rest by reading them once."""
+        prior = {}
+        doc = read_manifest(self.path)
+        if doc:
+            for e in doc.get("segments", []):
+                try:
+                    prior[int(e["seq"])] = e
+                except (KeyError, TypeError, ValueError):
+                    continue
+        manifest: dict[int, dict] = {}
+        for p in self._segments():
+            stem = os.path.basename(p)[len("segment-"):-len(".json")]
+            try:
+                seq = int(stem)
+            except ValueError:
+                continue
+            got = prior.get(seq)
+            if got is not None and got.get("file") == os.path.basename(p):
+                manifest[seq] = got
+                continue
+            try:
+                with open(p) as f:
+                    records = json.load(f).get("records", [])
+            except (OSError, ValueError):
+                continue
+            manifest[seq] = _manifest_entry(seq, records)
+        return manifest
+
+    def _write_manifest(self, manifest: dict[int, dict]) -> None:
+        entries = [manifest[s] for s in sorted(manifest)]
+        last_wave = None
+        for e in entries:
+            rng = e.get("waveRange")
+            if rng:
+                last_wave = rng[1]
+        try:
+            atomic_write_json(
+                os.path.join(self.path, _MANIFEST),
+                {
+                    "version": SCHEMA_VERSION,
+                    "segments": entries,
+                    "lastWave": last_wave,
+                    "waves": sum(int(e.get("waves", 0)) for e in entries),
+                },
+            )
+            self.manifest_writes += 1
+        except OSError:
+            # Derived data: replay falls back to scanning segments; the
+            # journal itself is NOT degraded by a missing manifest.
+            self.manifest_write_errors += 1
 
     def stats(self) -> dict:
         """JSON-able recorder state for /statusz "trace" and the metrics."""
@@ -501,10 +584,43 @@ class TraceRecorder:
             "queueDepth": self._queue.qsize(),
             "degraded": self.degraded,
             "writeErrors": self.write_errors,
+            "manifestWrites": self.manifest_writes,
+            "manifestWriteErrors": self.manifest_write_errors,
         }
         if self._last_write_error:
             doc["lastWriteError"] = self._last_write_error
         return doc
+
+
+def _manifest_entry(seq: int, records: list[dict]) -> dict:
+    """One segment's manifest row: id, record/wave counts, the wave-id range
+    it covers (commit order — first and last wave record), and the fleet
+    digests it re-emits (every segment replays standalone)."""
+    waves = [r.get("wave", "?") for r in records if r.get("kind") == "wave"]
+    return {
+        "file": f"segment-{seq:06d}.json",
+        "seq": seq,
+        "records": len(records),
+        "waves": len(waves),
+        "waveRange": [waves[0], waves[-1]] if waves else None,
+        "fleetDigests": sorted(
+            {r["digest"] for r in records if r.get("kind") == "fleet"}
+        ),
+    }
+
+
+def read_manifest(path: str) -> dict | None:
+    """The journal's segment manifest ({"version", "segments", "lastWave",
+    "waves"}), or None when absent/unreadable — callers fall back to
+    scanning segment files (`read_journal`). A restarting cell uses
+    `lastWave` as its resume point and the per-segment `waveRange` rows to
+    pick the tail segments worth replaying."""
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
 
 
 def journal_stats(path: str) -> dict:

@@ -1,0 +1,473 @@
+"""Cellular control plane (grove_tpu/cells; docs/design.md "Cellular
+control plane").
+
+Pins the partition invariants (every queue maps to exactly one cell via its
+root subtree; the partition is a pure deterministic function of the tree),
+the coordinator-only borrow seam (a cell refuses foreign gangs; borrowed
+capacity routes through `CellCoordinator` and reclaims cleanly), the
+LeaseSet's independent per-cell renewal clocks, the recorder's segment
+manifest, and the tentpole itself: a 2-cell kill/resume where the injected
+`cell.crash` kills a cell mid-stream and its replacement recovers by
+replaying the journal tail bitwise — zero lost gangs, zero double-bound
+gangs, zero oversubscribed node-ticks.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from grove_tpu.cells import (
+    Cell,
+    CellCoordinator,
+    CellCrash,
+    audit_journal,
+    cell_names,
+    fleet_slices,
+    partition_domains,
+    partition_tree,
+    recover,
+    with_fleet,
+)
+from grove_tpu.faults import FaultInjector, SiteSpec
+from grove_tpu.orchestrator.queues import QueueSpec, QueueTree
+from grove_tpu.runtime.lease import LeaseSet
+
+SEED = 20260807
+
+
+def _warm():
+    """One warm path shared by every engine-driving test in this module:
+    real deployments run one process per cell, but here sharing the compile
+    caches keeps the tier-1 smokes cheap without changing what is tested."""
+    from grove_tpu.solver.warm import WarmPath
+
+    global _WP
+    if _WP is None:
+        _WP = WarmPath()
+    return _WP
+
+
+_WP = None
+
+
+def _tree(order: list[str] | None = None) -> QueueTree:
+    """Two root subtrees (teams/*, batch) + a third root; `order` permutes
+    the spec-dict insertion order to prove it cannot matter."""
+    specs = {
+        "teams": QueueSpec(name="teams"),
+        "teams/ml": QueueSpec(name="teams/ml", parent="teams"),
+        "teams/ml/train": QueueSpec(name="teams/ml/train", parent="teams/ml"),
+        "teams/infra": QueueSpec(name="teams/infra", parent="teams"),
+        "batch": QueueSpec(name="batch"),
+        "adhoc": QueueSpec(name="adhoc"),
+    }
+    if order is not None:
+        specs = {name: specs[name] for name in order}
+    return QueueTree(specs)
+
+
+def _fleet(zones=2, racks=1, hosts=2):
+    from grove_tpu.sim.workloads import bench_topology, synthetic_cluster
+
+    topo = bench_topology()
+    nodes = synthetic_cluster(
+        zones=zones, blocks_per_zone=1, racks_per_block=racks, hosts_per_rack=hosts
+    )
+    return topo, nodes
+
+
+def _trace(seed=SEED, duration_s=10.0, rate=1.0):
+    from grove_tpu.sim.workloads import arrival_process, expand_arrivals
+
+    evs = arrival_process(seed, duration_s=duration_s, base_rate=rate)
+    return expand_arrivals(evs)
+
+
+# ---- partition invariants ---------------------------------------------------------
+
+
+def test_every_queue_maps_to_exactly_one_cell():
+    """Leaf or interior, every queue in the tree lands in exactly one cell,
+    and always its root's cell — a root subtree (the self-contained borrow
+    domain) never splits across cells."""
+    tree = _tree()
+    plan = partition_tree(tree, 2)
+    assert set(plan.queue_cell) == set(tree.specs)
+    for name in tree.specs:
+        assert plan.queue_cell[name] == plan.root_cell[tree.root_of(name)]
+    for leaf in tree.leaves():
+        owners = [c for c in plan.cells if leaf in plan.queues_of(c)]
+        assert len(owners) == 1
+    # Exhaustive + disjoint: the per-cell queue lists tile the tree.
+    tiled = sorted(q for c in plan.cells for q in plan.queues_of(c))
+    assert tiled == sorted(tree.specs)
+
+
+def test_partition_is_pure_and_insertion_order_independent():
+    """The plan is a function of (tree shape, count): permuting the config
+    dict's insertion order or recomputing must reproduce it byte for byte."""
+    a = partition_tree(_tree(), 3)
+    b = partition_tree(
+        _tree(order=["adhoc", "batch", "teams", "teams/infra", "teams/ml", "teams/ml/train"]),
+        3,
+    )
+    assert a.to_doc() == b.to_doc() == partition_tree(_tree(), 3).to_doc()
+
+
+def test_partition_unpinned_without_tree():
+    """No tree (or shard_by: topology) = no queue pins; gangs spread via
+    the coordinator instead."""
+    plan = partition_tree(None, 3)
+    assert plan.cells == cell_names(3)
+    assert plan.queue_cell == {} and plan.cell_of_queue("anything") is None
+    assert plan.cell_of_queue("") is None
+
+
+def test_fleet_slices_tile_the_fleet_along_whole_domains():
+    """Every node lands in exactly one cell's slice, domains move whole,
+    and the domain assignment is pure (sorted round-robin)."""
+    from grove_tpu.sim.workloads import ZONE_KEY
+
+    _, nodes = _fleet(zones=3)
+    plan = with_fleet(partition_tree(_tree(), 2), nodes, ZONE_KEY)
+    slices = fleet_slices(plan, nodes, ZONE_KEY)
+    flat = [n.name for ns in slices.values() for n in ns]
+    assert sorted(flat) == sorted(n.name for n in nodes)
+    for cname, ns in slices.items():
+        for n in ns:
+            assert plan.domain_cell[n.labels[ZONE_KEY]] == cname
+    assert partition_domains(["z1", "z0", "z2"], plan.cells) == plan.domain_cell
+
+
+# ---- coordinator seam -------------------------------------------------------------
+
+
+def _gang(name, queue="", slo="", base=None):
+    from grove_tpu.api.podgang import PodGang
+
+    return PodGang(name=name, queue=queue, slo_class=slo, base_podgang_name=base)
+
+
+def test_cell_refuses_foreign_gang_outright():
+    """A gang pinned to another cell's subtree never enters a cell's own
+    serve() — cross-subtree traffic is the coordinator's, full stop."""
+    topo, nodes = _fleet(zones=1)
+    cell = Cell(
+        "cell-0",
+        nodes,
+        topo,
+        journal_path=os.path.join(tempfile.mkdtemp(), "cell-0"),
+        owned_queues=("batch",),
+    )
+    with pytest.raises(ValueError, match="coordinator"):
+        cell.serve([(0.0, _gang("g0", queue="teams/ml"))], {})
+
+
+def test_coordinator_routes_pinned_and_spreads_families_whole():
+    """Queue-pinned gangs go to the plan's cell; unpinned families spread
+    round-robin by first appearance; a scaled gang always follows its
+    base — families never split across cells."""
+    plan = partition_tree(_tree(), 2)
+    coord = CellCoordinator(plan, {})
+    t_cell = plan.queue_cell["teams"]
+    assert coord.route(_gang("a", queue="teams/ml/train")) == t_cell
+    assert coord.route(_gang("b", queue="batch")) == plan.queue_cell["batch"]
+    base_cell = coord.route(_gang("fam-0"))
+    assert coord.route(_gang("fam-1", base="fam-0")) == base_cell
+    assigned = coord.assign(
+        [(0.0, _gang("fam-2", base="fam-0")), (1.0, _gang("c", queue="batch"))]
+    )
+    assert any(g.name == "fam-2" for _, g in assigned[base_cell])
+    assert coord.stats.routed >= 2 and coord.stats.unpinned >= 1
+
+
+def test_cell_partition_fault_defers_cross_cell_touch():
+    """An injected cell.partition makes the target unreachable for that
+    evaluation — the touch is counted and deferred, never half-applied."""
+    inj = FaultInjector(
+        {"cell.partition": SiteSpec(kind="error", rate=1.0, count=1)}, seed=7
+    )
+    coord = CellCoordinator(partition_tree(None, 2), {}, faults=inj)
+    assert not coord.reachable("cell-1")
+    assert coord.reachable("cell-1")  # schedule exhausted: next pass lands
+    assert coord.stats.partition_deferred == 1
+
+
+def test_borrow_and_reclaim_route_through_coordinator():
+    """Borrowed capacity: the coordinator places a family on another cell
+    via admit_borrowed (registered for reclaim), and reclaim() releases it
+    on the host — capacity returns to the host's free pool."""
+    topo, nodes = _fleet(zones=2, racks=1, hosts=2)
+    from grove_tpu.sim.workloads import ZONE_KEY
+
+    plan = with_fleet(partition_tree(None, 2), nodes, ZONE_KEY)
+    slices = fleet_slices(plan, nodes, ZONE_KEY)
+    root = tempfile.mkdtemp()
+    cells = {
+        c: Cell(
+            c, slices[c], topo, journal_path=os.path.join(root, c), warm_path=_warm()
+        )
+        for c in plan.cells
+    }
+    for c in cells.values():
+        c.start()
+    coord = CellCoordinator(plan, cells)
+    arrivals, pods = _trace(duration_s=6.0, rate=0.8)
+    fam = [arrivals[0]]
+    bound = coord.borrow(fam, pods, home="cell-0")
+    if not bound:
+        pytest.skip("trace's first gang did not fit the tiny host slice")
+    host = next(h for g, (hm, h) in coord._borrowed.items())
+    assert host != "cell-0" and coord.stats.borrows == len(bound)
+    assert all(g in cells[host].bindings for g in bound)
+    released = coord.reclaim("cell-0", pods)
+    assert sorted(released) == sorted(bound)
+    assert not coord._borrowed and coord.stats.reclaims == len(released)
+    assert all(g not in cells[host].bindings for g in bound)
+    assert float(cells[host].snapshot.allocated.sum()) == pytest.approx(0.0)
+    for c in cells.values():
+        c.close()
+
+
+# ---- LeaseSet: independent per-cell renewal clocks --------------------------------
+
+
+def test_losing_one_cells_lease_never_releases_anothers():
+    """Fake clock: cell-a renews on time, cell-b oversleeps its renew
+    deadline. b stands down (its lease file releases); a's lease is
+    untouched and still held — the clocks are per-lease, not per-process."""
+    d = tempfile.mkdtemp()
+    ls = LeaseSet(d, lease_duration_seconds=10.0, renew_deadline_seconds=4.0)
+    assert ls.try_acquire("cell-a", now=0.0)
+    assert ls.try_acquire("cell-b", now=0.0)
+    assert ls.try_acquire("cell-a", now=3.0)  # a renews inside its deadline
+    # b next renews at t=9: 9 - 0 > 4 — overslept, stands down + releases.
+    assert not ls.try_acquire("cell-b", now=9.0)
+    held = ls.held()
+    assert held == {"cell-a": True, "cell-b": False}
+    assert os.path.exists(os.path.join(d, "cell-a.lease"))
+    assert not os.path.exists(os.path.join(d, "cell-b.lease"))
+    # a keeps renewing on its own clock, unaffected by b's stand-down.
+    assert ls.try_acquire("cell-a", now=6.0)
+    # b re-acquires cleanly afterwards (fresh clock).
+    assert ls.try_acquire("cell-b", now=9.5)
+
+
+def test_leaseset_rejects_path_escaping_names():
+    ls = LeaseSet(tempfile.mkdtemp())
+    for bad in ("", "../evil", "a/b", ".hidden"):
+        with pytest.raises(ValueError):
+            ls.lease(bad)
+
+
+# ---- recorder segment manifest ----------------------------------------------------
+
+
+def test_recorder_writes_segment_manifest_and_prunes_it():
+    """manifest.json tracks every live segment (ids, wave ranges, fleet
+    digests) and shrinks when rotation prunes old segments — tail replay
+    finds its resume point without scanning every file."""
+    from grove_tpu.trace.recorder import TraceRecorder, read_manifest
+
+    d = tempfile.mkdtemp()
+    rec = TraceRecorder(d, max_records_per_file=2, max_files=2)
+    rec.start()
+    for i in range(10):
+        rec.capture_action(float(i), "noop", f"obj-{i}")
+    rec.stop()
+    manifest = read_manifest(d)
+    assert manifest is not None
+    files = sorted(f for f in os.listdir(d) if f.startswith("segment-"))
+    assert [s["file"] for s in manifest["segments"]] == files
+    assert 0 < len(files) <= 2  # rotation pruned, manifest followed
+    from grove_tpu.trace.recorder import read_journal
+
+    assert sum(s["records"] for s in manifest["segments"]) == len(read_journal(d))
+    assert read_manifest(tempfile.mkdtemp()) is None
+
+
+def test_manifest_names_the_resume_point_for_wave_journals():
+    """A cell journal's manifest carries per-segment wave-id ranges and the
+    journal-wide lastWave — the resume point recover() reports."""
+    from grove_tpu.trace.recorder import read_manifest
+
+    topo, nodes = _fleet(zones=1, racks=1, hosts=2)
+    arrivals, pods = _trace(duration_s=6.0, rate=0.8)
+    jp = os.path.join(tempfile.mkdtemp(), "cell-0")
+    cell = Cell("cell-0", nodes, topo, journal_path=jp, warm_path=_warm())
+    cell.start()
+    cell.serve(arrivals, pods)
+    cell.close()
+    manifest = read_manifest(jp)
+    assert manifest is not None and manifest["waves"] > 0
+    last = None
+    for seg in manifest["segments"]:
+        if seg["waveRange"] is not None:
+            assert seg["waveRange"][0].startswith("c")
+            last = seg["waveRange"][1]
+    assert manifest["lastWave"] == last is not None
+
+
+# ---- the tentpole: 2-cell kill/resume via journal replay --------------------------
+
+
+def test_two_cell_kill_resume_recovers_from_journal_tail():
+    """Tier-1 smoke of the bench's kill/resume gate: an injected cell.crash
+    kills cell-0 between family chunks; recover() replays the journal tail
+    bitwise, rebuilds decided/bindings/allocated, and the resumed serve
+    re-offers the trace with zero lost and zero double-bound gangs and a
+    clean whole-trace oversubscription audit."""
+    from grove_tpu.trace.recorder import read_journal
+
+    topo, nodes = _fleet(zones=2, racks=1, hosts=2)
+    from grove_tpu.sim.workloads import ZONE_KEY
+
+    plan = with_fleet(partition_tree(None, 2), nodes, ZONE_KEY)
+    slices = fleet_slices(plan, nodes, ZONE_KEY)
+    arrivals, pods = _trace(duration_s=11.0, rate=1.2)
+    root = tempfile.mkdtemp()
+    inj = FaultInjector(
+        {"cell.crash": SiteSpec(kind="error", rate=1.0, count=1)}, seed=3
+    )
+    wp = _warm()
+    cells = {
+        c: Cell(
+            c,
+            slices[c],
+            topo,
+            journal_path=os.path.join(root, c),
+            faults=(inj if c == "cell-0" else None),
+            crash_check_every=4,
+            warm_path=wp,
+        )
+        for c in plan.cells
+    }
+    for c in cells.values():
+        c.start()
+    coord = CellCoordinator(plan, cells)
+    assigned = coord.assign(arrivals)
+    cells["cell-1"].serve(assigned["cell-1"], pods)
+    with pytest.raises(CellCrash):
+        cells["cell-0"].serve(assigned["cell-0"], pods)
+    assert not cells["cell-0"].alive and cells["cell-0"].stats.crashes == 1
+    pre_decided = set(cells["cell-0"].decided)
+    pre_bound = dict(cells["cell-0"].bindings)
+    assert pre_decided  # the crash left journaled waves behind it
+
+    replacement, report = recover(
+        "cell-0",
+        slices["cell-0"],
+        topo,
+        journal_path=os.path.join(root, "cell-0"),
+        crash_check_every=4,
+        warm_path=wp,
+    )
+    assert report.verified and report.divergences == 0
+    assert report.waves_replayed > 0
+    assert replacement.decided == pre_decided
+    assert set(replacement.bindings) == set(pre_bound)
+    replacement.start()
+    resumed = replacement.serve(assigned["cell-0"], pods)
+    replacement.close()
+    cells["cell-1"].close()
+    # Zero double-bound: nothing the first life decided re-admits.
+    assert not set(resumed) & set(pre_bound)
+    # Zero lost: every offered gang carries a verdict across the two lives.
+    assert {g.name for _, g in assigned["cell-0"]} <= replacement.decided
+    # Whole-journal oversubscription audit (both lives, one journal).
+    audit = audit_journal(read_journal(os.path.join(root, "cell-0")))
+    assert audit["oversubscribed"] == 0 and audit["nodeTicks"] > 0
+    # The allocated state a fresh recovery rebuilds matches what the two
+    # lives committed in memory (bindings -> request vectors).
+    check, _ = recover(
+        "cell-0",
+        slices["cell-0"],
+        topo,
+        journal_path=os.path.join(root, "cell-0"),
+        verify=False,
+    )
+    np.testing.assert_allclose(
+        check.snapshot.allocated, replacement.snapshot.allocated, rtol=1e-5
+    )
+
+
+# ---- config wiring ----------------------------------------------------------------
+
+
+def test_cells_config_parses_and_validates():
+    from grove_tpu.runtime.config import parse_operator_config
+
+    cfg, errors = parse_operator_config(
+        {
+            "cells": {
+                "enabled": True,
+                "count": 4,
+                "shardBy": "topology",
+                "topologyLevel": "zone",
+                "journalRoot": "/tmp/x/cells",
+                "leaseDir": "/tmp/x/leases",
+                "leaseDurationSeconds": 20.0,
+                "renewDeadlineSeconds": 8.0,
+                "crashCheckEvery": 32,
+            }
+        }
+    )
+    assert not errors
+    assert cfg.cells.count == 4 and cfg.cells.shard_by == "topology"
+    _, errs = parse_operator_config(
+        {
+            "cells": {
+                "enabled": True,
+                "count": 0,
+                "shardBy": "nope",
+                "renewDeadlineSeconds": 99.0,
+            }
+        }
+    )
+    assert any("cells.count" in e for e in errs)
+    assert any("cells.shardBy" in e for e in errs)
+    assert any("cells.renewDeadlineSeconds" in e for e in errs)
+
+
+def test_manager_surfaces_cells_on_statusz_and_metrics():
+    """cells.enabled boots the partition plan + per-cell leases; /statusz
+    "cells" and the grove_cell_* gauges expose them; stop releases all."""
+    from grove_tpu.runtime.config import parse_operator_config
+    from grove_tpu.runtime.manager import Manager
+
+    cfg, errors = parse_operator_config(
+        {
+            "servers": {"healthPort": -1, "metricsPort": -1, "webhookPort": -1},
+            "cells": {
+                "enabled": True,
+                "count": 2,
+                "journalRoot": tempfile.mkdtemp(),
+                "leaseDir": tempfile.mkdtemp(),
+            },
+            "scheduling": {
+                "queues": {
+                    "teams": {"resources": {"google.com/tpu": {"quota": 64}}},
+                    "batch": {"resources": {"google.com/tpu": {"quota": 64}}},
+                }
+            },
+        }
+    )
+    assert not errors
+    m = Manager(cfg)
+    m.start()
+    try:
+        doc = m.statusz()["cells"]
+        assert doc["enabled"] and doc["count"] == 2
+        assert doc["plan"]["rootCell"] == {"batch": "cell-0", "teams": "cell-1"}
+        assert all(c["leaseHeld"] for c in doc["cells"].values())
+        assert m.metrics.gauge("grove_cell_count").value() == 2.0
+        assert (
+            m.metrics.gauge("grove_cell_lease_held").value(cell="cell-0") == 1.0
+        )
+    finally:
+        m.stop()
+    assert not os.listdir(cfg.cells.lease_dir)  # release_all at stop
