@@ -1367,7 +1367,8 @@ def run_cells_bench() -> dict:
       - zero lost gangs: every offered gang carries a journaled verdict
         across the two lives;
       - zero double-bound gangs: the resumed run re-admits nothing the
-        first life decided (the journal IS the dedup source);
+        first life bound (the journal IS the dedup source — rebuilt
+        `bindings` gate re-admission, `cell.reclaim` records mirrored);
       - zero oversubscribed node-ticks across the whole journal
         (cells.audit_journal checks every (wave, node) tick against the
         recorded fleet capacity);

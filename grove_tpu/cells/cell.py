@@ -15,8 +15,13 @@ state and bindings purely from its journal tail — verified by replaying it
 bitwise first — then warm-starts (persistent XLA cache + shape history make
 the warm path cheap; the replay itself re-populates the executable cache).
 Gangs whose waves never reached the journal are simply NOT in the rebuilt
-`decided` set; the coordinator re-offers them, so a crash loses nothing and
-double-binds nothing (`decided` gates re-admission).
+state; the coordinator re-offers them, so a crash loses nothing and
+double-binds nothing — `bindings` (admitted gangs holding capacity) gates
+re-admission, while `decided` (every journaled verdict) is the zero-lost
+ledger. Journaled `cell.reclaim` actions are mirrored during the rebuild,
+so a gang released before the crash stays released, and a journal whose
+oldest segments were rotation-pruned recovers flagged `truncated` (never
+`verified`) because the pruned admissions are unrecoverable.
 
 The `cell.crash` fault site fires BETWEEN engine runs (the engine itself is
 reused unchanged — its own sites keep covering the in-wave failure modes):
@@ -50,11 +55,15 @@ _EPOCH_RE = re.compile(r"^c(\d+)-")
 class CellCrash(RuntimeError):
     """The cell died mid-stream (injected via the `cell.crash` site). The
     instance is unusable; recover() builds its replacement from the
-    journal."""
+    journal. `partial` carries the bindings the interrupted call committed
+    (journaled) BEFORE the crash — a caller that was admitting a family
+    must treat those gangs as landed on this cell (they rebind on
+    recovery), never re-admit them elsewhere."""
 
-    def __init__(self, cell: str):
+    def __init__(self, cell: str, partial: dict | None = None):
         super().__init__(f"cell {cell} crashed mid-stream")
         self.cell = cell
+        self.partial: dict[str, dict[str, str]] = dict(partial or {})
 
 
 class _CellRecorder(TraceRecorder):
@@ -117,10 +126,16 @@ class RecoveryReport:
     waves_replayed: int = 0
     divergences: int = 0
     gangs_rebound: int = 0  # admitted gangs whose bindings were rebuilt
-    gangs_decided: int = 0  # gangs with ANY journaled verdict (gate set)
+    gangs_reclaimed: int = 0  # cell.reclaim records mirrored (releases)
+    gangs_decided: int = 0  # gangs with ANY journaled verdict (zero-lost)
     resume_point: str | None = None  # manifest lastWave (None: no manifest)
     manifest_segments: int = 0
-    verified: bool = False  # replay ran and diverged nowhere
+    # Rotation pruning dropped the journal's oldest waves: the rebuilt
+    # allocated/bindings state is missing their admissions, so recovery is
+    # NOT sound — verified stays False even when the surviving tail
+    # replays bitwise.
+    truncated: bool = False
+    verified: bool = False  # replay diverged nowhere AND the tail is complete
 
     def to_doc(self) -> dict:
         return {
@@ -128,9 +143,11 @@ class RecoveryReport:
             "wavesReplayed": self.waves_replayed,
             "divergences": self.divergences,
             "gangsRebound": self.gangs_rebound,
+            "gangsReclaimed": self.gangs_reclaimed,
             "gangsDecided": self.gangs_decided,
             "resumePoint": self.resume_point,
             "manifestSegments": self.manifest_segments,
+            "truncated": self.truncated,
             "verified": self.verified,
         }
 
@@ -180,8 +197,12 @@ class Cell:
             max_records_per_file=max_records_per_file,
             max_files=max_files,
         )
+        # bindings = admitted gangs still holding capacity — the re-admit
+        # gate (zero double-bound); decided = every journaled verdict,
+        # admitted or rejected — the zero-lost ledger. Rejected gangs are
+        # in decided but not bindings, so they stay re-offerable.
         self.bindings: dict[str, dict[str, str]] = {}
-        self.decided: set[str] = set()  # journaled verdicts — re-admit gate
+        self.decided: set[str] = set()
         self.stats = CellStats()
         self.alive = False
 
@@ -238,10 +259,14 @@ class Cell:
     def admit_borrowed(self, arrivals: list, pods_by_name: dict) -> dict:
         """Coordinator-only entry: admit gangs pinned elsewhere onto this
         cell's spare capacity (borrowed across the subtree seam). Same
-        engine, same journal; only the ownership gate is waived."""
+        engine, same journal; only the ownership gate is waived. The
+        borrowed_in count updates even when the call dies in a CellCrash —
+        the chunks committed before the crash DID land here."""
         before = self.stats.admitted
-        out = self._stream(arrivals, pods_by_name)
-        self.stats.borrowed_in += self.stats.admitted - before
+        try:
+            out = self._stream(arrivals, pods_by_name)
+        finally:
+            self.stats.borrowed_in += self.stats.admitted - before
         return out
 
     def _stream(self, arrivals: list, pods_by_name: dict) -> dict:
@@ -249,9 +274,11 @@ class Cell:
             raise CellCrash(self.name)
         inj = self.faults if self.faults is not None else faults_mod.active()
         fresh = [
-            (t, g) for t, g in arrivals if g.name not in self.decided
-        ]  # decided gangs (journaled verdicts) never re-admit: the
-        # zero-double-bound gate is enforced at the cell boundary
+            (t, g) for t, g in arrivals if g.name not in self.bindings
+        ]  # BOUND gangs (admitted, capacity held) never re-admit — the
+        # zero-double-bound gate is enforced at the cell boundary. Gangs
+        # merely REJECTED stay re-offerable: once capacity frees (release,
+        # reclaim) a later offer re-solves them instead of no-opping.
         new_bindings: dict[str, dict[str, str]] = {}
         for i, chunk in enumerate(
             _family_chunks(fresh, self.crash_check_every)
@@ -263,7 +290,10 @@ class Cell:
                     inj.maybe_raise("cell.crash", cell=self.name)
                 except faults_mod.InjectedFault as e:
                     self.crash()
-                    raise CellCrash(self.name) from e
+                    # new_bindings = the chunks this call committed (and
+                    # journaled) before dying: the caller must count them
+                    # as landed here, they rebind on recovery.
+                    raise CellCrash(self.name, partial=new_bindings) from e
             self.recorder.epoch += 1
             bindings, stats = drain_stream(
                 [(t, g) for t, g in chunk],
@@ -288,7 +318,8 @@ class Cell:
     def _commit(self, bindings, chunk, pods_by_name, stats) -> None:
         """Fold one engine run into the cell state: allocated rows advance
         by the bound pods' requests (the next run's snapshot carries them),
-        verdicts latch into `decided`."""
+        every verdict latches into the `decided` ledger, admissions into
+        the `bindings` gate."""
         for gang, per in bindings.items():
             self.bindings[gang] = dict(per)
             for pod_name, node_name in per.items():
@@ -313,7 +344,11 @@ class Cell:
     def release_gang(self, gang: str, pods_by_name: dict) -> bool:
         """Cross-cell reclaim: give a borrowed gang's capacity back (the
         coordinator calls this on the HOST cell). Journaled as an action
-        record so the trace shows the reclaim beside the admissions."""
+        record so recovery (and the trace) sees the reclaim beside the
+        admissions — recover() mirrors these records, or a released gang
+        would resurrect with its capacity. The verdict stays in `decided`
+        (it WAS decided here); only the `bindings` gate opens, so the gang
+        may legitimately re-admit later."""
         per = self.bindings.pop(gang, None)
         if per is None:
             return False
@@ -324,7 +359,6 @@ class Cell:
                 pods_by_name[pod_name], self.snapshot.resource_names
             )
             np.maximum(row, 0.0, out=row)
-        self.decided.discard(gang)
         self.stats.released += 1
         self.recorder.capture_action(
             time.time(), "cell.reclaim", gang, cell=self.name
@@ -338,9 +372,7 @@ class Cell:
             "nodes": len(self.nodes),
             "queues": sorted(self.owned_queues),
             "journal": self.journal_path,
-            "leaseHeld": (
-                None if self.lease is None else self.lease._last_renew is not None
-            ),
+            "leaseHeld": (None if self.lease is None else self.lease.held()),
             "epoch": self.recorder.epoch,
             **self.stats.to_doc(),
         }
@@ -414,15 +446,25 @@ def recover(
        warm path must reproduce its recorded plan exactly; replaying also
        re-populates the executable cache, so verification IS the warm
        start.
-    3. Allocated/free state and bindings rebuild from the recorded plans +
-       the pods' journaled encode closures; every journaled verdict lands
-       in `decided`, so re-offered traffic can neither double-bind a
-       recovered gang nor lose an undecided one (it simply re-admits).
+    3. Allocated/free state and bindings rebuild by walking the records in
+       commit order: wave records add admitted gangs' bindings + capacity,
+       `cell.reclaim` action records (journaled by release_gang) undo them
+       — skipping those would resurrect a released gang's binding and
+       capacity, and double-bind it if it re-admitted elsewhere after the
+       reclaim. Every journaled verdict lands in `decided` (the zero-lost
+       ledger); `bindings` gates re-admission, so re-offered traffic can
+       neither double-bind a recovered gang nor lose an undecided one.
+
+    A journal whose oldest segments were rotation-pruned away is flagged
+    `truncated` (and never `verified`): the pruned waves' admissions are
+    unrecoverable, so the rebuilt state under-counts allocation — the
+    caller must treat the recovery as best-effort, not sound.
 
     An empty journal (the cell died before its first segment) recovers to
     a fresh cell with an empty report — nothing was decided, everything
     re-offers.
     """
+    from grove_tpu.trace.recorder import journal_truncated
     from grove_tpu.trace.replay import replay_journal
 
     report = RecoveryReport(cell=name)
@@ -434,11 +476,12 @@ def recover(
         records = read_journal(journal_path)
     except FileNotFoundError:
         records = []
+    report.truncated = journal_truncated(journal_path)
     if verify and records:
         rep = replay_journal(records, warm_path=warm_path)
         report.waves_replayed = len(rep.waves)
         report.divergences = rep.divergence_count
-        report.verified = rep.divergence_count == 0
+        report.verified = rep.divergence_count == 0 and not report.truncated
     cell = Cell(
         name,
         nodes,
@@ -448,8 +491,22 @@ def recover(
         epoch=_next_epoch(records),
         **cell_kwargs,
     )
+    # Per-gang allocation contributions applied so far, so a later
+    # cell.reclaim record can subtract exactly what its wave added.
+    contrib: dict[str, list[tuple[int, np.ndarray]]] = {}
     for rec in records:
-        if rec.get("kind") != "wave":
+        kind = rec.get("kind")
+        if kind == "action" and rec.get("action") == "cell.reclaim":
+            gang = rec.get("object")
+            report.gangs_reclaimed += 1
+            if cell.bindings.pop(gang, None) is None:
+                continue  # admit wave pruned away; nothing was re-applied
+            for idx, vec in contrib.pop(gang, ()):
+                row = cell.snapshot.allocated[idx]
+                row -= vec
+                np.maximum(row, 0.0, out=row)
+            continue
+        if kind != "wave":
             continue
         pods_enc = rec.get("pods", {})
         for gang, ok in rec.get("ok", {}).items():
@@ -459,15 +516,16 @@ def recover(
             per = rec.get("plan", {}).get(gang, {})
             cell.bindings[gang] = dict(per)
             report.gangs_rebound += 1
+            rows = contrib[gang] = []
             for pod_name, node_name in per.items():
                 enc = pods_enc.get(pod_name)
                 if enc is None or node_name not in cell.snapshot.node_index_map:
                     continue
                 pod = serde.decode(enc)
                 idx = cell.snapshot.node_index(node_name)
-                cell.snapshot.allocated[idx] += pod_request_vector(
-                    pod, cell.snapshot.resource_names
-                )
+                vec = pod_request_vector(pod, cell.snapshot.resource_names)
+                cell.snapshot.allocated[idx] += vec
+                rows.append((idx, vec))
     report.gangs_decided = len(cell.decided)
     cell.stats.recoveries = 1
     return cell, report

@@ -172,8 +172,25 @@ class CellCoordinator:
                     continue
                 try:
                     got = self.cells[target].admit_borrowed(fam, pods_by_name)
-                except CellCrash:
-                    continue
+                except CellCrash as e:
+                    if not e.partial:
+                        continue  # nothing landed on the dead cell: the
+                        # next target is safe to try
+                    # The cell died BETWEEN family chunks: the chunks it
+                    # committed are journaled there and rebind on recovery,
+                    # so retrying the family elsewhere would double-admit
+                    # them. Register what landed (reclaim can undo it) and
+                    # stop; the unlanded remainder re-offers once the cell
+                    # recovers.
+                    for gang in e.partial:
+                        self._borrowed[gang] = (home, target)
+                    self.stats.borrows += len(e.partial)
+                    self.stats.borrow_denied += sum(
+                        1 for _, g in fam if g.name not in e.partial
+                    )
+                    bound.update(e.partial)
+                    landed = True
+                    break
                 if got:
                     for gang in got:
                         self._borrowed[gang] = (home, target)

@@ -640,6 +640,8 @@ def _trace_cmd(args) -> int:
                 ["manifest.segments", len(manifest.get("segments", []))],
                 ["manifest.waves", manifest.get("waves", 0)],
                 ["manifest.lastWave", manifest.get("lastWave") or "-"],
+                ["manifest.prunedSegments", manifest.get("prunedSegments", 0)],
+                ["manifest.prunedWaves", manifest.get("prunedWaves", 0)],
             ]
             for seg in manifest.get("segments", []):
                 wr = seg.get("waveRange")
@@ -663,6 +665,13 @@ def _trace_cmd(args) -> int:
             print(
                 f"warning: recorder degraded — {jstats['writeErrors']} "
                 "segment write(s) failed (ENOSPC/IO); the journal has holes",
+                file=sys.stderr,
+            )
+        if manifest is not None and manifest.get("prunedSegments"):
+            print(
+                f"warning: rotation pruned {manifest['prunedSegments']} "
+                f"segment(s) ({manifest.get('prunedWaves', 0)} wave(s)) — "
+                "state rebuilt from this journal is incomplete",
                 file=sys.stderr,
             )
         return 0

@@ -76,6 +76,16 @@ class FileLease:
         self._last_renew = now if won else None
         return won
 
+    def held(self, now: float | None = None) -> bool:
+        """True while this process holds the lease: the last try_acquire
+        won, no stand-down happened since, and leaseDurationSeconds has not
+        elapsed without renewal — an expired lease is stealable by anyone,
+        so it no longer counts as held even if nobody has stolen it yet."""
+        if self._last_renew is None:
+            return False
+        now = time.time() if now is None else now
+        return now - self._last_renew < self.lease_duration_seconds
+
     def release(self) -> None:
         doc = self._read()
         if doc and doc.get("holder") == self.identity:
@@ -143,11 +153,12 @@ class LeaseSet:
         untouched (independent renewal — the whole point of the set)."""
         return self.lease(name).try_acquire(now)
 
-    def held(self) -> dict[str, bool]:
-        """Last-known holdership per name (True = the most recent
-        try_acquire succeeded and no stand-down happened since)."""
+    def held(self, now: float | None = None) -> dict[str, bool]:
+        """Holdership per name (True = the most recent try_acquire won, no
+        stand-down since, and the lease has not expired unrenewed). Pass
+        `now` when driving the leases on a fake clock."""
         return {
-            name: lease._last_renew is not None
+            name: lease.held(now)
             for name, lease in sorted(self._leases.items())
         }
 

@@ -155,6 +155,13 @@ class TraceRecorder:
         # failed manifest write is counted but never degrades the journal.
         self.manifest_writes = 0
         self.manifest_write_errors = 0
+        # Rotation-pruning ledger, cumulative across writer lives (seeded
+        # back from the manifest on restart): once > 0 the journal's oldest
+        # waves are GONE, so a reader rebuilding state from it (cell
+        # recovery) is working from an incomplete tail and must say so
+        # (`journal_truncated`, RecoveryReport.truncated).
+        self.pruned_segments = 0
+        self.pruned_waves = 0
         # fleet digests already enqueued this process (the writer re-emits
         # per segment from its own payload cache).
         self._announced: set[str] = set()
@@ -508,12 +515,15 @@ class TraceRecorder:
             except OSError:
                 continue  # pruning is best-effort; the journal stays readable
             removed = True
+            self.pruned_segments += 1
             if manifest is not None:
                 stem = os.path.basename(p)[len("segment-"):-len(".json")]
                 try:
-                    manifest.pop(int(stem), None)
+                    entry = manifest.pop(int(stem), None)
                 except ValueError:
-                    pass
+                    entry = None
+                if entry:
+                    self.pruned_waves += int(entry.get("waves", 0) or 0)
         return removed
 
     # ---- segment manifest (writer thread) ------------------------------------------
@@ -525,6 +535,14 @@ class TraceRecorder:
         prior = {}
         doc = read_manifest(self.path)
         if doc:
+            # Carry the pruning ledger across writer lives (max, not +=, so
+            # a same-instance restart cannot double-count its own entries).
+            self.pruned_segments = max(
+                self.pruned_segments, int(doc.get("prunedSegments", 0) or 0)
+            )
+            self.pruned_waves = max(
+                self.pruned_waves, int(doc.get("prunedWaves", 0) or 0)
+            )
             for e in doc.get("segments", []):
                 try:
                     prior[int(e["seq"])] = e
@@ -564,6 +582,11 @@ class TraceRecorder:
                     "segments": entries,
                     "lastWave": last_wave,
                     "waves": sum(int(e.get("waves", 0)) for e in entries),
+                    # Pruning ledger: > 0 means the journal's oldest waves
+                    # were rotated away — state rebuilt from this journal is
+                    # incomplete (journal_truncated / recovery flags it).
+                    "prunedSegments": self.pruned_segments,
+                    "prunedWaves": self.pruned_waves,
                 },
             )
             self.manifest_writes += 1
@@ -586,6 +609,8 @@ class TraceRecorder:
             "writeErrors": self.write_errors,
             "manifestWrites": self.manifest_writes,
             "manifestWriteErrors": self.manifest_write_errors,
+            "prunedSegments": self.pruned_segments,
+            "prunedWaves": self.pruned_waves,
         }
         if self._last_write_error:
             doc["lastWriteError"] = self._last_write_error
@@ -621,6 +646,30 @@ def read_manifest(path: str) -> dict | None:
     except (OSError, ValueError):
         return None
     return doc if isinstance(doc, dict) else None
+
+
+def journal_truncated(path: str) -> bool:
+    """True when the journal's oldest segments were rotation-pruned away —
+    state rebuilt from it (cell recovery) is missing the pruned waves'
+    admissions and therefore under-counts allocation. Primary signal is the
+    manifest's pruning ledger (`prunedSegments`); the fallback — for a
+    journal whose manifest is missing — is the surviving segment numbering
+    (the writer numbers from 0, so a lowest seq > 0 means the head is
+    gone)."""
+    doc = read_manifest(path)
+    if doc is not None and int(doc.get("prunedSegments", 0) or 0) > 0:
+        return True
+    files = [path] if os.path.isfile(path) else sorted(
+        glob.glob(os.path.join(path, _SEGMENT_GLOB))
+    )
+    seqs = []
+    for p in files:
+        stem = os.path.basename(p)[len("segment-"):-len(".json")]
+        try:
+            seqs.append(int(stem))
+        except ValueError:
+            continue
+    return bool(seqs) and min(seqs) > 0
 
 
 def journal_stats(path: str) -> dict:

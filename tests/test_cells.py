@@ -233,6 +233,142 @@ def test_borrow_and_reclaim_route_through_coordinator():
         c.close()
 
 
+def test_reclaim_then_crash_then_recover_keeps_gang_released():
+    """The journaled `cell.reclaim` action must survive the host cell's
+    crash: recover() mirrors it, so the released gang's binding and
+    capacity do NOT resurrect (a resurrected binding would leak capacity
+    and double-bind the gang if it re-admitted elsewhere post-reclaim)."""
+    topo, nodes = _fleet(zones=2, racks=1, hosts=2)
+    from grove_tpu.sim.workloads import ZONE_KEY
+
+    plan = with_fleet(partition_tree(None, 2), nodes, ZONE_KEY)
+    slices = fleet_slices(plan, nodes, ZONE_KEY)
+    root = tempfile.mkdtemp()
+    cells = {
+        c: Cell(
+            c, slices[c], topo, journal_path=os.path.join(root, c), warm_path=_warm()
+        )
+        for c in plan.cells
+    }
+    for c in cells.values():
+        c.start()
+    coord = CellCoordinator(plan, cells)
+    arrivals, pods = _trace(duration_s=6.0, rate=0.8)
+    bound = coord.borrow([arrivals[0]], pods, home="cell-0")
+    if not bound:
+        pytest.skip("trace's first gang did not fit the tiny host slice")
+    host = cells[next(h for _, h in coord._borrowed.values())]
+    released = coord.reclaim("cell-0", pods)
+    assert sorted(released) == sorted(bound)
+    live_alloc = host.snapshot.allocated.copy()
+    host.crash()
+    recovered, report = recover(
+        host.name,
+        slices[host.name],
+        topo,
+        journal_path=os.path.join(root, host.name),
+        verify=False,
+    )
+    assert report.gangs_reclaimed == len(released)
+    assert not set(released) & set(recovered.bindings)
+    # The rebuilt allocation matches the live post-reclaim state: the
+    # released capacity is genuinely free again after recovery.
+    np.testing.assert_allclose(
+        recovered.snapshot.allocated, live_alloc, rtol=1e-5, atol=1e-9
+    )
+    for c in cells.values():
+        if c.alive:
+            c.close()
+
+
+def test_borrow_crash_mid_family_registers_partial_and_stops():
+    """A host cell that crashes mid-family already journaled the chunks it
+    committed — they rebind on its recovery. The coordinator must register
+    that partial landing for reclaim and must NOT retry the family on
+    another cell (the retry would double-admit the landed gangs). A crash
+    with nothing landed stays retryable."""
+    from types import SimpleNamespace
+
+    class _Stub:
+        def __init__(self, name, partial=None):
+            self.name = name
+            self.alive = True
+            self.snapshot = SimpleNamespace(free=np.ones(4))
+            self.families_offered = []
+            self._partial = partial
+
+        def admit_borrowed(self, fam, pods):
+            self.families_offered.append([g.name for _, g in fam])
+            if self._partial is not None:
+                self.alive = False
+                raise CellCrash(self.name, partial=self._partial)
+            return {g.name: {} for _, g in fam}
+
+    fam = [
+        (0.0, _gang("famA-0")),
+        (0.0, _gang("famA-1", base="famA-0")),
+        (0.0, _gang("famA-2", base="famA-0")),
+    ]
+    plan = partition_tree(None, 3)
+    # Headroom tie-break is by name: cell-1 (the crasher) is tried first.
+    crasher = _Stub("cell-1", partial={"famA-0": {"p0": "n0"}})
+    healthy = _Stub("cell-2")
+    coord = CellCoordinator(
+        plan, {"cell-0": _Stub("cell-0"), "cell-1": crasher, "cell-2": healthy}
+    )
+    bound = coord.borrow(fam, {}, home="cell-0")
+    assert bound == {"famA-0": {"p0": "n0"}}
+    assert coord._borrowed == {"famA-0": ("cell-0", "cell-1")}
+    assert healthy.families_offered == []  # no retry after a partial landing
+    assert coord.stats.borrows == 1 and coord.stats.borrow_denied == 2
+    # Nothing landed (empty partial): the next target is safe to try.
+    coord2 = CellCoordinator(
+        plan,
+        {
+            "cell-0": _Stub("cell-0"),
+            "cell-1": _Stub("cell-1", partial={}),
+            "cell-2": (healthy2 := _Stub("cell-2")),
+        },
+    )
+    bound2 = coord2.borrow(fam, {}, home="cell-0")
+    assert set(bound2) == {"famA-0", "famA-1", "famA-2"}
+    assert healthy2.families_offered == [["famA-0", "famA-1", "famA-2"]]
+    assert all(h == "cell-2" for _, h in coord2._borrowed.values())
+
+
+def test_rejected_gangs_stay_reofferable():
+    """A gang the engine rejected for capacity must NOT be latched out of
+    future admission: the re-admit gate is `bindings` (admitted gangs
+    holding capacity), so re-offering the rejected families re-solves them
+    — previously the cell silently no-opped every retry forever — while
+    already-bound gangs still never double-bind."""
+    topo, nodes = _fleet(zones=1, racks=1, hosts=2)
+    arrivals, pods = _trace(duration_s=12.0, rate=1.5)
+    jp = os.path.join(tempfile.mkdtemp(), "cell-0")
+    cell = Cell("cell-0", nodes, topo, journal_path=jp, warm_path=_warm())
+    cell.start()
+    cell.serve(arrivals, pods)
+    rejected = cell.decided - set(cell.bindings)
+    if not rejected:
+        pytest.skip("trace fit the tiny slice whole — nothing was rejected")
+    fams = {
+        (g.base_podgang_name or g.name) for _, g in arrivals if g.name in rejected
+    }
+    redo = [
+        (t, g) for t, g in arrivals if (g.base_podgang_name or g.name) in fams
+    ]
+    expected = sum(1 for _, g in redo if g.name not in cell.bindings)
+    before_offered = cell.stats.offered
+    before_bound = set(cell.bindings)
+    again = cell.serve(redo, pods)
+    cell.close()
+    assert expected > 0
+    # Every non-bound member was re-OFFERED to the engine (not filtered)…
+    assert cell.stats.offered == before_offered + expected
+    # …and nothing already bound was re-admitted.
+    assert not set(again) & before_bound
+
+
 # ---- LeaseSet: independent per-cell renewal clocks --------------------------------
 
 
@@ -247,8 +383,10 @@ def test_losing_one_cells_lease_never_releases_anothers():
     assert ls.try_acquire("cell-a", now=3.0)  # a renews inside its deadline
     # b next renews at t=9: 9 - 0 > 4 — overslept, stands down + releases.
     assert not ls.try_acquire("cell-b", now=9.0)
-    held = ls.held()
-    assert held == {"cell-a": True, "cell-b": False}
+    assert ls.held(now=9.0) == {"cell-a": True, "cell-b": False}
+    # Holdership expires with the lease: past leaseDuration without a
+    # renewal held() flips False even though nobody stole the lease yet.
+    assert ls.held(now=13.5) == {"cell-a": False, "cell-b": False}
     assert os.path.exists(os.path.join(d, "cell-a.lease"))
     assert not os.path.exists(os.path.join(d, "cell-b.lease"))
     # a keeps renewing on its own clock, unaffected by b's stand-down.
@@ -262,6 +400,25 @@ def test_leaseset_rejects_path_escaping_names():
     for bad in ("", "../evil", "a/b", ".hidden"):
         with pytest.raises(ValueError):
             ls.lease(bad)
+
+
+def test_filelease_held_is_public_and_expiry_aware():
+    """held() is the public holdership accessor (no `_last_renew` poking):
+    False before acquisition, True while the lease duration runs, False
+    once it elapses without renewal — an expired lease is stealable, so it
+    is no longer 'held' regardless of who renewed last."""
+    from grove_tpu.runtime.lease import FileLease
+
+    lease = FileLease(
+        path=os.path.join(tempfile.mkdtemp(), "x.lease"),
+        lease_duration_seconds=10.0,
+    )
+    assert not lease.held(now=0.0)
+    assert lease.try_acquire(now=0.0)
+    assert lease.held(now=5.0)
+    assert not lease.held(now=10.0)
+    assert lease.try_acquire(now=11.0)  # stale own lease: re-acquired
+    assert lease.held(now=12.0)
 
 
 # ---- recorder segment manifest ----------------------------------------------------
@@ -393,6 +550,50 @@ def test_two_cell_kill_resume_recovers_from_journal_tail():
     np.testing.assert_allclose(
         check.snapshot.allocated, replacement.snapshot.allocated, rtol=1e-5
     )
+
+
+def test_recover_flags_rotation_truncated_journal():
+    """Rotation pruning drops the journal's oldest waves, so a recovery
+    from it under-counts allocation. recover() must say so: `truncated`
+    flips and `verified` stays False even when the surviving tail replays
+    bitwise — and `journal_truncated` detects it standalone (manifest
+    pruning ledger, or surviving-seq fallback)."""
+    from grove_tpu.trace.recorder import journal_truncated, read_manifest
+
+    topo, nodes = _fleet(zones=1, racks=1, hosts=2)
+    arrivals, pods = _trace(duration_s=8.0, rate=1.0)
+    jp = os.path.join(tempfile.mkdtemp(), "cell-0")
+    cell = Cell(
+        "cell-0",
+        nodes,
+        topo,
+        journal_path=jp,
+        warm_path=_warm(),
+        crash_check_every=2,
+        max_records_per_file=1,
+        max_files=2,
+    )
+    cell.start()
+    cell.serve(arrivals, pods)
+    cell.close()
+    manifest = read_manifest(jp)
+    assert manifest is not None and manifest["prunedSegments"] > 0
+    assert journal_truncated(jp)
+    recovered, report = recover(
+        "cell-0", nodes, topo, journal_path=jp, warm_path=_warm()
+    )
+    assert report.truncated
+    assert report.divergences == 0  # the surviving tail itself is clean…
+    assert not report.verified  # …but a pruned journal is never 'verified'
+    # An unpruned journal stays clean end to end.
+    jp2 = os.path.join(tempfile.mkdtemp(), "cell-1")
+    cell2 = Cell("cell-1", nodes, topo, journal_path=jp2, warm_path=_warm())
+    cell2.start()
+    cell2.serve(arrivals[:2], pods)
+    cell2.close()
+    assert not journal_truncated(jp2)
+    _, rep2 = recover("cell-1", nodes, topo, journal_path=jp2, verify=False)
+    assert not rep2.truncated
 
 
 # ---- config wiring ----------------------------------------------------------------
